@@ -1,0 +1,76 @@
+"""mxnet_tpu — a TPU-native framework with the capabilities of Apache MXNet.
+
+Brand-new design for JAX/XLA/Pallas/pjit (see SURVEY.md in the repo root):
+  * ``mx.nd``       eager NDArray ops (XLA async dispatch = the engine)
+  * ``mx.autograd`` imperative tape over jax.vjp
+  * ``mx.gluon``    Block/HybridBlock (hybridize() -> jax.jit), Trainer
+  * ``mx.sym``/``mx.mod``  symbolic front-end + Module shim over jit
+  * ``mx.kvstore``  data-parallel comms over XLA collectives
+  * ``mx.parallel`` TPU-first parallelism (mesh/dp/tp/sp utilities)
+
+Typical use matches the reference:
+    import mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.gpu(0))   # gpu() == TPU chip
+"""
+__version__ = "0.1.0"
+
+from . import base  # noqa: F401
+from .base import MXNetError  # noqa: F401
+from .context import (  # noqa: F401
+    Context,
+    cpu,
+    cpu_pinned,
+    current_context,
+    gpu,
+    num_gpus,
+    num_tpus,
+    tpu,
+)
+from . import ndarray  # noqa: F401
+from . import ndarray as nd  # noqa: F401
+from . import autograd  # noqa: F401
+from . import random  # noqa: F401
+from .util import is_np_array, set_np, use_np  # noqa: F401
+
+# Subpackages added as milestones land (gluon, symbol, module, kvstore,
+# optimizer, metric, io, parallel) are imported lazily below to keep import
+# errors local while the framework is being built out.
+import importlib as _importlib
+
+_LAZY = {
+    "gluon": ".gluon",
+    "sym": ".symbol",
+    "symbol": ".symbol",
+    "mod": ".module",
+    "module": ".module",
+    "kvstore": ".kvstore",
+    "kv": ".kvstore",
+    "optimizer": ".optimizer",
+    "metric": ".metric",
+    "io": ".io",
+    "image": ".image",
+    "init": ".initializer",
+    "initializer": ".initializer",
+    "lr_scheduler": ".lr_scheduler",
+    "callback": ".callback",
+    "parallel": ".parallel",
+    "profiler": ".profiler",
+    "runtime": ".runtime",
+    "test_utils": ".test_utils",
+    "recordio": ".recordio",
+    "model": ".model",
+    "monitor": ".monitor",
+    "visualization": ".visualization",
+    "viz": ".visualization",
+    "np": ".numpy",
+    "npx": ".numpy_extension",
+    "engine": ".engine",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        mod = _importlib.import_module(_LAZY[name], __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu' has no attribute {name!r}")

@@ -1,0 +1,51 @@
+"""Global PRNG key plumbing.
+
+Reference parity: per-device seedable generators
+(include/mxnet/random_generator.h, ResourceRequest::kRandom resource.h:42).
+TPU-native redesign: JAX threaded PRNG keys.  Eager ops split a global key;
+under jit tracing (CachedOp / executor) a *traced* key is installed in a
+scope and sub-keys are derived with fold_in so the compiled program stays
+pure and reproducible.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+
+class _RngState(threading.local):
+    def __init__(self):
+        self.key = jax.random.key(0)
+        self.trace_key = None
+        self.trace_counter = 0
+
+
+_S = _RngState()
+
+
+def seed(seed_state: int, ctx="all"):
+    """mx.random.seed equivalent (python/mxnet/random.py)."""
+    _S.key = jax.random.key(int(seed_state))
+
+
+def take_key():
+    """A fresh PRNG key for one random op invocation."""
+    if _S.trace_key is not None:
+        k = jax.random.fold_in(_S.trace_key, _S.trace_counter)
+        _S.trace_counter += 1
+        return k
+    _S.key, sub = jax.random.split(_S.key)
+    return sub
+
+
+@contextlib.contextmanager
+def trace_key_scope(key):
+    """Install a traced key while tracing a jitted program."""
+    prev_k, prev_c = _S.trace_key, _S.trace_counter
+    _S.trace_key, _S.trace_counter = key, 0
+    try:
+        yield
+    finally:
+        _S.trace_key, _S.trace_counter = prev_k, prev_c

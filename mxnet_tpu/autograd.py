@@ -1,0 +1,284 @@
+"""Imperative autograd: record()/pause()/backward() over a VJP tape.
+
+Reference parity: python/mxnet/autograd.py (record/pause scopes :93-146,
+mark_variables :197, backward :246, grad) and the C++ tape in
+src/imperative/imperative.cc (RecordOp :193 attaches AGInfo to nnvm nodes,
+Backward :280 builds the gradient graph with the nnvm Gradient pass).
+
+TPU-native redesign: there is no nnvm graph.  While recording, every op
+dispatch runs through ``jax.vjp`` and the returned pull-back closure *is*
+the tape node — residuals live in device buffers managed by JAX, and
+``backward`` simply walks the tape in reverse topological order calling the
+stored pull-backs.  Gradient *computation* therefore runs as compiled XLA
+programs (each vjp is jit-compiled at the op/cached-op granularity), and
+the Python walk only sequences them — the analog of the reference pushing
+backward ops onto its dependency engine.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .base import MXNetError
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "set_recording",
+    "set_training",
+    "mark_variables",
+    "backward",
+    "grad",
+    "get_symbol",
+]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_STATE = _State()
+
+
+def is_recording():
+    return _STATE.recording
+
+
+def is_training():
+    return _STATE.training
+
+
+def set_recording(is_record):
+    prev = _STATE.recording
+    _STATE.recording = bool(is_record)
+    return prev
+
+
+def set_training(train_mode):
+    prev = _STATE.training
+    _STATE.training = bool(train_mode)
+    return prev
+
+
+class _Scope:
+    def __init__(self, recording, training):
+        self._recording = recording
+        self._training = training
+
+    def __enter__(self):
+        self._prev_r = (
+            set_recording(self._recording)
+            if self._recording is not None
+            else None
+        )
+        self._prev_t = (
+            set_training(self._training) if self._training is not None else None
+        )
+        return self
+
+    def __exit__(self, *exc):
+        if self._recording is not None:
+            _STATE.recording = self._prev_r
+        if self._training is not None:
+            _STATE.training = self._prev_t
+
+    # allow use as decorator, like the reference's _RecordingStateScope
+    def __call__(self, fn):
+        def wrapped(*a, **k):
+            with _Scope(self._recording, self._training):
+                return fn(*a, **k)
+
+        return wrapped
+
+
+def record(train_mode=True):
+    """Scope in which op invocations are taped (reference autograd.py:122)."""
+    return _Scope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _Scope(False, train_mode)
+
+
+def train_mode():
+    return _Scope(None, True)
+
+
+def predict_mode():
+    return _Scope(None, False)
+
+
+class TapeNode:
+    """One recorded op application: holds the vjp pull-back and the input
+    NDArrays (the reference's AGInfo, imperative.h:53-90)."""
+
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "op_name")
+
+    def __init__(self, vjp_fn, inputs, out_avals, op_name=""):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # list of NDArray (or None for non-diff inputs)
+        self.out_avals = out_avals  # [(shape, dtype)] per output
+        self.op_name = op_name
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to arrays (reference autograd.py:197)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        var._grad = g if req != "null" else None
+        var._grad_req = req
+        var._is_var = True
+
+
+def _zeros(aval):
+    shape, dtype = aval
+    return jnp.zeros(shape, dtype)
+
+
+def _toposort(heads):
+    """Reverse-topological order of tape nodes reachable from heads."""
+    order, seen = [], set()
+    stack = []
+    for h in heads:
+        if h._node is not None and id(h._node) not in seen:
+            stack.append((h._node, False))
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for inp in node.inputs:
+            if inp is not None and inp._node is not None and id(inp._node) not in seen:
+                stack.append((inp._node, False))
+    return order  # already reverse-topological w.r.t. dependency (children first)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Run reverse-mode through the tape starting at `heads`.
+
+    Matches mxnet.autograd.backward semantics: accumulates into the .grad
+    buffers attached by attach_grad/mark_variables, honoring grad_req.
+    """
+    from .ndarray import NDArray  # cycle: autograd <-> ndarray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    if len(head_grads) != len(heads):
+        raise MXNetError("heads and head_grads length mismatch")
+
+    # cotangent accumulator keyed by (tape node id, output index)
+    cot: dict[tuple[int, int], object] = {}
+    written: set[int] = set()  # vars whose .grad was written this pass
+    nodes_by_id = {}
+    for h, hg in zip(heads, head_grads):
+        if h._node is None:
+            if getattr(h, "_is_var", False) and h._grad is not None:
+                g = hg._data if hg is not None else jnp.ones(h.shape, h.dtype)
+                _accum_var_grad(h, g, written)
+                continue
+            raise MXNetError(
+                "cannot differentiate a head that was not computed under "
+                "autograd.record()"
+            )
+        key = (id(h._node), h._oidx)
+        g = hg._data if hg is not None else jnp.ones(h.shape, h.dtype)
+        cot[key] = cot[key] + g if key in cot else g
+        nodes_by_id[id(h._node)] = h._node
+
+    order = _toposort(heads)
+    # order is child-first; we need heads-first (reverse topological):
+    for node in reversed(order):
+        nid = id(node)
+        outs = tuple(
+            cot.get((nid, i), None) for i in range(len(node.out_avals))
+        )
+        if all(o is None for o in outs):
+            continue
+        outs = tuple(
+            o if o is not None else _zeros(av)
+            for o, av in zip(outs, node.out_avals)
+        )
+        if len(node.out_avals) == 1:
+            in_grads = node.vjp_fn(outs[0])
+        else:
+            in_grads = node.vjp_fn(outs)
+        for inp, g in zip(node.inputs, in_grads):
+            if inp is None or g is None:
+                continue
+            if getattr(g, "dtype", None) == jax.dtypes.float0:
+                continue
+            if inp._node is not None:
+                k = (id(inp._node), inp._oidx)
+                cot[k] = cot[k] + g if k in cot else g
+            if getattr(inp, "_is_var", False) and inp._grad is not None:
+                _accum_var_grad(inp, g, written)
+        if not retain_graph:
+            node.vjp_fn = None  # free residuals
+
+    if not retain_graph:
+        for h in heads:
+            h._node = None
+
+
+def _accum_var_grad(var, g, written):
+    """grad_req='write': overwrite on first contribution of this backward
+    pass, accumulate within the pass; 'add': always accumulate (reference
+    semantics, include/mxnet/op_attr_types.h OpReqType)."""
+    g = g.astype(var._grad.dtype)
+    if getattr(var, "_grad_req", "write") == "add" or id(var) in written:
+        var._grad._data = var._grad._data + g
+    else:
+        var._grad._data = g
+        written.add(id(var))
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Functional gradient API (reference autograd.py grad())."""
+    from .ndarray import NDArray
+
+    if create_graph:
+        raise MXNetError("create_graph=True (higher order) not yet supported")
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    saved = [
+        (v._grad, getattr(v, "_grad_req", "write"), getattr(v, "_is_var", False))
+        for v in variables
+    ]
+    from .ndarray import zeros
+
+    for v in variables:
+        v._grad = zeros(v.shape, dtype=v.dtype, ctx=v.context)
+        v._grad_req = "write"
+        v._is_var = True
+    backward(heads, head_grads, retain_graph=bool(retain_graph), train_mode=train_mode)
+    grads = [v._grad for v in variables]
+    for v, (g, req, isv) in zip(variables, saved):
+        v._grad, v._grad_req, v._is_var = g, req, isv
+    return grads[0] if single else grads
+
+
+def get_symbol(x):
+    raise MXNetError(
+        "autograd.get_symbol is not supported: the TPU build has no nnvm "
+        "graph; use gluon HybridBlock.export or mx.sym instead"
+    )

@@ -1,0 +1,102 @@
+"""Shared foundations: errors, registries, type helpers.
+
+TPU-native re-design of the roles played in the reference by dmlc-core
+(``dmlc::Registry``, ``dmlc::Parameter``, logging/CHECK macros — see
+reference ``3rdparty/dmlc-core`` and SURVEY.md §2.2) and by
+``python/mxnet/base.py`` (error type, registry plumbing).  There is no C ABI
+boundary here: the frontend talks straight to the JAX runtime, so the
+242-entry ``c_api.h`` surface collapses into ordinary Python calls.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as onp
+
+__all__ = [
+    "MXNetError",
+    "Registry",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+    "classproperty",
+]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (parity with mxnet.base.MXNetError)."""
+
+
+string_types = (str,)
+integer_types = (int, onp.integer)
+numeric_types = (float, int, onp.generic)
+
+
+class Registry:
+    """A tiny name->object registry with alias support.
+
+    Plays the role of ``dmlc::Registry`` / ``DMLC_REGISTRY_REGISTER`` in the
+    reference (e.g. optimizer registry python/mxnet/optimizer/optimizer.py:44,
+    initializer registry python/mxnet/initializer.py:41, metric registry
+    python/mxnet/metric.py).
+    """
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._map: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def register(self, obj=None, name: str | None = None, aliases=()):
+        def _do(o):
+            key = (name or getattr(o, "__name__", None) or str(o)).lower()
+            with self._lock:
+                self._map[key] = o
+                for a in aliases:
+                    self._map[a.lower()] = o
+            return o
+
+        if obj is None:
+            return _do
+        return _do(obj)
+
+    def alias(self, name: str):
+        """Decorator registering an additional alias for a class."""
+
+        def _do(o):
+            with self._lock:
+                self._map[name.lower()] = o
+            return o
+
+        return _do
+
+    def get(self, name: str):
+        try:
+            return self._map[name.lower()]
+        except KeyError:
+            raise MXNetError(
+                f"Cannot find {self.kind} '{name}'. "
+                f"Registered: {sorted(self._map)}"
+            ) from None
+
+    def find(self, name: str):
+        return self._map.get(name.lower())
+
+    def create(self, name, *args, **kwargs):
+        """Create an instance; `name` may already be an instance."""
+        if not isinstance(name, str):
+            return name
+        return self.get(name)(*args, **kwargs)
+
+    def list(self):
+        return sorted(self._map)
+
+    def __contains__(self, name):
+        return name.lower() in self._map
+
+
+class classproperty:
+    def __init__(self, fget):
+        self.fget = fget
+
+    def __get__(self, obj, owner):
+        return self.fget(owner)

@@ -1,0 +1,147 @@
+"""Device contexts mapped onto JAX devices.
+
+Reference parity: ``python/mxnet/context.py`` (Context, cpu()/gpu(),
+current_context, num_gpus) — see SURVEY.md §2.7.  TPU-native redesign:
+a Context names a ``jax.Device``; there are no streams or engine worker
+threads to manage (XLA's async dispatch replaces the reference's
+ThreadedEnginePerDevice, src/engine/threaded_engine_perdevice.cc:79-116).
+
+``gpu(i)`` is kept for API compatibility and resolves to the i-th
+accelerator device (TPU when present), so reference scripts written
+against ``mx.gpu(0)`` run unchanged on TPU.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+from .base import MXNetError
+
+__all__ = [
+    "Context",
+    "cpu",
+    "gpu",
+    "tpu",
+    "cpu_pinned",
+    "current_context",
+    "num_gpus",
+    "num_tpus",
+]
+
+
+def _cpu_devices():
+    return jax.devices("cpu") if jax.default_backend() != "cpu" else jax.devices()
+
+
+def _accel_devices():
+    """All non-CPU jax devices (TPU chips); empty list on CPU-only hosts."""
+    try:
+        devs = jax.devices()
+    except RuntimeError:
+        return []
+    return [d for d in devs if d.platform != "cpu"]
+
+
+class Context:
+    """A device context. devtype ids mirror the reference's Context enum
+    (include/mxnet/base.h kCPU=1 kGPU=2 kCPUPinned=3) with TPU added."""
+
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 5: "cpu_shared", 6: "tpu"}
+    devstr2type = {v: k for k, v in devtype2str.items()}
+    _default_ctx = threading.local()
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            device_id = device_type.device_id
+            device_type = device_type.device_type
+        if device_type not in self.devstr2type:
+            raise MXNetError(f"unknown device type {device_type!r}")
+        self.device_type = device_type
+        self.device_id = device_id
+        self._old_ctx = None
+
+    @property
+    def device_typeid(self):
+        return self.devstr2type[self.device_type]
+
+    def jax_device(self) -> jax.Device:
+        """Resolve to a concrete jax.Device (the TPU chip or a host CPU)."""
+        if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
+            devs = _cpu_devices()
+            return devs[min(self.device_id, len(devs) - 1)]
+        accel = _accel_devices()
+        if not accel:  # CPU-only host: fall back so gpu(0) code still runs
+            devs = _cpu_devices()
+            return devs[self.device_id % len(devs)]
+        if self.device_id >= len(accel):
+            raise MXNetError(
+                f"device {self} out of range: {len(accel)} accelerator(s)"
+            )
+        return accel[self.device_id]
+
+    @property
+    def _canon(self):
+        """gpu and tpu name the same accelerator chips — equal for
+        placement/grouping purposes."""
+        return "gpu" if self.device_type == "tpu" else self.device_type
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self._canon == other._canon
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self._canon, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    __str__ = __repr__
+
+    def __enter__(self):
+        if not hasattr(Context._default_ctx, "value"):
+            Context._default_ctx.value = Context("cpu", 0)
+        self._old_ctx = Context._default_ctx.value
+        Context._default_ctx.value = self
+        return self
+
+    def __exit__(self, *exc):
+        Context._default_ctx.value = self._old_ctx
+
+    def empty_cache(self):
+        """Reference: MXStorageEmptyCache. XLA owns HBM; nothing to do."""
+
+
+def cpu(device_id=0):
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id=0):
+    return Context("cpu_pinned", device_id)
+
+
+def gpu(device_id=0):
+    """Alias for the i-th accelerator (TPU chip) for reference-API parity."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id=0):
+    return Context("tpu", device_id)
+
+
+def current_context() -> Context:
+    if not hasattr(Context._default_ctx, "value"):
+        Context._default_ctx.value = Context("cpu", 0)
+    return Context._default_ctx.value
+
+
+def num_gpus():
+    """Number of accelerator chips visible (reference: mx.context.num_gpus)."""
+    return len(_accel_devices())
+
+
+def num_tpus():
+    return len(_accel_devices())

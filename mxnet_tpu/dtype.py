@@ -1,0 +1,47 @@
+"""Dtype mapping and the reference's on-disk type flags.
+
+Type flag values mirror mshadow (3rdparty/mshadow/mshadow/base.h:307-314)
+so ``.params`` files are bit-compatible with the reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as onp
+
+# mshadow type_flag <-> numpy dtype (base.h:307-314)
+TYPE_FLAG_TO_NP = {
+    0: onp.dtype("float32"),
+    1: onp.dtype("float64"),
+    2: onp.dtype("float16"),
+    3: onp.dtype("uint8"),
+    4: onp.dtype("int32"),
+    5: onp.dtype("int8"),
+    6: onp.dtype("int64"),
+    7: onp.dtype("bool"),
+}
+NP_TO_TYPE_FLAG = {v: k for k, v in TYPE_FLAG_TO_NP.items()}
+# bfloat16 has no reference flag; saved as float32 on disk.
+
+_STR_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "bfloat16": "bfloat16",
+}
+
+
+def normalize_dtype(dtype, default="float32"):
+    """Accept str / numpy dtype / jnp dtype / None -> canonical numpy dtype
+    object (bfloat16 handled via jnp)."""
+    if dtype is None:
+        dtype = default
+    if isinstance(dtype, str):
+        dtype = _STR_ALIASES.get(dtype, dtype)
+    if dtype in ("bfloat16", jnp.bfloat16):
+        return jnp.bfloat16
+    return onp.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    d = normalize_dtype(dtype)
+    return "bfloat16" if d == jnp.bfloat16 else onp.dtype(d).name

@@ -1,0 +1,26 @@
+"""``mx.nd.contrib`` namespace — contrib ops exposed eagerly.
+
+Reference parity: python/mxnet/ndarray/contrib.py over src/operator/contrib/
+(SURVEY.md §2.3).  Ops land here as they are implemented in
+mxnet_tpu/ops/contrib_ops.py; detection/transformer families are added in
+later milestones.
+"""
+from __future__ import annotations
+
+import sys
+
+from ..ops.registry import _OPS, get_op
+from . import _make_op_func
+
+_this = sys.modules[__name__]
+
+
+def _expose_contrib():
+    for name in list(_OPS):
+        if name.startswith("_contrib_"):
+            short = name[len("_contrib_"):]
+            if short.isidentifier() and not hasattr(_this, short):
+                setattr(_this, short, _make_op_func(get_op(name), short))
+
+
+_expose_contrib()

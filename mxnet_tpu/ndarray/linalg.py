@@ -1,0 +1,71 @@
+"""``mx.nd.linalg`` namespace (reference: python/mxnet/ndarray/linalg.py
+over src/operator/tensor/la_op.cc)."""
+from __future__ import annotations
+
+from .ndarray import invoke
+
+
+def gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2,
+          **kw):
+    return invoke("_linalg_gemm2", [A, B], transpose_a=transpose_a,
+                  transpose_b=transpose_b, alpha=alpha, axis=axis)
+
+
+def gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0,
+         axis=-2, **kw):
+    return invoke("_linalg_gemm", [A, B, C], transpose_a=transpose_a,
+                  transpose_b=transpose_b, alpha=alpha, beta=beta, axis=axis)
+
+
+def potrf(A, lower=True, **kw):
+    return invoke("_linalg_potrf", [A], lower=lower)
+
+
+def potri(A, lower=True, **kw):
+    return invoke("_linalg_potri", [A], lower=lower)
+
+
+def trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0, **kw):
+    return invoke("_linalg_trsm", [A, B], transpose=transpose,
+                  rightside=rightside, lower=lower, alpha=alpha)
+
+
+def trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0, **kw):
+    return invoke("_linalg_trmm", [A, B], transpose=transpose,
+                  rightside=rightside, lower=lower, alpha=alpha)
+
+
+def syrk(A, transpose=False, alpha=1.0, **kw):
+    return invoke("_linalg_syrk", [A], transpose=transpose, alpha=alpha)
+
+
+def gelqf(A, **kw):
+    return invoke("_linalg_gelqf", [A])
+
+
+def syevd(A, **kw):
+    return invoke("_linalg_syevd", [A])
+
+
+def sumlogdiag(A, **kw):
+    return invoke("_linalg_sumlogdiag", [A])
+
+
+def extractdiag(A, offset=0, **kw):
+    return invoke("_linalg_extractdiag", [A], offset=offset)
+
+
+def makediag(A, offset=0, **kw):
+    return invoke("_linalg_makediag", [A], offset=offset)
+
+
+def inverse(A, **kw):
+    return invoke("_linalg_inverse", [A])
+
+
+def det(A, **kw):
+    return invoke("_linalg_det", [A])
+
+
+def slogdet(A, **kw):
+    return invoke("_linalg_slogdet", [A])

@@ -1,0 +1,708 @@
+"""NDArray: the eager array type, backed by ``jax.Array``.
+
+Reference parity: include/mxnet/ndarray.h:82 (``NDArray`` over a ``Chunk``
+with an engine variable) and python/mxnet/ndarray/ndarray.py.  TPU-native
+redesign: a ``jax.Array`` already *is* an async handle — XLA dispatch gives
+the same returns-immediately semantics the reference gets from its threaded
+dependency engine (src/engine/threaded_engine.cc:318), and
+``block_until_ready`` is ``WaitToRead`` (threaded_engine.cc:379).  There is
+no storage pool to manage: XLA owns HBM.
+
+Mutation semantics: reference NDArrays are mutable buffers; here mutation
+rebinds the wrapped functional value (``_data``), which preserves the user-
+visible API (``x[:] = v``, ``x += 1``) without fighting XLA.
+"""
+from __future__ import annotations
+
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import _rng, autograd
+from ..base import MXNetError, integer_types, numeric_types
+from ..context import Context, cpu, current_context
+from ..dtype import NP_TO_TYPE_FLAG, TYPE_FLAG_TO_NP, dtype_name, normalize_dtype
+from ..ops.registry import OpDef, get_op
+
+__all__ = [
+    "NDArray",
+    "invoke",
+    "array",
+    "empty",
+    "zeros",
+    "ones",
+    "full",
+    "arange",
+    "linspace",
+    "eye",
+    "zeros_like",
+    "ones_like",
+    "from_jax",
+    "concat",
+    "concatenate",
+    "stack",
+    "split",
+    "save",
+    "load",
+    "load_buffer",
+    "save_buffer",
+    "waitall",
+]
+
+
+def _ctx_of_jax_array(a) -> Context:
+    try:
+        dev = list(a.devices())[0]
+    except Exception:
+        return current_context()
+    if dev.platform == "cpu" and jax.default_backend() != "cpu":
+        return Context("cpu", dev.id)
+    if dev.platform == "cpu":
+        return Context("cpu", dev.id)
+    return Context("gpu", dev.id)
+
+
+class NDArray:
+    __slots__ = ("_data", "_grad", "_grad_req", "_is_var", "_node", "_oidx",
+                 "_stype", "__weakref__")
+
+    def __init__(self, data, stype="default"):
+        self._data = data  # jax.Array (possibly a tracer under jit)
+        self._grad = None
+        self._grad_req = "null"
+        self._is_var = False
+        self._node = None  # autograd.TapeNode that produced this array
+        self._oidx = 0
+        self._stype = stype
+
+    # ------------------------------------------------------------- basics
+    @property
+    def shape(self):
+        return tuple(int(d) for d in self._data.shape)
+
+    @property
+    def dtype(self):
+        d = self._data.dtype
+        return d if d == jnp.bfloat16 else onp.dtype(d)
+
+    @property
+    def size(self):
+        s = 1
+        for d in self.shape:
+            s *= d
+        return s
+
+    @property
+    def ndim(self):
+        return len(self._data.shape)
+
+    @property
+    def context(self) -> Context:
+        return _ctx_of_jax_array(self._data)
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return self._stype
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError(
+                "The truth value of an NDArray with multiple elements is ambiguous."
+            )
+        return bool(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __repr__(self):
+        try:
+            body = str(self.asnumpy())
+        except Exception:  # tracer
+            body = f"<traced {self._data}>"
+        return f"\n{body}\n<NDArray {'x'.join(map(str, self.shape))} @{self.context}>"
+
+    # -------------------------------------------------------- sync points
+    def asnumpy(self):
+        """Blocking copy to host (reference: MXNDArraySyncCopyToCPU)."""
+        a = onp.asarray(self._data)
+        return a
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def wait_to_read(self):
+        """Reference: Engine::WaitForVar (threaded_engine.cc:379)."""
+        if hasattr(self._data, "block_until_ready"):
+            self._data.block_until_ready()
+        return self
+
+    wait_to_write = wait_to_read
+
+    # -------------------------------------------------------- conversions
+    def astype(self, dtype, copy=True):
+        dtype = normalize_dtype(dtype)
+        if not copy and self.dtype == dtype:
+            return self
+        return invoke("Cast", [self], dtype=dtype)
+
+    def copy(self):
+        return invoke("_copy", [self])
+
+    def copyto(self, other):
+        """Copy to an NDArray (writes into it) or a Context (new array)."""
+        if isinstance(other, NDArray):
+            other._adopt(jax.device_put(self._data, other.context.jax_device())
+                         .astype(other._data.dtype))
+            return other
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device()))
+        raise TypeError(f"copyto does not support type {type(other)}")
+
+    def as_in_context(self, context):
+        if context == self.context:
+            return self
+        return NDArray(jax.device_put(self._data, context.jax_device()))
+
+    as_in_ctx = as_in_context
+
+    def as_nd_ndarray(self):
+        return self
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from . import sparse  # lazy, avoids cycle
+
+        return sparse.cast_storage(self, stype)
+
+    def detach(self):
+        out = NDArray(self._data)
+        return out
+
+    def _adopt(self, new_data):
+        """In-place mutation: rebind the functional value."""
+        self._data = new_data
+        self._node = None
+
+    # ---------------------------------------------------------- autograd
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate a gradient buffer (reference ndarray.py attach_grad)."""
+        self._grad = zeros(self.shape, dtype=self.dtype, ctx=self.context)
+        self._grad_req = grad_req
+        self._is_var = True
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # ---------------------------------------------------------- indexing
+    def __getitem__(self, key):
+        if isinstance(key, onp.ndarray):
+            key = array(key, dtype=key.dtype)
+        if isinstance(key, NDArray):
+            if key.dtype == onp.bool_:
+                # boolean mask: data-dependent shape -> eager only, no tape
+                return NDArray(self._data[onp.asarray(key._data)])
+            return invoke("take", [self, key], axis=0, mode="clip")
+        key = _canonical_key(key)
+        return invoke("_getitem", [self], key=key)
+
+    def __setitem__(self, key, value):
+        if isinstance(key, NDArray):
+            if key.dtype == onp.bool_:
+                key = onp.asarray(key._data)
+            else:
+                key = onp.asarray(key._data)
+        else:
+            key = _canonical_key(key)
+        if isinstance(value, NDArray):
+            v = value._data
+        elif isinstance(value, (onp.ndarray, jnp.ndarray) + numeric_types):
+            v = value
+        else:
+            v = onp.asarray(value)
+        if key is Ellipsis or (isinstance(key, slice) and key == slice(None)):
+            new = jnp.broadcast_to(jnp.asarray(v, self._data.dtype), self._data.shape)
+        else:
+            new = self._data.at[key].set(v)
+        self._adopt(new.astype(self._data.dtype))
+
+    # ------------------------------------------------------- shape manip
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        if kwargs.get("shape") is not None:
+            shape = tuple(kwargs["shape"])
+        return invoke("Reshape", [self], shape=shape)
+
+    def reshape_like(self, other):
+        return invoke("reshape_like", [self, other])
+
+    # ------------------------------------------------------- arithmetic
+    def __add__(self, other):
+        return _binary(self, other, "broadcast_add", "_plus_scalar")
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return _binary(self, other, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        return _binary(self, other, "broadcast_sub", "_rminus_scalar",
+                       swap=True)
+
+    def __mul__(self, other):
+        return _binary(self, other, "broadcast_mul", "_mul_scalar")
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __truediv__(self, other):
+        return _binary(self, other, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, other):
+        return _binary(self, other, "broadcast_div", "_rdiv_scalar",
+                       swap=True)
+
+    def __mod__(self, other):
+        return _binary(self, other, "broadcast_mod", "_mod_scalar")
+
+    def __rmod__(self, other):
+        return _binary(self, other, "broadcast_mod", "_rmod_scalar",
+                       swap=True)
+
+    def __pow__(self, other):
+        return _binary(self, other, "broadcast_power", "_power_scalar")
+
+    def __rpow__(self, other):
+        return _binary(self, other, "broadcast_power", "_rpower_scalar",
+                       swap=True)
+
+    def __neg__(self):
+        return invoke("negative", [self])
+
+    def __abs__(self):
+        return invoke("abs", [self])
+
+    def __matmul__(self, other):
+        return invoke("_npi_matmul", [self, other])
+
+    def __iadd__(self, other):
+        self._adopt(self.__add__(other)._data)
+        return self
+
+    def __isub__(self, other):
+        self._adopt(self.__sub__(other)._data)
+        return self
+
+    def __imul__(self, other):
+        self._adopt(self.__mul__(other)._data)
+        return self
+
+    def __itruediv__(self, other):
+        self._adopt(self.__truediv__(other)._data)
+        return self
+
+    def __eq__(self, other):
+        if other is None:
+            return False
+        return _binary(self, other, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, other):
+        if other is None:
+            return True
+        return _binary(self, other, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, other):
+        return _binary(self, other, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, other):
+        return _binary(
+            self, other, "broadcast_greater_equal", "_greater_equal_scalar"
+        )
+
+    def __lt__(self, other):
+        return _binary(self, other, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, other):
+        return _binary(self, other, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    # numpy interop
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+
+def _canonical_key(key):
+    """Normalize an index expression to something hashable & jit-static.
+
+    NDArray / numpy-array indices never reach here — __getitem__ routes
+    them through ``take`` / boolean masking first.
+    """
+    if isinstance(key, list):
+        key = tuple(key)
+    if isinstance(key, tuple):
+        return tuple(
+            int(k) if isinstance(k, integer_types) else k for k in key
+        )
+    if isinstance(key, integer_types):
+        return int(key)
+    return key
+
+
+def _binary(lhs, rhs, elem_op, scalar_op, swap=False):
+    """Dispatch a binary dunder: NDArray rhs -> elementwise op, python
+    scalar -> *_scalar op, array-like -> wrap then elementwise.  ``swap``
+    marks reflected dunders (__rsub__ etc.): operand order is reversed
+    for the elementwise path."""
+    if isinstance(rhs, numeric_types):
+        return invoke(scalar_op, [lhs], scalar=float(rhs))
+    if isinstance(rhs, (onp.ndarray, list, tuple)):
+        rhs = array(rhs, dtype=lhs.dtype)
+    if isinstance(rhs, NDArray):
+        pair = [rhs, lhs] if swap else [lhs, rhs]
+        return invoke(elem_op, pair)
+    raise TypeError(f"unsupported operand type {type(rhs)}")
+
+
+# ============================================================== dispatcher
+def _needs_grad(x):
+    return isinstance(x, NDArray) and (x._is_var or x._node is not None)
+
+
+def invoke(op, inputs, out=None, **params):
+    """Apply a registered op to NDArrays — the single dispatch point.
+
+    Reference parity: MXImperativeInvokeEx -> Imperative::Invoke
+    (src/imperative/imperative.cc:89).  Shape/type inference, dispatch-mode
+    selection and engine push all collapse into calling the op's pure JAX
+    function; when autograd is recording we route through ``jax.vjp`` and
+    tape the pull-back (Imperative::RecordOp, imperative.cc:193).
+    """
+    opdef: OpDef = get_op(op) if isinstance(op, str) else op
+    params = {k: v for k, v in params.items() if v is not None}
+    arrs = []
+    nd_inputs = []
+    for i in inputs:
+        if isinstance(i, NDArray):
+            arrs.append(i._data)
+            nd_inputs.append(i)
+        else:
+            arrs.append(jnp.asarray(i))
+            nd_inputs.append(None)
+    if opdef.key_param:
+        params[opdef.key_param] = _rng.take_key()
+    if opdef.train_param and opdef.train_param not in params:
+        params[opdef.train_param] = autograd.is_training()
+
+    nout = opdef.out_count(params)
+    recording = (
+        autograd.is_recording()
+        and opdef.differentiable
+        and any(_needs_grad(i) for i in inputs)
+    )
+    if recording:
+        def _f(*xs):
+            return opdef.fn(*xs, **params)
+
+        out_vals, vjp_fn = jax.vjp(_f, *arrs)
+    else:
+        out_vals = opdef.fn(*arrs, **params)
+
+    single = not isinstance(out_vals, (tuple, list))
+    vals = (out_vals,) if single else tuple(out_vals)
+    outs = [NDArray(v) for v in vals]
+
+    if recording:
+        node = autograd.TapeNode(
+            vjp_fn,
+            [i if _needs_grad(i) else None for i in nd_inputs],
+            [(v.shape, v.dtype) for v in vals],
+            op_name=opdef.name,
+        )
+        for i, o in enumerate(outs):
+            o._node = node
+            o._oidx = i
+
+    if out is not None:
+        tgt = [out] if isinstance(out, NDArray) else list(out)
+        for t, o in zip(tgt, outs):
+            t._adopt(o._data)
+            t._node, t._oidx = o._node, o._oidx
+        return out
+    if single and nout == 1:
+        return outs[0]
+    return outs
+
+
+# ============================================================== creation
+def _device(ctx):
+    return (ctx or current_context()).jax_device()
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Reference semantics (python/mxnet/ndarray/utils.py array): dtype
+    defaults to the source dtype for ndarray inputs, else float32."""
+    from_nd = isinstance(source_array, (NDArray, onp.ndarray, jax.Array))
+    if isinstance(source_array, NDArray):
+        src = source_array.asnumpy()
+    else:
+        src = onp.asarray(source_array)
+    if dtype is None:
+        if not from_nd:
+            dtype = onp.float32
+        elif src.dtype == onp.float64:
+            dtype = onp.float32  # x64 is disabled under JAX defaults
+        else:
+            dtype = src.dtype
+    dtype = normalize_dtype(dtype)
+    return NDArray(jax.device_put(src.astype(dtype), _device(ctx)))
+
+
+def from_jax(a):
+    return NDArray(a)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx, dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs):
+    if isinstance(shape, integer_types):
+        shape = (shape,)
+    dtype = normalize_dtype(dtype)
+    return NDArray(
+        jax.device_put(jnp.zeros(shape, dtype), _device(ctx))
+    )
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    if isinstance(shape, integer_types):
+        shape = (shape,)
+    dtype = normalize_dtype(dtype)
+    return NDArray(jax.device_put(jnp.ones(shape, dtype), _device(ctx)))
+
+
+def full(shape, val, ctx=None, dtype=None, out=None):
+    if isinstance(shape, integer_types):
+        shape = (shape,)
+    dtype = normalize_dtype(dtype)
+    r = NDArray(jax.device_put(jnp.full(shape, val, dtype), _device(ctx)))
+    if out is not None:
+        out._adopt(r._data)
+        return out
+    return r
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    dtype = normalize_dtype(dtype)
+    a = jnp.arange(start, stop, step, dtype)
+    if repeat != 1:
+        a = jnp.repeat(a, repeat)
+    return NDArray(jax.device_put(a, _device(ctx)))
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype=None):
+    dtype = normalize_dtype(dtype)
+    return NDArray(
+        jax.device_put(jnp.linspace(start, stop, num, endpoint=endpoint,
+                                    dtype=dtype), _device(ctx))
+    )
+
+
+def eye(N, M=0, k=0, ctx=None, dtype=None):
+    dtype = normalize_dtype(dtype)
+    return NDArray(
+        jax.device_put(jnp.eye(N, M if M else None, k, dtype), _device(ctx))
+    )
+
+
+def zeros_like(data):
+    return invoke("zeros_like", [data])
+
+
+def ones_like(data):
+    return invoke("ones_like", [data])
+
+
+def concat(*data, dim=1, out=None):
+    return invoke("Concat", list(data), out=out, dim=dim, num_args=len(data))
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return invoke("Concat", list(arrays), dim=axis, num_args=len(arrays))
+
+
+def stack(*data, axis=0, out=None):
+    return invoke("stack", list(data), out=out, axis=axis, num_args=len(data))
+
+
+def split(data, num_outputs, axis=1, squeeze_axis=False):
+    return invoke("SliceChannel", [data], num_outputs=num_outputs, axis=axis,
+                  squeeze_axis=squeeze_axis)
+
+
+def waitall():
+    """Reference: MXNDArrayWaitAll / Engine::WaitForAll."""
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+# ========================================================= serialization
+# Bit-compatible with the reference .params format:
+#   container: src/c_api/c_api.cc:1824 (kMXAPINDArrayListMagic = 0x112)
+#   per-array: src/ndarray/ndarray.cc:1590 (NDARRAY_V2_MAGIC = 0xF993fac9,
+#   stype, TShape as int32 ndim + int64 dims, Context int32x2, type flag,
+#   raw little-endian data)
+_ND_MAGIC_V1 = 0xF993FAC8
+_ND_MAGIC_V2 = 0xF993FAC9
+_ND_MAGIC_V3 = 0xF993FACA
+_LIST_MAGIC = 0x112
+
+
+def _save_one(buf: bytearray, arr: NDArray):
+    a = arr.asnumpy()
+    if a.dtype == jnp.bfloat16 or str(a.dtype) == "bfloat16":
+        a = a.astype(onp.float32)
+    if a.dtype not in NP_TO_TYPE_FLAG:
+        a = a.astype(onp.float32)
+    # 0-dim arrays need the V3 (np-shape) magic: under V2 ndim==0 means
+    # "none array" and the reference reader stops after the shape
+    # (ndarray.cc NDArray::Load)
+    buf += struct.pack("<I", _ND_MAGIC_V3 if a.ndim == 0 else _ND_MAGIC_V2)
+    buf += struct.pack("<i", 0)  # kDefaultStorage
+    buf += struct.pack("<i", a.ndim)
+    buf += struct.pack(f"<{a.ndim}q", *a.shape)
+    buf += struct.pack("<ii", 1, 0)  # Context: kCPU, id 0
+    buf += struct.pack("<i", NP_TO_TYPE_FLAG[a.dtype])
+    buf += onp.ascontiguousarray(a).tobytes()
+
+
+class _Reader:
+    def __init__(self, data):
+        self.d = data
+        self.o = 0
+
+    def read(self, fmt):
+        vals = struct.unpack_from(fmt, self.d, self.o)
+        self.o += struct.calcsize(fmt)
+        return vals if len(vals) > 1 else vals[0]
+
+    def read_tuple(self, fmt):
+        vals = struct.unpack_from(fmt, self.d, self.o)
+        self.o += struct.calcsize(fmt)
+        return vals
+
+    def raw(self, n):
+        b = self.d[self.o:self.o + n]
+        self.o += n
+        return b
+
+
+def _load_one(r: _Reader, ctx=None) -> NDArray:
+    magic = r.read("<I")
+    if magic in (_ND_MAGIC_V2, _ND_MAGIC_V3):
+        stype = r.read("<i")
+        if stype not in (0,):
+            raise MXNetError("loading sparse ndarrays is not supported yet")
+        ndim = r.read("<i")
+        shape = r.read_tuple(f"<{ndim}q") if ndim else ()
+        if magic == _ND_MAGIC_V2 and ndim == 0:
+            # "none" array: the record ends here (no ctx/type/data bytes)
+            return zeros((), ctx=ctx)
+    elif magic == _ND_MAGIC_V1:
+        ndim = r.read("<I")
+        shape = r.read_tuple(f"<{ndim}q") if ndim else ()
+    else:
+        # legacy: magic *is* ndim, dims are uint32 (ndarray.cc LegacyTShapeLoad)
+        ndim = magic
+        shape = r.read_tuple(f"<{ndim}I") if ndim else ()
+    r.read("<ii")  # saved Context, ignored: we place on the requested ctx
+    type_flag = r.read("<i")
+    np_dtype = TYPE_FLAG_TO_NP[type_flag]
+    n = int(onp.prod(shape)) if shape else 1
+    data = onp.frombuffer(r.raw(n * np_dtype.itemsize), dtype=np_dtype)
+    a = data.reshape(shape)
+    return NDArray(jax.device_put(jnp.asarray(a), _device(ctx)))
+
+
+def save_buffer(data) -> bytes:
+    if isinstance(data, NDArray):
+        arrays, keys = [data], []
+    elif isinstance(data, (list, tuple)):
+        arrays, keys = list(data), []
+    elif isinstance(data, dict):
+        keys = list(data.keys())
+        arrays = [data[k] for k in keys]
+    else:
+        raise MXNetError("save expects NDArray, list or dict of NDArrays")
+    buf = bytearray()
+    buf += struct.pack("<QQ", _LIST_MAGIC, 0)
+    buf += struct.pack("<Q", len(arrays))
+    for a in arrays:
+        _save_one(buf, a)
+    buf += struct.pack("<Q", len(keys))
+    for k in keys:
+        kb = k.encode()
+        buf += struct.pack("<Q", len(kb)) + kb
+    return bytes(buf)
+
+
+def save(fname, data):
+    """Save NDArrays in the reference .params binary format."""
+    with open(fname, "wb") as f:
+        f.write(save_buffer(data))
+
+
+def load_buffer(data: bytes, ctx=None):
+    r = _Reader(data)
+    magic, _reserved = r.read("<QQ")
+    if magic != _LIST_MAGIC:
+        raise MXNetError("invalid NDArray file format")
+    count = r.read("<Q")
+    arrays = [_load_one(r, ctx) for _ in range(count)]
+    nkeys = r.read("<Q")
+    if nkeys == 0:
+        return arrays
+    keys = []
+    for _ in range(nkeys):
+        klen = r.read("<Q")
+        keys.append(r.raw(klen).decode())
+    return dict(zip(keys, arrays))
+
+
+def load(fname, ctx=None):
+    """Load a reference-format .params file."""
+    with open(fname, "rb") as f:
+        return load_buffer(f.read(), ctx)

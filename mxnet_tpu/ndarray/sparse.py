@@ -1,0 +1,136 @@
+"""Sparse NDArray API surface: CSRNDArray / RowSparseNDArray.
+
+Reference parity: python/mxnet/ndarray/sparse.py over the row_sparse/csr
+storage types (include/mxnet/ndarray.h:61-65) and cast_storage
+(src/operator/tensor/cast_storage.cc).
+
+TPU-native reality (SURVEY.md §7 "hard parts"): XLA/TPU has no sparse
+buffer type, so sparse arrays are *dense-backed with sparse metadata* —
+the API (indices/indptr/data, retain, cast_storage) is preserved while the
+math runs dense on the MXU.  This keeps sparse-using reference workloads
+(sparse FM, row_sparse embeddings/optimizers) functional; the memory win
+is deferred to a host-side (CPU backend) representation if ever needed.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from .ndarray import NDArray, array, invoke, zeros as _dense_zeros
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ()
+
+
+class CSRNDArray(BaseSparseNDArray):
+    __slots__ = ()
+
+    def __init__(self, data):
+        super().__init__(data, stype="csr")
+
+    @property
+    def indices(self):
+        a = onp.asarray(self._data)
+        idx = [onp.nonzero(row)[0] for row in a]
+        return array(onp.concatenate(idx) if idx else onp.zeros(0),
+                     dtype="int64")
+
+    @property
+    def indptr(self):
+        a = onp.asarray(self._data)
+        counts = [0] + [int((row != 0).sum()) for row in a]
+        return array(onp.cumsum(counts), dtype="int64")
+
+    @property
+    def data(self):
+        a = onp.asarray(self._data)
+        return array(a[a != 0])
+
+    def tostype(self, stype):
+        if stype == "default":
+            return NDArray(self._data)
+        if stype == "csr":
+            return self
+        raise MXNetError(f"cast_storage csr->{stype} unsupported")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    __slots__ = ()
+
+    def __init__(self, data):
+        super().__init__(data, stype="row_sparse")
+
+    @property
+    def indices(self):
+        a = onp.asarray(self._data)
+        nz = onp.nonzero(a.reshape(a.shape[0], -1).any(axis=1))[0]
+        return array(nz, dtype="int64")
+
+    @property
+    def data(self):
+        a = onp.asarray(self._data)
+        nz = a.reshape(a.shape[0], -1).any(axis=1)
+        return array(a[nz])
+
+    def retain(self, indices):
+        idx = onp.asarray(indices._data
+                          if isinstance(indices, NDArray) else indices,
+                          dtype=onp.int64)
+        mask = onp.zeros(self.shape[0], dtype=bool)
+        mask[idx] = True
+        d = jnp.where(jnp.asarray(mask).reshape((-1,) + (1,) *
+                                                (self.ndim - 1)),
+                      self._data, 0)
+        return RowSparseNDArray(d)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return NDArray(self._data)
+        if stype == "row_sparse":
+            return self
+        raise MXNetError(f"cast_storage row_sparse->{stype} unsupported")
+
+
+def cast_storage(arr, stype):
+    if stype == "default":
+        return NDArray(arr._data)
+    if stype == "csr":
+        return CSRNDArray(arr._data)
+    if stype == "row_sparse":
+        return RowSparseNDArray(arr._data)
+    raise MXNetError(f"unknown stype {stype}")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        import numpy as np
+
+        dense = onp.zeros(shape, dtype=dtype or "float32")
+        data = onp.asarray(data)
+        indices = onp.asarray(indices, dtype=onp.int64)
+        indptr = onp.asarray(indptr, dtype=onp.int64)
+        for r in range(shape[0]):
+            cols = indices[indptr[r]:indptr[r + 1]]
+            dense[r, cols] = data[indptr[r]:indptr[r + 1]]
+        return CSRNDArray(array(dense, ctx=ctx, dtype=dtype)._data)
+    return CSRNDArray(array(arg1, ctx=ctx, dtype=dtype)._data)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = onp.asarray(data)
+        indices = onp.asarray(indices, dtype=onp.int64)
+        full_shape = shape or ((int(indices.max()) + 1,) + data.shape[1:])
+        dense = onp.zeros(full_shape, dtype=dtype or "float32")
+        dense[indices] = data
+        return RowSparseNDArray(array(dense, ctx=ctx, dtype=dtype)._data)
+    return RowSparseNDArray(array(arg1, ctx=ctx, dtype=dtype)._data)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    d = _dense_zeros(shape, ctx=ctx, dtype=dtype)
+    return cast_storage(d, stype)

@@ -1,0 +1,18 @@
+"""Operator library package: importing this registers every op.
+
+The registry (ops/registry.py) replaces NNVM op registration; each submodule
+documents which reference source tree it covers (SURVEY.md §2.3).
+"""
+from . import (  # noqa: F401  (import-for-registration)
+    elemwise,
+    reduce,
+    shape_ops,
+    nn,
+    conv,
+    rnn,
+    random_ops,
+    sort_ops,
+    sequence_ops,
+    linalg_ops,
+)
+from .registry import OpDef, alias_op, get_op, list_ops, register_op  # noqa: F401

@@ -1,0 +1,233 @@
+"""Convolution / Deconvolution / Pooling / UpSampling.
+
+Reference parity: src/operator/nn/convolution.cc, deconvolution.cc,
+pooling.cc, upsampling.cc (+ their cuDNN wrappers nn/cudnn/ with the
+autotuned algo registry cudnn_algoreg-inl.h).  TPU-native: one
+``lax.conv_general_dilated`` call — XLA picks MXU tilings, so the whole
+cuDNN algorithm-selection machinery disappears.  Layouts are the
+reference's NCW/NCHW/NCDHW; weights are OIHW (num_filter, C/group, *k).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _tup(v, n, default=1):
+    if v is None or v == ():
+        return (default,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _dimnums(nd):
+    # NCHW-family dimension numbers for any spatial rank
+    sp = "".join(chr(ord("0") + i) for i in range(nd))  # placeholder
+    spatial = ["W", "HW", "DHW"][nd - 1]
+    return jax.lax.conv_dimension_numbers(
+        (1, 1) + (1,) * nd,
+        (1, 1) + (1,) * nd,
+        (f"NC{spatial}", f"OI{spatial}", f"NC{spatial}"),
+    )
+
+
+@register_op("Convolution", aliases=("Convolution_v1",))
+def convolution(data, weight, bias=None, *, kernel, num_filter, stride=None,
+                dilate=None, pad=None, num_group=1, no_bias=False,
+                workspace=1024, cudnn_tune=None, cudnn_off=False,
+                layout=None):
+    """Reference: src/operator/nn/convolution.cc."""
+    nd = len(kernel)
+    stride = _tup(stride, nd)
+    dilate = _tup(dilate, nd)
+    pad = _tup(pad, nd, 0)
+    dn = _dimnums(nd)
+    out = jax.lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+    )
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register_op("Deconvolution")
+def deconvolution(data, weight, bias=None, *, kernel, num_filter,
+                  stride=None, dilate=None, pad=None, adj=None,
+                  target_shape=None, num_group=1, no_bias=True,
+                  workspace=512, cudnn_tune=None, cudnn_off=False,
+                  layout=None):
+    """Reference: src/operator/nn/deconvolution.cc — the transposed conv:
+    implemented as input-dilated convolution (lhs_dilation=stride)."""
+    nd = len(kernel)
+    stride = _tup(stride, nd)
+    dilate = _tup(dilate, nd)
+    pad = _tup(pad, nd, 0)
+    adj = _tup(adj, nd, 0)
+    dn = _dimnums(nd)
+    # effective padding for transposed conv: k_eff - 1 - p
+    padding = []
+    for i in range(nd):
+        k_eff = (kernel[i] - 1) * dilate[i] + 1
+        lo = k_eff - 1 - pad[i]
+        hi = k_eff - 1 - pad[i] + adj[i]
+        padding.append((lo, hi))
+    # weight layout (C_in, C_out/group, *k) -> flip spatial, swap IO
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
+    if num_group > 1:
+        ci, co_g = w.shape[0], w.shape[1]
+        w = w.reshape(num_group, ci // num_group, co_g, *w.shape[2:])
+        w = jnp.swapaxes(w, 1, 2)
+        w = w.reshape(num_group * co_g, ci // num_group, *w.shape[3:])
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    out = jax.lax.conv_general_dilated(
+        data, w,
+        window_strides=(1,) * nd,
+        padding=padding,
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+    )
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register_op("Pooling", aliases=("Pooling_v1",))
+def pooling(data, *, kernel=(), pool_type="max", global_pool=False,
+            stride=None, pad=None, pooling_convention="valid",
+            count_include_pad=True, cudnn_off=False, p_value=2,
+            layout=None):
+    """Reference: src/operator/nn/pooling.cc via lax.reduce_window."""
+    nd = data.ndim - 2
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * nd
+        pad = (0,) * nd
+    stride = _tup(stride, nd)
+    pad = _tup(pad, nd, 0)
+    kernel = _tup(kernel, nd)
+    dims = (1, 1) + kernel
+    strides = (1, 1) + stride
+    base_pad = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+    if pooling_convention == "full":
+        # ceil mode: add extra right-pad so last window fits
+        base_pad = [(0, 0), (0, 0)]
+        for i in range(nd):
+            size = data.shape[2 + i] + 2 * pad[i]
+            rem = (size - kernel[i]) % stride[i]
+            extra = (stride[i] - rem) % stride[i] if rem else 0
+            base_pad.append((pad[i], pad[i] + extra))
+
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
+            else jnp.iinfo(data.dtype).min
+        return jax.lax.reduce_window(data, init, jax.lax.max, dims, strides,
+                                     base_pad)
+    if pool_type in ("avg", "sum"):
+        s = jax.lax.reduce_window(data, 0.0, jax.lax.add, dims, strides,
+                                  base_pad)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            denom = 1
+            for k in kernel:
+                denom *= k
+            return s / denom
+        ones = jnp.ones_like(data)
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides,
+                                    base_pad)
+        return s / cnt
+    if pool_type == "lp":
+        s = jax.lax.reduce_window(jnp.abs(data) ** p_value, 0.0, jax.lax.add,
+                                  dims, strides, base_pad)
+        return s ** (1.0 / p_value)
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+@register_op("UpSampling")
+def upsampling(*inputs, scale, sample_type="nearest", num_args=1,
+               num_filter=0, multi_input_mode="concat", workspace=512):
+    """Reference: src/operator/nn/upsampling.cc."""
+    data = inputs[0]
+    n, c, h, w = data.shape
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+    else:  # bilinear: reference uses a Deconvolution with bilinear kernel
+        out = jax.image.resize(data, (n, c, h * scale, w * scale),
+                               method="bilinear")
+    return out
+
+
+@register_op("BilinearSampler")
+def bilinear_sampler(data, grid, *, cudnn_off=False):
+    """Reference: src/operator/bilinear_sampler.cc — grid in [-1, 1]."""
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1) * (w - 1) / 2
+    gy = (grid[:, 1] + 1) * (h - 1) / 2
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(y, x):
+        yc = jnp.clip(y, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(x, 0, w - 1).astype(jnp.int32)
+        valid = ((y >= 0) & (y <= h - 1) & (x >= 0) & (x <= w - 1))
+        idx = yc * w + xc
+        flat = data.reshape(n, c, h * w)
+        g = jnp.take_along_axis(
+            flat, idx.reshape(n, 1, -1).repeat(c, axis=1), axis=2
+        ).reshape(n, c, *gx.shape[1:])
+        return g * valid[:, None].astype(data.dtype)
+
+    out = (gather(y0, x0) * ((1 - wx) * (1 - wy))[:, None]
+           + gather(y0, x0 + 1) * (wx * (1 - wy))[:, None]
+           + gather(y0 + 1, x0) * ((1 - wx) * wy)[:, None]
+           + gather(y0 + 1, x0 + 1) * (wx * wy)[:, None])
+    return out
+
+
+@register_op("GridGenerator")
+def grid_generator(data, *, transform_type="affine", target_shape=(0, 0)):
+    """Reference: src/operator/grid_generator.cc."""
+    h, w = target_shape
+    if transform_type == "affine":
+        n = data.shape[0]
+        ys = jnp.linspace(-1, 1, h)
+        xs = jnp.linspace(-1, 1, w)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx.reshape(-1), gy.reshape(-1),
+                          jnp.ones(h * w)], axis=0)
+        theta = data.reshape(n, 2, 3)
+        out = jnp.einsum("nij,jk->nik", theta, base)
+        return out.reshape(n, 2, h, w)
+    # warp
+    n = data.shape[0]
+    ys = jnp.arange(h, dtype=data.dtype)
+    xs = jnp.arange(w, dtype=data.dtype)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    flow_x = (data[:, 0] + gx) * 2 / max(w - 1, 1) - 1
+    flow_y = (data[:, 1] + gy) * 2 / max(h - 1, 1) - 1
+    return jnp.stack([flow_x, flow_y], axis=1)
+
+
+@register_op("SpatialTransformer")
+def spatial_transformer(data, loc, *, target_shape=(0, 0),
+                        transform_type="affine", sampler_type="bilinear",
+                        cudnn_off=False):
+    """Reference: src/operator/spatial_transformer.cc."""
+    from .registry import get_op
+
+    g = get_op("GridGenerator").fn(loc, transform_type=transform_type,
+                                   target_shape=target_shape)
+    return get_op("BilinearSampler").fn(data, g)

@@ -1,0 +1,259 @@
+"""Elementwise unary / binary / scalar operator families.
+
+Reference parity: src/operator/tensor/elemwise_{unary,binary,binary_scalar,
+binary_broadcast}_op*.{cc,cu} and the mshadow_op functor zoo
+(src/operator/mshadow_op.h) — ~35k LoC of CUDA/C++ that collapses to jnp
+one-liners here because XLA owns codegen and fusion (SURVEY.md §7: the
+pointwise-fusion pass src/executor/pointwise_fusion_pass.cc is obsolete on
+XLA, which fuses elementwise chains into neighboring MXU ops natively).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+_f32 = jnp.float32
+
+
+def _promote_bool(x):
+    return x.astype(jnp.int32) if x.dtype == jnp.bool_ else x
+
+
+# --------------------------------------------------------------- unary
+_UNARY = {
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "rint": jnp.rint,
+    "round": jnp.round,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.trunc,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "negative": jnp.negative,
+    "reciprocal": jnp.reciprocal,
+    "erf": jax.lax.erf,
+    "erfinv": jax.lax.erf_inv,
+    "gamma": lambda x: jnp.exp(jax.lax.lgamma(x)),
+    "gammaln": jax.lax.lgamma,
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+    "relu": jax.nn.relu,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+}
+
+for _name, _f in _UNARY.items():
+    register_op(_name, aliases=(f"_np_{_name}",))(
+        (lambda f: lambda x: f(x))(_f)
+    )
+
+
+@register_op("_copy", aliases=("identity",))
+def _copy(x):
+    return x
+
+
+@register_op("BlockGrad", aliases=("stop_gradient",))
+def block_grad(x):
+    """Reference: src/operator/tensor/elemwise_unary_op_basic.cc BlockGrad."""
+    return jax.lax.stop_gradient(x)
+
+
+@register_op("make_loss")
+def make_loss(x):
+    """Reference make_loss: gradient of ones (src/operator/make_loss.cc)."""
+    return x
+
+
+@register_op("zeros_like")
+def zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register_op("ones_like")
+def ones_like(x):
+    return jnp.ones_like(x)
+
+
+@register_op("clip")
+def clip(x, *, a_min, a_max):
+    return jnp.clip(x, a_min, a_max)
+
+
+@register_op("smooth_l1")
+def smooth_l1(x, *, scalar=1.0):
+    """Reference: src/operator/tensor/elemwise_binary_scalar_op_extended.cc."""
+    s2 = scalar * scalar
+    ax = jnp.abs(x)
+    return jnp.where(ax < 1.0 / s2, 0.5 * s2 * x * x, ax - 0.5 / s2)
+
+
+# --------------------------------------------------------------- binary
+def _true_div(a, b):
+    if jnp.issubdtype(jnp.result_type(a, b), jnp.integer):
+        return (a / b).astype(jnp.result_type(a, b))
+    return a / b
+
+
+_BINARY = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": _true_div,
+    "mod": jnp.fmod,
+    "power": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "hypot": jnp.hypot,
+    "logical_and": lambda a, b: jnp.logical_and(a != 0, b != 0).astype(a.dtype),
+    "logical_or": lambda a, b: jnp.logical_or(a != 0, b != 0).astype(a.dtype),
+    "logical_xor": lambda a, b: jnp.logical_xor(a != 0, b != 0).astype(a.dtype),
+}
+
+_BINARY_ALIASES = {
+    "add": ("elemwise_add", "_plus", "_add"),
+    "sub": ("elemwise_sub", "_minus", "_sub"),
+    "mul": ("elemwise_mul", "_mul"),
+    "div": ("elemwise_div", "_div"),
+    "mod": ("_mod",),
+    "power": ("_power",),
+    "maximum": ("_maximum",),
+    "minimum": ("_minimum",),
+    "hypot": ("_hypot",),
+    "logical_and": ("_logical_and",),
+    "logical_or": ("_logical_or",),
+    "logical_xor": ("_logical_xor",),
+}
+
+for _name, _f in _BINARY.items():
+    # broadcast_* and elemwise_* share impls: XLA broadcasting covers both
+    register_op(f"broadcast_{_name}", aliases=_BINARY_ALIASES[_name])(
+        (lambda f: lambda a, b: f(a, b))(_f)
+    )
+
+_CMP = {
+    "equal": jnp.equal,
+    "not_equal": jnp.not_equal,
+    "greater": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+    "lesser": jnp.less,
+    "lesser_equal": jnp.less_equal,
+}
+
+for _name, _f in _CMP.items():
+    register_op(f"broadcast_{_name}", aliases=(f"_{_name}",),
+                differentiable=False)(
+        (lambda f: lambda a, b: f(a, b).astype(_f32))(_f)
+    )
+
+
+@register_op("_hypot_scalar")
+def _hypot_scalar(x, *, scalar):
+    return jnp.hypot(x, scalar)
+
+
+# --------------------------------------------------------------- scalar
+_SCALAR = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_mod_scalar": lambda x, s: jnp.fmod(x, s),
+    "_rmod_scalar": lambda x, s: jnp.fmod(s, x),
+    "_power_scalar": lambda x, s: jnp.power(x, s),
+    "_rpower_scalar": lambda x, s: jnp.power(s, x),
+    "_maximum_scalar": lambda x, s: jnp.maximum(x, s),
+    "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
+}
+
+for _name, _f in _SCALAR.items():
+    register_op(_name)(
+        (lambda f: lambda x, *, scalar: f(
+            x, jnp.asarray(scalar, dtype=x.dtype
+                           if jnp.issubdtype(x.dtype, jnp.floating)
+                           else jnp.result_type(x.dtype, type(scalar)))))(_f)
+    )
+
+_SCALAR_CMP = {
+    "_equal_scalar": jnp.equal,
+    "_not_equal_scalar": jnp.not_equal,
+    "_greater_scalar": jnp.greater,
+    "_greater_equal_scalar": jnp.greater_equal,
+    "_lesser_scalar": jnp.less,
+    "_lesser_equal_scalar": jnp.less_equal,
+}
+
+for _name, _f in _SCALAR_CMP.items():
+    register_op(_name, differentiable=False)(
+        (lambda f: lambda x, *, scalar: f(x, scalar).astype(_f32))(_f)
+    )
+
+
+@register_op("add_n", aliases=("ElementWiseSum",))
+def add_n(*args):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register_op("Cast", aliases=("cast",))
+def cast(x, *, dtype):
+    from ..dtype import normalize_dtype
+
+    return x.astype(normalize_dtype(dtype))
+
+
+@register_op("amp_cast")
+def amp_cast(x, *, dtype):
+    from ..dtype import normalize_dtype
+
+    return x.astype(normalize_dtype(dtype))
+
+
+@register_op("amp_multicast", num_outputs=lambda p: p.get("num_outputs", 1))
+def amp_multicast(*args, num_outputs):
+    """Cast all inputs to the widest input dtype (reference
+    src/operator/tensor/amp_cast.cc)."""
+    widest = jnp.result_type(*[a.dtype for a in args])
+    return tuple(a.astype(widest) for a in args)
+
+
+@register_op("where")
+def where(condition, x, y):
+    return jnp.where(condition != 0, x, y)
+
+
+@register_op("_getitem")
+def _getitem(x, *, key):
+    return x[key]
+
+

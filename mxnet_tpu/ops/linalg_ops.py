@@ -1,0 +1,138 @@
+"""Linear-algebra ops (the reference's la_op family).
+
+Reference parity: src/operator/tensor/la_op.cc (linalg_gemm2, potrf, potri,
+trsm, trmm, syrk, gelqf, syevd, ...) backed there by cuBLAS/LAPACK
+(src/operator/linalg.h); here by jnp.linalg / lax.linalg which XLA lowers
+to MXU-friendly blocked kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("_linalg_gemm2", aliases=("linalg_gemm2",))
+def linalg_gemm2(a, b, *, transpose_a=False, transpose_b=False, alpha=1.0,
+                 axis=-2):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b)
+
+
+@register_op("_linalg_gemm", aliases=("linalg_gemm",))
+def linalg_gemm(a, b, c, *, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-2):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b) + beta * c
+
+
+@register_op("_linalg_potrf", aliases=("linalg_potrf",))
+def linalg_potrf(a, *, lower=True):
+    l = jnp.linalg.cholesky(a)
+    if not lower:
+        l = jnp.swapaxes(l, -1, -2)
+    return l
+
+
+@register_op("_linalg_potri", aliases=("linalg_potri",))
+def linalg_potri(a, *, lower=True):
+    """Inverse from Cholesky factor: inv(A) given L with A = L L^T."""
+    linv = jax.scipy.linalg.solve_triangular(
+        a, jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape),
+        lower=lower)
+    if lower:
+        return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+    return jnp.matmul(linv, jnp.swapaxes(linv, -1, -2))
+
+
+@register_op("_linalg_trsm", aliases=("linalg_trsm",))
+def linalg_trsm(a, b, *, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    if rightside:
+        # solve X A = alpha B  ->  A^T X^T = alpha B^T
+        xt = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(a, -1, -2), jnp.swapaxes(alpha * b, -1, -2),
+            lower=not lower, trans=1 if transpose else 0)
+        return jnp.swapaxes(xt, -1, -2)
+    return jax.scipy.linalg.solve_triangular(
+        a, alpha * b, lower=lower, trans=1 if transpose else 0)
+
+
+@register_op("_linalg_trmm", aliases=("linalg_trmm",))
+def linalg_trmm(a, b, *, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    tri = jnp.tril(a) if lower else jnp.triu(a)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    if rightside:
+        return alpha * jnp.matmul(b, tri)
+    return alpha * jnp.matmul(tri, b)
+
+
+@register_op("_linalg_syrk", aliases=("linalg_syrk",))
+def linalg_syrk(a, *, transpose=False, alpha=1.0):
+    at = jnp.swapaxes(a, -1, -2)
+    if transpose:
+        return alpha * jnp.matmul(at, a)
+    return alpha * jnp.matmul(a, at)
+
+
+@register_op("_linalg_gelqf", aliases=("linalg_gelqf",), num_outputs=2)
+def linalg_gelqf(a):
+    """LQ factorization: A = L Q (reference la_op gelqf)."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register_op("_linalg_syevd", aliases=("linalg_syevd",), num_outputs=2)
+def linalg_syevd(a):
+    w, v = jnp.linalg.eigh(a)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register_op("_linalg_sumlogdiag", aliases=("linalg_sumlogdiag",))
+def linalg_sumlogdiag(a):
+    return jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register_op("_linalg_extractdiag", aliases=("linalg_extractdiag",))
+def linalg_extractdiag(a, *, offset=0):
+    return jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1)
+
+
+@register_op("_linalg_makediag", aliases=("linalg_makediag",))
+def linalg_makediag(a, *, offset=0):
+    return _makediag(a, offset)
+
+
+def _makediag(a, offset):
+    n = a.shape[-1] + abs(offset)
+    out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    idx = jnp.arange(a.shape[-1])
+    if offset >= 0:
+        return out.at[..., idx, idx + offset].set(a)
+    return out.at[..., idx - offset, idx].set(a)
+
+
+@register_op("_linalg_inverse", aliases=("linalg_inverse", "inverse"))
+def linalg_inverse(a):
+    return jnp.linalg.inv(a)
+
+
+@register_op("_linalg_det", aliases=("linalg_det", "det"))
+def linalg_det(a):
+    return jnp.linalg.det(a)
+
+
+@register_op("_linalg_slogdet", aliases=("linalg_slogdet", "slogdet"),
+             num_outputs=2)
+def linalg_slogdet(a):
+    sign, logdet = jnp.linalg.slogdet(a)
+    return sign, logdet

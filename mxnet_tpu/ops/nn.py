@@ -1,0 +1,372 @@
+"""Neural-network ops: FullyConnected, activations, softmax family,
+normalization layers, Dropout, loss/output ops.
+
+Reference parity: src/operator/nn/ (fully_connected.cc:258-348, activation,
+softmax, batch_norm, layer_norm, group_norm, dropout, lrn, l2_normalization)
+and the *Output ops (src/operator/softmax_output.cc, regression_output).
+TPU-native notes: FullyConnected/conv are MXU work — we keep them as plain
+lax/jnp calls so XLA fuses the elementwise epilogues (bias, activation)
+into the matmul; the reference needed cuDNN + a pointwise-fusion JIT pass
+for the same effect.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("FullyConnected", aliases=("_FullyConnected",))
+def fully_connected(data, weight, bias=None, *, num_hidden, no_bias=False,
+                    flatten=True):
+    """Reference: src/operator/nn/fully_connected.cc:258."""
+    if flatten:
+        x = data.reshape(data.shape[0], -1)
+    else:
+        x = data
+    out = jnp.dot(x, weight.T)
+    if not no_bias and bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op("Activation")
+def activation(x, *, act_type):
+    if act_type == "relu":
+        return jax.nn.relu(x)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if act_type == "tanh":
+        return jnp.tanh(x)
+    if act_type == "softrelu":
+        return jax.nn.softplus(x)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(x)
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+@register_op("LeakyReLU")
+def leaky_relu(*inputs, act_type="leaky", slope=0.25, lower_bound=0.125,
+               upper_bound=0.334):
+    """Reference: src/operator/leaky_relu.cc (leaky/prelu/elu/selu/gelu)."""
+    x = inputs[0]
+    if act_type == "leaky":
+        return jnp.where(x > 0, x, slope * x)
+    if act_type == "prelu":
+        gamma = inputs[1]
+        if gamma.ndim < x.ndim and gamma.size > 1:
+            shape = [1] * x.ndim
+            shape[1] = gamma.size
+            gamma = gamma.reshape(shape)
+        return jnp.where(x > 0, x, gamma * x)
+    if act_type == "elu":
+        return jnp.where(x > 0, x, slope * jnp.expm1(x))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+    if act_type == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if act_type == "rrelu":
+        s = (lower_bound + upper_bound) / 2.0
+        return jnp.where(x > 0, x, s * x)
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+@register_op("softmax")
+def softmax(x, length=None, *, axis=-1, temperature=None, use_length=False,
+            dtype=None):
+    if temperature:
+        x = x / temperature
+    if use_length and length is not None:
+        pos = jnp.arange(x.shape[axis])
+        shape = [1] * x.ndim
+        shape[axis] = -1
+        mask = pos.reshape(shape) < jnp.expand_dims(length, axis)
+        x = jnp.where(mask, x, -jnp.inf)
+        r = jax.nn.softmax(x, axis=axis)
+        return jnp.where(mask, r, 0.0)
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_op("log_softmax")
+def log_softmax(x, *, axis=-1, temperature=None, dtype=None,
+                use_length=False):
+    if temperature:
+        x = x / temperature
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register_op("softmin")
+def softmin(x, *, axis=-1, temperature=None, dtype=None, use_length=False):
+    return jax.nn.softmax(-x, axis=axis)
+
+
+@register_op("SoftmaxActivation")
+def softmax_activation(x, *, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(x, axis=1)
+    return jax.nn.softmax(x.reshape(x.shape[0], -1), axis=-1).reshape(x.shape)
+
+
+@register_op("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    lp = jax.nn.log_softmax(data, axis=-1)
+    nll = -jnp.take_along_axis(lp, label.astype(jnp.int32)[:, None], axis=-1)
+    return jnp.sum(nll)
+
+
+# ----------------------------------------------------------- BatchNorm
+def _mean_var_nout(p):
+    return 3 if p.get("output_mean_var") else 1
+
+
+@register_op("BatchNorm", aliases=("BatchNorm_v1",),
+             num_outputs=_mean_var_nout, train_param="train")
+def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               output_mean_var=False, axis=1, cudnn_off=False, train=False):
+    """Reference: src/operator/nn/batch_norm.cc.
+
+    Pure function: with output_mean_var returns (out, batch_mean,
+    batch_var).  The caller (gluon BatchNorm layer / executor) folds batch
+    stats into the moving aux arrays — the reference op mutates its aux
+    inputs in-place instead, which has no XLA analog.
+    """
+    red = tuple(i for i in range(data.ndim) if i != axis % data.ndim)
+    bshape = [1] * data.ndim
+    bshape[axis % data.ndim] = data.shape[axis % data.ndim]
+
+    if train and not use_global_stats:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+    else:
+        mean, var = moving_mean, moving_var
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    inv = jax.lax.rsqrt(var + eps)
+    out = (data - mean.reshape(bshape)) * (inv * g).reshape(bshape) \
+        + beta.reshape(bshape)
+    if output_mean_var:
+        return out, mean, var
+    return out
+
+
+@register_op("LayerNorm", num_outputs=_mean_var_nout)
+def layer_norm(data, gamma, beta, *, axis=-1, eps=1e-5,
+               output_mean_var=False):
+    """Reference: src/operator/nn/layer_norm.cc."""
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    shape = [1] * data.ndim
+    ax = axis % data.ndim
+    shape[ax] = data.shape[ax]
+    out = (data - mean) * inv * gamma.reshape(shape) + beta.reshape(shape)
+    if output_mean_var:
+        return out, jnp.squeeze(mean, ax), jnp.squeeze(var, ax)
+    return out
+
+
+@register_op("InstanceNorm")
+def instance_norm(data, gamma, beta, *, eps=1e-3):
+    """Reference: src/operator/instance_norm.cc (normalize over spatial)."""
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    shape = [1, data.shape[1]] + [1] * (data.ndim - 2)
+    return (data - mean) * jax.lax.rsqrt(var + eps) * gamma.reshape(shape) \
+        + beta.reshape(shape)
+
+
+@register_op("GroupNorm", num_outputs=_mean_var_nout)
+def group_norm(data, gamma, beta, *, num_groups=1, eps=1e-5,
+               output_mean_var=False):
+    """Reference: src/operator/nn/group_norm.cc."""
+    n, c = data.shape[0], data.shape[1]
+    rest = data.shape[2:]
+    x = data.reshape(n, num_groups, c // num_groups, *rest)
+    red = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.var(x, axis=red, keepdims=True)
+    xn = ((x - mean) * jax.lax.rsqrt(var + eps)).reshape(data.shape)
+    shape = [1, c] + [1] * (data.ndim - 2)
+    out = xn * gamma.reshape(shape) + beta.reshape(shape)
+    if output_mean_var:
+        return out, jnp.squeeze(mean), jnp.squeeze(var)
+    return out
+
+
+@register_op("L2Normalization")
+def l2_normalization(data, *, eps=1e-10, mode="instance"):
+    """Reference: src/operator/l2_normalization.cc."""
+    if mode == "instance":
+        red = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        red = (1,)
+    else:  # spatial
+        red = tuple(range(2, data.ndim))
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + eps)
+    return data / norm
+
+
+@register_op("LRN")
+def lrn(data, *, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Reference: src/operator/nn/lrn.cc (cross-channel normalization)."""
+    sq = jnp.square(data)
+    half = nsize // 2
+    pad = jnp.pad(sq, [(0, 0), (half, half)] + [(0, 0)] * (data.ndim - 2))
+    acc = jnp.zeros_like(data)
+    for i in range(nsize):
+        acc = acc + jax.lax.dynamic_slice_in_dim(pad, i, data.shape[1], 1)
+    norm = jnp.power(knorm + alpha / nsize * acc, beta)
+    return data / norm
+
+
+@register_op("Dropout", key_param="key", train_param="train")
+def dropout(data, *, p=0.5, mode="training", axes=(), cudnn_off=False,
+            key=None, train=False):
+    """Reference: src/operator/nn/dropout.cc (scaled Bernoulli mask)."""
+    if (not train and mode != "always") or p == 0:
+        return data
+    shape = list(data.shape)
+    if axes:
+        for a in axes:
+            shape[a] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(shape)).astype(data.dtype) \
+        / keep
+    return data * mask
+
+
+# -------------------------------------------------- output ops (custom vjp)
+# These ops have *loss-style* backward semantics decoupled from their
+# forward values (softmax_output.cc: grad = softmax - one_hot(label)).
+@jax.custom_vjp
+def _softmax_output(data, label, grad_scale, ignore_label, use_ignore,
+                    smooth_alpha, normalize):
+    return jax.nn.softmax(data, axis=-1)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
+                        smooth_alpha, normalize):
+    out = jax.nn.softmax(data, axis=-1)
+    return out, (out, label, grad_scale, ignore_label, use_ignore,
+                 smooth_alpha, normalize)
+
+
+def _softmax_output_bwd(res, g):
+    out, label, grad_scale, ignore_label, use_ignore, smooth_alpha, \
+        normalize = res
+    k = out.shape[-1]
+    oh = jax.nn.one_hot(label.astype(jnp.int32), k, dtype=out.dtype)
+    if smooth_alpha:
+        oh = oh * (1 - smooth_alpha) + smooth_alpha / (k - 1) * (1 - oh)
+    grad = out - oh
+    if use_ignore:
+        keep = (label != ignore_label).astype(out.dtype)
+        grad = grad * keep[..., None]
+    scale = grad_scale
+    if normalize:
+        scale = scale / out.shape[0]
+    return (grad * scale, None, None, None, None, None, None)
+
+
+_softmax_output.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
+@register_op("SoftmaxOutput", aliases=("Softmax",))
+def softmax_output(data, label, *, grad_scale=1.0, ignore_label=-1.0,
+                   multi_output=False, use_ignore=False, preserve_shape=False,
+                   normalization="null", smooth_alpha=0.0,
+                   out_grad=False):
+    if multi_output or data.ndim > 2:
+        # class axis 1: move to last for the shared impl
+        perm = (0,) + tuple(range(2, data.ndim)) + (1,)
+        inv = tuple(onp_argsort(perm))
+        out = _softmax_output(jnp.transpose(data, perm), label, grad_scale,
+                              ignore_label, use_ignore, smooth_alpha,
+                              normalization == "valid")
+        return jnp.transpose(out, inv)
+    return _softmax_output(data, label, grad_scale, ignore_label, use_ignore,
+                           smooth_alpha, normalization == "valid")
+
+
+def onp_argsort(perm):
+    import numpy as onp
+
+    return onp.argsort(perm)
+
+
+def _make_regression(transform, grad_fn, name):
+    """Regression output ops: forward transform, loss-style backward
+    ``grad_fn(pred, label) * grad_scale / batch`` (reference
+    src/operator/regression_output-inl.h)."""
+
+    @jax.custom_vjp
+    def _op(data, label, grad_scale):
+        return transform(data)
+
+    def _fwd(data, label, grad_scale):
+        out = transform(data)
+        return out, (out, label, grad_scale)
+
+    def _bwd(res, g):
+        out, label, grad_scale = res
+        batch = out.shape[0] if out.ndim else 1
+        return (grad_fn(out, label) * (grad_scale / batch), None, None)
+
+    _op.defvjp(_fwd, _bwd)
+
+    @register_op(name)
+    def _reg(data, label, *, grad_scale=1.0):
+        return _op(data, label.reshape(data.shape), grad_scale)
+
+    return _reg
+
+
+_make_regression(lambda x: x, lambda o, l: o - l, "LinearRegressionOutput")
+_make_regression(jax.nn.sigmoid, lambda o, l: o - l,
+                 "LogisticRegressionOutput")
+_make_regression(lambda x: x, lambda o, l: jnp.sign(o - l),
+                 "MAERegressionOutput")
+
+
+@register_op("CTCLoss", aliases=("ctc_loss", "_contrib_CTCLoss",
+                                "_contrib_ctc_loss"))
+def ctc_loss(data, label, data_lengths=None, label_lengths=None, *,
+             use_data_lengths=False, use_label_lengths=False,
+             blank_label="first"):
+    """Reference: src/operator/nn/ctc_loss.cc.  data: (T, N, C)."""
+    import optax
+
+    t, n, c = data.shape
+    logits = jnp.transpose(data, (1, 0, 2))  # (N, T, C)
+    if use_data_lengths and data_lengths is not None:
+        lp = jnp.arange(t)[None, :] >= data_lengths[:, None]
+    else:
+        lp = jnp.zeros((n, t), dtype=jnp.float32)
+    labels = label.astype(jnp.int32)
+    if use_label_lengths and label_lengths is not None:
+        pad = jnp.arange(labels.shape[1])[None, :] >= label_lengths[:, None]
+    else:
+        # reference padding convention (src/operator/nn/ctc_loss-inl.h:79):
+        # 'first' pads with 0 (labels are 1-based, blank=0); 'last' pads
+        # with -1 (labels 0-based, blank=c-1)
+        pad = labels == 0 if blank_label == "first" else labels < 0
+    if blank_label == "first":
+        # optax uses blank=0 as well; labels already 1-based w.r.t. blank
+        pass
+    else:
+        # blank is last (= c-1): rotate logits so blank becomes 0 and
+        # shift labels to 1-based
+        logits = jnp.concatenate([logits[..., -1:], logits[..., :-1]], -1)
+        labels = jnp.where(labels < 0, 0, labels + 1)
+    loss = optax.ctc_loss(logits, lp.astype(jnp.float32), labels,
+                          pad.astype(jnp.float32))
+    return loss
+
+
+@register_op("IdentityAttachKLSparseReg")
+def identity_attach_kl_sparse_reg(data, *, sparseness_target=0.1,
+                                  penalty=0.001, momentum=0.9):
+    return data

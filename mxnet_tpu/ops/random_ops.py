@@ -1,0 +1,137 @@
+"""Random sampling ops.
+
+Reference parity: src/operator/random/ (sample_op.cc: uniform/normal/gamma/
+exponential/poisson/negative_binomial/generalized_negative_binomial/randint,
+multinomial, shuffle) — SURVEY.md §2.3 `random/`.  TPU-native: JAX threaded
+PRNG; the dispatcher injects a fresh key per call (see ops/registry.py
+``key_param``), replacing the reference's per-device generator arrays
+(include/mxnet/random_generator.h).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..dtype import normalize_dtype
+from .registry import register_op
+
+
+def _dt(dtype):
+    return normalize_dtype(dtype if dtype not in (None, "None") else "float32")
+
+
+@register_op("_random_uniform", aliases=("random_uniform", "uniform"),
+             key_param="key", differentiable=False)
+def random_uniform(*, low=0.0, high=1.0, shape=(1,), dtype=None, ctx=None,
+                   key=None):
+    return jax.random.uniform(key, tuple(shape), _dt(dtype), low, high)
+
+
+@register_op("_random_normal", aliases=("random_normal", "normal"),
+             key_param="key", differentiable=False)
+def random_normal(*, loc=0.0, scale=1.0, shape=(1,), dtype=None, ctx=None,
+                  key=None):
+    return jax.random.normal(key, tuple(shape), _dt(dtype)) * scale + loc
+
+
+@register_op("_random_gamma", aliases=("random_gamma",), key_param="key",
+             differentiable=False)
+def random_gamma(*, alpha=1.0, beta=1.0, shape=(1,), dtype=None, ctx=None,
+                 key=None):
+    return jax.random.gamma(key, alpha, tuple(shape), _dt(dtype)) * beta
+
+
+@register_op("_random_exponential", aliases=("random_exponential",),
+             key_param="key", differentiable=False)
+def random_exponential(*, lam=1.0, shape=(1,), dtype=None, ctx=None,
+                       key=None):
+    return jax.random.exponential(key, tuple(shape), _dt(dtype)) / lam
+
+
+@register_op("_random_poisson", aliases=("random_poisson",), key_param="key",
+             differentiable=False)
+def random_poisson(*, lam=1.0, shape=(1,), dtype=None, ctx=None, key=None):
+    return jax.random.poisson(key, lam, tuple(shape)).astype(_dt(dtype))
+
+
+@register_op("_random_negative_binomial",
+             aliases=("random_negative_binomial",), key_param="key",
+             differentiable=False)
+def random_negative_binomial(*, k=1, p=1.0, shape=(1,), dtype=None, ctx=None,
+                             key=None):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k, tuple(shape)) * (1 - p) / p
+    return jax.random.poisson(k2, lam, tuple(shape)).astype(_dt(dtype))
+
+
+@register_op("_random_generalized_negative_binomial",
+             aliases=("random_generalized_negative_binomial",),
+             key_param="key", differentiable=False)
+def random_gen_neg_binomial(*, mu=1.0, alpha=1.0, shape=(1,), dtype=None,
+                            ctx=None, key=None):
+    k1, k2 = jax.random.split(key)
+    r = 1.0 / alpha
+    lam = jax.random.gamma(k1, r, tuple(shape)) * (mu * alpha)
+    return jax.random.poisson(k2, lam, tuple(shape)).astype(_dt(dtype))
+
+
+@register_op("_random_randint", aliases=("random_randint", "randint"),
+             key_param="key", differentiable=False)
+def random_randint(*, low=0, high=None, shape=(1,), dtype=None, ctx=None,
+                   key=None):
+    return jax.random.randint(key, tuple(shape), low, high,
+                              _dt(dtype or "int32"))
+
+
+@register_op("_sample_multinomial", aliases=("sample_multinomial",),
+             key_param="key", differentiable=False)
+def sample_multinomial(data, *, shape=(), get_prob=False, dtype="int32",
+                       key=None):
+    n = shape if isinstance(shape, int) else (shape[0] if shape else 1)
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    if data.ndim == 1:
+        out = jax.random.categorical(key, logits, shape=(n,))
+        if not shape:
+            out = out[0]
+    else:
+        out = jax.random.categorical(key, logits[None, :, :],
+                                     shape=(n, data.shape[0])).T
+        if not shape:
+            out = out[:, 0]
+    return out.astype(_dt(dtype))
+
+
+@register_op("_shuffle", aliases=("shuffle",), key_param="key",
+             differentiable=False)
+def shuffle(data, *, key=None):
+    return jax.random.permutation(key, data, axis=0)
+
+
+@register_op("sample_uniform", key_param="key", differentiable=False)
+def sample_uniform(low, high, *, shape=(), dtype=None, key=None):
+    s = tuple(low.shape) + (tuple(shape) if shape else ())
+    u = jax.random.uniform(key, s, _dt(dtype))
+    low_b = low.reshape(low.shape + (1,) * (len(s) - low.ndim))
+    high_b = high.reshape(high.shape + (1,) * (len(s) - high.ndim))
+    return low_b + u * (high_b - low_b)
+
+
+@register_op("sample_normal", key_param="key", differentiable=False)
+def sample_normal(mu, sigma, *, shape=(), dtype=None, key=None):
+    s = tuple(mu.shape) + (tuple(shape) if shape else ())
+    z = jax.random.normal(key, s, _dt(dtype))
+    mu_b = mu.reshape(mu.shape + (1,) * (len(s) - mu.ndim))
+    sig_b = sigma.reshape(sigma.shape + (1,) * (len(s) - sigma.ndim))
+    return mu_b + z * sig_b
+
+
+@register_op("_random_uniform_like", aliases=("uniform_like",),
+             key_param="key", differentiable=False)
+def uniform_like(data, *, low=0.0, high=1.0, key=None):
+    return jax.random.uniform(key, data.shape, data.dtype, low, high)
+
+
+@register_op("_random_normal_like", aliases=("normal_like",),
+             key_param="key", differentiable=False)
+def normal_like(data, *, loc=0.0, scale=1.0, key=None):
+    return jax.random.normal(key, data.shape, data.dtype) * scale + loc

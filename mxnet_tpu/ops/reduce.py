@@ -1,0 +1,95 @@
+"""Reductions and index reductions.
+
+Reference parity: src/operator/tensor/broadcast_reduce_op*.{h,cc,cu}
+(sum/mean/prod/nansum/nanprod/max/min/norm with axis/keepdims/exclude) and
+ordering ops argmax/argmin (SURVEY.md §2.3 `tensor/`).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _axes(axis, ndim, exclude=False):
+    if axis is None or axis == ():
+        return None  # reduce over everything
+    if isinstance(axis, int):
+        axis = (axis,)
+    ax = tuple(sorted(a % ndim for a in axis))
+    if exclude:
+        ax = tuple(i for i in range(ndim) if i not in ax)
+    return ax
+
+
+def _reduce(fn_name):
+    f = getattr(jnp, fn_name)
+
+    def op(x, *, axis=None, keepdims=False, exclude=False):
+        ax = _axes(axis, x.ndim, exclude)
+        return f(x, axis=ax, keepdims=keepdims)
+
+    return op
+
+
+for _n, _jn in [("sum", "sum"), ("mean", "mean"), ("prod", "prod"),
+                ("nansum", "nansum"), ("nanprod", "nanprod"),
+                ("max", "max"), ("min", "min")]:
+    register_op(_n, aliases=(f"{_n}_axis",))(_reduce(_jn))
+
+
+@register_op("norm")
+def norm(x, *, ord=2, axis=None, keepdims=False, out_dtype=None):
+    if isinstance(axis, int):
+        axis = (axis,)
+    if ord == 1:
+        r = jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims)
+    else:
+        r = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims))
+    if out_dtype is not None:
+        from ..dtype import normalize_dtype
+
+        r = r.astype(normalize_dtype(out_dtype))
+    return r
+
+
+def _index_reduce(f):
+    def op(x, *, axis=None, keepdims=False):
+        if axis is None:
+            return f(x.reshape(-1)).astype(jnp.float32)
+        r = f(x, axis=int(axis))
+        if keepdims:
+            r = jnp.expand_dims(r, int(axis))
+        return r.astype(jnp.float32)
+
+    return op
+
+
+register_op("argmax", differentiable=False)(_index_reduce(jnp.argmax))
+register_op("argmin", differentiable=False)(_index_reduce(jnp.argmin))
+
+
+@register_op("argmax_channel", differentiable=False)
+def argmax_channel(x):
+    return jnp.argmax(x, axis=1).astype(jnp.float32)
+
+
+@register_op("cumsum", aliases=("_np_cumsum",))
+def cumsum(x, *, axis=None, dtype=None):
+    from ..dtype import normalize_dtype
+
+    if dtype is not None:
+        x = x.astype(normalize_dtype(dtype))
+    if axis is None:
+        return jnp.cumsum(x.reshape(-1))
+    return jnp.cumsum(x, axis=axis)
+
+
+@register_op("moments", num_outputs=2)
+def moments(x, *, axes=None, keepdims=False):
+    """Reference: src/operator/nn/moments.cc."""
+    if isinstance(axes, int):
+        axes = (axes,)
+    mean = jnp.mean(x, axis=axes, keepdims=keepdims)
+    var = jnp.var(x, axis=axes, keepdims=keepdims)
+    return mean, var

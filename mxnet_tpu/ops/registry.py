@@ -1,0 +1,110 @@
+"""Declarative operator registry — the TPU-native analog of NNVM op registration.
+
+Reference parity: every reference op is an ``nnvm::Op`` with attribute maps
+(``NNVM_REGISTER_OP`` + FInferShape/FInferType/FCompute<cpu|gpu>/FGradient,
+see include/mxnet/op_attr_types.h:293 and SURVEY.md §2.3).  On TPU none of
+those attributes need to exist separately: an op is a *pure JAX-traceable
+function* — shape/dtype inference is jax.eval_shape, FCompute is the function
+itself (XLA compiles it for any backend), and FGradient is jax.vjp.
+
+The registry is consumed by:
+  * ``mxnet_tpu.ndarray`` — generates eager ``mx.nd.*`` wrappers
+    (reference: python/mxnet/ndarray/register.py:116 generated code);
+  * ``mxnet_tpu.symbol`` — generates graph-building ``mx.sym.*`` wrappers;
+  * the executor/CachedOp paths, which trace the same functions under jit.
+"""
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from typing import Callable, Optional
+
+from ..base import MXNetError
+
+__all__ = ["OpDef", "register_op", "get_op", "list_ops", "alias_op"]
+
+_OPS: dict[str, "OpDef"] = {}
+
+
+@dataclasses.dataclass
+class OpDef:
+    """One operator.
+
+    fn: pure function (jax arrays in, jax array or tuple out); keyword
+        arguments are the op's hyper-parameters (reference: dmlc::Parameter
+        structs).
+    num_outputs: static output count, or a callable(params)->int for ops
+        whose arity depends on hyper-params (e.g. split, BatchNorm).
+    differentiable: False for ops with no meaningful gradient (argmax, ...);
+        autograd will treat their outputs as constants.
+    key_param: name of an implicit PRNG-key parameter; the dispatcher
+        injects a fresh key (random ops, Dropout).
+    """
+
+    name: str
+    fn: Callable
+    num_outputs: object = 1
+    differentiable: bool = True
+    key_param: Optional[str] = None
+    train_param: Optional[str] = None  # injected with autograd.is_training()
+    doc: str = ""
+
+    def out_count(self, params) -> int:
+        if callable(self.num_outputs):
+            return self.num_outputs(params)
+        return self.num_outputs
+
+    @property
+    def param_names(self):
+        sig = inspect.signature(self.fn)
+        return [
+            p.name
+            for p in sig.parameters.values()
+            if p.kind is inspect.Parameter.KEYWORD_ONLY
+        ]
+
+
+def register_op(name=None, *, aliases=(), num_outputs=1, differentiable=True,
+                key_param=None, train_param=None):
+    """Decorator: register a pure function as an operator.
+
+    Positional (or *args) parameters are tensor inputs; keyword-only
+    parameters are hyper-parameters.
+    """
+
+    def _do(fn):
+        opname = name or fn.__name__
+        op = OpDef(
+            name=opname,
+            fn=fn,
+            num_outputs=num_outputs,
+            differentiable=differentiable,
+            key_param=key_param,
+            train_param=train_param,
+            doc=fn.__doc__ or "",
+        )
+        if opname in _OPS:
+            raise MXNetError(f"duplicate op registration: {opname}")
+        _OPS[opname] = op
+        for a in aliases:
+            _OPS[a] = op
+        return fn
+
+    return _do
+
+
+def alias_op(existing: str, *aliases: str):
+    op = get_op(existing)
+    for a in aliases:
+        _OPS[a] = op
+
+
+def get_op(name: str) -> OpDef:
+    try:
+        return _OPS[name]
+    except KeyError:
+        raise MXNetError(f"operator '{name}' not registered") from None
+
+
+def list_ops():
+    return sorted(_OPS)

@@ -1,0 +1,145 @@
+"""Fused multi-layer RNN op (LSTM / GRU / vanilla RNN).
+
+Reference parity: src/operator/rnn-inl.h:414 (``RNNOp``) — the reference
+dispatches to cuDNN (CUDNN_LSTM etc., rnn-inl.h:444-476) with a packed flat
+parameter vector; CPU fallback in rnn_impl.h.  TPU-native redesign: one
+``lax.scan`` per (layer, direction) — scan keeps the time loop inside the
+compiled program (no per-step dispatch), and each step is a fused
+(batch, 4H) matmul on the MXU.
+
+Weight packing follows the reference/cuDNN convention so checkpoints can be
+transliterated: for each layer, for each direction: W_i2h (G*H, in),
+W_h2h (G*H, H); then for each layer/direction: b_i2h (G*H), b_h2h (G*H).
+Gate order: LSTM [i, f, g, o]; GRU [r, z, n].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def unpack_rnn_params(params, mode, num_layers, input_size, state_size,
+                      bidirectional=False):
+    """Split the flat parameter vector into per-layer weight/bias arrays."""
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    h = state_size
+    ws, bs = [], []
+    off = 0
+    for layer in range(num_layers):
+        ins = input_size if layer == 0 else h * d
+        for _ in range(d):
+            w_i2h = params[off:off + g * h * ins].reshape(g * h, ins)
+            off += g * h * ins
+            w_h2h = params[off:off + g * h * h].reshape(g * h, h)
+            off += g * h * h
+            ws.append((w_i2h, w_h2h))
+    for layer in range(num_layers):
+        for _ in range(d):
+            b_i2h = params[off:off + g * h]
+            off += g * h
+            b_h2h = params[off:off + g * h]
+            off += g * h
+            bs.append((b_i2h, b_h2h))
+    return ws, bs
+
+
+def rnn_param_size(mode, num_layers, input_size, state_size,
+                   bidirectional=False):
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    h = state_size
+    size = 0
+    for layer in range(num_layers):
+        ins = input_size if layer == 0 else h * d
+        size += d * (g * h * ins + g * h * h + 2 * g * h)
+    return size
+
+
+def _cell_step(mode, w_i2h, w_h2h, b_i2h, b_h2h, x, h_prev, c_prev):
+    gi = jnp.dot(x, w_i2h.T) + b_i2h
+    gh = jnp.dot(h_prev, w_h2h.T) + b_h2h
+    hsz = w_h2h.shape[1]
+    if mode == "lstm":
+        z = gi + gh
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c = f * c_prev + i * g
+        return o * jnp.tanh(c), c
+    if mode == "gru":
+        ri, zi, ni = jnp.split(gi, 3, axis=-1)
+        rh, zh, nh = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ri + rh)
+        z = jax.nn.sigmoid(zi + zh)
+        n = jnp.tanh(ni + r * nh)
+        return (1 - z) * n + z * h_prev, c_prev
+    act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
+    return act(gi + gh), c_prev
+
+
+def _run_layer(mode, wb, x, h0, c0, reverse=False):
+    (w_i2h, w_h2h), (b_i2h, b_h2h) = wb
+
+    def step(carry, xt):
+        h_prev, c_prev = carry
+        h, c = _cell_step(mode, w_i2h, w_h2h, b_i2h, b_h2h, xt, h_prev,
+                          c_prev)
+        return (h, c), h
+
+    (hT, cT), ys = jax.lax.scan(step, (h0, c0), x, reverse=reverse)
+    return ys, hT, cT
+
+
+def _rnn_nout(p):
+    n = 1
+    if p.get("state_outputs", False):
+        n += 2 if p.get("mode", "lstm") == "lstm" else 1
+    return n
+
+
+@register_op("RNN", num_outputs=_rnn_nout, key_param="key",
+             train_param="train")
+def rnn(data, parameters, state, state_cell=None, *, state_size, num_layers,
+        mode="lstm", bidirectional=False, p=0.0, state_outputs=False,
+        projection_size=None, lstm_state_clip_min=None,
+        lstm_state_clip_max=None, lstm_state_clip_nan=False,
+        use_sequence_length=False, key=None, train=False):
+    """data: (T, N, I); state: (L*dir, N, H). Returns output (T, N, H*dir)
+    [+ final h [+ final c for lstm] when state_outputs]."""
+    t, n, input_size = data.shape
+    d = 2 if bidirectional else 1
+    ws, bs = unpack_rnn_params(parameters, mode, num_layers, input_size,
+                               state_size, bidirectional)
+    x = data
+    h_fin, c_fin = [], []
+    for layer in range(num_layers):
+        outs = []
+        for direction in range(d):
+            idx = layer * d + direction
+            h0 = state[idx]
+            c0 = state_cell[idx] if (mode == "lstm" and state_cell is not None) \
+                else jnp.zeros_like(h0)
+            ys, hT, cT = _run_layer(mode, (ws[idx], bs[idx]), x, h0, c0,
+                                    reverse=(direction == 1))
+            outs.append(ys)
+            h_fin.append(hT)
+            c_fin.append(cT)
+        x = outs[0] if d == 1 else jnp.concatenate(outs, axis=-1)
+        if train and p > 0 and layer < num_layers - 1 and key is not None:
+            sub = jax.random.fold_in(key, layer)
+            mask = jax.random.bernoulli(sub, 1 - p, x.shape)
+            x = jnp.where(mask, x / (1 - p), 0).astype(x.dtype)
+        if mode == "lstm" and lstm_state_clip_min is not None:
+            c_fin = [jnp.clip(c, lstm_state_clip_min, lstm_state_clip_max)
+                     for c in c_fin]
+    if not state_outputs:
+        return x
+    hs = jnp.stack(h_fin)
+    if mode == "lstm":
+        return x, hs, jnp.stack(c_fin)
+    return x, hs

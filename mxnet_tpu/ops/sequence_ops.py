@@ -1,0 +1,56 @@
+"""Sequence ops: SequenceMask / SequenceLast / SequenceReverse.
+
+Reference parity: src/operator/sequence_mask.cc, sequence_last.cc,
+sequence_reverse.cc (SURVEY.md §2.3 "Sequence & misc").  Layout is the
+reference's: time-major (T, N, ...) with optional per-batch lengths.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _len_mask(x, seq_len):
+    t = x.shape[0]
+    pos = jnp.arange(t)[:, None]
+    return pos < seq_len[None, :].astype(jnp.int32)
+
+
+@register_op("SequenceMask")
+def sequence_mask(data, sequence_length=None, *, use_sequence_length=False,
+                  value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    x = jnp.swapaxes(data, 0, axis) if axis != 0 else data
+    m = _len_mask(x, sequence_length)
+    m = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    out = jnp.where(m, x, jnp.asarray(value, x.dtype))
+    return jnp.swapaxes(out, 0, axis) if axis != 0 else out
+
+
+@register_op("SequenceLast")
+def sequence_last(data, sequence_length=None, *, use_sequence_length=False,
+                  axis=0):
+    x = jnp.swapaxes(data, 0, axis) if axis != 0 else data
+    if not use_sequence_length or sequence_length is None:
+        return x[-1]
+    idx = (sequence_length.astype(jnp.int32) - 1)
+    idx = idx.reshape((1, -1) + (1,) * (x.ndim - 2))
+    idx = jnp.broadcast_to(idx, (1,) + x.shape[1:])
+    return jnp.take_along_axis(x, idx, axis=0)[0]
+
+
+@register_op("SequenceReverse")
+def sequence_reverse(data, sequence_length=None, *, use_sequence_length=False,
+                     axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    t = data.shape[0]
+    lens = sequence_length.astype(jnp.int32)
+    pos = jnp.arange(t)[:, None]
+    # within-length positions are mirrored, the rest stay in place
+    rev = jnp.where(pos < lens[None, :], lens[None, :] - 1 - pos, pos)
+    rev = rev.reshape(rev.shape + (1,) * (data.ndim - 2))
+    rev = jnp.broadcast_to(rev, data.shape)
+    return jnp.take_along_axis(data, rev, axis=0)

@@ -1,0 +1,337 @@
+"""Shape manipulation, indexing, gather/scatter, and matmul family.
+
+Reference parity: src/operator/tensor/matrix_op*.{cc,cu} (reshape/transpose/
+slice/take/tile/repeat/pad/...), dot (src/operator/tensor/dot-inl.h),
+indexing ops (gather_nd/scatter_nd), Embedding
+(src/operator/tensor/indexing_op.cc) — SURVEY.md §2.3 `tensor/`.
+Dense matmuls route to the MXU via jnp.dot/einsum; XLA tiles them.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .registry import register_op
+
+
+@register_op("Reshape", aliases=("reshape",))
+def reshape(x, *, shape=None, reverse=False):
+    """Supports the reference's special codes 0 / -1 / -2 / -3 / -4 and
+    reverse=True right-to-left matching (matrix_op.cc Reshape docs)."""
+    if shape is None:
+        return x
+    if reverse:
+        # reference algorithm (matrix_op-inl.h InferReshapeShape:96-165):
+        # reverse dims and spec, run the same left-to-right resolution,
+        # reverse the result — e.g. (10,5,4) with (-1,0) -> (50,4)
+        tgt = _resolve_reshape_spec(list(x.shape)[::-1],
+                                    list(shape)[::-1])[::-1]
+        return jnp.reshape(x, tuple(tgt))
+    tgt = _resolve_reshape_spec(list(x.shape), list(shape))
+    return jnp.reshape(x, tuple(tgt))
+
+
+def _resolve_reshape_spec(src, shape):
+    out = []
+    i = 0  # index into src
+    j = 0
+    while j < len(shape):
+        d = shape[j]
+        if d == 0:
+            out.append(src[i]); i += 1
+        elif d == -1:
+            out.append(-1); i += 1
+        elif d == -2:
+            out.extend(src[i:]); i = len(src)
+        elif d == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif d == -4:
+            a, b = shape[j + 1], shape[j + 2]
+            if a == -1:
+                a = src[i] // b
+            if b == -1:
+                b = src[i] // a
+            out.extend([a, b]); i += 1; j += 2
+        else:
+            out.append(d); i += 1
+        j += 1
+    return out  # a -1 entry is resolved by jnp.reshape
+
+
+@register_op("reshape_like")
+def reshape_like(x, y):
+    return jnp.reshape(x, y.shape)
+
+
+@register_op("Flatten", aliases=("flatten",))
+def flatten(x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register_op("transpose")
+def transpose(x, *, axes=None):
+    if axes is None or axes == ():
+        axes = tuple(reversed(range(x.ndim)))
+    return jnp.transpose(x, axes)
+
+
+@register_op("expand_dims")
+def expand_dims(x, *, axis):
+    return jnp.expand_dims(x, axis)
+
+
+@register_op("squeeze")
+def squeeze(x, *, axis=None):
+    return jnp.squeeze(x, axis)
+
+
+@register_op("swapaxes", aliases=("SwapAxis",))
+def swapaxes(x, *, dim1=0, dim2=0):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+@register_op("flip", aliases=("reverse",))
+def flip(x, *, axis):
+    return jnp.flip(x, axis)
+
+
+@register_op("tile")
+def tile(x, *, reps):
+    return jnp.tile(x, reps)
+
+
+@register_op("repeat")
+def repeat(x, *, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register_op("Pad", aliases=("pad",))
+def pad(x, *, mode="constant", pad_width=None, constant_value=0.0):
+    """Reference: src/operator/pad.cc — pad_width is 2*ndim flat list."""
+    pw = [(int(pad_width[2 * i]), int(pad_width[2 * i + 1]))
+          for i in range(x.ndim)]
+    if mode == "constant":
+        return jnp.pad(x, pw, constant_values=constant_value)
+    return jnp.pad(x, pw, mode={"edge": "edge", "reflect": "reflect"}[mode])
+
+
+@register_op("broadcast_to")
+def broadcast_to(x, *, shape):
+    shape = tuple(s if s != 0 else x.shape[i] for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+@register_op("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(x, *, axis=None, size=None):
+    if isinstance(axis, int):
+        axis, size = (axis,), (size,)
+    tgt = list(x.shape)
+    for a, s in zip(axis, size):
+        tgt[a] = s
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+@register_op("broadcast_like")
+def broadcast_like(x, y, *, lhs_axes=None, rhs_axes=None):
+    if lhs_axes is None:
+        return jnp.broadcast_to(x, y.shape)
+    tgt = list(x.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        tgt[la] = y.shape[ra]
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+@register_op("slice", aliases=("crop",))
+def slice_op(x, *, begin, end, step=None):
+    idx = []
+    step = step or [None] * len(begin)
+    for b, e, s in zip(begin, end, step):
+        idx.append(builtins_slice(b, e, s))
+    return x[tuple(idx)]
+
+
+def builtins_slice(b, e, s):
+    return slice(b, e, s)
+
+
+@register_op("slice_axis")
+def slice_axis(x, *, axis, begin, end):
+    idx = [slice(None)] * x.ndim
+    if end is not None and end < 0:
+        end = x.shape[axis] + end
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register_op("slice_like")
+def slice_like(x, y, *, axes=()):
+    axes = axes or tuple(range(min(x.ndim, y.ndim)))
+    idx = [slice(None)] * x.ndim
+    for a in axes:
+        idx[a] = slice(0, y.shape[a])
+    return x[tuple(idx)]
+
+
+@register_op("take")
+def take(x, indices, *, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, x.shape[axis])
+        mode = "clip"
+    return jnp.take(x, idx, axis=axis, mode="clip")
+
+
+@register_op("batch_take")
+def batch_take(x, indices):
+    return jnp.take_along_axis(
+        x, indices.astype(jnp.int32)[:, None], axis=1
+    )[:, 0]
+
+
+@register_op("pick")
+def pick(x, indices, *, axis=-1, keepdims=False, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    idxe = jnp.expand_dims(idx, axis if axis >= 0 else x.ndim + axis)
+    r = jnp.take_along_axis(x, idxe, axis=axis)
+    if not keepdims:
+        r = jnp.squeeze(r, axis=axis)
+    return r
+
+
+@register_op("gather_nd")
+def gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register_op("scatter_nd")
+def scatter_nd(data, indices, *, shape):
+    idx = tuple(indices.astype(jnp.int32))
+    out = jnp.zeros(tuple(shape), data.dtype)
+    return out.at[idx].add(data)
+
+
+@register_op("one_hot", differentiable=False)
+def one_hot(indices, *, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    from ..dtype import normalize_dtype
+
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth)
+    return (oh * (on_value - off_value) + off_value).astype(
+        normalize_dtype(dtype))
+
+
+@register_op("Embedding")
+def embedding(data, weight, *, input_dim=None, output_dim=None, dtype=None,
+              sparse_grad=False):
+    """Reference: src/operator/tensor/indexing_op.cc Embedding."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register_op("Concat", aliases=("concat",))
+def concat_op(*args, dim=1, num_args=None):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register_op("rnn_param_concat")
+def rnn_param_concat(*args, dim=0, num_args=None):
+    return jnp.concatenate([a.reshape(-1) for a in args], axis=0)
+
+
+@register_op("stack")
+def stack_op(*args, axis=0, num_args=None):
+    return jnp.stack(args, axis=axis)
+
+
+def _split_count(p):
+    return int(p.get("num_outputs", 1))
+
+
+@register_op("SliceChannel", aliases=("split",), num_outputs=_split_count)
+def slice_channel(x, *, num_outputs, axis=1, squeeze_axis=False):
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if num_outputs > 1 else parts[0]
+
+
+@register_op("split_v2", num_outputs=lambda p: p["_num"])
+def split_v2(x, *, indices, axis=0, squeeze_axis=False, _num=None):
+    parts = jnp.split(x, list(indices), axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register_op("depth_to_space")
+def depth_to_space(x, *, block_size):
+    n, c, h, w = x.shape
+    b = block_size
+    y = x.reshape(n, b, b, c // (b * b), h, w)
+    y = y.transpose(0, 3, 4, 1, 5, 2)
+    return y.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register_op("space_to_depth")
+def space_to_depth(x, *, block_size):
+    n, c, h, w = x.shape
+    b = block_size
+    y = x.reshape(n, c, h // b, b, w // b, b)
+    y = y.transpose(0, 3, 5, 1, 2, 4)
+    return y.reshape(n, c * b * b, h // b, w // b)
+
+
+@register_op("diag")
+def diag(x, *, k=0, axis1=0, axis2=1):
+    if x.ndim == 1:
+        return jnp.diag(x, k)
+    return jnp.diagonal(x, offset=k, axis1=axis1, axis2=axis2)
+
+
+@register_op("shape_array", differentiable=False)
+def shape_array(x):
+    return jnp.asarray(x.shape, dtype=jnp.int64)
+
+
+@register_op("size_array", differentiable=False)
+def size_array(x):
+    return jnp.asarray([x.size], dtype=jnp.int64)
+
+
+# ------------------------------------------------------------- matmul
+@register_op("dot")
+def dot(lhs, rhs, *, transpose_a=False, transpose_b=False,
+        forward_stype=None):
+    """Reference semantics (tensor/dot-inl.h): contract last axis of lhs
+    with first axis of rhs; transpose flags reverse all axes first."""
+    if transpose_a:
+        lhs = jnp.transpose(lhs)
+    if transpose_b:
+        rhs = jnp.transpose(rhs)
+    if lhs.ndim == 1 and rhs.ndim == 1:
+        return jnp.dot(lhs, rhs)
+    return jnp.tensordot(lhs, rhs, axes=1)
+
+
+@register_op("batch_dot")
+def batch_dot(lhs, rhs, *, transpose_a=False, transpose_b=False,
+              forward_stype=None):
+    if transpose_a:
+        lhs = jnp.swapaxes(lhs, -1, -2)
+    if transpose_b:
+        rhs = jnp.swapaxes(rhs, -1, -2)
+    return jnp.matmul(lhs, rhs)
+
+
+@register_op("_npi_matmul", aliases=("matmul",))
+def matmul(a, b):
+    return jnp.matmul(a, b)
+
+
+@register_op("khatri_rao")
+def khatri_rao(*args):
+    out = args[0]
+    for m in args[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, m).reshape(
+            out.shape[0] * m.shape[0], *out.shape[1:])
+    return out

@@ -1,0 +1,63 @@
+"""Ordering ops: sort / argsort / topk.
+
+Reference parity: src/operator/tensor/ordering_op.cc (SURVEY.md §2.3).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("sort")
+def sort(x, *, axis=-1, is_ascend=True):
+    r = jnp.sort(x, axis=axis)
+    if not is_ascend:
+        r = jnp.flip(r, axis=axis if axis is not None else 0)
+    return r
+
+
+@register_op("argsort", differentiable=False)
+def argsort(x, *, axis=-1, is_ascend=True, dtype="float32"):
+    from ..dtype import normalize_dtype
+
+    r = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        r = jnp.flip(r, axis=axis if axis is not None else 0)
+    return r.astype(normalize_dtype(dtype))
+
+
+def _topk_nout(p):
+    rt = p.get("ret_typ", "indices")
+    return 2 if rt == "both" else 1
+
+
+@register_op("topk", num_outputs=_topk_nout, differentiable=False)
+def topk(x, *, axis=-1, k=1, ret_typ="indices", is_ascend=False,
+         dtype="float32"):
+    """Reference: ordering_op.cc TopK; uses lax.top_k on the MXU-friendly
+    last axis, transposing as needed."""
+    from ..dtype import normalize_dtype
+
+    dt = normalize_dtype(dtype)
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    ax = axis % x.ndim
+    xt = jnp.moveaxis(x, ax, -1)
+    if is_ascend:
+        vals, idxs = jax.lax.top_k(-xt, k)
+        vals = -vals
+    else:
+        vals, idxs = jax.lax.top_k(xt, k)
+    vals = jnp.moveaxis(vals, -1, ax)
+    idxs = jnp.moveaxis(idxs, -1, ax)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "mask":
+        oh = jax.nn.one_hot(idxs, xt.shape[-1], dtype=x.dtype).sum(-2)
+        return jnp.moveaxis(oh, -1, ax)
+    if ret_typ == "both":
+        return vals, idxs.astype(dt)
+    return idxs.astype(dt)

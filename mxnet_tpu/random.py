@@ -1,0 +1,23 @@
+"""``mx.random`` — global seeding + module-level samplers.
+
+Reference parity: python/mxnet/random.py (seed routed to per-device
+generators via MXRandomSeedContext); here a single JAX key chain (_rng.py).
+"""
+from __future__ import annotations
+
+from ._rng import seed  # noqa: F401
+from .ndarray.random import (  # noqa: F401
+    exponential,
+    gamma,
+    generalized_negative_binomial,
+    multinomial,
+    negative_binomial,
+    normal,
+    normal_like,
+    poisson,
+    randint,
+    randn,
+    shuffle,
+    uniform,
+    uniform_like,
+)

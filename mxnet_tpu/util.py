@@ -1,0 +1,88 @@
+"""np-semantics switches and misc utilities.
+
+Reference parity: python/mxnet/util.py (set_np/use_np decorators switching
+numpy-shape/array semantics, imperative.h:114 ``Imperative::is_np_shape``).
+In this build the numpy namespace (mx.np) is always numpy-semantic; the
+flags exist for API compatibility and gate only zero-dim shape handling.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+
+
+class _NpState(threading.local):
+    def __init__(self):
+        self.shape = False
+        self.array = False
+
+
+_NP = _NpState()
+
+
+def set_np(shape=True, array=True):
+    _NP.shape, _NP.array = shape, array
+
+
+def reset_np():
+    set_np(False, False)
+
+
+def is_np_shape():
+    return _NP.shape
+
+
+def is_np_array():
+    return _NP.array
+
+
+class np_shape:
+    def __init__(self, active=True):
+        self.active = active
+
+    def __enter__(self):
+        self.prev = _NP.shape
+        _NP.shape = self.active
+        return self
+
+    def __exit__(self, *exc):
+        _NP.shape = self.prev
+
+
+class np_array:
+    def __init__(self, active=True):
+        self.active = active
+
+    def __enter__(self):
+        self.prev = _NP.array
+        _NP.array = self.active
+        return self
+
+    def __exit__(self, *exc):
+        _NP.array = self.prev
+
+
+def use_np(func):
+    """Decorator: run `func` under np semantics (reference util.py use_np)."""
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with np_shape(True), np_array(True):
+            return func(*args, **kwargs)
+
+    return wrapper
+
+
+def use_np_shape(func):
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with np_shape(True):
+            return func(*args, **kwargs)
+
+    return wrapper
+
+
+def get_gpu_count():
+    from .context import num_gpus
+
+    return num_gpus()

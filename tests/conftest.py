@@ -1,0 +1,28 @@
+"""Test config: force an 8-device virtual CPU mesh before JAX initializes.
+
+Mirrors the reference's strategy of testing distributed semantics with
+multi-process local jobs (SURVEY.md §4: ci runs `launch.py -n 7 --launcher
+local dist_sync_kvstore.py`); here multi-chip semantics are tested on
+XLA's forced host-platform device count.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as onp
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    import mxnet_tpu as mx
+
+    mx.random.seed(0)
+    onp.random.seed(0)
+    yield

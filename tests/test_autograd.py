@@ -1,0 +1,155 @@
+"""Autograd tape (reference: tests/python/unittest/test_autograd.py)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_chain_and_broadcast():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x) + x * 2
+        z = y.sum()
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                onp.exp(x.asnumpy()) + 2, rtol=1e-5)
+
+
+def test_grad_accumulation_within_pass():
+    # x used twice: contributions must sum
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + x * 3
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [7.0])
+
+
+def test_write_overwrites_between_passes():
+    x = nd.array([2.0])
+    x.attach_grad()
+    for _ in range(2):
+        with autograd.record():
+            y = x * x
+        y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [4.0])  # not 8
+
+
+def test_grad_req_add():
+    x = nd.array([2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(2):
+        with autograd.record():
+            y = x * x
+        y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [8.0])
+
+
+def test_head_grads():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+    y.backward(out_grad=nd.array([10.0, 100.0]))
+    onp.testing.assert_allclose(x.grad.asnumpy(), [20, 200])
+
+
+def test_detach_blocks_grad():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).detach() * x
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [9.0])
+
+
+def test_block_grad_op():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.BlockGrad(x * x) * x
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [9.0])
+
+
+def test_multi_output_op_grad():
+    x = nd.array([[1.0, 2.0, 3.0]])
+    x.attach_grad()
+    with autograd.record():
+        parts = nd.SliceChannel(x, num_outputs=3, axis=1)
+        y = parts[0] * 1 + parts[2] * 5
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [[1, 0, 5]])
+
+
+def test_training_flags():
+    assert not autograd.is_training()
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_training()
+        assert autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.pause():
+        assert not autograd.is_recording()
+
+
+def test_grad_function_api():
+    x = nd.array([2.0, 3.0])
+    with autograd.record():
+        # mark via attach_grad then use functional grad
+        x.attach_grad()
+        y = (x ** 3).sum()
+    g = autograd.grad(y, x, retain_graph=True)
+    onp.testing.assert_allclose(g.asnumpy(), 3 * x.asnumpy() ** 2)
+
+
+def test_mark_variables():
+    x = nd.array([1.0, 4.0])
+    g = nd.zeros((2,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = nd.sqrt(x).sum()
+    y.backward()
+    onp.testing.assert_allclose(g.asnumpy(), 0.5 / onp.sqrt(x.asnumpy()))
+
+
+def test_fc_backward_matches_manual():
+    onp.random.seed(0)
+    xx = onp.random.rand(4, 5).astype("float32")
+    ww = onp.random.rand(3, 5).astype("float32")
+    bb = onp.random.rand(3).astype("float32")
+    x, w, b = nd.array(xx), nd.array(ww), nd.array(bb)
+    for v in (x, w, b):
+        v.attach_grad()
+    with autograd.record():
+        y = nd.FullyConnected(x, w, b, num_hidden=3)
+        loss = (y * y).sum()
+    loss.backward()
+    gy = 2 * (xx @ ww.T + bb)
+    onp.testing.assert_allclose(x.grad.asnumpy(), gy @ ww, rtol=1e-4)
+    onp.testing.assert_allclose(w.grad.asnumpy(), gy.T @ xx, rtol=1e-4)
+    onp.testing.assert_allclose(b.grad.asnumpy(), gy.sum(0), rtol=1e-4)
+
+
+def test_softmax_output_backward():
+    data = nd.array(onp.random.rand(4, 3).astype("float32"))
+    label = nd.array([0, 1, 2, 1])
+    data.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(data, label)
+    out.backward()
+    sm = onp.exp(data.asnumpy())
+    sm /= sm.sum(1, keepdims=True)
+    oh = onp.eye(3)[label.asnumpy().astype(int)]
+    onp.testing.assert_allclose(data.grad.asnumpy(), sm - oh, rtol=1e-4)

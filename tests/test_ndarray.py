@@ -1,0 +1,165 @@
+"""NDArray basics (reference: tests/python/unittest/test_ndarray.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_create_and_asnumpy():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == onp.float32
+    onp.testing.assert_allclose(a.asnumpy(), [[1, 2], [3, 4]])
+
+
+def test_creation_helpers():
+    assert nd.zeros((2, 3)).asnumpy().sum() == 0
+    assert nd.ones((2, 3)).asnumpy().sum() == 6
+    onp.testing.assert_allclose(nd.full((2,), 7).asnumpy(), [7, 7])
+    onp.testing.assert_allclose(nd.arange(0, 6, 2).asnumpy(), [0, 2, 4])
+    e = nd.eye(3).asnumpy()
+    onp.testing.assert_allclose(e, onp.eye(3))
+
+
+def test_arithmetic():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([4.0, 5.0, 6.0])
+    onp.testing.assert_allclose((a + b).asnumpy(), [5, 7, 9])
+    onp.testing.assert_allclose((a - b).asnumpy(), [-3, -3, -3])
+    onp.testing.assert_allclose((a * b).asnumpy(), [4, 10, 18])
+    onp.testing.assert_allclose((b / a).asnumpy(), [4, 2.5, 2])
+    onp.testing.assert_allclose((a + 1).asnumpy(), [2, 3, 4])
+    onp.testing.assert_allclose((1 - a).asnumpy(), [0, -1, -2])
+    onp.testing.assert_allclose((a ** 2).asnumpy(), [1, 4, 9])
+    onp.testing.assert_allclose((-a).asnumpy(), [-1, -2, -3])
+
+
+def test_inplace_ops():
+    a = nd.ones((3,))
+    a += 2
+    onp.testing.assert_allclose(a.asnumpy(), [3, 3, 3])
+    a *= 2
+    onp.testing.assert_allclose(a.asnumpy(), [6, 6, 6])
+
+
+def test_indexing():
+    a = nd.array(onp.arange(12).reshape(3, 4))
+    onp.testing.assert_allclose(a[1].asnumpy(), [4, 5, 6, 7])
+    onp.testing.assert_allclose(a[1:3, 0].asnumpy(), [4, 8])
+    a[0, 0] = 99
+    assert a.asnumpy()[0, 0] == 99
+    a[:] = 0
+    assert a.asnumpy().sum() == 0
+
+
+def test_reshape_special_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((6, 4)).shape == (6, 4)
+
+
+def test_reductions():
+    a = nd.array(onp.arange(6).reshape(2, 3).astype("float32"))
+    assert a.sum().asscalar() == 15
+    onp.testing.assert_allclose(a.sum(axis=0).asnumpy(), [3, 5, 7])
+    onp.testing.assert_allclose(a.mean(axis=1).asnumpy(), [1, 4])
+    assert a.max().asscalar() == 5
+    assert a.argmax(axis=1).asnumpy().tolist() == [2, 2]
+    # exclude semantics
+    r = nd.sum(a, axis=0, exclude=True)
+    onp.testing.assert_allclose(r.asnumpy(), [3, 12])
+
+
+def test_dot():
+    a = nd.array(onp.random.rand(3, 4))
+    b = nd.array(onp.random.rand(4, 5))
+    onp.testing.assert_allclose(
+        nd.dot(a, b).asnumpy(), a.asnumpy() @ b.asnumpy(), rtol=1e-5
+    )
+    onp.testing.assert_allclose(
+        nd.dot(a, b.T, transpose_b=True).asnumpy(),
+        a.asnumpy() @ b.asnumpy(), rtol=1e-5,
+    )
+
+
+def test_concat_split_stack():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = nd.split(c, 2, axis=0)
+    assert parts[0].shape == (2, 3)
+
+
+def test_astype_copy_context():
+    a = nd.ones((2, 2))
+    b = a.astype("float16")
+    assert b.dtype == onp.float16
+    c = a.copyto(mx.cpu())
+    onp.testing.assert_allclose(c.asnumpy(), a.asnumpy())
+    d = a.as_in_context(mx.cpu())
+    assert d.context.device_type in ("cpu",)
+
+
+def test_save_load_roundtrip(tmp_path):
+    f = str(tmp_path / "test.params")
+    d = {"a": nd.array([1.0, 2.0]), "b": nd.ones((2, 3), dtype="int32")}
+    nd.save(f, d)
+    loaded = nd.load(f)
+    assert set(loaded) == {"a", "b"}
+    onp.testing.assert_allclose(loaded["a"].asnumpy(), [1, 2])
+    assert loaded["b"].dtype == onp.int32
+    # list form
+    nd.save(f, [nd.zeros((2,))])
+    arrays = nd.load(f)
+    assert isinstance(arrays, list) and arrays[0].shape == (2,)
+
+
+def test_take_pick_onehot():
+    a = nd.array(onp.arange(12).reshape(3, 4).astype("float32"))
+    idx = nd.array([0, 2], dtype="int32")
+    onp.testing.assert_allclose(nd.take(a, idx).asnumpy(),
+                                [[0, 1, 2, 3], [8, 9, 10, 11]])
+    p = nd.pick(a, nd.array([1, 0, 3]), axis=1)
+    onp.testing.assert_allclose(p.asnumpy(), [1, 4, 11])
+    oh = nd.one_hot(nd.array([0, 2]), 3)
+    onp.testing.assert_allclose(oh.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+def test_broadcast_ops():
+    a = nd.ones((2, 1, 3))
+    b = nd.ones((1, 4, 3))
+    assert (a + b).shape == (2, 4, 3)
+    assert nd.broadcast_to(nd.ones((1, 3)), shape=(2, 3)).shape == (2, 3)
+
+
+def test_topk_sort():
+    a = nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    t = nd.topk(a, k=2, ret_typ="value")
+    onp.testing.assert_allclose(t.asnumpy(), [[3, 2], [5, 4]])
+    s = nd.sort(a, axis=1)
+    onp.testing.assert_allclose(s.asnumpy(), [[1, 2, 3], [0, 4, 5]])
+
+
+def test_random_ops_shapes():
+    mx.random.seed(42)
+    u = nd.random.uniform(0, 1, shape=(3, 4))
+    assert u.shape == (3, 4)
+    assert ((u.asnumpy() >= 0) & (u.asnumpy() < 1)).all()
+    n1 = nd.random.normal(0, 1, shape=(100,)).asnumpy()
+    mx.random.seed(42)
+    u2 = nd.random.uniform(0, 1, shape=(3, 4))
+    onp.testing.assert_allclose(u.asnumpy(), u2.asnumpy())
+
+
+def test_wait_and_context():
+    a = nd.ones((2, 2))
+    a.wait_to_read()
+    nd.waitall()
+    assert isinstance(a.context, mx.Context)
