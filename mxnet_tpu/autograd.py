@@ -248,6 +248,7 @@ def _accum_var_grad(var, g, written):
     else:
         var._grad._data = g
         written.add(id(var))
+    var._fresh_grad = True
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,

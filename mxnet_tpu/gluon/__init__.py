@@ -1,0 +1,40 @@
+"""Gluon — the imperative/hybrid neural network API.
+
+Reference parity: python/mxnet/gluon/ (Block/HybridBlock, Parameter,
+Trainer, nn/rnn layers, losses, data, model_zoo).
+"""
+from . import block  # noqa: F401
+from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
+from .parameter import (  # noqa: F401
+    Constant,
+    DeferredInitializationError,
+    Parameter,
+    ParameterDict,
+)
+from . import nn  # noqa: F401
+from . import loss  # noqa: F401
+
+import importlib as _importlib
+
+_LAZY = {
+    "rnn": ".rnn",
+    "data": ".data",
+    "trainer": ".trainer",
+    "Trainer": (".trainer", "Trainer"),
+    "model_zoo": ".model_zoo",
+    "contrib": ".contrib",
+    "utils": ".utils",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        spec = _LAZY[name]
+        if isinstance(spec, tuple):
+            mod = _importlib.import_module(spec[0], __name__)
+            obj = getattr(mod, spec[1])
+        else:
+            obj = _importlib.import_module(spec, __name__)
+        globals()[name] = obj
+        return obj
+    raise AttributeError(f"module 'mxnet_tpu.gluon' has no attribute {name!r}")

@@ -1,0 +1,399 @@
+"""Parameter / ParameterDict — Gluon's weight containers.
+
+Reference parity: python/mxnet/gluon/parameter.py (``Parameter`` with
+deferred init, grad_req plumbing, per-context copies; ``ParameterDict``
+prefix-scoped registry).  TPU-native redesign: one logical copy of each
+parameter as an NDArray over a jax.Array — replication/sharding across
+chips is an XLA sharding annotation applied by the Trainer/parallel layer,
+not N explicit per-device copies (reference keeps `_ctx_list` arrays;
+here `list_ctx` reports the devices of the underlying jax.Array).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as onp
+
+from .. import initializer as init_mod
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..initializer import InitDesc
+
+__all__ = ["DeferredInitializationError", "Parameter", "Constant", "ParameterDict"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter used before its shape is known (reference parameter.py)."""
+
+
+class Parameter:
+    """A weight tensor with autograd + initialization state.
+
+    Matches the reference's API surface: ``initialize``, ``data``,
+    ``grad``, ``set_data``, ``zero_grad``, ``var``, ``cast``,
+    ``shape``/``dtype``/``grad_req`` mutability and deferred init (shape
+    with 0s resolved at first forward).
+    """
+
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self.name = name
+        self._grad_req = None
+        if isinstance(shape, int):
+            shape = (shape,)
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        self._stype = stype
+        self._grad_stype = grad_stype
+        self._data = None  # NDArray
+        self._deferred_init = None  # (init, ctx, default_init)
+        self.grad_req = grad_req
+        self._attributes = {}
+
+    # ---------------------------------------------------------------- attrs
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise MXNetError(f"grad_req must be write/add/null, got {req}")
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._data._grad = None
+                self._data._grad_req = "null"
+            else:
+                self._init_grad()
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape) if new_shape is not None else None
+            return
+        if new_shape is None:
+            return
+        unknown_ok = all(
+            s1 in (0, s2) for s1, s2 in zip(self._shape, new_shape)
+        ) and len(self._shape) == len(new_shape)
+        if not unknown_ok:
+            raise MXNetError(
+                f"Expected shape {new_shape} is incompatible with given "
+                f"shape {self._shape} for Parameter {self.name}"
+            )
+        self._shape = tuple(new_shape)
+
+    def _shape_known(self):
+        return self._shape is not None and all(s > 0 for s in self._shape)
+
+    # ------------------------------------------------------------- lifecycle
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        if default_init is None:
+            default_init = init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = current_context()
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if not self._shape_known():
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise MXNetError(
+                f"Cannot initialize Parameter {self.name} because it has "
+                f"invalid shape: {self._shape}."
+            )
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_deferred_init(self):
+        if self._deferred_init is None:
+            return
+        init, ctx, default_init = self._deferred_init
+        if not self._shape_known():
+            raise DeferredInitializationError(
+                f"Parameter {self.name} has unknown shape {self._shape}"
+            )
+        self._deferred_init = None
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        initializer = init if init is not None else (self.init or default_init)
+        initializer = init_mod.create(initializer) if not callable(
+            initializer
+        ) or isinstance(initializer, init_mod.Initializer) else initializer
+        if isinstance(initializer, init_mod.Initializer) or callable(initializer):
+            value = initializer(InitDesc(self.name), self._shape, self.dtype)
+        else:  # pragma: no cover
+            raise MXNetError(f"bad initializer for {self.name}")
+        arr = nd.array(onp.asarray(value), ctx=ctx[0], dtype=self.dtype)
+        self._data = arr
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _init_grad(self):
+        if self._data is None:
+            return
+        self._data.attach_grad(grad_req=self._grad_req)
+
+    # ----------------------------------------------------------------- data
+    def _check_init(self):
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    f"Parameter {self.name} has not been initialized yet "
+                    "because initialization was deferred. Actual "
+                    "initialization happens during the first forward pass."
+                )
+            raise MXNetError(
+                f"Parameter {self.name} has not been initialized. You "
+                "should initialize parameters with Block.initialize()."
+            )
+
+    def data(self, ctx=None):
+        self._check_init()
+        return self._data
+
+    def list_data(self):
+        self._check_init()
+        return [self._data]
+
+    def grad(self, ctx=None):
+        self._check_init()
+        if self._data._grad is None:
+            raise MXNetError(
+                f"Cannot get gradient array for Parameter {self.name} "
+                "because grad_req='null'"
+            )
+        return self._data._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        if self._data is None and self._deferred_init is not None:
+            return self._deferred_init[1]
+        self._check_init()
+        return [self._data.context]
+
+    def set_data(self, data):
+        self.shape = tuple(data.shape)
+        if self._data is None:
+            if self._deferred_init is None:
+                raise MXNetError(
+                    f"Parameter {self.name} has not been initialized"
+                )
+            init, ctx, default_init = self._deferred_init
+            self._deferred_init = None
+            self._finish_init(init_mod.Constant(0), ctx, default_init)
+        if not isinstance(data, nd.NDArray):
+            data = nd.array(data, dtype=self.dtype)
+        self._data._adopt(data.astype(self.dtype)._data)
+
+    def zero_grad(self):
+        if self._data is not None and self._data._grad is not None:
+            g = self._data._grad
+            g._adopt(nd.zeros(g.shape, dtype=g.dtype)._data)
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            had_grad = self._data._grad is not None
+            self._data = self._data.astype(dtype)
+            if had_grad:
+                self._init_grad()
+
+    def reset_ctx(self, ctx):
+        pass  # single logical copy; sharding is a compiler annotation
+
+    def var(self):
+        from .. import symbol
+        return symbol.var(
+            self.name, shape=self.shape, dtype=self.dtype,
+            lr_mult=self.lr_mult, wd_mult=self.wd_mult,
+        )
+
+    def __repr__(self):
+        return (
+            f"Parameter {self.name} (shape={self._shape}, "
+            f"dtype={self.dtype})"
+        )
+
+
+class Constant(Parameter):
+    """Non-trainable parameter holding a constant (reference Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, nd.NDArray):
+            value = nd.array(onp.asarray(value))
+        self.value = value
+
+        class _CInit(init_mod.Initializer):
+            def _init_weight(_self, _name, _shape):
+                return value.asnumpy()
+
+        super().__init__(
+            name, grad_req="null", shape=value.shape, dtype=value.dtype,
+            init=_CInit(), differentiable=False,
+        )
+
+
+class ParameterDict:
+    """Prefix-scoped ordered dict of Parameters (reference ParameterDict)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __contains__(self, name):
+        return name in self._params
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __repr__(self):
+        lines = [f"{self._prefix or 'ParameterDict'} ("]
+        lines += [f"  {v!r}" for v in self._params.values()]
+        lines.append(")")
+        return "\n".join(lines)
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        """Get-or-create ``self.prefix + name`` (reference get())."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+            return param
+        for k, v in kwargs.items():
+            if k == "shape":
+                param.shape = v
+            elif getattr(param, k, None) is None or k in ("init",):
+                setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise MXNetError(
+                    f"No constant named '{name}'. Please specify value."
+                )
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError(
+                    f"Cannot update self with other because they have "
+                    f"different Parameters with the same name '{k}'"
+                )
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            init = init_mod.Uniform()
+        for v in self.values():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        arg_dict = {}
+        for param in self.values():
+            block = param.list_data()
+            weight = sum(w.copyto(cpu()) for w in block) / len(block)
+            if not param.name.startswith(strip_prefix):
+                raise MXNetError(
+                    f"Prefix '{strip_prefix}' is to be stripped before "
+                    f"saving, but Parameter's name '{param.name}' does not "
+                    "start with it"
+                )
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        arg_dict = nd.load(filename)
+        if restore_prefix:
+            arg_dict = {restore_prefix + k: v for k, v in arg_dict.items()}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in arg_dict:
+                    raise MXNetError(
+                        f"Parameter '{name}' is missing in file '{filename}'"
+                    )
+        for name, arr in arg_dict.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise MXNetError(
+                        f"Parameter '{name}' loaded from file "
+                        f"'{filename}' is not present in this ParameterDict"
+                    )
+                continue
+            param = self._params[name]
+            if param._data is None and param._deferred_init is not None:
+                param.shape = tuple(arr.shape)
+            elif param._data is None:
+                param.shape = tuple(arr.shape)
+                param.initialize(ctx=ctx)
+            param.set_data(arr)

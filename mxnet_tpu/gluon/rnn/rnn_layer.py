@@ -1,0 +1,217 @@
+"""RNN / LSTM / GRU layers over the fused RNN op.
+
+Reference parity: python/mxnet/gluon/rnn/rnn_layer.py (``_RNNLayer`` ->
+fused ``RNN`` op, src/operator/rnn-inl.h).  Parameters are kept per
+(layer, direction) like the reference ({l,r}{i}_{i2h,h2h}_{weight,bias})
+and packed into the fused flat vector at forward time — the pack is pure
+concatenation so XLA folds it away.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, projection_size=None, **kwargs):
+        super().__init__(**kwargs)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError(f"Invalid layout {layout}; must be TNC or NTC")
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._i2h_weight_initializer = i2h_weight_initializer
+        self._h2h_weight_initializer = h2h_weight_initializer
+        self._i2h_bias_initializer = i2h_bias_initializer
+        self._h2h_bias_initializer = h2h_bias_initializer
+        if projection_size is not None:
+            raise MXNetError("projection_size not supported yet")
+
+        self._gates = _GATES[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        with self.name_scope():
+            for i in range(num_layers):
+                for j in ["l", "r"][: self._dir]:
+                    self._register_param(
+                        f"{j}{i}_i2h_weight", (ng * nh, ni),
+                        i2h_weight_initializer)
+                    self._register_param(
+                        f"{j}{i}_h2h_weight", (ng * nh, nh),
+                        h2h_weight_initializer)
+                    self._register_param(
+                        f"{j}{i}_i2h_bias", (ng * nh,),
+                        i2h_bias_initializer)
+                    self._register_param(
+                        f"{j}{i}_h2h_bias", (ng * nh,),
+                        h2h_bias_initializer)
+                ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(
+            name, shape=shape, init=init, allow_deferred_init=True)
+        setattr(self, name, p)
+        return p
+
+    def _infer_param_shapes(self, x, *args):
+        ins = x.shape[2]  # C is axis 2 in both TNC and NTC
+        ng, nh = self._gates, self._hidden_size
+        ni = ins
+        for i in range(self._num_layers):
+            for j in ["l", "r"][: self._dir]:
+                getattr(self, f"{j}{i}_i2h_weight").shape = (ng * nh, ni)
+            ni = nh * self._dir
+        self._input_size = ins
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as nd
+
+        if func is None:
+            func = nd.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(func(shape=info["shape"], **kwargs)
+                          if "shape" in info else func(**kwargs))
+        return states
+
+    def cast(self, dtype):
+        super().cast(dtype)
+
+    def hybrid_forward(self, F, inputs, states=None, **params):
+        if self._layout == "NTC":
+            inputs = F.swapaxes(inputs, 0, 1)
+        batch_size = inputs.shape[1]
+        skip_states = states is None
+        if skip_states:
+            states = [
+                F.zeros(info["shape"], dtype=str(inputs.dtype))
+                for info in self.state_info(batch_size)
+            ]
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+
+        # pack per-layer params into the fused flat vector (weights then
+        # biases, layer-major, direction-minor — rnn.py layout)
+        flat = []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][: self._dir]:
+                flat.append(params[f"{j}{i}_i2h_weight"].reshape((-1,)))
+                flat.append(params[f"{j}{i}_h2h_weight"].reshape((-1,)))
+        for i in range(self._num_layers):
+            for j in ["l", "r"][: self._dir]:
+                flat.append(params[f"{j}{i}_i2h_bias"])
+                flat.append(params[f"{j}{i}_h2h_bias"])
+        packed = F.concat(*flat, dim=0)
+
+        rnn_args = [inputs, packed] + list(states)
+        out = F.RNN(
+            *rnn_args,
+            state_size=self._hidden_size,
+            num_layers=self._num_layers,
+            bidirectional=self._dir == 2,
+            p=self._dropout,
+            state_outputs=True,
+            mode=self._mode,
+        )
+        if self._mode == "lstm":
+            outputs, states = out[0], [out[1], out[2]]
+        else:
+            outputs, states = out[0], [out[1]]
+        if self._layout == "NTC":
+            outputs = F.swapaxes(outputs, 0, 1)
+        if skip_states:
+            return outputs
+        return outputs, states
+
+    def __repr__(self):
+        s = "{name}({mapping}, {_layout}"
+        if self._num_layers != 1:
+            s += ", num_layers={_num_layers}"
+        if self._dropout != 0:
+            s += ", dropout={_dropout}"
+        if self._dir == 2:
+            s += ", bidirectional"
+        s += ")"
+        mapping = "{0} -> {1}".format(
+            self._input_size if self._input_size else None, self._hidden_size
+        )
+        return s.format(name=self.__class__.__name__, mapping=mapping,
+                        **self.__dict__)
+
+
+class RNN(_RNNLayer):
+    """Vanilla multi-layer Elman RNN (tanh or relu)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, **kwargs):
+        super().__init__(
+            hidden_size, num_layers, layout, dropout, bidirectional,
+            input_size, i2h_weight_initializer, h2h_weight_initializer,
+            i2h_bias_initializer, h2h_bias_initializer,
+            "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{
+            "shape": (self._num_layers * self._dir, batch_size,
+                      self._hidden_size),
+            "__layout__": "LNC",
+        }]
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 projection_size=None, **kwargs):
+        super().__init__(
+            hidden_size, num_layers, layout, dropout, bidirectional,
+            input_size, i2h_weight_initializer, h2h_weight_initializer,
+            i2h_bias_initializer, h2h_bias_initializer, "lstm",
+            projection_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [
+            {"shape": (self._num_layers * self._dir, batch_size,
+                       self._hidden_size), "__layout__": "LNC"},
+            {"shape": (self._num_layers * self._dir, batch_size,
+                       self._hidden_size), "__layout__": "LNC"},
+        ]
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(
+            hidden_size, num_layers, layout, dropout, bidirectional,
+            input_size, i2h_weight_initializer, h2h_weight_initializer,
+            i2h_bias_initializer, h2h_bias_initializer, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{
+            "shape": (self._num_layers * self._dir, batch_size,
+                      self._hidden_size),
+            "__layout__": "LNC",
+        }]

@@ -1,0 +1,170 @@
+"""Trainer — applies an Optimizer to a set of Parameters.
+
+Reference parity: python/mxnet/gluon/trainer.py (``Trainer`` :27,
+``_init_kvstore`` :169 deciding update_on_kvstore, ``allreduce_grads``
+:334, ``step`` :305, ``update`` :366).
+
+TPU-native redesign: parameters have ONE logical copy, so the reference's
+multi-device allreduce collapses to a no-op on one chip; under a device
+mesh, gradients arriving from a pjit/shard_map step are already psum-ed by
+XLA collectives.  ``update_on_kvstore`` therefore only matters for the
+dist parameter-server emulation path; the fast path applies jitted update
+rules directly.
+"""
+from __future__ import annotations
+
+from .. import kvstore as kvs
+from .. import optimizer as opt
+from ..base import MXNetError
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError(
+                "First argument must be a list or dict of Parameters, "
+                f"got {type(params)}.")
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise MXNetError(
+                    "First argument must be a list or dict of Parameters, "
+                    f"got list of {type(param)}.")
+            self._param2idx[param.name] = i
+            self._params.append(param)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params if optimizer_params else {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_params = {
+            "kvstore": kvstore,
+            "update_on_kvstore": update_on_kvstore,
+        }
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            if optimizer_params:
+                raise MXNetError(
+                    "optimizer_params must be None if optimizer is an "
+                    "instance of Optimizer instead of str")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(
+                optimizer, param_dict=param_dict, **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        config = self._kvstore_params
+        kvstore = config["kvstore"]
+        update_on_kvstore = config["update_on_kvstore"]
+        if kvstore and isinstance(kvstore, str) and kvstore.startswith(
+            "dist"
+        ):
+            self._kvstore = kvs.create(kvstore)
+            if update_on_kvstore is None:
+                update_on_kvstore = True
+            if update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+        elif isinstance(kvstore, kvs.KVStore):
+            self._kvstore = kvstore
+            if update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+        else:
+            # single-process local/device: one logical copy — no kvstore
+            self._kvstore = None
+            update_on_kvstore = False
+        self._update_on_kvstore = bool(update_on_kvstore)
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def allreduce_grads(self):
+        """Sum gradients across workers (reference trainer.py:334).  On a
+        single logical copy this is the identity; under jax.distributed
+        the gradients were already reduced by XLA collectives inside the
+        step program."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """rescale + allreduce + update (reference trainer.py:305)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self.allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        updater = self._updaters[0]
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if param._data is None:
+                if param._deferred_init is not None and ignore_stale_grad:
+                    continue
+                raise MXNetError(
+                    f"Parameter {param.name} has not been initialized")
+            if param._data._grad is None or not param._data._fresh_grad:
+                if ignore_stale_grad:
+                    continue
+                raise MXNetError(
+                    f"Gradient of Parameter `{param.name}` on context "
+                    "has not been updated by backward since last `step`. "
+                    "This could mean a bug in your model that made it only "
+                    "use a subset of the Parameters for the last forward "
+                    "pass. Set ignore_stale_grad=True to suppress this "
+                    "warning.")
+            updater(i, param._data._grad, param._data)
+            param._data._fresh_grad = False
+
+    def save_states(self, fname):
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.load_optimizer_states(fname)
+            self._optimizer = self._kvstore._updater.optimizer
+        else:
+            with open(fname, "rb") as f:
+                states = f.read()
+            for updater in self._updaters:
+                updater.set_states(states)
+                updater.optimizer = self._updaters[0].optimizer
+            self._optimizer = self._updaters[0].optimizer

@@ -1,0 +1,194 @@
+"""KVStore — the data-parallel communication layer.
+
+Reference parity: include/mxnet/kvstore.h + python/mxnet/kvstore.py
+(init/push/pull/pushpull, optimizer-on-store, rank/num_workers/barrier,
+gradient compression) with backends local/device (src/kvstore/comm.h),
+nccl (kvstore_nccl.h) and dist_sync/dist_async (ps-lite,
+kvstore_dist.h / kvstore_dist_server.h).
+
+TPU-native redesign (SURVEY.md §2.5): a single logical copy of every
+value lives as a jax.Array; "device aggregation" of a list of per-shard
+gradients is a jnp tree-sum (XLA fuses it); multi-host `dist_*` modes ride
+``jax.distributed`` + global collectives over the pod mesh rather than a
+parameter-server process group.  Inside pjit/shard_map training steps the
+same reduction is a ``lax.psum`` — the Trainer uses KVStore only at the
+API boundary, exactly like the reference.  Gradient compression maps to
+2-bit quantize + error-feedback residual kept as device state.
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+
+from . import ndarray as nd
+from .base import MXNetError
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _key_list(key):
+    single = not isinstance(key, (list, tuple))
+    return ([key] if single else list(key)), single
+
+
+class GradientCompression:
+    """2-bit gradient compression with error-feedback residual
+    (reference src/kvstore/gradient_compression.h:38-121)."""
+
+    def __init__(self, threshold=0.5):
+        self.threshold = float(threshold)
+        self._residual = {}
+
+    def compress(self, key, grad_v):
+        r = self._residual.get(key)
+        if r is None:
+            r = jnp.zeros_like(grad_v)
+        acc = grad_v + r
+        t = self.threshold
+        q = jnp.where(acc >= t, t, jnp.where(acc <= -t, -t, 0.0))
+        self._residual[key] = acc - q
+        return q
+
+
+class KVStore:
+    """Single-process KVStore covering local/device semantics; dist modes
+    report rank/size from the jax.distributed runtime when initialized."""
+
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression = None
+        self._str_keys = False
+        if kv_type.startswith("dist"):
+            try:
+                self._rank = jax.process_index()
+                self._size = jax.process_count()
+            except Exception:
+                self._rank, self._size = 0, 1
+        else:
+            self._rank, self._size = 0, 1
+
+    # ------------------------------------------------------------ basics
+    def init(self, key, value):
+        keys, _ = _key_list(key)
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        if len(keys) != len(vals):
+            raise MXNetError("key/value length mismatch")
+        for k, v in zip(keys, vals):
+            if k in self._store:
+                raise MXNetError(f"key {k} already initialized")
+            self._store[k] = v.copy() if isinstance(v, nd.NDArray) else (
+                nd.array(v))
+
+    def push(self, key, value, priority=0):
+        keys, single = _key_list(key)
+        if single:
+            grouped = [value if isinstance(value, list) else [value]]
+        else:
+            grouped = [v if isinstance(v, list) else [v] for v in value]
+        for k, vlist in zip(keys, grouped):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            # device-style aggregation: tree-sum of per-device grads
+            agg = vlist[0]._data
+            for v in vlist[1:]:
+                agg = agg + v._data
+            if self._compression is not None:
+                agg = self._compression.compress(k, agg)
+            agg_nd = nd.NDArray(agg)
+            if self._updater is not None:
+                self._updater(self._key_index(k), agg_nd, self._store[k])
+            else:
+                # no updater: stored value becomes the pushed aggregate
+                # (reference KVStore default-merge semantics)
+                self._store[k]._adopt(agg.astype(self._store[k]._data.dtype))
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, single = _key_list(key)
+        if single:
+            outs = [out if isinstance(out, list) else [out]]
+        else:
+            outs = [o if isinstance(o, list) else [o] for o in out]
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            src = self._store[k]
+            for o in olist:
+                o._adopt(src._data.astype(o._data.dtype))
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        # dense emulation (TPU-hostile sparse path; SURVEY.md §7 hard parts)
+        self.pull(key, out, priority)
+
+    def set_gradient_compression(self, compression_params):
+        ctype = compression_params.get("type", "2bit")
+        if ctype != "2bit":
+            raise MXNetError(f"unsupported compression {ctype}")
+        self._compression = GradientCompression(
+            compression_params.get("threshold", 0.5))
+
+    # --------------------------------------------------------- optimizer
+    def set_optimizer(self, optimizer):
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def _key_index(self, k):
+        try:
+            return int(k)
+        except (TypeError, ValueError):
+            return k
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._size
+
+    def barrier(self):
+        if self._size > 1:
+            # a tiny global psum is the TPU-native barrier
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("kvstore_barrier")
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("updater is not initialized")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("updater is not initialized")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def _send_command_to_servers(self, head, body):
+        pass  # no server processes in the TPU design
+
+
+def create(name="local"):
+    """Factory (reference src/kvstore/kvstore.cc:40-70)."""
+    if not isinstance(name, str):
+        raise MXNetError("name must be a string")
+    valid = ("local", "device", "local_allreduce_cpu",
+             "local_allreduce_device", "nccl", "dist_sync", "dist_async",
+             "dist_sync_device", "dist_device_sync", "dist")
+    if name not in valid:
+        raise MXNetError(f"unknown KVStore type {name}")
+    return KVStore(name)

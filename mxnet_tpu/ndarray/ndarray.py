@@ -66,7 +66,7 @@ def _ctx_of_jax_array(a) -> Context:
 
 class NDArray:
     __slots__ = ("_data", "_grad", "_grad_req", "_is_var", "_node", "_oidx",
-                 "_stype", "__weakref__")
+                 "_stype", "_fresh_grad", "__weakref__")
 
     def __init__(self, data, stype="default"):
         self._data = data  # jax.Array (possibly a tracer under jit)
@@ -76,6 +76,7 @@ class NDArray:
         self._node = None  # autograd.TapeNode that produced this array
         self._oidx = 0
         self._stype = stype
+        self._fresh_grad = False  # set by backward, cleared by Trainer
 
     # ------------------------------------------------------------- basics
     @property
@@ -203,6 +204,20 @@ class NDArray:
     def detach(self):
         out = NDArray(self._data)
         return out
+
+    # pickle via host numpy (optimizer-state checkpointing)
+    def __getstate__(self):
+        return {"data": self.asnumpy(), "stype": self._stype}
+
+    def __setstate__(self, state):
+        self._data = jnp.asarray(state["data"])
+        self._grad = None
+        self._grad_req = "null"
+        self._is_var = False
+        self._node = None
+        self._oidx = 0
+        self._stype = state.get("stype", "default")
+        self._fresh_grad = False
 
     def _adopt(self, new_data):
         """In-place mutation: rebind the functional value."""

@@ -189,6 +189,11 @@ def group_norm(data, gamma, beta, *, num_groups=1, eps=1e-5,
     mean = jnp.mean(x, axis=red, keepdims=True)
     var = jnp.var(x, axis=red, keepdims=True)
     xn = ((x - mean) * jax.lax.rsqrt(var + eps)).reshape(data.shape)
+    if gamma.size == num_groups != c:
+        # reference layer keeps per-group affine params
+        # (python/mxnet/gluon/nn/basic_layers.py GroupNorm)
+        gamma = jnp.repeat(gamma, c // num_groups)
+        beta = jnp.repeat(beta, c // num_groups)
     shape = [1, c] + [1] * (data.ndim - 2)
     out = xn * gamma.reshape(shape) + beta.reshape(shape)
     if output_mean_var:

@@ -1,0 +1,3 @@
+"""Optimizer package (reference: python/mxnet/optimizer/)."""
+from .optimizer import *  # noqa: F401,F403
+from .optimizer import Optimizer, Updater, create, register  # noqa: F401

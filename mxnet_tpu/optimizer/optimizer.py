@@ -1,0 +1,815 @@
+"""Optimizers.
+
+Reference parity: python/mxnet/optimizer/optimizer.py (Optimizer registry,
+per-param lr/wd multipliers, mixed-precision master weights, Updater) and
+the fused optimizer *ops* in src/operator/optimizer_op.cc.
+
+TPU-native redesign: each update rule is a pure jitted function
+``(weight, grad, *state, lr, wd, ...) -> (new_weight, *new_state)``.
+XLA fuses the whole rule into one kernel — the analog of the reference's
+hand-fused SGD/Adam CUDA kernels — and jit caching per shape plays the
+role of the reference's multi-tensor batching.  State lives in device
+buffers between steps.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import ndarray as nd
+from ..base import MXNetError
+
+__all__ = [
+    "Optimizer", "SGD", "Signum", "NAG", "Adam", "AdamW", "AdaGrad",
+    "RMSProp", "AdaDelta", "Adamax", "Nadam", "Ftrl", "FTML", "LARS",
+    "SGLD", "DCASGD", "LBSGD", "Updater", "create", "register",
+    "get_updater", "Test",
+]
+
+_REGISTRY: dict[str, type] = {}
+
+
+def register(klass):
+    name = klass.__name__.lower()
+    _REGISTRY[name] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    if name.lower() not in _REGISTRY:
+        raise MXNetError(f"Cannot find optimizer {name}")
+    return _REGISTRY[name.lower()](**kwargs)
+
+
+class Optimizer:
+    """Base optimizer (reference optimizer.py:Optimizer).
+
+    State handling: ``create_state(index, weight)`` returns a tuple of
+    NDArrays; ``update(index, weight, grad, state)`` applies one step
+    functionally (weight/state buffers are rebound, not mutated).
+    """
+
+    opt_registry = _REGISTRY  # reference-compat alias
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        if param_idx2name is None:
+            param_idx2name = {}
+        self.idx2name = param_idx2name.copy()
+        self.param_dict = param_dict if param_dict else {}
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    create_optimizer = staticmethod(create)
+
+    # ------------------------------------------------------------ lr / wd
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError(
+                "LRScheduler of the optimizer has already been defined. "
+                "Note that set_learning_rate can mutate the value of the "
+                "learning rate of the optimizer only when the LRScheduler "
+                "of the optimizer is undefined.")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        self.wd_mult.update(args_wd_mult)
+
+    def _get_lr(self, index):
+        lr = self.learning_rate
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index],
+                              self.num_update)
+
+    # ------------------------------------------------------------- state
+    def create_state(self, index, weight):
+        return ()
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype in (onp.float16,
+                                                     jnp.bfloat16):
+            master = weight.astype("float32")
+            return (master, self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype in (onp.float16,
+                                                     jnp.bfloat16):
+            master, base_state = state
+            g32 = grad.astype("float32")
+            self.update(index, master, g32, base_state)
+            weight._adopt(master._data.astype(weight._data.dtype))
+        else:
+            self.update(index, weight, grad, state)
+
+    # -------------------------------------------------- shared grad prep
+    def _prep(self, grad_v):
+        g = grad_v * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+
+def _jit(fn):
+    """jit with scalar hyper-params as traced args (no recompile per lr)."""
+    return jax.jit(fn)
+
+
+# ================================================================= rules
+@_jit
+def _sgd_step(w, g, lr, wd):
+    return w - lr * (g + wd * w)
+
+
+@_jit
+def _sgd_mom_step(w, mom, g, lr, wd, momentum):
+    mom = momentum * mom - lr * (g + wd * w)
+    return w + mom, mom
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum (reference optimizer.py SGD; op
+    src/operator/optimizer_op.cc sgd_update/sgd_mom_update).
+
+    update: mom = momentum*mom - lr*(grad + wd*w); w += mom
+    """
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return ()
+        return (nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._prep(grad._data)
+        if self.momentum == 0.0:
+            weight._adopt(_sgd_step(weight._data, g, lr, wd))
+        else:
+            (mom,) = state
+            new_w, new_m = _sgd_mom_step(
+                weight._data, mom._data, g, lr, wd, self.momentum)
+            weight._adopt(new_w)
+            mom._adopt(new_m)
+
+
+@register
+class Test(Optimizer):
+    """Reference test optimizer: w += grad * rescale."""
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context),)
+
+    def update(self, index, weight, grad, state):
+        weight._adopt(weight._data + grad._data * self.rescale_grad)
+
+
+@_jit
+def _nag_step(w, mom, g, lr, wd, momentum):
+    g = g + wd * w
+    mom = momentum * mom + g
+    return w - lr * (g + momentum * mom), mom
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference NAG)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return ()
+        return (nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._prep(grad._data)
+        if self.momentum == 0.0:
+            weight._adopt(_sgd_step(weight._data, g, lr, wd))
+        else:
+            (mom,) = state
+            new_w, new_m = _nag_step(weight._data, mom._data, g, lr, wd,
+                                     self.momentum)
+            weight._adopt(new_w)
+            mom._adopt(new_m)
+
+
+@_jit
+def _signum_step(w, mom, g, lr, wd, momentum, wd_lh):
+    mom = momentum * mom - (1 - momentum) * (g + wd * w)
+    return (1 - lr * wd_lh) * w + lr * jnp.sign(mom), mom
+
+
+@register
+class Signum(Optimizer):
+    """signSGD / Signum (reference Signum)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return ()
+        return (nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._prep(grad._data)
+        if self.momentum == 0.0:
+            weight._adopt(
+                (1 - lr * self.wd_lh) * weight._data
+                - lr * jnp.sign(g + wd * weight._data))
+        else:
+            (mom,) = state
+            new_w, new_m = _signum_step(
+                weight._data, mom._data, g, lr, wd, self.momentum,
+                self.wd_lh)
+            weight._adopt(new_w)
+            mom._adopt(new_m)
+
+
+@_jit
+def _adam_step(w, m, v, g, lr, wd, beta1, beta2, eps, t):
+    g = g + wd * w
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * g * g
+    coef1 = 1.0 - beta1 ** t
+    coef2 = 1.0 - beta2 ** t
+    lr_t = lr * jnp.sqrt(coef2) / coef1
+    return w - lr_t * m / (jnp.sqrt(v) + eps), m, v
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference Adam; op adam_update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (
+            nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+            nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        m, v = state
+        g = self._prep(grad._data)
+        new_w, new_m, new_v = _adam_step(
+            weight._data, m._data, v._data, g, lr, wd, self.beta1,
+            self.beta2, self.epsilon, float(t))
+        weight._adopt(new_w)
+        m._adopt(new_m)
+        v._adopt(new_v)
+
+
+@_jit
+def _adamw_step(w, m, v, g, lr, eta, wd, beta1, beta2, eps, t):
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * g * g
+    coef1 = 1.0 - beta1 ** t
+    coef2 = 1.0 - beta2 ** t
+    lr_t = lr * jnp.sqrt(coef2) / coef1
+    return w - eta * (lr_t * m / (jnp.sqrt(v) + eps) + wd * w), m, v
+
+
+@register
+class AdamW(Adam):
+    """Decoupled weight decay Adam (reference
+    src/operator/contrib/adamw.cc)."""
+
+    def __init__(self, eta=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.eta = eta
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        m, v = state
+        g = self._prep(grad._data)
+        new_w, new_m, new_v = _adamw_step(
+            weight._data, m._data, v._data, g, lr, self.eta, wd,
+            self.beta1, self.beta2, self.epsilon, float(t))
+        weight._adopt(new_w)
+        m._adopt(new_m)
+        v._adopt(new_v)
+
+
+@_jit
+def _adagrad_step(w, hist, g, lr, wd, eps):
+    g = g + wd * w
+    hist = hist + g * g
+    return w - lr * g / (jnp.sqrt(hist) + eps), hist
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        (hist,) = state
+        g = self._prep(grad._data)
+        new_w, new_h = _adagrad_step(weight._data, hist._data, g, lr, wd,
+                                     self.float_stable_eps)
+        weight._adopt(new_w)
+        hist._adopt(new_h)
+
+
+@_jit
+def _rmsprop_step(w, n, g, lr, wd, rho, eps):
+    g = g + wd * w
+    n = rho * n + (1 - rho) * g * g
+    return w - lr * g / jnp.sqrt(n + eps), n
+
+
+@_jit
+def _rmsprop_alex_step(w, n, gavg, delta, g, lr, wd, rho, momentum, eps):
+    g = g + wd * w
+    n = rho * n + (1 - rho) * g * g
+    gavg = rho * gavg + (1 - rho) * g
+    delta = momentum * delta - lr * g / jnp.sqrt(n - gavg * gavg + eps)
+    return w + delta, n, gavg, delta
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp (reference RMSProp; centered=True uses Alex Graves' variant)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        z = lambda: nd.zeros(weight.shape, ctx=weight.context,
+                             dtype=weight.dtype)
+        if self.centered:
+            return (z(), z(), z())
+        return (z(),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._prep(grad._data)
+        if self.centered:
+            n, gavg, delta = state
+            new_w, new_n, new_g, new_d = _rmsprop_alex_step(
+                weight._data, n._data, gavg._data, delta._data, g, lr, wd,
+                self.gamma1, self.gamma2, self.epsilon)
+            weight._adopt(new_w)
+            n._adopt(new_n)
+            gavg._adopt(new_g)
+            delta._adopt(new_d)
+        else:
+            (n,) = state
+            new_w, new_n = _rmsprop_step(
+                weight._data, n._data, g, lr, wd, self.gamma1, self.epsilon)
+            weight._adopt(new_w)
+            n._adopt(new_n)
+        if self.clip_weights:
+            weight._adopt(jnp.clip(weight._data, -self.clip_weights,
+                                   self.clip_weights))
+
+
+@_jit
+def _adadelta_step(w, acc_g, acc_delta, g, wd, rho, eps):
+    g = g + wd * w
+    acc_g = rho * acc_g + (1 - rho) * g * g
+    delta = jnp.sqrt(acc_delta + eps) / jnp.sqrt(acc_g + eps) * g
+    acc_delta = rho * acc_delta + (1 - rho) * delta * delta
+    return w - delta, acc_g, acc_delta
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        z = lambda: nd.zeros(weight.shape, ctx=weight.context,
+                             dtype=weight.dtype)
+        return (z(), z())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        acc_g, acc_delta = state
+        g = self._prep(grad._data)
+        new_w, new_ag, new_ad = _adadelta_step(
+            weight._data, acc_g._data, acc_delta._data, g, wd, self.rho,
+            self.epsilon)
+        weight._adopt(new_w)
+        acc_g._adopt(new_ag)
+        acc_delta._adopt(new_ad)
+
+
+@_jit
+def _adamax_step(w, m, u, g, lr, wd, beta1, beta2, t):
+    g = g + wd * w
+    m = beta1 * m + (1 - beta1) * g
+    u = jnp.maximum(beta2 * u, jnp.abs(g))
+    lr_t = lr / (1.0 - beta1 ** t)
+    return w - lr_t * m / (u + 1e-8), m, u
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        z = lambda: nd.zeros(weight.shape, ctx=weight.context,
+                             dtype=weight.dtype)
+        return (z(), z())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        m, u = state
+        g = self._prep(grad._data)
+        new_w, new_m, new_u = _adamax_step(
+            weight._data, m._data, u._data, g, lr, wd, self.beta1,
+            self.beta2, float(t))
+        weight._adopt(new_w)
+        m._adopt(new_m)
+        u._adopt(new_u)
+
+
+@_jit
+def _nadam_step(w, m, v, g, lr, wd, beta1, beta2, eps, t, m_schedule,
+                schedule_decay):
+    g = g + wd * w
+    momentum_t = beta1 * (1.0 - 0.5 * 0.96 ** (t * schedule_decay))
+    momentum_t_1 = beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * schedule_decay))
+    new_m_schedule = m_schedule * momentum_t
+    m_schedule_next = new_m_schedule * momentum_t_1
+    g_prime = g / (1.0 - new_m_schedule)
+    m = beta1 * m + (1.0 - beta1) * g
+    m_prime = m / (1.0 - m_schedule_next)
+    v = beta2 * v + (1.0 - beta2) * g * g
+    v_prime = v / (1.0 - beta2 ** t)
+    m_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_prime
+    return w - lr * m_bar / (jnp.sqrt(v_prime) + eps), m, v, new_m_schedule
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        z = lambda: nd.zeros(weight.shape, ctx=weight.context,
+                             dtype=weight.dtype)
+        return (z(), z())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        m, v = state
+        g = self._prep(grad._data)
+        new_w, new_m, new_v, ms = _nadam_step(
+            weight._data, m._data, v._data, g, lr, wd, self.beta1,
+            self.beta2, self.epsilon, float(t), self.m_schedule,
+            self.schedule_decay)
+        self.m_schedule = float(ms)
+        weight._adopt(new_w)
+        m._adopt(new_m)
+        v._adopt(new_v)
+
+
+@_jit
+def _ftrl_step(w, z, n, g, lr, wd, lamda1, beta):
+    sigma = (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / lr
+    z = z + g - sigma * w
+    n = n + g * g
+    denom = wd + (beta + jnp.sqrt(n)) / lr
+    new_w = jnp.where(
+        jnp.abs(z) > lamda1,
+        -(z - jnp.sign(z) * lamda1) / denom,
+        jnp.zeros_like(w))
+    return new_w, z, n
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        z = lambda: nd.zeros(weight.shape, ctx=weight.context,
+                             dtype=weight.dtype)
+        return (z(), z())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        zst, n = state
+        g = self._prep(grad._data)
+        new_w, new_z, new_n = _ftrl_step(
+            weight._data, zst._data, n._data, g, lr, wd, self.lamda1,
+            self.beta)
+        weight._adopt(new_w)
+        zst._adopt(new_z)
+        n._adopt(new_n)
+
+
+@_jit
+def _ftml_step(w, d, s, z, g, lr, wd, beta1, beta2, eps, t):
+    g = g + wd * w
+    v = beta2 * s + (1 - beta2) * g * g
+    d_t = (1.0 - beta1 ** t) / lr * (
+        jnp.sqrt(v / (1.0 - beta2 ** t)) + eps)
+    sigma_t = d_t - beta1 * d
+    z = beta1 * z + (1.0 - beta1) * g - sigma_t * w
+    return -z / d_t, d_t, v, z
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        z = lambda: nd.zeros(weight.shape, ctx=weight.context,
+                             dtype=weight.dtype)
+        return (z(), z(), z())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        d, s, zz = state
+        g = self._prep(grad._data)
+        new_w, new_d, new_s, new_z = _ftml_step(
+            weight._data, d._data, s._data, zz._data, g, lr, wd,
+            self.beta1, self.beta2, self.epsilon, float(t))
+        weight._adopt(new_w)
+        d._adopt(new_d)
+        s._adopt(new_s)
+        zz._adopt(new_z)
+
+
+@_jit
+def _lars_step(w, mom, g, lr, wd, momentum, eta, eps):
+    w_norm = jnp.linalg.norm(w)
+    g_norm = jnp.linalg.norm(g)
+    trust = jnp.where(
+        (w_norm > 0) & (g_norm > 0),
+        eta * w_norm / (g_norm + wd * w_norm + eps),
+        jnp.ones_like(w_norm))
+    scaled_lr = lr * trust
+    mom = momentum * mom + scaled_lr * (g + wd * w)
+    return w - mom, mom
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise Adaptive Rate Scaling (reference optimizer.py:796 and
+    the multi_lars fused ops, src/operator/contrib/multi_lars.cc)."""
+
+    def __init__(self, momentum=0.0, lars_eta=0.001, lars_epsilon=0,
+                 momentum_correction=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.eta = lars_eta
+        self.epsilon = lars_epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        (mom,) = state
+        g = self._prep(grad._data)
+        new_w, new_m = _lars_step(
+            weight._data, mom._data, g, lr, wd, self.momentum, self.eta,
+            self.epsilon)
+        weight._adopt(new_w)
+        mom._adopt(new_m)
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with warmup (reference LBSGD; here LARS-style
+    adaptive rate atop SGD semantics)."""
+
+    def __init__(self, momentum=0.0, multi_precision=False,
+                 warmup_strategy="linear", warmup_epochs=5, batch_scale=1,
+                 updates_per_epoch=32, begin_epoch=0, num_epochs=60,
+                 **kwargs):
+        super().__init__(momentum=momentum,
+                         multi_precision=multi_precision, **kwargs)
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = updates_per_epoch
+        self.init_updates = begin_epoch * updates_per_epoch
+        self.num_epochs = num_epochs
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (reference SGLD)."""
+
+    def create_state(self, index, weight):
+        return ()
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._prep(grad._data)
+        noise = nd.random_normal(
+            0, math.sqrt(lr), shape=weight.shape,
+            dtype=str(weight.dtype) if weight.dtype != jnp.bfloat16
+            else "float32")
+        weight._adopt(
+            weight._data - lr / 2 * (g + wd * weight._data)
+            + noise._data.astype(weight._data.dtype))
+
+
+@_jit
+def _dcasgd_step(w, mom, prev_w, g, lr, wd, momentum, lamda):
+    g = g + wd * w
+    mom = momentum * mom - lr * (g + lamda * g * g * (w - prev_w))
+    return w + mom, mom, w
+
+
+@register
+class DCASGD(Optimizer):
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        z = lambda: nd.zeros(weight.shape, ctx=weight.context,
+                             dtype=weight.dtype)
+        return (z(), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        mom, prev_w = state
+        g = self._prep(grad._data)
+        new_w, new_m, new_prev = _dcasgd_step(
+            weight._data, mom._data, prev_w._data, g, lr, wd,
+            self.momentum, self.lamda)
+        weight._adopt(new_w)
+        mom._adopt(new_m)
+        prev_w._adopt(new_prev)
+
+
+# ================================================================ Updater
+class Updater:
+    """Applies an optimizer locally (reference optimizer.py:1943
+    get_updater); used by KVStore local mode and Module."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = (
+                self.optimizer.create_state_multi_precision(index, weight))
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(
+            index, weight, grad, self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+
+        return pickle.dumps(
+            (self.states, self.optimizer) if dump_optimizer else self.states)
+
+    def set_states(self, states):
+        import pickle
+
+        states = pickle.loads(states)
+        if isinstance(states, tuple) and len(states) == 2:
+            self.states, self.optimizer = states
+        else:
+            self.states = states
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,275 @@
+"""Gluon Block/layer tests.
+
+Modeled on the reference tests/python/unittest/test_gluon.py: parameter
+lifecycle, deferred init, hybridize parity, layer output shapes, losses,
+rnn layers/cells, save/load round-trips.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier")
+    assert p.shape == (10, 10)
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+
+
+def test_parameter_deferred_init():
+    p = gluon.Parameter("weight", shape=(10, 0), allow_deferred_init=True)
+    p.initialize()
+    with pytest.raises(gluon.DeferredInitializationError):
+        p.data()
+    p.shape = (10, 5)
+    p._finish_deferred_init()
+    assert p.data().shape == (10, 5)
+
+
+def test_constant():
+    const_val = onp.random.rand(10, 10).astype("float32")
+
+    class Test(gluon.HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.value = onp.asarray(const_val)
+            self.const = self.params.get_constant("const", self.value)
+
+        def hybrid_forward(self, F, x, const):
+            return x + const
+
+    test = Test()
+    test.initialize()
+    trainer = gluon.Trainer(
+        test.collect_params(), "sgd", {"learning_rate": 1.0}
+    )
+    with autograd.record():
+        x = mx.nd.ones((10, 10))
+        x.attach_grad()
+        y = test(x)
+        y.backward()
+    trainer.step(1)
+    assert onp.allclose(test.const.data().asnumpy(), const_val)
+    assert onp.allclose(x.grad.asnumpy(), onp.ones((10, 10)))
+
+
+def test_dense_and_deferred_shape():
+    net = nn.Dense(8)
+    net.initialize()
+    x = mx.nd.ones((4, 7))
+    y = net(x)
+    assert y.shape == (4, 8)
+    assert net.weight.shape == (8, 7)
+
+
+def test_hybridize_parity():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = mx.nd.random_uniform(shape=(5, 10))
+    y_eager = net(x).asnumpy()
+    net.hybridize()
+    y_jit = net(x).asnumpy()
+    onp.testing.assert_allclose(y_eager, y_jit, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_grad_parity():
+    def run(hybridize):
+        mx.random.seed(7)
+        onp.random.seed(7)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="tanh"), nn.Dense(1))
+        net.initialize(init=mx.init.Xavier())
+        if hybridize:
+            net.hybridize()
+        x = mx.nd.array(onp.random.rand(6, 5).astype("float32"))
+        with autograd.record():
+            loss = gluon.loss.L2Loss()(net(x), mx.nd.zeros((6, 1)))
+        loss.backward()
+        return [p.grad().asnumpy() for p in net.collect_params().values()]
+
+    g1, g2 = run(False), run(True)
+    for a, b in zip(g1, g2):
+        onp.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_running_stats():
+    net = nn.BatchNorm(in_channels=3)
+    net.initialize()
+    x = mx.nd.array(onp.random.rand(4, 3, 2, 2).astype("float32") + 5)
+    with autograd.record():
+        net(x)
+    rm = net.running_mean.data().asnumpy()
+    assert abs(rm).sum() > 0  # moved toward batch mean
+
+
+def test_conv_shapes():
+    layers = [
+        (nn.Conv1D(16, 3, in_channels=4), (1, 4, 10), (1, 16, 8)),
+        (nn.Conv2D(16, (3, 4), in_channels=4), (1, 4, 20, 20), (1, 16, 18, 17)),
+        (nn.Conv3D(16, (1, 8, 4), in_channels=4, activation="relu"),
+         (1, 4, 10, 10, 10), (1, 16, 10, 3, 7)),
+        (nn.Conv2DTranspose(16, (3, 4), in_channels=4), (1, 4, 20, 20),
+         (1, 16, 22, 23)),
+    ]
+    for layer, in_shape, out_shape in layers:
+        layer.initialize()
+        x = mx.nd.ones(in_shape)
+        assert layer(x).shape == out_shape, (layer, layer(x).shape)
+
+
+def test_pool_shapes():
+    x = mx.nd.ones((2, 3, 8, 8))
+    assert nn.MaxPool2D()(x).shape == (2, 3, 4, 4)
+    assert nn.AvgPool2D((3, 3), strides=2)(x).shape == (2, 3, 3, 3)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+    assert nn.MaxPool2D((3, 3), strides=2, ceil_mode=True)(x).shape == (2, 3, 4, 4)
+
+
+def test_norm_layers():
+    x = mx.nd.random_uniform(shape=(2, 5, 4))
+    ln = nn.LayerNorm(in_channels=4)
+    ln.initialize()
+    y = ln(x).asnumpy()
+    onp.testing.assert_allclose(y.mean(axis=-1), 0, atol=1e-5)
+
+    inorm = nn.InstanceNorm(in_channels=5)
+    inorm.initialize()
+    assert inorm(x).shape == x.shape
+
+    gn = nn.GroupNorm(num_groups=2)
+    gn.initialize()
+    x2 = mx.nd.random_uniform(shape=(2, 4, 3, 3))
+    assert gn(x2).shape == x2.shape
+
+
+def test_embedding_flatten_lambda():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = mx.nd.array([[1, 2], [3, 4]])
+    assert emb(idx).shape == (2, 2, 4)
+
+    assert nn.Flatten()(mx.nd.ones((2, 3, 4))).shape == (2, 12)
+
+    lam = nn.HybridLambda(lambda F, x: F.relu(x))
+    assert lam(mx.nd.array([-1.0, 1.0])).asnumpy().tolist() == [0.0, 1.0]
+
+
+def test_activations():
+    x = mx.nd.array([-2.0, 0.0, 2.0])
+    for blk in [nn.Activation("relu"), nn.LeakyReLU(0.1), nn.ELU(),
+                nn.SELU(), nn.GELU(), nn.Swish()]:
+        blk.initialize()
+        y = blk(x)
+        assert y.shape == x.shape
+    prelu = nn.PReLU()
+    prelu.initialize()
+    y = prelu(x).asnumpy()
+    onp.testing.assert_allclose(y, [-0.5, 0.0, 2.0])
+
+
+def test_losses():
+    pred = mx.nd.random_uniform(shape=(4, 5))
+    label_idx = mx.nd.array([0, 1, 2, 3])
+    label_dense = mx.nd.random_uniform(shape=(4, 5))
+
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label_idx)
+    assert l.shape == (4,)
+    ref = -onp.take_along_axis(
+        onp.log(onp.exp(pred.asnumpy())
+                / onp.exp(pred.asnumpy()).sum(-1, keepdims=True)),
+        label_idx.asnumpy().astype(int)[:, None], 1).squeeze(1)
+    onp.testing.assert_allclose(l.asnumpy(), ref, rtol=1e-4)
+
+    assert gluon.loss.L1Loss()(pred, label_dense).shape == (4,)
+    assert gluon.loss.L2Loss()(pred, label_dense).shape == (4,)
+    assert gluon.loss.SigmoidBCELoss()(pred, label_dense).shape == (4,)
+    assert gluon.loss.KLDivLoss()(
+        mx.nd.log_softmax(pred), mx.nd.softmax(label_dense)).shape == (4,)
+    assert gluon.loss.HuberLoss()(pred, label_dense).shape == (4,)
+    assert gluon.loss.HingeLoss()(pred, label_dense).shape == (4,)
+
+
+def test_rnn_layers():
+    for layer, nstate in [
+        (gluon.rnn.LSTM(20, num_layers=2), 2),
+        (gluon.rnn.GRU(20), 1),
+        (gluon.rnn.RNN(20, activation="tanh"), 1),
+    ]:
+        layer.initialize()
+        x = mx.nd.random_uniform(shape=(3, 4, 10))  # TNC
+        out = layer(x)
+        assert out.shape == (3, 4, 20)
+        states = layer.begin_state(batch_size=4)
+        out, new_states = layer(x, states)
+        assert out.shape == (3, 4, 20)
+        assert len(new_states) == nstate
+
+
+def test_rnn_bidirectional_layer():
+    layer = gluon.rnn.LSTM(16, num_layers=2, bidirectional=True)
+    layer.initialize()
+    x = mx.nd.random_uniform(shape=(7, 2, 8))
+    assert layer(x).shape == (7, 2, 32)
+
+
+def test_rnn_cells_unroll():
+    for cell_cls in (gluon.rnn.RNNCell, gluon.rnn.LSTMCell,
+                     gluon.rnn.GRUCell):
+        cell = cell_cls(12)
+        cell.initialize()
+        x = mx.nd.random_uniform(shape=(2, 5, 6))  # NTC
+        outputs, states = cell.unroll(5, x, layout="NTC",
+                                      merge_outputs=True)
+        assert outputs.shape == (2, 5, 12)
+
+
+def test_sequential_rnn_cell():
+    stack = gluon.rnn.SequentialRNNCell()
+    stack.add(gluon.rnn.LSTMCell(8))
+    stack.add(gluon.rnn.DropoutCell(0.2))
+    stack.add(gluon.rnn.LSTMCell(8))
+    stack.initialize()
+    x = mx.nd.random_uniform(shape=(2, 4, 6))
+    outputs, states = stack.unroll(4, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 4, 8)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, in_units=10), nn.Dense(4, in_units=16))
+    net.initialize()
+    f = str(tmp_path / "model.params")
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(16, in_units=10), nn.Dense(4, in_units=16))
+    net2.load_parameters(f)
+    onp.testing.assert_allclose(
+        net[0].weight.data().asnumpy(), net2[0].weight.data().asnumpy())
+
+
+def test_collect_params_select():
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=4))
+    net.initialize()
+    all_p = net.collect_params()
+    w_only = net.collect_params(".*weight")
+    assert len(w_only) == 1
+    assert len(all_p) == 2
+
+
+def test_sequential_getitem_len():
+    net = nn.Sequential()
+    net.add(nn.Dense(4), nn.Dense(5), nn.Dense(6))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
