@@ -1,0 +1,65 @@
+"""Headline benchmark: ResNet-50 training throughput (img/s) on one chip.
+
+Reference baseline (BASELINE.md): 363.69 img/s — MXNet 1.2 ResNet-50
+training, batch 128, single V100 (docs perf.md:243-254).  The driver runs
+this on the real TPU chip and records the JSON line.
+
+One fused XLA program per step (fwd+bwd+SGD momentum, donated buffers),
+bf16 activations/weights with fp32 BatchNorm statistics — the MXU-native
+configuration.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as onp
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import make_train_step
+
+    batch = 128
+    net = gluon.model_zoo.vision.resnet50_v1(classes=1000)
+    net.initialize(init=mx.init.Xavier())
+    net(mx.nd.zeros((1, 3, 224, 224)))  # resolve deferred shapes
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step_fn, params, opt_state = make_train_step(
+        net, loss_fn, optimizer="sgd", learning_rate=0.1, momentum=0.9,
+        donate=False, compute_dtype="bfloat16")
+
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.asarray(onp.random.rand(batch, 3, 224, 224), dtype=jnp.bfloat16
+                    ).astype(jnp.float32)
+    y = jnp.asarray(
+        onp.random.randint(0, 1000, size=(batch,)).astype("float32"))
+    key = jax.random.key(0)
+
+    # warmup / compile
+    loss, params, opt_state = step_fn(params, opt_state, x, y, key, 1.0)
+    jax.block_until_ready(loss)
+
+    n_steps = 20
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        loss, params, opt_state = step_fn(
+            params, opt_state, x, y, key, float(i + 2))
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    throughput = batch * n_steps / dt
+
+    baseline = 363.69  # V100 bs128 (BASELINE.md row 1)
+    print(json.dumps({
+        "metric": "resnet50_train_throughput",
+        "value": round(throughput, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(throughput / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,200 @@
+"""DataLoader (reference: python/mxnet/gluon/data/dataloader.py).
+
+Reference design: multi-process workers + shared-memory NDArray rebuild
+via ForkingPickler (dataloader.py:28-92).  TPU-native redesign: workers
+produce host numpy batches (pickled over pipes — no CUDA context issues
+to dodge), and the main process device_puts once per batch; the
+double-buffered host→HBM copy is the prefetch.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import multiprocessing.pool
+import sys
+
+import numpy as onp
+
+from ... import ndarray as nd
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference default_batchify_fn)."""
+    if isinstance(data[0], nd.NDArray):
+        return nd.stack(*data)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = onp.asarray(data)
+    return nd.array(data, dtype=data.dtype)
+
+
+def default_mp_batchify_fn(data):
+    """Worker-side batchify: keep numpy (device_put happens in the main
+    process — workers must not touch the accelerator)."""
+    if isinstance(data[0], nd.NDArray):
+        return onp.stack([d.asnumpy() for d in data])
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_mp_batchify_fn(i) for i in data]
+    return onp.asarray(data)
+
+
+def _numpy_to_nd(data):
+    """device_put worker-produced numpy batches in the main process."""
+    if isinstance(data, onp.ndarray):
+        return nd.array(data, dtype=data.dtype)
+    if isinstance(data, (list, tuple)):
+        return [_numpy_to_nd(d) for d in data]
+    return data
+
+
+_worker_dataset = None
+
+
+def _worker_initializer(dataset):
+    global _worker_dataset
+    _worker_dataset = dataset
+
+
+def _worker_fn(samples, batchify_fn, dataset=None):
+    """Function for processing data in worker process."""
+    global _worker_dataset
+    ds = dataset if dataset is not None else _worker_dataset
+    return batchify_fn([ds[i] for i in samples])
+
+
+class _MultiWorkerIter:
+    def __init__(self, worker_pool, batchify_fn, batch_sampler,
+                 pin_memory=False, worker_fn=_worker_fn, prefetch=0,
+                 dataset=None):
+        self._worker_pool = worker_pool
+        self._batchify_fn = batchify_fn
+        self._batch_sampler = batch_sampler
+        self._data_buffer = {}
+        self._rcvd_idx = 0
+        self._sent_idx = 0
+        self._iter = iter(self._batch_sampler)
+        self._worker_fn = worker_fn
+        self._pin_memory = pin_memory
+        self._dataset = dataset
+        for _ in range(prefetch):
+            self._push_next()
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _push_next(self):
+        r = next(self._iter, None)
+        if r is None:
+            return
+        async_ret = self._worker_pool.apply_async(
+            self._worker_fn, (r, self._batchify_fn, self._dataset))
+        self._data_buffer[self._sent_idx] = async_ret
+        self._sent_idx += 1
+
+    def __next__(self):
+        self._push_next()
+        if self._rcvd_idx == self._sent_idx:
+            assert not self._data_buffer, (
+                "Data buffer should be empty at this moment")
+            raise StopIteration
+        assert self._rcvd_idx < self._sent_idx, (
+            "rcvd_idx must be smaller than sent_idx")
+        assert self._rcvd_idx in self._data_buffer, (
+            "fatal error with _push_next, rcvd_idx missing")
+        ret = self._data_buffer.pop(self._rcvd_idx)
+        batch = _numpy_to_nd(ret.get())
+        self._rcvd_idx += 1
+        return batch
+
+    def next(self):
+        return self.__next__()
+
+    def __iter__(self):
+        return self
+
+
+class DataLoader:
+    """Loads batches from a Dataset (reference gluon DataLoader)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False,
+                 sampler=None, last_batch=None, batch_sampler=None,
+                 batchify_fn=None, num_workers=0, pin_memory=False,
+                 prefetch=None, thread_pool=False):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._thread_pool = thread_pool
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is "
+                    "specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(
+                sampler, batch_size, last_batch if last_batch else "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be "
+                "specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = num_workers if num_workers >= 0 else 0
+        self._worker_pool = None
+        self._prefetch = max(
+            0, int(prefetch) if prefetch is not None
+            else 2 * self._num_workers)
+        if self._num_workers > 0:
+            if self._thread_pool:
+                self._worker_pool = multiprocessing.pool.ThreadPool(
+                    self._num_workers)
+            else:
+                self._worker_pool = multiprocessing.get_context(
+                    "fork").Pool(
+                    self._num_workers,
+                    initializer=_worker_initializer,
+                    initargs=[self._dataset])
+        if batchify_fn is None:
+            if num_workers > 0 and not thread_pool:
+                self._batchify_fn = default_mp_batchify_fn
+            else:
+                self._batchify_fn = default_batchify_fn
+        else:
+            self._batchify_fn = batchify_fn
+
+    def __iter__(self):
+        if self._num_workers == 0:
+
+            def same_process_iter():
+                for batch in self._batch_sampler:
+                    ret = self._batchify_fn(
+                        [self._dataset[idx] for idx in batch])
+                    yield ret
+
+            return same_process_iter()
+        return _MultiWorkerIter(
+            self._worker_pool, self._batchify_fn, self._batch_sampler,
+            pin_memory=self._pin_memory, worker_fn=_worker_fn,
+            prefetch=self._prefetch,
+            # fork-Pool workers get the dataset via _worker_initializer;
+            # ThreadPool workers share our address space and need it passed
+            dataset=self._dataset if self._thread_pool else None)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __del__(self):
+        if self._worker_pool:
+            assert isinstance(
+                self._worker_pool,
+                (multiprocessing.pool.Pool, multiprocessing.pool.ThreadPool))
+            self._worker_pool.terminate()

@@ -1,0 +1,195 @@
+"""Vision datasets (reference: python/mxnet/gluon/data/vision/datasets.py).
+
+MNIST/FashionMNIST read the standard IDX files; CIFAR10/100 read the
+binary batches; ImageRecordDataset/ImageFolderDataset over local files.
+Zero-egress environment: datasets are read from `root`, never downloaded.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as onp
+
+from .... import ndarray as nd
+from .... import recordio
+from ....base import MXNetError
+from ..dataset import Dataset, RecordFileDataset, _DownloadedDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset"]
+
+
+def _read_idx(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        data = f.read()
+    magic = struct.unpack(">I", data[:4])[0]
+    ndim = magic & 0xFF
+    dims = struct.unpack(">" + "I" * ndim, data[4 : 4 + 4 * ndim])
+    arr = onp.frombuffer(data[4 + 4 * ndim:], dtype=onp.uint8)
+    return arr.reshape(dims)
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from IDX files in `root` (reference gluon MNIST)."""
+
+    _train_files = ("train-images-idx3-ubyte", "train-labels-idx1-ubyte")
+    _test_files = ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _find(self, base):
+        for cand in (base, base + ".gz"):
+            p = os.path.join(self._root, cand)
+            if os.path.isfile(p):
+                return p
+        raise MXNetError(
+            f"{base} not found under {self._root}; this environment has "
+            "no network egress — place the IDX files there manually.")
+
+    def _get_data(self):
+        files = self._train_files if self._train else self._test_files
+        images = _read_idx(self._find(files[0]))
+        labels = _read_idx(self._find(files[1]))
+        self._data = nd.array(
+            images.reshape(-1, 28, 28, 1).astype(onp.uint8), dtype="uint8")
+        self._label = labels.astype(onp.int32)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "fashion-mnist"),
+                 train=True, transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR10 from the binary batches in `root`."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "cifar10"),
+                 train=True, transform=None):
+        self._train = train
+        self._archive_subdir = "cifar-10-batches-bin"
+        super().__init__(root, transform)
+
+    def _read_batch(self, filename):
+        with open(filename, "rb") as fin:
+            data = onp.frombuffer(fin.read(), dtype=onp.uint8).reshape(
+                -1, 3072 + 1)
+        return (
+            data[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1),
+            data[:, 0].astype(onp.int32))
+
+    def _get_data(self):
+        base = self._root
+        sub = os.path.join(base, self._archive_subdir)
+        if os.path.isdir(sub):
+            base = sub
+        if self._train:
+            filenames = [os.path.join(base, f"data_batch_{i}.bin")
+                         for i in range(1, 6)]
+        else:
+            filenames = [os.path.join(base, "test_batch.bin")]
+        for f in filenames:
+            if not os.path.isfile(f):
+                raise MXNetError(
+                    f"{f} not found; no network egress — place CIFAR "
+                    "binary batches there manually.")
+        data, label = zip(*[self._read_batch(f) for f in filenames])
+        self._data = nd.array(onp.concatenate(data), dtype="uint8")
+        self._label = onp.concatenate(label)
+
+
+class CIFAR100(CIFAR10):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "cifar100"),
+                 fine_label=False, train=True, transform=None):
+        self._fine_label = fine_label
+        self._train = train
+        self._archive_subdir = "cifar-100-binary"
+        _DownloadedDataset.__init__(self, root, transform)
+
+    def _read_batch(self, filename):
+        with open(filename, "rb") as fin:
+            data = onp.frombuffer(fin.read(), dtype=onp.uint8).reshape(
+                -1, 3072 + 2)
+        return (
+            data[:, 2:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1),
+            data[:, 0 + self._fine_label].astype(onp.int32))
+
+    def _get_data(self):
+        base = self._root
+        sub = os.path.join(base, self._archive_subdir)
+        if os.path.isdir(sub):
+            base = sub
+        name = "train.bin" if self._train else "test.bin"
+        f = os.path.join(base, name)
+        if not os.path.isfile(f):
+            raise MXNetError(f"{f} not found (no network egress)")
+        data, label = self._read_batch(f)
+        self._data = nd.array(data, dtype="uint8")
+        self._label = label
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Images + labels from a .rec file (reference ImageRecordDataset)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        record = super().__getitem__(idx)
+        header, img_bytes = recordio.unpack(record)
+        img = recordio._imdecode(
+            onp.frombuffer(img_bytes, dtype=onp.uint8), self._flag)
+        img = nd.array(img, dtype="uint8")
+        if self._transform is not None:
+            return self._transform(img, header.label)
+        return img, header.label
+
+
+class ImageFolderDataset(Dataset):
+    """label = subfolder index (reference ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                filename = os.path.join(path, filename)
+                ext = os.path.splitext(filename)[1]
+                if ext.lower() not in self._exts:
+                    continue
+                self.items.append((filename, label))
+
+    def __getitem__(self, idx):
+        fname, label = self.items[idx]
+        with open(fname, "rb") as f:
+            buf = onp.frombuffer(f.read(), dtype=onp.uint8)
+        img = nd.array(recordio._imdecode(buf, self._flag), dtype="uint8")
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

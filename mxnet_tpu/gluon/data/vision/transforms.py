@@ -1,0 +1,273 @@
+"""Vision transforms (reference: python/mxnet/gluon/data/vision/transforms.py).
+
+Transforms are HybridBlocks over the image ops (src/operator/image/ in the
+reference), operating on HWC uint8/float images.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ... import nn
+from ...block import Block, HybridBlock
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
+           "RandomSaturation", "RandomHue", "RandomColorJitter",
+           "RandomLighting", "RandomGray"]
+
+
+class Compose(nn.Sequential):
+    """Sequentially compose transforms."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        transforms.append(None)
+        hybrid = []
+        for i in transforms:
+            if isinstance(i, HybridBlock):
+                hybrid.append(i)
+                continue
+            if len(hybrid) == 1:
+                self.add(hybrid[0])
+            elif len(hybrid) > 1:
+                hblock = nn.HybridSequential()
+                for j in hybrid:
+                    hblock.add(j)
+                self.add(hblock)
+            hybrid = []
+            if i is not None:
+                self.add(i)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1]."""
+
+    def hybrid_forward(self, F, x):
+        x = F.cast(x, dtype="float32") / 255.0
+        if x.ndim == 3:
+            return F.transpose(x, axes=(2, 0, 1))
+        return F.transpose(x, axes=(0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = mean
+        self._std = std
+
+    def hybrid_forward(self, F, x):
+        from .... import ndarray as nd_mod
+
+        mean = onp.asarray(self._mean, dtype="float32").reshape(-1, 1, 1)
+        std = onp.asarray(self._std, dtype="float32").reshape(-1, 1, 1)
+        return (x - nd_mod.array(mean)) / nd_mod.array(std)
+
+
+def _resize_hwc(x, size, interp=1):
+    """Bilinear resize of an HWC image via jax.image."""
+    import jax.image
+
+    from .... import ndarray as nd_mod
+
+    if isinstance(size, int):
+        size = (size, size)
+    w, h = size  # reference convention: (width, height)
+    data = x._data.astype("float32")
+    out = jax.image.resize(
+        data, (h, w, data.shape[2]),
+        method="nearest" if interp == 0 else "linear")
+    return nd_mod.NDArray(out.astype(x._data.dtype))
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        if self._keep and isinstance(self._size, int):
+            h, w = x.shape[0], x.shape[1]
+            if h > w:
+                size = (self._size, int(h * self._size / w))
+            else:
+                size = (int(w * self._size / h), self._size)
+        else:
+            size = self._size
+        return _resize_hwc(x, size, self._interpolation)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        if isinstance(size, int):
+            size = (size, size)
+        self._size = size
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        w, h = self._size
+        H, W = x.shape[0], x.shape[1]
+        if H < h or W < w:
+            x = _resize_hwc(x, (max(w, W), max(h, H)), self._interpolation)
+            H, W = x.shape[0], x.shape[1]
+        y0 = (H - h) // 2
+        x0 = (W - w) // 2
+        return x[y0 : y0 + h, x0 : x0 + w, :]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        if isinstance(size, int):
+            size = (size, size)
+        self._size = size
+        self._scale = scale
+        self._ratio = ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        H, W = x.shape[0], x.shape[1]
+        area = H * W
+        for _ in range(10):
+            target_area = onp.random.uniform(*self._scale) * area
+            log_ratio = (onp.log(self._ratio[0]), onp.log(self._ratio[1]))
+            aspect = onp.exp(onp.random.uniform(*log_ratio))
+            w = int(round(onp.sqrt(target_area * aspect)))
+            h = int(round(onp.sqrt(target_area / aspect)))
+            if w <= W and h <= H:
+                x0 = onp.random.randint(0, W - w + 1)
+                y0 = onp.random.randint(0, H - h + 1)
+                crop = x[y0 : y0 + h, x0 : x0 + w, :]
+                return _resize_hwc(crop, self._size, self._interpolation)
+        return CenterCrop(self._size, self._interpolation)(x)
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if onp.random.rand() < 0.5:
+            return x[:, ::-1, :]
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if onp.random.rand() < 0.5:
+            return x[::-1, :, :]
+        return x
+
+
+class _RandomJitter(Block):
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0,
+                 hue=0.0):
+        super().__init__()
+        self._args = (brightness, contrast, saturation, hue)
+
+    def forward(self, x):
+        from .... import ndarray as nd_mod
+
+        img = x.asnumpy().astype("float32")
+        b, c, s, h = self._args
+        if b > 0:
+            img *= 1.0 + onp.random.uniform(-b, b)
+        if c > 0:
+            coef = onp.array([0.299, 0.587, 0.114], dtype="float32")
+            alpha = 1.0 + onp.random.uniform(-c, c)
+            gray_mean = (img * coef).sum() / (img.size / 3)
+            img = img * alpha + gray_mean * (1 - alpha)
+        if s > 0:
+            coef = onp.array([0.299, 0.587, 0.114], dtype="float32")
+            alpha = 1.0 + onp.random.uniform(-s, s)
+            gray = (img * coef).sum(axis=2, keepdims=True)
+            img = img * alpha + gray * (1 - alpha)
+        if h > 0:
+            alpha = onp.random.uniform(-h, h)
+            u = onp.cos(alpha * onp.pi)
+            w = onp.sin(alpha * onp.pi)
+            bt = onp.array([[1.0, 0.0, 0.0],
+                            [0.0, u, -w],
+                            [0.0, w, u]], dtype="float32")
+            t_yiq = onp.array([[0.299, 0.587, 0.114],
+                               [0.596, -0.274, -0.321],
+                               [0.211, -0.523, 0.311]], dtype="float32")
+            t_rgb = onp.array([[1.0, 0.956, 0.621],
+                               [1.0, -0.272, -0.647],
+                               [1.0, -1.107, 1.705]], dtype="float32")
+            t = t_rgb @ bt @ t_yiq
+            img = img @ t.T
+        return nd_mod.array(onp.clip(img, 0, 255), dtype="float32")
+
+
+class RandomBrightness(_RandomJitter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+
+
+class RandomContrast(_RandomJitter):
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+
+
+class RandomSaturation(_RandomJitter):
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+
+
+class RandomHue(_RandomJitter):
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+
+
+class RandomColorJitter(_RandomJitter):
+    pass
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA noise (reference random_lighting)."""
+
+    _eigval = onp.array([55.46, 4.794, 1.148], dtype="float32")
+    _eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]], dtype="float32")
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        from .... import ndarray as nd_mod
+
+        alpha = onp.random.normal(0, self._alpha, size=(3,)).astype(
+            "float32")
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        return nd_mod.array(
+            x.asnumpy().astype("float32") + rgb, dtype="float32")
+
+
+class RandomGray(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        from .... import ndarray as nd_mod
+
+        if onp.random.rand() < self._p:
+            coef = onp.array([0.299, 0.587, 0.114], dtype="float32")
+            gray = (x.asnumpy().astype("float32") * coef).sum(
+                axis=2, keepdims=True)
+            return nd_mod.array(
+                onp.broadcast_to(gray, x.shape).copy(), dtype="float32")
+        return x

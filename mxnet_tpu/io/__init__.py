@@ -1,0 +1,2 @@
+"""Data iterators (reference: python/mxnet/io/)."""
+from .io import *  # noqa: F401,F403

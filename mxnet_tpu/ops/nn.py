@@ -137,15 +137,21 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
     bshape = [1] * data.ndim
     bshape[axis % data.ndim] = data.shape[axis % data.ndim]
 
+    # stats in fp32 regardless of activation dtype (bf16 AMP-safe);
+    # output cast back so downstream matmuls stay on the bf16 MXU path
+    data32 = data.astype(jnp.float32)
     if train and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
+        mean = jnp.mean(data32, axis=red)
+        var = jnp.var(data32, axis=red)
     else:
-        mean, var = moving_mean, moving_var
+        mean, var = (moving_mean.astype(jnp.float32),
+                     moving_var.astype(jnp.float32))
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     inv = jax.lax.rsqrt(var + eps)
-    out = (data - mean.reshape(bshape)) * (inv * g).reshape(bshape) \
-        + beta.reshape(bshape)
+    out = (data32 - mean.reshape(bshape))
+    out = out * (inv * g.astype(jnp.float32)).reshape(bshape) \
+        + beta.astype(jnp.float32).reshape(bshape)
+    out = out.astype(data.dtype)
     if output_mean_var:
         return out, mean, var
     return out

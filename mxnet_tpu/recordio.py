@@ -1,0 +1,269 @@
+"""RecordIO format: pack/unpack + (indexed) record file readers/writers.
+
+Reference parity: python/mxnet/recordio.py (509 LoC: ``MXRecordIO``,
+``MXIndexedRecordIO``, ``IRHeader``, pack/unpack/pack_img/unpack_img) and
+the dmlc-core recordio framing (magic + cflag|length + payload + padding).
+This implementation is pure Python but byte-compatible with the reference
+file format so .rec datasets interchange.
+"""
+from __future__ import annotations
+
+import ctypes  # noqa: F401  (API-compat import)
+import numbers
+import os
+import struct
+from collections import namedtuple
+
+import numpy as onp
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "unpack_img", "pack_img"]
+
+_kMagic = 0xCED7230A
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def _pad_size(n):
+    return ((n + 3) // 4) * 4 - n
+
+
+class MXRecordIO:
+    """Sequential .rec reader/writer (reference MXRecordIO; C++ framing
+    dmlc-core src/recordio.cc)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.fp = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.fp = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.fp = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError(f"Invalid flag {self.flag}")
+        self.is_open = True
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        is_open = self.is_open
+        self.close()
+        d = dict(self.__dict__)
+        d["is_open"] = is_open
+        d.pop("fp", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.fp = None
+        is_open = d.get("is_open", False)
+        self.is_open = False
+        if is_open:
+            self.open()
+
+    def close(self):
+        if self.is_open and self.fp is not None:
+            self.fp.close()
+            self.fp = None
+        self.is_open = False
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def _write_part(self, cflag, part):
+        lrec = (cflag << 29) | len(part)
+        self.fp.write(struct.pack("<II", _kMagic, lrec))
+        self.fp.write(part)
+        pad = _pad_size(len(part))
+        if pad:
+            self.fp.write(b"\x00" * pad)
+
+    def write(self, buf):
+        """Write one logical record.
+
+        dmlc framing (dmlc-core src/recordio.cc): a payload containing
+        the magic bytes is split at each occurrence into continuation
+        parts — cflag 1=begin / 2=middle / 3=end, magic dropped from the
+        parts and re-inserted by the reader — so the stream stays
+        resynchronizable.
+        """
+        assert self.writable
+        magic_bytes = struct.pack("<I", _kMagic)
+        parts = []
+        start = 0
+        i = buf.find(magic_bytes)
+        while i != -1:
+            parts.append(buf[start:i])
+            start = i + 4
+            i = buf.find(magic_bytes, start)
+        parts.append(buf[start:])
+        if len(parts) == 1:
+            self._write_part(0, parts[0])
+        else:
+            for j, part in enumerate(parts):
+                cflag = 1 if j == 0 else (3 if j == len(parts) - 1 else 2)
+                self._write_part(cflag, part)
+
+    def _read_part(self):
+        head = self.fp.read(8)
+        if len(head) < 8:
+            return None, None
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _kMagic:
+            raise MXNetError("Invalid record magic number")
+        cflag = (lrec >> 29) & 0x7
+        length = lrec & 0x1FFFFFFF
+        buf = self.fp.read(length)
+        pad = _pad_size(length)
+        if pad:
+            self.fp.read(pad)
+        return cflag, buf
+
+    def read(self):
+        """Read one logical record, reassembling continuation parts."""
+        assert not self.writable
+        cflag, buf = self._read_part()
+        if buf is None:
+            return None
+        if cflag == 0:
+            return buf
+        parts = [buf]
+        while cflag != 3:
+            cflag, nxt = self._read_part()
+            if nxt is None:
+                raise MXNetError(
+                    "truncated multi-part record at end of file")
+            parts.append(nxt)
+        return struct.pack("<I", _kMagic).join(parts)
+
+    def tell(self):
+        return self.fp.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access .rec via a .idx sidecar (reference MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin.readlines():
+                    line = line.strip().split("\t")
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.is_open and self.writable:
+            with open(self.idx_path, "w") as fout:
+                for key in self.keys:
+                    fout.write(f"{key}\t{self.idx[key]}\n")
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        pos = self.idx[idx]
+        self.fp.seek(pos)
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+def pack(header, s):
+    """Pack an IRHeader + byte string (reference recordio.py pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = header._replace(flag=0)
+    else:
+        label = onp.asarray(header.label, dtype=onp.float32)
+        header = header._replace(flag=label.size, label=0)
+        s = label.tobytes() + s
+    s = struct.pack(_IR_FORMAT, header.flag, header.label, header.id,
+                    header.id2) + s
+    return s
+
+
+def unpack(s):
+    """Unpack to (IRHeader, payload bytes) (reference recordio.py unpack)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        header = header._replace(
+            label=onp.frombuffer(s, onp.float32, header.flag))
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack a packed image record to (header, BGR ndarray)."""
+    header, s = unpack(s)
+    img = _imdecode(onp.frombuffer(s, dtype=onp.uint8), iscolor)
+    return header, img
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack an image array (requires cv2; gated in this environment)."""
+    try:
+        import cv2
+    except ImportError:
+        raise MXNetError(
+            "pack_img requires opencv (cv2), unavailable in this "
+            "environment; pack pre-encoded bytes with pack() instead.")
+    encode_params = None
+    if img_fmt in (".jpg", ".jpeg"):
+        encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+    elif img_fmt == ".png":
+        encode_params = [cv2.IMWRITE_PNG_COMPRESSION, quality]
+    ret, buf = cv2.imencode(img_fmt, img, encode_params)
+    assert ret, "failed to encode image"
+    return pack(header, buf.tobytes())
+
+
+def _imdecode(buf, iscolor=-1):
+    try:
+        import cv2
+
+        return cv2.imdecode(buf, iscolor)
+    except ImportError:
+        pass
+    try:
+        from PIL import Image
+        import io as _io
+
+        img = onp.asarray(Image.open(_io.BytesIO(buf.tobytes())))
+        if img.ndim == 3:
+            img = img[..., ::-1]  # RGB -> BGR to match cv2 convention
+        return img
+    except ImportError:
+        raise MXNetError(
+            "image decode requires cv2 or PIL; neither is available")

@@ -4,16 +4,25 @@ Mirrors the reference's strategy of testing distributed semantics with
 multi-process local jobs (SURVEY.md §4: ci runs `launch.py -n 7 --launcher
 local dist_sync_kvstore.py`); here multi-chip semantics are tested on
 XLA's forced host-platform device count.
+
+NOTE: this environment presets JAX_PLATFORMS=axon (the TPU tunnel) and
+the env var does NOT yield to a later os.environ write — only
+jax.config.update('jax_platforms', ...) reliably overrides, so we do
+both.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as onp
 import pytest

@@ -1,0 +1,383 @@
+"""Trainer-level integration tests.
+
+Reference model: tests/python/train/test_mlp.py & test_conv.py — small
+real trainings asserting final accuracy on synthetic data (no dataset
+downloads in this environment).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def _toy_classification(n=512, d=16, classes=4, seed=3):
+    """Linearly separable-ish synthetic data."""
+    rng = onp.random.RandomState(seed)
+    w = rng.randn(d, classes).astype("float32")
+    X = rng.randn(n, d).astype("float32")
+    y = (X @ w + 0.1 * rng.randn(n, classes)).argmax(axis=1)
+    return X, y.astype("float32")
+
+
+@pytest.mark.parametrize("hybridize", [False, True])
+def test_mlp_convergence(hybridize):
+    X, y = _toy_classification()
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(64, activation="relu"), nn.Dense(4))
+    net.initialize(init=mx.init.Xavier())
+    if hybridize:
+        net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    data_iter = mx.io.NDArrayIter(X, y, batch_size=64, shuffle=True)
+    metric = mx.metric.Accuracy()
+    for epoch in range(10):
+        data_iter.reset()
+        metric.reset()
+        for batch in data_iter:
+            with autograd.record():
+                out = net(batch.data[0])
+                loss = loss_fn(out, batch.label[0])
+            loss.backward()
+            trainer.step(batch.data[0].shape[0])
+            metric.update([batch.label[0]], [out])
+    assert metric.get()[1] > 0.9, metric.get()
+
+
+def test_lenet_one_step():
+    net = gluon.model_zoo.vision.get_model("lenet")
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.random_uniform(shape=(2, 1, 28, 28))
+    y = mx.nd.array([1, 2])
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam")
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(2)
+    assert loss.shape == (2,)
+
+
+@pytest.mark.parametrize("name,in_size", [
+    ("resnet18_v1", 32),
+    ("resnet18_v2", 32),
+    ("mobilenet0.25", 32),
+    ("squeezenet1.1", 64),
+])
+def test_model_zoo_forward(name, in_size):
+    net = gluon.model_zoo.vision.get_model(name, classes=10)
+    net.initialize()
+    x = mx.nd.random_uniform(shape=(1, 3, in_size, in_size))
+    out = net(x)
+    assert out.shape == (1, 10)
+
+
+def test_resnet50_builds():
+    net = gluon.model_zoo.vision.resnet50_v1(classes=10)
+    net.initialize()
+    x = mx.nd.random_uniform(shape=(1, 3, 64, 64))
+    assert net(x).shape == (1, 10)
+
+
+def test_optimizers_decrease_loss():
+    X, y = _toy_classification(n=128, d=8, classes=2)
+    Xn, yn = mx.nd.array(X), mx.nd.array(y)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for opt_name, opt_args in [
+        ("sgd", {"learning_rate": 0.1}),
+        ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+        ("nag", {"learning_rate": 0.05, "momentum": 0.9}),
+        ("adam", {}),
+        ("adagrad", {"learning_rate": 0.1}),
+        ("rmsprop", {}),
+        ("adadelta", {"rho": 0.9}),
+        ("signum", {"learning_rate": 0.01}),
+        ("ftrl", {}),
+        ("adamax", {}),
+        ("nadam", {}),
+    ]:
+        net = nn.Dense(2)
+        net.initialize()
+        trainer = gluon.Trainer(net.collect_params(), opt_name, opt_args)
+        first = last = None
+        for _ in range(20):
+            with autograd.record():
+                loss = mx.nd.mean(loss_fn(net(Xn), yn))
+            loss.backward()
+            trainer.step(1)
+            v = float(loss.asnumpy())
+            first = v if first is None else first
+            last = v
+        assert last < first, (opt_name, first, last)
+
+
+def test_lr_schedulers():
+    s = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(5) == 1.0
+    assert s(15) == 0.5
+    m = mx.lr_scheduler.MultiFactorScheduler(
+        step=[10, 20], factor=0.1, base_lr=1.0)
+    assert m(5) == 1.0
+    assert abs(m(15) - 0.1) < 1e-9
+    p = mx.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0, pwr=1)
+    assert abs(p(50) - 0.5) < 1e-6
+    c = mx.lr_scheduler.CosineScheduler(max_update=100, base_lr=1.0)
+    assert abs(c(50) - 0.5) < 1e-6
+    w = mx.lr_scheduler.FactorScheduler(
+        step=1000, base_lr=1.0, warmup_steps=10, warmup_begin_lr=0.0)
+    assert w(5) == 0.5
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(2, in_units=4)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.1})
+    x = mx.nd.random_uniform(shape=(8, 4))
+    with autograd.record():
+        loss = mx.nd.mean(net(x))
+    loss.backward()
+    trainer.step(8)
+    f = str(tmp_path / "trainer.states")
+    trainer.save_states(f)
+    trainer2 = gluon.Trainer(net.collect_params(), "adam",
+                             {"learning_rate": 0.1})
+    trainer2.load_states(f)
+    assert trainer2._updaters[0].states.keys() == \
+        trainer._updaters[0].states.keys()
+
+
+def test_stale_grad_detection():
+    net = nn.Dense(2, in_units=4)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = mx.nd.random_uniform(shape=(2, 4))
+    with autograd.record():
+        loss = mx.nd.mean(net(x))
+    loss.backward()
+    trainer.step(2)
+    with pytest.raises(mx.MXNetError):
+        trainer.step(2)  # no new backward -> stale
+
+
+def test_kvstore_local():
+    kv = mx.kv.create("local")
+    shape = (4, 4)
+    kv.init("3", mx.nd.ones(shape))
+    out = mx.nd.zeros(shape)
+    kv.push("3", mx.nd.ones(shape) * 8)
+    kv.pull("3", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), 8 * onp.ones(shape))
+    # list aggregation
+    kv.push("3", [mx.nd.ones(shape)] * 4)
+    kv.pull("3", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), 4 * onp.ones(shape))
+
+
+def test_kvstore_compression():
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("k", mx.nd.zeros((4,)))
+    kv.push("k", mx.nd.array([1.0, -1.0, 0.2, 0.0]))
+    out = mx.nd.zeros((4,))
+    kv.pull("k", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0, 0.0])
+
+
+def test_ndarray_iter_pad_and_shuffle():
+    X = onp.arange(20, dtype="float32").reshape(10, 2)
+    y = onp.arange(10, dtype="float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    it2 = mx.io.NDArrayIter(X, y, batch_size=4,
+                            last_batch_handle="discard")
+    assert len(list(it2)) == 2
+
+
+def test_dataloader_and_datasets():
+    from mxnet_tpu.gluon import data as gdata
+
+    X = onp.random.rand(20, 3).astype("float32")
+    y = onp.arange(20).astype("float32")
+    ds = gdata.ArrayDataset(X, y)
+    assert len(ds) == 20
+    loader = gdata.DataLoader(ds, batch_size=6, shuffle=True,
+                              last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (6, 3)
+
+    ds2 = ds.transform_first(lambda x: x * 2)
+    x0, y0 = ds2[0]
+    onp.testing.assert_allclose(onp.asarray(x0), X[0] * 2, rtol=1e-6)
+
+
+def test_metrics():
+    acc = mx.metric.Accuracy()
+    pred = mx.nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = mx.nd.array([1, 0, 0])
+    acc.update([label], [pred])
+    assert abs(acc.get()[1] - 2.0 / 3) < 1e-6
+
+    topk = mx.metric.TopKAccuracy(top_k=2)
+    topk.update([label], [pred])
+    assert topk.get()[1] == 1.0
+
+    mse = mx.metric.MSE()
+    mse.update([mx.nd.zeros((3, 1))], [mx.nd.ones((3, 1))])
+    assert abs(mse.get()[1] - 1.0) < 1e-6
+
+    ppl = mx.metric.Perplexity(ignore_label=None)
+    p = mx.nd.array([[0.5, 0.5], [0.9, 0.1]])
+    l = mx.nd.array([0, 0])
+    ppl.update([l], [p])
+    expected = onp.exp(-(onp.log(0.5) + onp.log(0.9)) / 2)
+    assert abs(ppl.get()[1] - expected) < 1e-5
+
+    comp = mx.metric.create(["acc", "mse"])
+    assert isinstance(comp, mx.metric.CompositeEvalMetric)
+
+
+def test_recordio_roundtrip(tmp_path):
+    from mxnet_tpu import recordio
+
+    f = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(f, "w")
+    for i in range(5):
+        w.write(f"record{i}".encode())
+    w.close()
+    r = recordio.MXRecordIO(f, "r")
+    for i in range(5):
+        assert r.read() == f"record{i}".encode()
+    assert r.read() is None
+    r.close()
+
+
+def test_indexed_recordio_and_pack(tmp_path):
+    from mxnet_tpu import recordio
+
+    frec = str(tmp_path / "x.rec")
+    fidx = str(tmp_path / "x.idx")
+    w = recordio.MXIndexedRecordIO(fidx, frec, "w")
+    for i in range(5):
+        header = recordio.IRHeader(0, float(i), i, 0)
+        w.write_idx(i, recordio.pack(header, f"payload{i}".encode()))
+    w.close()
+    r = recordio.MXIndexedRecordIO(fidx, frec, "r")
+    h, s = recordio.unpack(r.read_idx(3))
+    assert h.label == 3.0
+    assert s == b"payload3"
+    # multi-label header
+    h2 = recordio.IRHeader(0, onp.array([1.0, 2.0], dtype="float32"), 7, 0)
+    packed = recordio.pack(h2, b"xy")
+    hh, ss = recordio.unpack(packed)
+    onp.testing.assert_allclose(hh.label, [1.0, 2.0])
+    assert ss == b"xy"
+
+
+def test_ndarray_iter_roll_over():
+    X = onp.arange(20, dtype="float32").reshape(10, 2)
+    y = onp.arange(10, dtype="float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=4,
+                           last_batch_handle="roll_over")
+    epoch1 = list(it)
+    assert len(epoch1) == 2  # partial tail cached, not yielded
+    it.reset()
+    epoch2 = list(it)
+    # first batch of epoch 2 = 2 cached rows + 2 new rows
+    assert epoch2[0].data[0].shape == (4, 2)
+    onp.testing.assert_allclose(
+        epoch2[0].data[0].asnumpy()[:2], X[8:10])
+    assert epoch2[0].pad == 2
+
+
+def test_dataloader_thread_pool():
+    from mxnet_tpu.gluon import data as gdata
+
+    X = onp.random.rand(12, 3).astype("float32")
+    y = onp.arange(12).astype("float32")
+    ds = gdata.ArrayDataset(X, y)
+    loader = gdata.DataLoader(ds, batch_size=4, num_workers=2,
+                              thread_pool=True)
+    batches = list(loader)
+    assert len(batches) == 3
+    assert batches[0][0].shape == (4, 3)
+
+
+def test_recordio_magic_in_payload(tmp_path):
+    """Payload containing the magic bytes must round-trip via
+    continuation records (dmlc framing)."""
+    import struct
+    from mxnet_tpu import recordio
+
+    magic = struct.pack("<I", 0xCED7230A)
+    payloads = [
+        b"head" + magic + b"tail",
+        magic + b"x",
+        b"x" + magic,
+        magic * 3,
+        b"plain",
+    ]
+    f = str(tmp_path / "m.rec")
+    w = recordio.MXRecordIO(f, "w")
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(f, "r")
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None
+
+
+def test_fused_adam_matches_eager_adam():
+    from mxnet_tpu.parallel import make_train_step
+    import jax
+    import jax.numpy as jnp
+
+    def build():
+        mx.random.seed(5)
+        onp.random.seed(5)
+        net = nn.Dense(2, in_units=3)
+        net.initialize(init=mx.init.Constant(0.3))
+        return net
+
+    rng = onp.random.RandomState(2)
+    X = rng.rand(8, 3).astype("float32")
+    Y = rng.rand(8, 2).astype("float32")
+    wd = 0.01
+
+    # eager path
+    net1 = build()
+    trainer = gluon.Trainer(net1.collect_params(), "adam",
+                            {"learning_rate": 0.1, "wd": wd,
+                             "rescale_grad": 1.0})
+    loss_fn = gluon.loss.L2Loss()
+    for _ in range(3):
+        with autograd.record():
+            loss = mx.nd.mean(loss_fn(net1(mx.nd.array(X)),
+                                      mx.nd.array(Y)))
+        loss.backward()
+        trainer.step(1)
+
+    # fused path (loss_of takes jnp.mean of the same per-sample loss)
+    net2 = build()
+    step_fn, params, opt_state = make_train_step(
+        net2, loss_fn, optimizer="adam", learning_rate=0.1, wd=wd,
+        donate=False)
+    xj, yj = jnp.asarray(X), jnp.asarray(Y)
+    key = jax.random.key(0)
+    for t in range(3):
+        _, params, opt_state = step_fn(params, opt_state, xj, yj, key,
+                                       float(t + 1))
+    w_eager = net1.weight.data().asnumpy()
+    w_fused = onp.asarray(
+        [v for k, v in params.items() if k.endswith("weight")][0])
+    onp.testing.assert_allclose(w_eager, w_fused, rtol=1e-5, atol=1e-6)
