@@ -1,0 +1,236 @@
+"""Module: executor-backed trainable module.
+
+Reference parity: python/mxnet/module/module.py (``Module`` :40 over
+``DataParallelExecutorGroup``).  TPU-native: ONE executor on one logical
+device view — batch sharding over chips is the parallel layer's job
+(mxnet_tpu.parallel), not N executors.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as onp
+
+from .. import initializer as init_mod
+from .. import ndarray as nd
+from .. import optimizer as opt
+from ..base import MXNetError
+from ..context import cpu
+from .base_module import BaseModule
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        self._symbol = symbol
+        self._data_names = list(data_names) if data_names else []
+        self._label_names = list(label_names) if label_names else []
+        self._context = context or cpu()
+        if isinstance(self._context, (list, tuple)):
+            self._context = self._context[0]  # one logical device view
+        self._fixed_param_names = set(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        self._param_names = [
+            n for n in arg_names
+            if n not in self._data_names and n not in self._label_names
+        ]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._exec = None
+        self._optimizer = None
+        self._updater = None
+        self._arg_params = None  # preloaded checkpoint weights (load())
+        self._aux_params = None
+        self._grad_req = None
+
+    # ------------------------------------------------------------- bind
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        shape_kwargs = {}
+        for desc in data_shapes:
+            name, shape = desc[0], desc[1]
+            shape_kwargs[name] = tuple(shape)
+        if label_shapes:
+            for desc in label_shapes:
+                name, shape = desc[0], desc[1]
+                shape_kwargs[name] = tuple(shape)
+        req = {}
+        for n in self._symbol.list_arguments():
+            if n in self._data_names:
+                req[n] = "write" if inputs_need_grad else "null"
+            elif n in self._label_names or n in self._fixed_param_names:
+                req[n] = "null"
+            else:
+                req[n] = grad_req if for_training else "null"
+        self._grad_req = req
+        self._exec = self._symbol.simple_bind(
+            self._context, grad_req=req, **shape_kwargs)
+        self.binded = True
+        if shared_module is not None and shared_module._exec is not None:
+            # share the actual parameter NDArray objects (reference:
+            # shared_exec memory pool, bucketing_module.py) — an update
+            # through any bucket is visible to all
+            for n in self._param_names:
+                if n in shared_module._exec.arg_dict:
+                    self._exec.arg_dict[n] = \
+                        shared_module._exec.arg_dict[n]
+            for n in self._aux_names:
+                if n in shared_module._exec.aux_dict:
+                    self._exec.aux_dict[n] = \
+                        shared_module._exec.aux_dict[n]
+            self._exec.arg_arrays = [
+                self._exec.arg_dict[n]
+                for n in self._symbol.list_arguments()]
+            self._exec.aux_arrays = [
+                self._exec.aux_dict[n] for n in self._aux_names]
+            if shared_module.params_initialized:
+                self.params_initialized = True
+        if self._arg_params is not None:
+            # apply weights preloaded by Module.load (reference: load
+            # stashes arg/aux params and bind installs them)
+            self.init_params(arg_params=self._arg_params,
+                             aux_params=self._aux_params,
+                             force_init=True, allow_missing=True)
+
+    # ----------------------------------------------------------- params
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        self._check_binded()
+        if self.params_initialized and not force_init:
+            return
+        if initializer is None and (arg_params is None
+                                    or aux_params is None):
+            initializer = init_mod.Uniform(0.01)
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                arr._adopt(self._as_jax(arg_params[name], arr))
+            elif initializer is not None:
+                val = initializer(init_mod.InitDesc(name), arr.shape,
+                                  str(arr.dtype))
+                arr._adopt(nd.array(onp.asarray(val))._data)
+            elif not allow_missing:
+                raise MXNetError(f"missing parameter {name}")
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            if aux_params is not None and name in aux_params:
+                arr._adopt(self._as_jax(aux_params[name], arr))
+            elif initializer is not None:
+                val = initializer(init_mod.InitDesc(name), arr.shape,
+                                  str(arr.dtype))
+                arr._adopt(nd.array(onp.asarray(val))._data)
+        self.params_initialized = True
+
+    @staticmethod
+    def _as_jax(v, like):
+        if isinstance(v, nd.NDArray):
+            return v._data.astype(like._data.dtype)
+        return nd.array(onp.asarray(v))._data.astype(like._data.dtype)
+
+    def get_params(self):
+        self._check_binded()
+        arg = {n: self._exec.arg_dict[n].copy()
+               for n in self._param_names}
+        aux = {n: self._exec.aux_dict[n].copy() for n in self._aux_names}
+        return arg, aux
+
+    # -------------------------------------------------------- optimizer
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        self._check_binded()
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            # key optimizer state by parameter NAME so the updater can be
+            # shared across buckets whose graphs order params differently
+            idx2name = {n: n for n in self._param_names}
+            opt_params = dict(optimizer_params)
+            if "rescale_grad" not in opt_params:
+                # reference module.py: default grad rescale is 1/batch
+                batch_size = self._exec.arg_dict[
+                    self._data_names[0]].shape[0]
+                opt_params["rescale_grad"] = 1.0 / batch_size
+            optimizer = opt.create(
+                optimizer, param_idx2name=idx2name, **opt_params)
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+        self.optimizer_initialized = True
+
+    # ------------------------------------------------------------- exec
+    def forward(self, data_batch, is_train=None):
+        self._check_binded()
+        if is_train is None:
+            is_train = self.for_training
+        feeds = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feeds[name] = arr
+        if data_batch.label is not None and self._label_names:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feeds[name] = arr
+        # rebind on shape change (reference module reshapes executors)
+        for k, v in feeds.items():
+            if tuple(self._exec.arg_dict[k].shape) != tuple(v.shape):
+                self._exec = self._exec.reshape(
+                    **{k2: tuple(v2.shape) for k2, v2 in feeds.items()})
+                break
+        self._exec.forward(is_train=is_train, **feeds)
+
+    def backward(self, out_grads=None):
+        self._check_binded()
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        self._check_binded()
+        assert self.optimizer_initialized
+        for name in self._param_names:
+            if self._grad_req.get(name, "null") == "null":
+                continue
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            self._updater(name, grad, self._exec.arg_dict[name])
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update(labels, self.get_outputs())
+
+    def get_outputs(self, merge_multi_context=True):
+        self._check_binded()
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        self._check_binded()
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    # --------------------------------------------------------------- io
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        from .. import model
+
+        arg_params, aux_params = self.get_params()
+        model.save_checkpoint(prefix, epoch, self._symbol, arg_params,
+                              aux_params)
+        if save_optimizer_states:
+            with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
+                f.write(self._updater.get_states())
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        from .. import model
+
+        sym, arg_params, aux_params = model.load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params, mod._aux_params = arg_params, aux_params
+        return mod
+
+    def install_monitor(self, mon):
+        pass  # monitor integration lands with mx.monitor

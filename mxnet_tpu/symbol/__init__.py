@@ -1,0 +1,5 @@
+"""``mx.sym`` — symbolic front-end (reference: python/mxnet/symbol/)."""
+from .symbol import *  # noqa: F401,F403
+from .symbol import Symbol, Variable, var, Group, load, load_json  # noqa: F401
+from . import _op_namespace  # noqa: F401  (populates sym.<Op> functions)
+from ._op_namespace import *  # noqa: F401,F403

@@ -1,0 +1,274 @@
+"""Executor: run a Symbol graph as one jitted XLA program.
+
+Reference parity: src/executor/graph_executor.{h,cc} (``GraphExecutor``
+bind/simple_bind, Forward/Backward, shared memory pool) — all the graph
+passes (memory planning plan_memory.cc, fusion, CSE) collapse into XLA
+compilation; backward is ``jax.vjp`` over the compiled forward.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import _rng, autograd
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..context import current_context
+from ..ops.registry import get_op
+
+__all__ = ["Executor"]
+
+
+_BN_OPS = ("BatchNorm", "BatchNorm_v1", "SyncBatchNorm")
+
+
+def _eval_graph(sym, value_of, key, train):
+    """Evaluate the DAG: value_of maps variable name -> jax value.
+
+    Returns (outputs list, aux_updates {aux_name: new value}).  During
+    training, BatchNorm batch stats fold into the moving aux values
+    (reference: the op mutates its aux inputs in place,
+    src/operator/nn/batch_norm.cc — no XLA analog, so we thread the
+    update out functionally)."""
+    results = {}  # id(node) -> list of jax values
+    aux_updates = {}
+
+    with _rng.trace_key_scope(key), autograd._Scope(False, train):
+        for node in sym._topo():
+            if node.op is None:
+                results[id(node)] = [value_of[node.name]]
+                continue
+            if node.op == "_group":
+                continue
+            vals = [results[id(inp)][oi] for (inp, oi) in node.inputs]
+            opdef = get_op(node.op)
+            params = dict(node.attrs)
+            if opdef.key_param:
+                params[opdef.key_param] = _rng.take_key()
+            if opdef.train_param and opdef.train_param not in params:
+                params[opdef.train_param] = train
+            if (node.op in _BN_OPS and train
+                    and not params.get("use_global_stats", False)):
+                params["output_mean_var"] = True
+                out, batch_mean, batch_var = opdef.fn(*vals, **params)
+                m = params.get("momentum", 0.9)
+                for slot, stat in ((3, batch_mean), (4, batch_var)):
+                    inp, _ = node.inputs[slot]
+                    if inp.op is None:
+                        old = value_of[inp.name]
+                        aux_updates[inp.name] = (
+                            m * old + (1.0 - m) * stat.astype(old.dtype))
+                results[id(node)] = [out]
+                continue
+            out = opdef.fn(*vals, **params)
+            results[id(node)] = (list(out)
+                                 if isinstance(out, (list, tuple))
+                                 else [out])
+    outs = [results[id(n)][i] for (n, i) in sym._outputs_list()]
+    return outs, aux_updates
+
+
+class Executor:
+    """Graph executor (reference GraphExecutor)."""
+
+    def __init__(self, symbol, ctx, args, args_grad, grad_req, aux_states):
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+
+        if isinstance(args, dict):
+            self.arg_dict = {
+                n: self._as_nd(args[n]) for n in arg_names if n in args}
+            missing = [n for n in arg_names if n not in args]
+            if missing:
+                raise MXNetError(f"missing arguments: {missing}")
+        elif args is not None:
+            if len(args) != len(arg_names):
+                raise MXNetError(
+                    f"expected {len(arg_names)} args, got {len(args)}")
+            self.arg_dict = {
+                n: self._as_nd(a) for n, a in zip(arg_names, args)}
+        else:
+            raise MXNetError("args required for bind")
+
+        if aux_states is None:
+            self.aux_dict = {}
+        elif isinstance(aux_states, dict):
+            self.aux_dict = {n: self._as_nd(v)
+                             for n, v in aux_states.items()}
+        else:
+            self.aux_dict = {
+                n: self._as_nd(a) for n, a in zip(aux_names, aux_states)}
+        for n in aux_names:
+            if n not in self.aux_dict:
+                raise MXNetError(f"missing auxiliary state {n}")
+
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(arg_names, grad_req))
+        else:
+            self._grad_req = dict(grad_req)
+        if args_grad is None:
+            self.grad_dict = {}
+        elif isinstance(args_grad, dict):
+            self.grad_dict = {n: self._as_nd(v)
+                              for n, v in args_grad.items()}
+        else:
+            self.grad_dict = {
+                n: self._as_nd(g)
+                for n, g in zip(arg_names, args_grad) if g is not None}
+
+        self._arg_names = arg_names
+        self._aux_names = aux_names
+        self.outputs = []
+        self._vjp_fn = None
+        self._fwd_jit = {}
+        self.grad_arrays = [self.grad_dict.get(n) for n in arg_names]
+        self.arg_arrays = [self.arg_dict[n] for n in arg_names]
+        self.aux_arrays = [self.aux_dict[n] for n in aux_names]
+
+    @staticmethod
+    def _as_nd(v):
+        if isinstance(v, nd.NDArray):
+            return v
+        return nd.array(onp.asarray(v))
+
+    @classmethod
+    def _simple_bind(cls, symbol, ctx, grad_req, shape_kwargs):
+        """Allocate args/grads from inferred shapes (reference
+        simple_bind, graph_executor.cc:803)."""
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shape_kwargs)
+        if arg_shapes is None or any(s is None for s in arg_shapes):
+            raise MXNetError(
+                "simple_bind: could not infer all argument shapes from "
+                f"{shape_kwargs}")
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        args = {n: nd.zeros(s) for n, s in zip(arg_names, arg_shapes)}
+        aux = {n: nd.zeros(s) for n, s in zip(aux_names, aux_shapes)}
+        grads = {
+            n: nd.zeros(s) for n, s in zip(arg_names, arg_shapes)
+            if (grad_req if isinstance(grad_req, str)
+                else grad_req.get(n, "write")) != "null"
+        }
+        return cls(symbol, ctx, args, grads, grad_req, aux)
+
+    # ------------------------------------------------------------- run
+    def _fwd_key(self, train):
+        shapes = tuple(
+            (n, self.arg_dict[n].shape, str(self.arg_dict[n].dtype))
+            for n in self._arg_names)
+        return (shapes, train)
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError(f"unknown argument {k}")
+            self.arg_dict[k]._adopt(self._as_nd(v)._data)
+
+        sig = self._fwd_key(is_train)
+        entry = self._fwd_jit.get(sig)
+        if entry is None:
+            sym = self._symbol
+            aux_names = self._aux_names
+            entry = {"aux_order": None}
+
+            def _run(arg_vals, aux_vals, key):
+                value_of = dict(zip(self._arg_names, arg_vals))
+                value_of.update(zip(aux_names, aux_vals))
+                outs, aux_updates = _eval_graph(sym, value_of, key,
+                                                is_train)
+                entry["aux_order"] = tuple(sorted(aux_updates))
+                return tuple(outs) + tuple(
+                    aux_updates[n] for n in sorted(aux_updates))
+
+            entry["fn"] = jax.jit(_run)
+            self._fwd_jit[sig] = entry
+
+        arg_vals = [self.arg_dict[n]._data for n in self._arg_names]
+        aux_vals = [self.aux_dict[n]._data for n in self._aux_names]
+        key = _rng.take_key()
+        n_out = self._symbol.num_outputs
+
+        if is_train and any(r != "null" for r in self._grad_req.values()):
+            fn = entry["fn"]
+
+            def _f(avals):
+                return fn(avals, aux_vals, key)
+
+            outs, vjp_fn = jax.vjp(_f, arg_vals)
+            self._vjp_fn = vjp_fn
+            self._out_avals = [(tuple(map(int, o.shape)), o.dtype)
+                               for o in outs]
+            self._n_primary = n_out
+        else:
+            outs = entry["fn"](arg_vals, aux_vals, key)
+            self._vjp_fn = None
+        # fold BatchNorm moving-stat updates back into aux state
+        for name, val in zip(entry["aux_order"] or (), outs[n_out:]):
+            self.aux_dict[name]._adopt(val)
+        self.outputs = [nd.NDArray(o) for o in outs[:n_out]]
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        """Accumulate into grad arrays per grad_req (reference
+        GraphExecutor::Backward)."""
+        if self._vjp_fn is None:
+            raise MXNetError("backward called before forward(is_train=True)")
+        n_primary = self._n_primary
+        if out_grads is None:
+            cts = [jnp.ones(s, d)
+                   for (s, d) in self._out_avals[:n_primary]]
+        else:
+            if isinstance(out_grads, nd.NDArray):
+                out_grads = [out_grads]
+            cts = [
+                g._data if isinstance(g, nd.NDArray) else jnp.asarray(g)
+                for g in out_grads]
+        # aux-update extras carry no cotangent
+        cts += [jnp.zeros(s, d)
+                for (s, d) in self._out_avals[n_primary:]]
+        (arg_grads,) = self._vjp_fn(tuple(cts))  # _run returns a tuple
+        self._vjp_fn = None
+        for n, g in zip(self._arg_names, arg_grads):
+            req = self._grad_req.get(n, "write")
+            if req == "null" or n not in self.grad_dict:
+                continue
+            tgt = self.grad_dict[n]
+            if req == "add":
+                tgt._adopt(tgt._data + g.astype(tgt._data.dtype))
+            else:
+                tgt._adopt(g.astype(tgt._data.dtype))
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False,
+                **kwargs):
+        """New executor sharing weights, new data shapes (reference
+        GraphExecutor::Reshape — memory sharing is XLA's concern)."""
+        new_args = dict(self.arg_dict)
+        for n, s in kwargs.items():
+            if n in new_args and tuple(new_args[n].shape) != tuple(s):
+                new_args[n] = nd.zeros(s)
+        return Executor(self._symbol, self._ctx, new_args,
+                        dict(self.grad_dict) or None, self._grad_req,
+                        dict(self.aux_dict))
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for n, v in arg_params.items():
+            if n in self.arg_dict:
+                self.arg_dict[n]._adopt(self._as_nd(v)._data)
+            elif not allow_extra_params:
+                raise MXNetError(f"extra param {n}")
+        if aux_params:
+            for n, v in aux_params.items():
+                if n in self.aux_dict:
+                    self.aux_dict[n]._adopt(self._as_nd(v)._data)
+                elif not allow_extra_params:
+                    raise MXNetError(f"extra aux {n}")
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
