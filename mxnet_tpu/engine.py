@@ -1,0 +1,40 @@
+"""Engine properties management (reference python/mxnet/engine.py).
+
+The reference exposes knobs on its threaded dependency engine: bulk-size
+(how many small ops fuse into one engine segment).  On TPU the XLA
+runtime owns scheduling — `jax.jit` IS the bulking mechanism — so these
+calls keep the reference API and record the requested value, but the
+actual fusion decisions belong to the compiler.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["set_bulk_size", "get_bulk_size", "bulk"]
+
+_BULK_SIZE = 15  # reference default MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN
+
+
+def set_bulk_size(size):
+    """Set size limit on bulk execution (reference engine.py:26).
+
+    Returns the previous value.  No-op for execution on TPU: XLA fuses
+    whole jitted programs regardless.
+    """
+    global _BULK_SIZE
+    prev, _BULK_SIZE = _BULK_SIZE, int(size)
+    return prev
+
+
+def get_bulk_size():
+    return _BULK_SIZE
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """Scoped bulk-size override (reference engine.py bulk())."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

@@ -315,6 +315,19 @@ class ParameterDict:
                 param.shape = v
             elif getattr(param, k, None) is None or k in ("init",):
                 setattr(param, k, v)
+            else:
+                existing = getattr(param, k)
+                if k == "dtype":
+                    same = onp.dtype(existing) == onp.dtype(v)
+                else:
+                    same = existing == v
+                if not same:
+                    # reference parameter.py get() asserts existing
+                    # attributes match a re-declaration
+                    raise MXNetError(
+                        f"Parameter '{name}' already exists with "
+                        f"{k}={existing!r}, but the request specifies "
+                        f"{k}={v!r}.")
         return param
 
     def get_constant(self, name, value=None):

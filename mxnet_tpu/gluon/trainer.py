@@ -146,25 +146,28 @@ class Trainer:
             param._data._fresh_grad = False
 
     def save_states(self, fname):
+        """Save optimizer (updater) states.
+
+        _update() always applies updates through the local updater —
+        even under dist kvstores, where gradient reduction is XLA's job
+        and the 'server-side optimizer' of the reference has no separate
+        state — so states are always saved from/loaded into
+        self._updaters[0] regardless of _update_on_kvstore.
+        """
         assert self._optimizer is not None
         if not self._kv_initialized:
             self._init_kvstore()
-        if self._update_on_kvstore and self._kvstore is not None:
-            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
-        else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updaters[0].get_states(dump_optimizer=True))
+        with open(fname, "wb") as fout:
+            fout.write(self._updaters[0].get_states(dump_optimizer=True))
 
     def load_states(self, fname):
         if not self._kv_initialized:
             self._init_kvstore()
+        with open(fname, "rb") as f:
+            states = f.read()
+        for updater in self._updaters:
+            updater.set_states(states)
+            updater.optimizer = self._updaters[0].optimizer
+        self._optimizer = self._updaters[0].optimizer
         if self._update_on_kvstore and self._kvstore is not None:
-            self._kvstore.load_optimizer_states(fname)
-            self._optimizer = self._kvstore._updater.optimizer
-        else:
-            with open(fname, "rb") as f:
-                states = f.read()
-            for updater in self._updaters:
-                updater.set_states(states)
-                updater.optimizer = self._updaters[0].optimizer
-            self._optimizer = self._updaters[0].optimizer
+            self._kvstore.set_optimizer(self._optimizer)

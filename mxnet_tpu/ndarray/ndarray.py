@@ -226,10 +226,21 @@ class NDArray:
 
     # ---------------------------------------------------------- autograd
     def attach_grad(self, grad_req="write", stype=None):
-        """Allocate a gradient buffer (reference ndarray.py attach_grad)."""
-        self._grad = zeros(self.shape, dtype=self.dtype, ctx=self.context)
+        """Allocate a gradient buffer (reference ndarray.py attach_grad).
+
+        grad_req='null' marks the array as a variable without allocating
+        a buffer (no gradient will be written); 'add' accumulates across
+        backward calls.  stype is recorded; sparse grads are
+        dense-emulated (see ndarray/sparse.py).
+        """
         self._grad_req = grad_req
         self._is_var = True
+        if grad_req == "null":
+            self._grad = None
+            return
+        self._grad = zeros(self.shape, dtype=self.dtype, ctx=self.context)
+        if stype is not None:
+            self._grad._stype = stype
 
     def backward(self, out_grad=None, retain_graph=False, train_mode=True):
         autograd.backward([self], [out_grad] if out_grad is not None else None,

@@ -381,9 +381,10 @@ class AdamW(Adam):
 
 @_jit
 def _adagrad_step(w, hist, g, lr, wd, eps):
-    g = g + wd * w
+    # reference adagrad op: history accumulates the raw grad^2, eps sits
+    # inside the sqrt, and wd applies as a decoupled term
     hist = hist + g * g
-    return w - lr * g / (jnp.sqrt(hist) + eps), hist
+    return w - lr * (g / jnp.sqrt(hist + eps) + wd * w), hist
 
 
 @register
@@ -795,17 +796,29 @@ class Updater:
             index, weight, grad, self.states[index])
 
     def get_states(self, dump_optimizer=False):
+        import copy
         import pickle
 
-        return pickle.dumps(
-            (self.states, self.optimizer) if dump_optimizer else self.states)
+        if dump_optimizer:
+            # runtime handles (live Parameter objects) must not be
+            # serialized: the reference excludes them, and pickling them
+            # would both duplicate every weight tensor into the .states
+            # file and detach lr_mult/wd_mult lookups from the live
+            # parameters after load
+            opt = copy.copy(self.optimizer)
+            opt.param_dict = {}
+            return pickle.dumps((self.states, opt))
+        return pickle.dumps(self.states)
 
     def set_states(self, states):
         import pickle
 
         states = pickle.loads(states)
         if isinstance(states, tuple) and len(states) == 2:
-            self.states, self.optimizer = states
+            self.states, new_opt = states
+            # reattach the live param_dict (stripped at save time)
+            new_opt.param_dict = getattr(self.optimizer, "param_dict", {})
+            self.optimizer = new_opt
         else:
             self.states = states
         self.states_synced = dict.fromkeys(self.states.keys(), False)

@@ -1,0 +1,101 @@
+"""Runtime feature detection (reference python/mxnet/runtime.py:28-90).
+
+The reference surfaces compile-time build flags (CUDA/CUDNN/NCCL/
+DIST_KVSTORE/..., include/mxnet/libinfo.h:141-190) through
+`mx.runtime.feature_list()`.  The TPU build has no compile-time matrix —
+capabilities are determined by the live JAX install — so features are
+probed at call time instead of baked in.
+"""
+from __future__ import annotations
+
+import collections
+
+__all__ = ["Feature", "feature_list", "Features"]
+
+
+class Feature:
+    """One named capability flag (reference runtime.py:28 exposes
+    ctypes structs; here a plain object with the same attributes)."""
+
+    def __init__(self, name, enabled):
+        self._name = name
+        self._enabled = bool(enabled)
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def enabled(self):
+        return self._enabled
+
+    def __repr__(self):
+        if self.enabled:
+            return f"✔ {self.name}"
+        return f"✖ {self.name}"
+
+
+def _probe():
+    import jax
+
+    feats = collections.OrderedDict()
+
+    def add(name, on):
+        feats[name] = Feature(name, on)
+
+    try:
+        platforms = {d.platform for d in jax.devices()}
+    except Exception:  # backend init can fail in exotic environments
+        platforms = set()
+    add("TPU", "tpu" in platforms)
+    add("CUDA", "gpu" in platforms or "cuda" in platforms)
+    add("CPU", True)
+    add("XLA", True)
+    add("JIT", True)
+    add("BF16", True)
+    add("INT64_TENSOR_SIZE", True)
+    try:
+        import jax.experimental.pallas  # noqa: F401
+        add("PALLAS", True)
+    except Exception:
+        add("PALLAS", False)
+    add("DIST_KVSTORE", True)  # jax.distributed (kvstore dist modes)
+    add("F16C", True)
+    add("SIGNAL_HANDLER", False)
+    add("PROFILER", True)
+    add("OPENCV", _has_module("cv2"))
+    add("MKLDNN", False)
+    add("TENSORRT", False)
+    add("BLAS_OPEN", False)
+    add("LAPACK", True)  # jax.scipy.linalg
+    return feats
+
+
+def _has_module(name):
+    import importlib.util
+
+    return importlib.util.find_spec(name) is not None
+
+
+def feature_list():
+    """List capabilities of the current runtime (reference
+    runtime.py:51)."""
+    return list(_probe().values())
+
+
+class Features(collections.OrderedDict):
+    """OrderedDict of name -> Feature (reference runtime.py:65)."""
+
+    def __init__(self):
+        super().__init__([(f.name, f) for f in feature_list()])
+
+    def __repr__(self):
+        return str(list(self.values()))
+
+    def is_enabled(self, feature_name):
+        feature_name = feature_name.upper()
+        if feature_name not in self:
+            raise RuntimeError(f"Feature '{feature_name}' is unknown, "
+                               "known features are: "
+                               f"{list(self.keys())}")
+        return self[feature_name].enabled

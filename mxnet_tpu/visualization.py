@@ -1,0 +1,240 @@
+"""Network visualization (reference python/mxnet/visualization.py).
+
+``print_summary`` (reference :47) — text table of layers, output
+shapes, and parameter counts, driven by the symbol's JSON graph +
+infer_shape (the same inputs the reference uses).
+
+``plot_network`` (reference :211) — graphviz Digraph of the symbol
+graph; requires the optional ``graphviz`` package (gated, like the
+reference's ImportError behavior).
+"""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _str2tuple(string):
+    """'(1,2,3)' -> ['1','2','3'] (reference visualization.py:32)."""
+    import re
+
+    return re.findall(r"\d+", str(string))
+
+
+def print_summary(symbol, shape=None, line_length=120,
+                  positions=(.44, .64, .74, 1.)):
+    """Print a layer-by-layer summary table (reference
+    visualization.py:47).
+
+    shape: dict of input name -> shape for output-shape inference.
+    """
+    from .symbol.symbol import Symbol
+
+    if not isinstance(symbol, Symbol):
+        raise MXNetError("symbol must be a Symbol")
+    show_shape = False
+    shape_dict = {}
+    if shape is not None:
+        show_shape = True
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise MXNetError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    # reference quirk kept for parity: set(heads[0]) = {last_node_id,
+    # out_idx, 0}, which includes node 0 — so the 'data' variable counts
+    # as a predecessor and the first layer's input channels are counted
+    heads = set(conf["heads"][0]) if conf.get("heads") else {0}
+    positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #",
+                  "Previous Layer"]
+
+    def print_row(fields, pos):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[: pos[i]]
+            line += " " * (pos[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+    total_params = 0
+
+    def print_layer_summary(node, out_shape):
+        op = node["op"]
+        pre_node = []
+        pre_filter = 0
+        if op != "null":
+            inputs = node["inputs"]
+            for item in inputs:
+                input_node = nodes[item[0]]
+                input_name = input_node["name"]
+                if input_node["op"] != "null" or item[0] in heads:
+                    pre_node.append(input_name)
+                    if show_shape:
+                        key = input_name
+                        if input_node["op"] != "null":
+                            key += "_output"
+                        if key in shape_dict:
+                            shape = shape_dict[key][1:]
+                            pre_filter = pre_filter + int(shape[0]) \
+                                if shape else pre_filter
+        cur_param = 0
+        attrs = node.get("attrs") or {}
+        if op == "Convolution":
+            num_group = int(attrs.get("num_group", "1"))
+            cur_param = pre_filter * int(attrs["num_filter"]) // num_group
+            for k in _str2tuple(attrs["kernel"]):
+                cur_param *= int(k)
+            if attrs.get("no_bias", "False") not in ("True", "1", "true"):
+                cur_param += int(attrs["num_filter"])
+        elif op == "FullyConnected":
+            cur_param = pre_filter * int(attrs["num_hidden"])
+            if attrs.get("no_bias", "False") not in ("True", "1", "true"):
+                cur_param += int(attrs["num_hidden"])
+        elif op == "BatchNorm":
+            key = node["name"] + "_output"
+            if show_shape and key in shape_dict:
+                cur_param = int(shape_dict[key][1]) * 4
+        elif op == "Embedding":
+            cur_param = int(attrs["input_dim"]) * int(attrs["output_dim"])
+        first_connection = pre_node[0] if pre_node else ""
+        fields = [f"{node['name']}({op})",
+                  "x".join(str(x) for x in out_shape),
+                  cur_param, first_connection]
+        print_row(fields, positions)
+        for i in range(1, len(pre_node)):
+            fields = ["", "", "", pre_node[i]]
+            print_row(fields, positions)
+        return cur_param
+
+    for i, node in enumerate(nodes):
+        out_shape = []
+        op = node["op"]
+        if op == "null" and i > 0:
+            continue
+        if op != "null" or i in heads:
+            key = node["name"] + ("_output" if op != "null" else "")
+            if show_shape and key in shape_dict:
+                out_shape = shape_dict[key][1:]
+        total_params += print_layer_summary(node, out_shape)
+        if i == len(nodes) - 1:
+            print("=" * line_length)
+        else:
+            print("_" * line_length)
+    print(f"Total params: {total_params}")
+    print("_" * line_length)
+    return total_params
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 dtype=None, node_attrs=None, hide_weights=True):
+    """Graphviz Digraph of the symbol graph (reference
+    visualization.py:211).  Requires the optional graphviz package."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError(
+            "Draw network requires graphviz library") from None
+    from .symbol.symbol import Symbol
+
+    if not isinstance(symbol, Symbol):
+        raise MXNetError("symbol must be a Symbol")
+    node_attrs = node_attrs or {}
+    draw_shape = False
+    shape_dict = {}
+    if shape is not None:
+        draw_shape = True
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise MXNetError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    node_attr.update(node_attrs)
+    dot = Digraph(name=title, format=save_format)
+    # color palette from the reference
+    cm = ("#8dd3c7", "#fb8072", "#ffffb3", "#bebada", "#80b1d3",
+          "#fdb462", "#b3de69", "#fccde5")
+
+    def looks_like_weight(name):
+        weight_like = ("_weight", "_bias", "_beta", "_gamma",
+                       "_moving_var", "_moving_mean", "_running_var",
+                       "_running_mean")
+        return name.endswith(weight_like)
+
+    hidden_nodes = set()
+    for node in nodes:
+        op = node["op"]
+        name = node["name"]
+        attrs = {"shape": "box", "fixedsize": "false"}
+        label = name
+        if op == "null":
+            if looks_like_weight(name):
+                if hide_weights:
+                    hidden_nodes.add(name)
+                continue
+            attrs["shape"] = "oval"
+            attrs["fillcolor"] = cm[0]
+        elif op == "Convolution":
+            a = node.get("attrs") or {}
+            label = "Convolution\n{kernel}/{stride}, {filter}".format(
+                kernel="x".join(_str2tuple(a.get("kernel", ""))),
+                stride="x".join(_str2tuple(a.get("stride", "1"))),
+                filter=a.get("num_filter", "?"))
+            attrs["fillcolor"] = cm[1]
+        elif op == "FullyConnected":
+            a = node.get("attrs") or {}
+            label = f"FullyConnected\n{a.get('num_hidden', '?')}"
+            attrs["fillcolor"] = cm[1]
+        elif op == "BatchNorm":
+            attrs["fillcolor"] = cm[3]
+        elif op in ("Activation", "LeakyReLU"):
+            a = node.get("attrs") or {}
+            label = f"{op}\n{a.get('act_type', '')}"
+            attrs["fillcolor"] = cm[2]
+        elif op == "Pooling":
+            a = node.get("attrs") or {}
+            label = "Pooling\n{t}, {k}/{s}".format(
+                t=a.get("pool_type", "?"),
+                k="x".join(_str2tuple(a.get("kernel", ""))),
+                s="x".join(_str2tuple(a.get("stride", "1"))))
+            attrs["fillcolor"] = cm[4]
+        elif op in ("Concat", "Flatten", "Reshape"):
+            attrs["fillcolor"] = cm[5]
+        elif op == "Softmax" or op == "SoftmaxOutput":
+            attrs["fillcolor"] = cm[6]
+        else:
+            attrs["fillcolor"] = cm[7]
+        dot.node(name=name, label=label, **attrs)
+
+    for node in nodes:
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            continue
+        for item in node["inputs"]:
+            input_node = nodes[item[0]]
+            input_name = input_node["name"]
+            if input_name in hidden_nodes:
+                continue
+            attrs = {"dir": "back", "arrowtail": "open"}
+            if draw_shape:
+                key = input_name
+                if input_node["op"] != "null":
+                    key += "_output"
+                if key in shape_dict:
+                    attrs["label"] = "x".join(
+                        str(x) for x in shape_dict[key][1:])
+            dot.edge(tail_name=name, head_name=input_name, **attrs)
+    return dot
