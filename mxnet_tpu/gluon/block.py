@@ -31,6 +31,17 @@ from .parameter import DeferredInitializationError, Parameter, ParameterDict
 __all__ = ["Block", "HybridBlock", "SymbolBlock"]
 
 
+class _SuppressHooks(threading.local):
+    """Set during internal forward passes (deferred-shape resolution) so
+    user-registered hooks only observe real user-initiated forwards."""
+
+    def __init__(self):
+        self.flag = False
+
+
+_suppress_hooks = _SuppressHooks()
+
+
 class _BlockScope(threading.local):
     """Name-scope manager producing reference-compatible prefixes."""
 
@@ -280,6 +291,8 @@ class Block:
 
     # ------------------------------------------------------------- forward
     def __call__(self, *args):
+        if _suppress_hooks.flag:
+            return self.forward(*args)
         for hook in self._forward_pre_hooks.values():
             hook(self, args)
         out = self.forward(*args)
@@ -422,10 +435,13 @@ class HybridBlock(Block):
                 _disable(c)
 
         _disable(self)
+        prev = _suppress_hooks.flag
+        _suppress_hooks.flag = True  # internal pass: no user hooks
         try:
             with autograd.pause():
                 Block.__call__(self, *args)
         finally:
+            _suppress_hooks.flag = prev
             for b, s in states:
                 b._active = s
 
