@@ -7,6 +7,13 @@ this on the real TPU chip and records the JSON line.
 One fused XLA program per step (fwd+bwd+SGD momentum, donated buffers),
 bf16 activations/weights with fp32 BatchNorm statistics — the MXU-native
 configuration.
+
+Perf note (round 2): the model is initialized ON the accelerator
+(ctx=mx.gpu(0)) and the whole bench path never executes a single op on
+the JAX CPU backend.  Mixing host-backend eager compute into a TPU
+process forces per-dispatch synchronization with the device runtime and
+serializes the step stream (measured: 57 ms/step vs 1.9 ms/step for the
+identical executable).  Keep eager work on-device or in numpy.
 """
 from __future__ import annotations
 
@@ -21,20 +28,20 @@ def main():
     from mxnet_tpu import gluon
     from mxnet_tpu.parallel import make_train_step
 
+    import jax
+    import jax.numpy as jnp
+
     batch = 128
+    ctx = mx.gpu(0)  # falls back to cpu on accelerator-less hosts
     net = gluon.model_zoo.vision.resnet50_v1(classes=1000)
-    net.initialize(init=mx.init.Xavier())
-    net(mx.nd.zeros((1, 3, 224, 224)))  # resolve deferred shapes
+    net.initialize(init=mx.init.Xavier(), ctx=ctx)
+    net(mx.nd.zeros((1, 3, 224, 224), ctx=ctx))  # resolve deferred shapes
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     step_fn, params, opt_state = make_train_step(
         net, loss_fn, optimizer="sgd", learning_rate=0.1, momentum=0.9,
         donate=False, compute_dtype="bfloat16")
 
-    import jax
-    import jax.numpy as jnp
-
-    x = jnp.asarray(onp.random.rand(batch, 3, 224, 224), dtype=jnp.bfloat16
-                    ).astype(jnp.float32)
+    x = jnp.asarray(onp.random.rand(batch, 3, 224, 224), dtype=jnp.bfloat16)
     y = jnp.asarray(
         onp.random.randint(0, 1000, size=(batch,)).astype("float32"))
     key = jax.random.key(0)
@@ -43,7 +50,7 @@ def main():
     loss, params, opt_state = step_fn(params, opt_state, x, y, key, 1.0)
     jax.block_until_ready(loss)
 
-    n_steps = 20
+    n_steps = 50
     t0 = time.perf_counter()
     for i in range(n_steps):
         loss, params, opt_state = step_fn(

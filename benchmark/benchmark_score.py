@@ -1,0 +1,76 @@
+"""Inference scoring benchmark — the reference's
+example/image-classification/benchmark_score.py rebuilt for TPU.
+
+Scores model-zoo networks (batched forward only, no grad) at several
+batch sizes and dtypes, printing one JSON line per configuration:
+
+    {"model": "resnet50_v1", "batch": 32, "dtype": "bfloat16",
+     "throughput": ..., "unit": "img/s"}
+
+Reference anchors (BASELINE.md): ResNet-50 fp32 1,076.81 img/s (bs 32)
+and fp16 2,085.51 img/s on V100; ResNet-152 451.82 / 887.34.
+
+Usage:  python benchmark/benchmark_score.py [--models resnet50_v1,...]
+        [--batches 1,32,128] [--dtypes float32,bfloat16]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as onp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def score(model_name, batch, dtype, image_shape=(3, 224, 224), steps=30):
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import amp_cast_params, functionalize
+
+    ctx = mx.gpu(0)  # falls back to cpu on accelerator-less hosts
+    net = gluon.model_zoo.vision.get_model(model_name, classes=1000)
+    net.initialize(init=mx.init.Xavier(), ctx=ctx)
+    net(mx.nd.zeros((1,) + image_shape, ctx=ctx))
+    params, apply_fn = functionalize(net, train=False)
+
+    cdtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    params = amp_cast_params(params, cdtype) if dtype == "bfloat16" \
+        else params
+    fwd = jax.jit(lambda p, xx: apply_fn(p, xx))
+    x = jnp.asarray(onp.random.rand(batch, *image_shape), dtype=cdtype)
+
+    out = fwd(params, x)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fwd(params, x)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    return batch * steps / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--models", default="resnet50_v1,resnet152_v1")
+    ap.add_argument("--batches", default="1,32,128")
+    ap.add_argument("--dtypes", default="float32,bfloat16")
+    args = ap.parse_args()
+    for model in args.models.split(","):
+        for dtype in args.dtypes.split(","):
+            for batch in (int(b) for b in args.batches.split(",")):
+                tp = score(model, batch, dtype)
+                print(json.dumps({
+                    "model": model, "batch": batch, "dtype": dtype,
+                    "throughput": round(tp, 2), "unit": "img/s",
+                }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

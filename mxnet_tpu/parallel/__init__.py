@@ -29,7 +29,26 @@ from .. import ndarray as nd
 from ..base import MXNetError
 
 __all__ = ["get_mesh", "functionalize", "make_train_step",
-           "DataParallelTrainer", "Mesh", "NamedSharding", "P"]
+           "DataParallelTrainer", "Mesh", "NamedSharding", "P",
+           "NORM_STAT_SUFFIXES", "amp_cast_params"]
+
+#: parameter-name suffixes that stay fp32 under mixed precision (the AMP
+#: policy the reference encodes in contrib/amp/lists: norm affine+stats)
+NORM_STAT_SUFFIXES = ("gamma", "beta", "running_mean", "running_var",
+                      "moving_mean", "moving_var")
+
+
+def _is_norm_stat(name):
+    return any(name.endswith(s) for s in NORM_STAT_SUFFIXES)
+
+
+def amp_cast_params(params, compute_dtype):
+    """Cast a {name: array} tree to the compute dtype, keeping norm
+    affine/stat parameters in their original (fp32) dtype."""
+    if compute_dtype is None:
+        return params
+    return {n: (v if _is_norm_stat(n) else v.astype(compute_dtype))
+            for n, v in params.items()}
 
 
 def get_mesh(shape=None, axis_names=("data",), devices=None):
@@ -137,21 +156,11 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
         dev = jax.devices()[0]
         params = jax.device_put(params, dev)
 
-    _norm_stats = ("gamma", "beta", "running_mean", "running_var",
-                   "moving_mean", "moving_var")
-
-    def _to_compute(name, v):
-        # AMP policy (reference contrib/amp list semantics): matmul/conv
-        # weights in bf16, norm affine+stats in fp32
-        if compute_dtype is None or any(name.endswith(s)
-                                        for s in _norm_stats):
-            return v
-        return v.astype(compute_dtype)
-
     def loss_of(param_dict, x, y, key):
         if compute_dtype is not None:
-            param_dict = {n: _to_compute(n, v)
-                          for n, v in param_dict.items()}
+            # AMP policy (reference contrib/amp list semantics): matmul/
+            # conv weights in bf16, norm affine+stats in fp32
+            param_dict = amp_cast_params(param_dict, compute_dtype)
             x = x.astype(compute_dtype)
         out = apply_fn(param_dict, x, key=key)
         loss_nd = loss_fn(nd.NDArray(out.astype(jnp.float32)),
