@@ -25,8 +25,12 @@ import numpy as onp
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+_DTYPES = {"float32": "float32", "bfloat16": "bfloat16",
+           "float16": "float16"}
 
-def score(model_name, batch, dtype, image_shape=(3, 224, 224), steps=30):
+
+def score_model(model_name, batches, dtypes, image_shape=(3, 224, 224),
+                steps=30):
     import jax
     import jax.numpy as jnp
 
@@ -38,22 +42,26 @@ def score(model_name, batch, dtype, image_shape=(3, 224, 224), steps=30):
     net = gluon.model_zoo.vision.get_model(model_name, classes=1000)
     net.initialize(init=mx.init.Xavier(), ctx=ctx)
     net(mx.nd.zeros((1,) + image_shape, ctx=ctx))
-    params, apply_fn = functionalize(net, train=False)
-
-    cdtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
-    params = amp_cast_params(params, cdtype) if dtype == "bfloat16" \
-        else params
+    params0, apply_fn = functionalize(net, train=False)
     fwd = jax.jit(lambda p, xx: apply_fn(p, xx))
-    x = jnp.asarray(onp.random.rand(batch, *image_shape), dtype=cdtype)
 
-    out = fwd(params, x)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fwd(params, x)
-    jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
-    return batch * steps / dt
+    for dtype in dtypes:
+        cdtype = jnp.dtype(_DTYPES[dtype])
+        params = params0 if dtype == "float32" \
+            else amp_cast_params(params0, cdtype)
+        for batch in batches:
+            x = jnp.asarray(onp.random.rand(batch, *image_shape),
+                            dtype=cdtype)
+            out = fwd(params, x)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = fwd(params, x)
+            jax.block_until_ready(out)
+            dt = time.perf_counter() - t0
+            yield {"model": model_name, "batch": batch, "dtype": dtype,
+                   "throughput": round(batch * steps / dt, 2),
+                   "unit": "img/s"}
 
 
 def main():
@@ -62,14 +70,15 @@ def main():
     ap.add_argument("--batches", default="1,32,128")
     ap.add_argument("--dtypes", default="float32,bfloat16")
     args = ap.parse_args()
+    dtypes = args.dtypes.split(",")
+    unknown = set(dtypes) - set(_DTYPES)
+    if unknown:
+        ap.error(f"unknown dtypes: {sorted(unknown)} "
+                 f"(choose from {sorted(_DTYPES)})")
+    batches = [int(b) for b in args.batches.split(",")]
     for model in args.models.split(","):
-        for dtype in args.dtypes.split(","):
-            for batch in (int(b) for b in args.batches.split(",")):
-                tp = score(model, batch, dtype)
-                print(json.dumps({
-                    "model": model, "batch": batch, "dtype": dtype,
-                    "throughput": round(tp, 2), "unit": "img/s",
-                }), flush=True)
+        for row in score_model(model, batches, dtypes):
+            print(json.dumps(row), flush=True)
 
 
 if __name__ == "__main__":

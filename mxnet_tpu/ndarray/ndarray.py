@@ -435,6 +435,22 @@ def invoke(op, inputs, out=None, **params):
     tape the pull-back (Imperative::RecordOp, imperative.cc:193).
     """
     opdef: OpDef = get_op(op) if isinstance(op, str) else op
+    global _profiler
+    if _profiler is None:  # lazy: keep profiler import errors local
+        from .. import profiler as _profiler_mod
+
+        _profiler = _profiler_mod
+    scope = _profiler.op_scope(opdef.name)
+    if scope is not None:
+        with scope:
+            return _invoke_impl(opdef, inputs, out, params)
+    return _invoke_impl(opdef, inputs, out, params)
+
+
+_profiler = None
+
+
+def _invoke_impl(opdef, inputs, out, params):
     params = {k: v for k, v in params.items() if v is not None}
     arrs = []
     nd_inputs = []

@@ -1,0 +1,384 @@
+"""Profiler with the reference API, emitting Chrome-trace JSON.
+
+Reference parity: python/mxnet/profiler.py:33-151 (set_config /
+set_state / dump / dumps / pause / resume) and the user-scope objects
+Domain/Task/Frame/Event/Counter/Marker (:225-497), backed in the
+reference by the C++ Profiler with lock-free per-thread stat buffers
+(src/profiler/profiler.h:251) dumped as Chrome tracing JSON
+(src/profiler/aggregate_stats.cc).
+
+TPU-native design: there is no engine thread pool to instrument — ops
+dispatch asynchronously into the XLA runtime.  The profiler therefore
+records two complementary layers:
+
+  * host-side events — every ``nd`` op dispatch (the analog of the
+    reference's per-op ProfileOperator begin/end), user scopes
+    (Task/Frame/Event), counters and instant markers — buffered
+    in-process and dumped as a Chrome trace (``chrome://tracing`` /
+    Perfetto).
+  * device-side tracing — ``jax.profiler`` XPlane capture for
+    TensorBoard, toggled by the same set_state('run'/'stop') when
+    ``set_config(profile_device=True, tensorboard_logdir=...)``.
+
+Aggregate statistics (``dumps(format='table')``) mirror the reference's
+aggregate_stats table: per-op call counts and total/min/max/mean host
+dispatch time.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+
+from .base import MXNetError
+
+__all__ = [
+    "set_config", "profiler_set_config", "set_state", "profiler_set_state",
+    "dump", "dump_profile", "dumps", "pause", "resume", "op_scope",
+    "Domain", "Task", "Frame", "Event", "Counter", "Marker",
+]
+
+_lock = threading.Lock()
+_config = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_symbolic": False,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": True,
+    "aggregate_stats": False,
+    "continuous_dump": False,
+    "dump_period": 1.0,
+    "profile_device": False,
+    "tensorboard_logdir": None,
+}
+_state = "stop"
+_paused = False
+_events = []  # chrome trace event dicts
+_agg = {}  # name -> [count, total_us, min_us, max_us]
+_jax_trace_active = False
+_t0 = time.perf_counter()
+
+
+def _now_us():
+    return (time.perf_counter() - _t0) * 1e6
+
+
+def is_running():
+    return _state == "run" and not _paused
+
+
+def set_config(**kwargs):
+    """Reference: profiler.py:33 — configure before set_state('run').
+
+    Accepted kwargs mirror the reference (filename, profile_all,
+    profile_symbolic, profile_imperative, profile_memory, profile_api,
+    aggregate_stats, continuous_dump, dump_period) plus the TPU
+    extensions profile_device / tensorboard_logdir.
+    """
+    unknown = set(kwargs) - set(_config)
+    if unknown:
+        raise MXNetError(f"unknown profiler config keys: {sorted(unknown)}")
+    if kwargs.get("profile_all"):
+        _config.update(profile_symbolic=True, profile_imperative=True,
+                       profile_memory=True, profile_api=True)
+    _config.update(kwargs)
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """Deprecated reference alias (profiler.py:70)."""
+    set_config(profile_symbolic=(mode in ("symbolic", "all")),
+               profile_imperative=(mode in ("imperative", "all")),
+               filename=filename)
+
+
+def set_state(state="stop", profile_process="worker"):
+    """Reference: profiler.py:89 — 'run' starts collection, 'stop' ends.
+
+    Stopping with continuous_dump set dumps automatically (the reference
+    dumps from the C++ side on WorkerProfile teardown).
+    """
+    global _state, _paused, _jax_trace_active
+    if state not in ("run", "stop"):
+        raise MXNetError(f"invalid profiler state {state!r}")
+    prev = _state
+    _state = state
+    _paused = False
+    if state == "run" and prev != "run":
+        _record_instant("profiler_start", "profiler")
+        if _config["profile_device"] and not _jax_trace_active:
+            import jax
+
+            logdir = _config["tensorboard_logdir"] or "/tmp/mxnet_tpu_trace"
+            jax.profiler.start_trace(logdir)
+            _jax_trace_active = True
+    elif state == "stop" and prev == "run":
+        if _jax_trace_active:
+            import jax
+
+            jax.profiler.stop_trace()
+            _jax_trace_active = False
+        if _config["continuous_dump"]:
+            dump()
+
+
+def profiler_set_state(state="stop"):
+    """Deprecated reference alias (profiler.py:109)."""
+    set_state(state)
+
+
+def pause(profile_process="worker"):
+    """Reference: profiler.py:193."""
+    global _paused
+    _paused = True
+
+
+def resume(profile_process="worker"):
+    """Reference: profiler.py:209."""
+    global _paused
+    _paused = False
+
+
+def _record(name, cat, ph, ts_us, dur_us=None, args=None, tid=None):
+    ev = {
+        "name": name, "cat": cat, "ph": ph, "ts": ts_us,
+        "pid": os.getpid(),
+        "tid": tid if tid is not None else threading.get_ident() % 100000,
+    }
+    if dur_us is not None:
+        ev["dur"] = dur_us
+    if args:
+        ev["args"] = args
+    with _lock:
+        _events.append(ev)
+
+
+def _record_instant(name, cat, args=None):
+    _record(name, cat, "i", _now_us(), args=args)
+
+
+def record_op(name, dur_us, cat="operator", args=None):
+    """Record one complete op-dispatch event (internal hook; the analog
+    of the reference's ProfileOperator, src/profiler/profiler.h:77)."""
+    _record(name, cat, "X", _now_us() - dur_us, dur_us, args=args)
+    if _config["aggregate_stats"]:
+        with _lock:
+            ent = _agg.setdefault(name, [0, 0.0, float("inf"), 0.0])
+            ent[0] += 1
+            ent[1] += dur_us
+            ent[2] = min(ent[2], dur_us)
+            ent[3] = max(ent[3], dur_us)
+
+
+def op_scope(name):
+    """Public dispatcher hook: a context manager timing one op dispatch,
+    or None when op profiling is off (the hot-path fast exit)."""
+    if is_running() and _config["profile_imperative"]:
+        return _OpScope(name)
+    return None
+
+
+class _OpScope:
+    """Context manager used by the nd dispatcher to time op dispatch."""
+
+    __slots__ = ("name", "_start")
+
+    def __init__(self, name):
+        self.name = name
+
+    def __enter__(self):
+        self._start = _now_us()
+        return self
+
+    def __exit__(self, *exc):
+        record_op(self.name, _now_us() - self._start)
+        return False
+
+
+def dump(finished=True, profile_process="worker"):
+    """Reference: profiler.py:122 — write the Chrome trace JSON file."""
+    path = _config["filename"]
+    with _lock:
+        events = list(_events)
+        if finished:
+            _events.clear()
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return path
+
+
+def dump_profile():
+    """Deprecated reference alias (profiler.py:143)."""
+    dump(True)
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    """Reference: profiler.py:151 — return aggregate stats as a string.
+
+    Requires set_config(aggregate_stats=True).  sort_by in
+    {'total','avg','min','max','count'}.
+    """
+    if format not in ("table", "json"):
+        raise MXNetError(f"invalid format {format!r}")
+    key_idx = {"count": 0, "total": 1, "min": 2, "max": 3, "avg": 4}
+    if sort_by not in key_idx:
+        raise MXNetError(f"invalid sort_by {sort_by!r}")
+    with _lock:
+        rows = [
+            (name, c, tot, mn if c else 0.0, mx, (tot / c) if c else 0.0)
+            for name, (c, tot, mn, mx) in _agg.items()
+        ]
+        if reset:
+            _agg.clear()
+    rows.sort(key=lambda r: r[1 + key_idx[sort_by]], reverse=not ascending)
+    if format == "json":
+        return json.dumps([
+            {"name": n, "count": c, "total_us": t, "min_us": mn,
+             "max_us": mx, "avg_us": av} for n, c, t, mn, mx, av in rows])
+    lines = [f"{'Name':<40s}{'Calls':>8s}{'Total(us)':>14s}"
+             f"{'Min(us)':>12s}{'Max(us)':>12s}{'Avg(us)':>12s}"]
+    for n, c, t, mn, mx, av in rows:
+        lines.append(f"{n:<40.40s}{c:>8d}{t:>14.1f}{mn:>12.1f}"
+                     f"{mx:>12.1f}{av:>12.1f}")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------ user scopes
+class Domain:
+    """Reference: profiler.py:225 — namespace for user scope objects."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def __str__(self):
+        return self.name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_frame(self, name):
+        return Frame(self, name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+
+class _Span:
+    _cat = "user"
+
+    def __init__(self, domain, name):
+        self.name = name
+        self.domain = domain
+        self._start_ts = None
+
+    def start(self):
+        self._start_ts = _now_us()
+
+    def stop(self):
+        if self._start_ts is None:
+            return
+        dur = _now_us() - self._start_ts
+        cat = f"{self._cat}:{self.domain}" if self.domain else self._cat
+        _record(self.name, cat, "X", self._start_ts, dur)
+        self._start_ts = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+    def __str__(self):
+        return self.name
+
+
+class Task(_Span):
+    """Reference: profiler.py:284."""
+
+    _cat = "task"
+
+
+class Frame(_Span):
+    """Reference: profiler.py:326."""
+
+    _cat = "frame"
+
+
+class Event(_Span):
+    """Reference: profiler.py:368 (domain-less event)."""
+
+    _cat = "event"
+
+    def __init__(self, name):
+        super().__init__(None, name)
+
+
+class Counter:
+    """Reference: profiler.py:404 — emits Chrome 'C' counter samples."""
+
+    def __init__(self, domain, name, value=None):
+        self.domain = domain
+        self.name = name
+        self._value = 0
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        self._value = value
+        _record(self.name, f"counter:{self.domain}", "C", _now_us(),
+                args={self.name: value})
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+    def __iadd__(self, delta):
+        self.increment(delta)
+        return self
+
+    def __isub__(self, delta):
+        self.decrement(delta)
+        return self
+
+    def __str__(self):
+        return str(self._value)
+
+
+class Marker:
+    """Reference: profiler.py:474 — instant event."""
+
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+
+    def mark(self, scope="process"):
+        _record(self.name, f"marker:{self.domain}", "i", _now_us(),
+                args={"scope": scope})
+
+
+@atexit.register
+def _shutdown():
+    global _jax_trace_active
+    if _jax_trace_active:
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _jax_trace_active = False

@@ -1,0 +1,105 @@
+"""Exception/async-error semantics (reference:
+tests/python/unittest/test_exc_handling.py).
+
+The reference engine runs ops asynchronously and re-throws captured
+exceptions at synchronization points (Engine ThrowException,
+src/engine/threaded_engine.cc:496).  The TPU-native semantics differ by
+design and are pinned down here:
+
+  * invalid op invocations (shape/type/parameter errors) surface
+    IMMEDIATELY at dispatch — jax traces the op eagerly, so there is no
+    deferred-shape-error window;
+  * device-side numeric events (inf/nan) never raise — they propagate
+    through values, exactly like the reference;
+  * errors inside a hybridized (jit) block surface at the first call
+    that traces the graph;
+  * after an exception the runtime is NOT poisoned: subsequent ops on
+    fresh and existing arrays work (the reference requires the same:
+    exc tests re-use the engine after failures).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.base import MXNetError
+
+
+def test_shape_mismatch_raises_at_dispatch():
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.ones((4, 5))
+    with pytest.raises(Exception):
+        (a + b).wait_to_read()
+    # runtime not poisoned
+    c = (a * 2).asnumpy()
+    assert (c == 2).all()
+
+
+def test_invalid_op_param_raises():
+    with pytest.raises(Exception):
+        mx.nd.invoke("Pooling", [mx.nd.ones((2, 3, 4, 4))],
+                     kernel=(9, 9), pool_type="bogus")
+    with pytest.raises(MXNetError):
+        mx.nd.invoke("not_a_real_op", [mx.nd.ones((2,))])
+
+
+def test_nan_inf_propagate_without_raising():
+    a = mx.nd.array(onp.array([1.0, 0.0], dtype="float32"))
+    out = (a / 0.0).asnumpy()  # inf / nan, no exception
+    assert onp.isinf(out[0]) and onp.isnan(out[1])
+    assert not onp.isfinite((mx.nd.log(mx.nd.zeros((2,)))).asnumpy()).any()
+
+
+def test_exception_inside_autograd_propagates_and_recovers():
+    a = mx.nd.ones((2, 3))
+    a.attach_grad()
+    with pytest.raises(Exception):
+        with autograd.record():
+            bad = mx.nd.dot(a, mx.nd.ones((5, 2)))  # inner dims mismatch
+            bad.backward()
+    # tape recovered: a fresh recording works
+    with autograd.record():
+        out = (a * a).sum()
+    out.backward()
+    assert (a.grad.asnumpy() == 2).all()
+
+
+def test_exception_in_hybridized_block_at_first_call():
+    class Bad(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.dot(x, F.zeros((7, 3)))  # shape mismatch vs (n, 4)
+
+    net = Bad()
+    net.initialize()
+    net.hybridize()
+    with pytest.raises(Exception):
+        net(mx.nd.ones((2, 4)))
+    # a correct block still hybridizes and runs afterwards
+    ok = gluon.nn.Dense(3)
+    ok.initialize()
+    ok.hybridize()
+    assert ok(mx.nd.ones((2, 4))).shape == (2, 3)
+
+
+def test_waitall_after_errors_is_clean():
+    a = mx.nd.ones((8, 8))
+    for _ in range(4):
+        a = mx.nd.dot(a, a)
+    mx.nd.waitall()  # no exception from healthy async queue
+    with pytest.raises(Exception):
+        mx.nd.dot(a, mx.nd.ones((3, 3))).wait_to_read()
+    mx.nd.waitall()  # still clean after a failed dispatch
+
+
+def test_error_in_dataloader_worker_surfaces():
+    class ExplodingDataset(gluon.data.Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, idx):
+            raise RuntimeError("boom")
+
+    loader = gluon.data.DataLoader(ExplodingDataset(), batch_size=2,
+                                   num_workers=0)
+    with pytest.raises(RuntimeError, match="boom"):
+        next(iter(loader))

@@ -1,0 +1,290 @@
+"""Finite-difference gradient verification across the op registry, plus
+the test_utils harness itself.
+
+Reference model: tests/python/unittest/test_operator.py drives
+check_numeric_gradient (test_utils.py:981) over each op.  Here one
+parametrized sweep covers every differentiable registered op: ops with a
+curated spec get exact inputs/params; remaining unary/binary elementwise
+ops are auto-probed with safe-domain inputs; ops that are integer-valued,
+random, or need structured inputs are excluded with a reason.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import test_utils as tu
+from mxnet_tpu.ops.registry import get_op, list_ops
+
+onp.random.seed(7)
+
+
+def _u(shape, lo=0.3, hi=0.9):
+    return onp.random.uniform(lo, hi, size=shape).astype("float32")
+
+
+def _n(shape, scale=1.0):
+    return (onp.random.randn(*shape) * scale).astype("float32")
+
+
+def _spd(n):
+    a = onp.random.randn(n, n).astype("float32")
+    return (a @ a.T + n * onp.eye(n, dtype="float32")).astype("float32")
+
+
+def _tril(n):
+    return onp.tril(onp.random.randn(n, n).astype("float32") +
+                    2 * onp.eye(n, dtype="float32"))
+
+
+# ---- curated specs: op -> (inputs, params) -------------------------------
+SPECS = {
+    "FullyConnected": ([_n((4, 5)), _n((3, 5)), _n((3,))],
+                       dict(num_hidden=3)),
+    "Convolution": ([_n((2, 3, 5, 5)), _n((4, 3, 3, 3)), _n((4,))],
+                    dict(kernel=(3, 3), num_filter=4, pad=(1, 1))),
+    "Deconvolution": ([_n((2, 4, 5, 5)), _n((4, 3, 3, 3)), _n((3,))],
+                      dict(kernel=(3, 3), num_filter=3, no_bias=False)),
+    "Pooling": ([_n((2, 3, 6, 6))], dict(kernel=(2, 2), stride=(2, 2),
+                                         pool_type="avg")),
+    "BatchNorm": ([_n((4, 3, 5, 5)), _u((3,)), _n((3,)), _n((3,)),
+                   _u((3,), 0.5, 1.5)],
+                  dict(fix_gamma=False, use_global_stats=True)),
+    "LayerNorm": ([_n((4, 6)), _u((6,)), _n((6,))], {}),
+    "InstanceNorm": ([_n((2, 3, 4, 4)), _u((3,)), _n((3,))], {}),
+    "L2Normalization": ([_n((4, 6))], {}),
+    "LRN": ([_n((2, 4, 5, 5))], dict(nsize=3)),
+    "softmax": ([_n((4, 6))], {}),
+    "log_softmax": ([_n((4, 6))], {}),
+    "softmin": ([_n((4, 6))], {}),
+    "SoftmaxActivation": ([_n((4, 6))], {}),
+    "Activation": ([_n((4, 6))], dict(act_type="tanh")),
+    "LeakyReLU": ([_n((4, 6))], dict(act_type="leaky")),
+    "UpSampling": ([_n((2, 3, 4, 4))], dict(scale=2, sample_type="nearest")),
+    "dot": ([_n((4, 5)), _n((5, 3))], {}),
+    "batch_dot": ([_n((2, 4, 5)), _n((2, 5, 3))], {}),
+    "transpose": ([_n((3, 4))], {}),
+    "reshape": ([_n((3, 4))], dict(shape=(4, 3))),
+    "Reshape": ([_n((3, 4))], dict(shape=(4, 3))),
+    "Flatten": ([_n((3, 4, 2))], {}),
+    "expand_dims": ([_n((3, 4))], dict(axis=1)),
+    "Concat": ([_n((3, 4)), _n((3, 4))], dict(dim=1, num_args=2)),
+    "stack": ([_n((3, 4)), _n((3, 4))], dict(num_args=2)),
+    "slice": ([_n((5, 6))], dict(begin=(1, 2), end=(4, 5))),
+    "slice_axis": ([_n((5, 6))], dict(axis=1, begin=1, end=4)),
+    "take": ([_n((5, 3)), onp.array([0, 2, 4], dtype="float32")], {},
+             [0]),
+    "Embedding": ([onp.array([0, 2, 1], dtype="float32"), _n((4, 3))],
+                  dict(input_dim=4, output_dim=3), [1]),
+    "sum": ([_n((3, 4))], dict(axis=1)),
+    "mean": ([_n((3, 4))], dict(axis=0)),
+    "prod": ([_u((3, 4))], {}),
+    "max": ([_u((3, 4))], {}),
+    "min": ([_u((3, 4))], {}),
+    "norm": ([_n((3, 4))], {}),
+    "broadcast_add": ([_n((3, 4)), _n((1, 4))], {}),
+    "broadcast_sub": ([_n((3, 4)), _n((3, 1))], {}),
+    "broadcast_mul": ([_n((3, 4)), _n((1, 4))], {}),
+    "broadcast_div": ([_n((3, 4)), _u((1, 4), 0.5, 1.5)], {}),
+    "broadcast_power": ([_u((3, 4)), _u((1, 4))], {}),
+    "broadcast_maximum": ([_n((3, 4)), _n((1, 4))], {}),
+    "broadcast_minimum": ([_n((3, 4)), _n((1, 4))], {}),
+    "broadcast_hypot": ([_u((3, 4)), _u((1, 4))], {}),
+    "where": ([onp.array([[1, 0], [0, 1], [1, 1]], dtype="float32"),
+               _n((3, 2)), _n((3, 2))], {}, [1, 2]),
+    "maximum": ([_n((3, 4)), _n((3, 4))], {}),
+    "minimum": ([_n((3, 4)), _n((3, 4))], {}),
+    "hypot": ([_u((3, 4)), _u((3, 4))], {}),
+    "power": ([_u((3, 4)), _u((3, 4))], {}),
+    "SequenceMask": ([_n((4, 3, 2)),
+                      onp.array([2, 4, 1], dtype="float32")],
+                     dict(use_sequence_length=True), [0]),
+    "SequenceReverse": ([_n((4, 3, 2))], {}),
+    "SequenceLast": ([_n((4, 3, 2))], {}),
+    "pad": ([_n((2, 3, 4, 4))],
+            dict(mode="constant", pad_width=(0, 0, 0, 0, 1, 1, 1, 1))),
+    "tile": ([_n((2, 3))], dict(reps=(2, 2))),
+    "repeat": ([_n((2, 3))], dict(repeats=2)),
+    "flip": ([_n((3, 4))], dict(axis=1)),
+    "reverse": ([_n((3, 4))], dict(axis=1)),
+    "clip": ([_n((3, 4))], dict(a_min=-0.5, a_max=0.5)),
+    "gather_nd": ([_n((4, 3)),
+                   onp.array([[0, 2], [1, 0]], dtype="float32")], {},
+                  [0]),
+    "arccosh": ([_u((3, 4), 1.5, 3.0)], {}),
+    "arctanh": ([_u((3, 4), -0.5, 0.5)], {}),
+    "log_sigmoid": ([_n((3, 4))], {}),
+    "softsign": ([_n((3, 4))], {}),
+    "smooth_l1": ([_n((3, 4))], {}),
+    "MakeLoss": ([_u((3, 4))], {}),
+    "make_loss": ([_u((3, 4))], {}),
+    # scalar-kwarg elemwise family
+    "_plus_scalar": ([_n((3, 4))], dict(scalar=1.5)),
+    "_minus_scalar": ([_n((3, 4))], dict(scalar=1.5)),
+    "_rminus_scalar": ([_n((3, 4))], dict(scalar=1.5)),
+    "_mul_scalar": ([_n((3, 4))], dict(scalar=1.5)),
+    "_div_scalar": ([_n((3, 4))], dict(scalar=1.5)),
+    "_rdiv_scalar": ([_u((3, 4), 0.5, 1.5)], dict(scalar=1.5)),
+    "_mod_scalar": ([_u((3, 4), 0.3, 0.9)], dict(scalar=1.5)),
+    "_rmod_scalar": ([_u((3, 4), 1.2, 1.9)], dict(scalar=1.0)),
+    "_power_scalar": ([_u((3, 4))], dict(scalar=2.0)),
+    "_rpower_scalar": ([_u((3, 4))], dict(scalar=2.0)),
+    "_maximum_scalar": ([_n((3, 4))], dict(scalar=0.1)),
+    "_minimum_scalar": ([_n((3, 4))], dict(scalar=0.1)),
+    "_hypot_scalar": ([_u((3, 4))], dict(scalar=1.0)),
+    "_npi_matmul": ([_n((4, 5)), _n((5, 3))], {}),
+    # linalg family (SPD inputs where factorizations need them)
+    "_linalg_gemm": ([_n((3, 4)), _n((4, 5)), _n((3, 5))], {}),
+    "_linalg_gemm2": ([_n((3, 4)), _n((4, 5))], {}),
+    "_linalg_det": ([_spd(3) + onp.eye(3, dtype="float32")], {}),
+    "_linalg_slogdet": ([_spd(3) + 2 * onp.eye(3, dtype="float32")], {},
+                        None),
+    "_linalg_inverse": ([_spd(3) + 2 * onp.eye(3, dtype="float32")], {}),
+    "_linalg_potrf": ([_spd(3)], {}),
+    "_linalg_potri": ([_spd(3)], {}),
+    "_linalg_trmm": ([_tril(3), _n((3, 3))], {}),
+    "_linalg_trsm": ([_tril(3) + 2 * onp.eye(3, dtype="float32"),
+                      _n((3, 3))], {}),
+    "GroupNorm": ([_n((2, 4, 3, 3)), _u((2,)), _n((2,))],
+                  dict(num_groups=2)),
+    "Pad": ([_n((2, 3, 4, 4))],
+            dict(mode="edge", pad_width=(0, 0, 0, 0, 1, 1, 1, 1))),
+    "_getitem": ([_n((5, 4))], dict(key=(slice(1, 4),))),
+    "broadcast_axis": ([_n((1, 4))], dict(axis=0, size=3)),
+    "broadcast_to": ([_n((1, 4))], dict(shape=(3, 4))),
+    "moments": ([_n((3, 4))], dict(axes=(0,))),
+    "pick": ([_n((4, 3)), onp.array([0, 2, 1, 0], dtype="float32")], {},
+             [0]),
+    "batch_take": ([_n((4, 3)), onp.array([0, 2, 1, 0], dtype="float32")],
+                   {}, [0]),
+    "softmax_cross_entropy": ([_n((4, 5)),
+                               onp.array([0, 2, 1, 4], dtype="float32")],
+                              {}, [0]),
+}
+
+# ops legitimately excluded from the finite-difference sweep
+EXCLUDE_REASON = {
+    "int-valued": {
+        "argmax", "argmin", "argsort", "argmax_channel", "topk", "round",
+        "rint", "fix", "floor", "ceil", "trunc", "sign", "one_hot",
+        "Cast", "cast", "shape_array", "size_array", "ones_like",
+        "zeros_like", "batchnorm_moments",
+    },
+    "random/rng": {
+        o for o in list_ops()
+        if get_op(o).key_param or o.startswith(("sample_", "random_",
+                                                "_sample_", "_random_"))
+    },
+    "non-smooth-or-structural": {
+        "sort", "abs", "relu", "BlockGrad", "stop_gradient", "Custom",
+        "CTCLoss", "ctc_loss", "SoftmaxOutput", "SVMOutput",
+        "LogisticRegressionOutput", "LinearRegressionOutput",
+        "MAERegressionOutput", "SliceChannel", "split", "RNN",
+        "SwapAxis", "swapaxes", "Crop", "crop", "space_to_depth",
+        "depth_to_space", "scatter_nd", "BilinearSampler",
+        "GridGenerator", "SpatialTransformer", "Correlation", "IdentityAttachKLSparseReg",
+        "identity_attach_kl_sparse_reg", "khatri_rao", "amp_cast",
+        "amp_multicast", "split_v2", "_linalg_gelqf", "_linalg_syevd",
+    },
+}
+
+
+def _auto_probe(op):
+    """Try calling an unspecced op with 1 or 2 safe-domain arrays."""
+    for arity in (1, 2):
+        args = [_u((3, 4)) for _ in range(arity)]
+        try:
+            out = op.fn(*[mx.nd.array(a)._data for a in args])
+        except Exception:
+            continue
+        if isinstance(out, (tuple, list)):
+            continue
+        try:
+            if not onp.issubdtype(onp.asarray(out).dtype, onp.floating):
+                continue
+            if not onp.all(onp.isfinite(onp.asarray(out))):
+                continue
+        except Exception:
+            continue
+        return args
+    return None
+
+
+_seen = set()
+_cases = []
+_skipped = []
+for name in list_ops():
+    op = get_op(name)
+    if id(op) in _seen:
+        continue
+    _seen.add(id(op))
+    if not op.differentiable:
+        continue
+    if any(name in s or op.name in s for s in EXCLUDE_REASON.values()):
+        continue
+    if op.name in SPECS or name in SPECS:
+        spec = SPECS.get(op.name) or SPECS[name]
+        inputs, params = spec[0], spec[1]
+        wrt = spec[2] if len(spec) > 2 else None
+        _cases.append(pytest.param(op.name, inputs, params, wrt,
+                                   id=op.name))
+    else:
+        _cases.append(pytest.param(op.name, None, None, None, id=op.name))
+
+
+@pytest.mark.parametrize("opname,inputs,params,wrt", _cases)
+def test_op_gradient_vs_finite_difference(opname, inputs, params, wrt):
+    op = get_op(opname)
+    if inputs is None:
+        inputs = _auto_probe(op)
+        if inputs is None:
+            pytest.skip(f"{opname}: no auto-probe inputs (needs spec)")
+        params = {}
+    tu.check_numeric_gradient(opname, inputs, rtol=5e-2, atol=1e-2,
+                              wrt=wrt, **params)
+
+
+# ---------------------------------------------------------------- harness
+def test_assert_almost_equal_reports_location():
+    a = onp.zeros((2, 2), dtype="float32")
+    b = a.copy()
+    b[1, 1] = 1.0
+    with pytest.raises(AssertionError, match="max rel err"):
+        tu.assert_almost_equal(a, b)
+
+
+def test_numeric_grad_quadratic():
+    f = lambda x: mx.nd.array(x) * mx.nd.array(x)  # noqa: E731
+    x = onp.array([1.0, 2.0, 3.0], dtype="float32")
+    (g,) = tu.numeric_grad(lambda x_: mx.nd.array(x_) ** 2, [x])
+    onp.testing.assert_allclose(g, 2 * x, rtol=1e-4)
+
+
+def test_check_numeric_gradient_symbol():
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    net = mx.sym.FullyConnected(data=data, weight=w, num_hidden=3,
+                                no_bias=True, name="fc")
+    tu.check_numeric_gradient(
+        net, {"data": _n((4, 5)), "w": _n((3, 5))}, rtol=5e-2, atol=1e-2)
+
+
+def test_check_symbolic_forward_backward():
+    x = mx.sym.Variable("x")
+    y = 2 * x
+    loc = [onp.array([[1.0, 2.0]], dtype="float32")]
+    tu.check_symbolic_forward(y, loc, [2 * loc[0]])
+    tu.check_symbolic_backward(
+        y, loc, [onp.ones((1, 2), dtype="float32")],
+        [2 * onp.ones((1, 2), dtype="float32")])
+
+
+def test_check_consistency_dtype_ladder():
+    data = mx.sym.Variable("data", shape=(4, 5))
+    w = mx.sym.Variable("w", shape=(3, 5))
+    net = mx.sym.FullyConnected(data=data, weight=w, num_hidden=3,
+                                no_bias=True)
+    tu.check_consistency(net, dtypes=("float32", "float16"))
+
+
+def test_lazy_namespace():
+    assert mx.test_utils is tu
