@@ -25,8 +25,7 @@ import numpy as onp
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-_DTYPES = {"float32": "float32", "bfloat16": "bfloat16",
-           "float16": "float16"}
+_ALLOWED_DTYPES = ("float32", "bfloat16", "float16")
 
 
 def score_model(model_name, batches, dtypes, image_shape=(3, 224, 224),
@@ -46,7 +45,7 @@ def score_model(model_name, batches, dtypes, image_shape=(3, 224, 224),
     fwd = jax.jit(lambda p, xx: apply_fn(p, xx))
 
     for dtype in dtypes:
-        cdtype = jnp.dtype(_DTYPES[dtype])
+        cdtype = jnp.dtype(dtype)
         params = params0 if dtype == "float32" \
             else amp_cast_params(params0, cdtype)
         for batch in batches:
@@ -71,10 +70,10 @@ def main():
     ap.add_argument("--dtypes", default="float32,bfloat16")
     args = ap.parse_args()
     dtypes = args.dtypes.split(",")
-    unknown = set(dtypes) - set(_DTYPES)
+    unknown = set(dtypes) - set(_ALLOWED_DTYPES)
     if unknown:
         ap.error(f"unknown dtypes: {sorted(unknown)} "
-                 f"(choose from {sorted(_DTYPES)})")
+                 f"(choose from {sorted(_ALLOWED_DTYPES)})")
     batches = [int(b) for b in args.batches.split(",")]
     for model in args.models.split(","):
         for row in score_model(model, batches, dtypes):

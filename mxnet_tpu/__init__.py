@@ -65,6 +65,8 @@ _LAZY = {
     "np": ".numpy",
     "npx": ".numpy_extension",
     "engine": ".engine",
+    "contrib": ".contrib",
+    "amp": ".contrib.amp",
 }
 
 

@@ -1,0 +1,2 @@
+"""Contrib python packages (reference: python/mxnet/contrib/)."""
+from . import amp  # noqa: F401

@@ -1,0 +1,162 @@
+"""Automatic Mixed Precision (reference: python/mxnet/contrib/amp/amp.py).
+
+TPU-native design: the reference rewrites the symbol graph, inserting
+``amp_cast``/``amp_multicast`` nodes per its op lists
+(src/nnvm/low_precision_pass.cc).  Here the same policy lists drive the
+SINGLE eager dispatch point (ndarray.invoke): when AMP is active,
+floating inputs of MXU-heavy ops are cast to the target dtype, fp32-list
+ops get fp32 inputs, and widest-cast ops promote to the widest input
+dtype.  XLA fuses the resulting converts, which is exactly what the
+reference's graph pass painstakingly arranges by hand.
+
+Loss scaling: ``init_trainer`` + ``scale_loss`` give gluon training
+dynamic loss scaling with overflow skipping (all_finite op); the fused
+SPMD path has the same logic compiled in via
+``make_train_step(loss_scale='dynamic')``.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax.numpy as jnp
+
+from ...base import MXNetError
+from . import lists
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale",
+           "convert_model", "convert_hybrid_block", "lists", "LossScaler"]
+
+_active = False
+_target_dtype = None
+_target_set = frozenset()
+_fp32_set = frozenset()
+_widest_set = frozenset()
+
+
+def init(target_dtype="bfloat16"):
+    """Turn on AMP for eager/gluon execution (reference amp.py:init).
+
+    The reference only allows calling once; re-init with a different
+    dtype raises, matching that behavior.
+    """
+    global _active, _target_dtype, _target_set, _fp32_set, _widest_set
+    if isinstance(target_dtype, str):
+        if target_dtype in ("bfloat16", "bf16"):
+            target_dtype = jnp.bfloat16
+        elif target_dtype in ("float16", "fp16"):
+            target_dtype = jnp.float16
+        else:
+            raise MXNetError(
+                f"AMP target_dtype must be bfloat16 or float16, got "
+                f"{target_dtype!r}")
+    if _active and target_dtype != _target_dtype:
+        raise MXNetError("AMP already initialized with a different dtype")
+    _target_dtype = target_dtype
+    _target_set = frozenset(lists.TARGET_DTYPE_OPS)
+    _fp32_set = frozenset(lists.FP32_OPS)
+    _widest_set = frozenset(lists.WIDEST_TYPE_CASTS)
+    _active = True
+
+
+def is_active():
+    return _active
+
+
+def _off():
+    """Internal/test helper: disable AMP."""
+    global _active
+    _active = False
+
+
+def _is_float(a):
+    return jnp.issubdtype(a.dtype, jnp.floating)
+
+
+def cast_inputs(op_name, arrays):
+    """Apply the policy lists to one op invocation's array inputs."""
+    if op_name in _target_set:
+        return [a.astype(_target_dtype) if _is_float(a) else a
+                for a in arrays]
+    if op_name in _fp32_set:
+        return [a.astype(jnp.float32) if _is_float(a) and
+                a.dtype != jnp.float32 else a for a in arrays]
+    if op_name in _widest_set:
+        floats = [a.dtype for a in arrays if _is_float(a)]
+        if len(set(floats)) > 1:
+            widest = functools.reduce(jnp.promote_types, floats)
+            return [a.astype(widest) if _is_float(a) else a
+                    for a in arrays]
+    return arrays
+
+
+def init_trainer(trainer):
+    """Attach a dynamic LossScaler to a gluon Trainer (reference
+    amp.py:init_trainer)."""
+    if getattr(trainer, "_amp_loss_scaler", None) is None:
+        trainer._amp_loss_scaler = LossScaler()
+        trainer._amp_original_scale = trainer._scale
+    return trainer
+
+
+@contextlib.contextmanager
+def scale_loss(loss, trainer):
+    """Scale the loss and arrange for gradients to be unscaled in
+    trainer.step (reference amp.py:scale_loss)."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        raise MXNetError("call amp.init_trainer(trainer) first")
+    trainer._scale = trainer._amp_original_scale / scaler.loss_scale
+    if isinstance(loss, (list, tuple)):
+        yield [l * scaler.loss_scale for l in loss]
+    else:
+        yield loss * scaler.loss_scale
+
+
+def unscale(trainer):
+    """Divide current gradients by the loss scale (for gradient clipping
+    between backward and step; reference amp.py:unscale)."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        raise MXNetError("call amp.init_trainer(trainer) first")
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        g = p._data._grad if p._data is not None else None
+        if g is not None:
+            g._adopt(g._data * inv)
+    trainer._scale = trainer._amp_original_scale
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16"):
+    """Convert a symbolic model for low-precision inference (reference
+    amp.py:convert_model).
+
+    The reference inserts amp_cast nodes into the graph; on TPU the
+    dispatch-level policy handles activation dtypes, so converting a
+    model = casting its parameters (norm stats stay fp32).
+    """
+    from ...parallel import amp_cast_params
+
+    dt = jnp.bfloat16 if str(target_dtype) in ("bfloat16", "bf16") \
+        else jnp.float16
+    arg_np = {k: v._data for k, v in arg_params.items()}
+    aux_keep = dict(aux_params)  # aux = norm running stats: keep fp32
+    casted = amp_cast_params(arg_np, dt)
+    from ... import ndarray as nd
+
+    return sym, {k: nd.NDArray(v) for k, v in casted.items()}, aux_keep
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16"):
+    """Cast a HybridBlock's parameters per the AMP policy (reference
+    amp.py:convert_hybrid_block)."""
+    from ...parallel import _is_norm_stat
+
+    dt = "bfloat16" if str(target_dtype) in ("bfloat16", "bf16") \
+        else "float16"
+    for name, p in block.collect_params().items():
+        if not _is_norm_stat(name) and p._data is not None and \
+                jnp.issubdtype(p.data()._data.dtype, jnp.floating):
+            p.cast(dt)
+    return block

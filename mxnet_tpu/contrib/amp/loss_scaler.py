@@ -1,0 +1,46 @@
+"""Dynamic loss scaler (reference: python/mxnet/contrib/amp/loss_scaler.py).
+
+Scale doubles after ``scale_window`` consecutive overflow-free steps and
+halves on overflow; overflow detection uses the ``multi_all_finite`` op
+(reference src/operator/contrib/all_finite.cc).
+"""
+from __future__ import annotations
+
+import logging
+
+
+class LossScaler:
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, tolerance=0.0):
+        self.loss_scale = float(init_scale)
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+        self._tolerance = tolerance
+        self._total = 0
+        self._skipped = 0
+
+    def has_overflow(self, params):
+        """True when any gradient of ``params`` is non-finite."""
+        from ... import ndarray as nd
+
+        grads = [p._data._grad for p in params
+                 if p._data is not None and p._data._grad is not None]
+        if not grads:
+            return False
+        ok = nd.invoke("multi_all_finite", grads, num_arrays=len(grads))
+        return float(ok.asnumpy()[0]) == 0.0
+
+    def update_scale(self, overflow):
+        self._total += 1
+        if overflow:
+            self._skipped += 1
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+            logging.info("AMP: gradient overflow, lowering loss scale to "
+                         "%g", self.loss_scale)
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0

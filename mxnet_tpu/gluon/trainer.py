@@ -123,6 +123,17 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is not None:
+            # AMP dynamic loss scaling: skip the whole update on overflow
+            # (reference contrib/amp trainer integration + all_finite op)
+            overflow = scaler.has_overflow(self._params)
+            scaler.update_scale(overflow)
+            if overflow:
+                for param in self._params:
+                    if param._data is not None:
+                        param._data._fresh_grad = False
+                return
         updater = self._updaters[0]
         for i, param in enumerate(self._params):
             if param.grad_req == "null":

@@ -448,6 +448,7 @@ def invoke(op, inputs, out=None, **params):
 
 
 _profiler = None
+_amp = None
 
 
 def _invoke_impl(opdef, inputs, out, params):
@@ -461,6 +462,12 @@ def _invoke_impl(opdef, inputs, out, params):
         else:
             arrs.append(jnp.asarray(i))
             nd_inputs.append(None)
+    global _amp
+    if _amp is None:  # lazy: keep contrib import errors local
+        from ..contrib import amp as _amp_mod
+
+        _amp = _amp_mod
+    amp_on = _amp.is_active()
     if opdef.key_param:
         params[opdef.key_param] = _rng.take_key()
     if opdef.train_param and opdef.train_param not in params:
@@ -473,11 +480,17 @@ def _invoke_impl(opdef, inputs, out, params):
         and any(_needs_grad(i) for i in inputs)
     )
     if recording:
+        # AMP casts live INSIDE the differentiated function so vjp
+        # cotangent dtypes match the tape's (uncast) primal dtypes
         def _f(*xs):
+            if amp_on:
+                xs = _amp.cast_inputs(opdef.name, list(xs))
             return opdef.fn(*xs, **params)
 
         out_vals, vjp_fn = jax.vjp(_f, *arrs)
     else:
+        if amp_on:
+            arrs = _amp.cast_inputs(opdef.name, arrs)
         out_vals = opdef.fn(*arrs, **params)
 
     single = not isinstance(out_vals, (tuple, list))

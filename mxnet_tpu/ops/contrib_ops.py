@@ -1,0 +1,153 @@
+"""Contrib operators: AMP support, boolean masking, FFT, index ops.
+
+Reference parity: src/operator/contrib/ — all_finite.cc (AMP dynamic
+loss scaling), boolean_mask.cc, fft/ifft.cc, index_copy.cc,
+allclose_op.cc, gradientmultiplier_op.cc, hawkes_ll.cc.  Dynamic-shape
+outputs (boolean_mask) use the fixed-size+mask XLA idiom documented in
+SURVEY.md §7 hard parts.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("all_finite", differentiable=False)
+def all_finite(data, *, init_output=True):
+    """Reference: src/operator/contrib/all_finite.cc — scalar 1.0 when
+    every element is finite, else 0.0 (feeds AMP loss-scale logic)."""
+    return jnp.isfinite(data).all().astype(jnp.float32).reshape(1)
+
+
+@register_op("multi_all_finite", differentiable=False)
+def multi_all_finite(*arrays, num_arrays=1, init_output=True):
+    """Reference: all_finite.cc multi-tensor variant."""
+    ok = jnp.array(True)
+    for a in arrays:
+        ok = ok & jnp.isfinite(a).all()
+    return ok.astype(jnp.float32).reshape(1)
+
+
+@register_op("_contrib_boolean_mask", aliases=("boolean_mask",))
+def boolean_mask(data, index, *, axis=0):
+    """Reference: src/operator/contrib/boolean_mask.cc.
+
+    XLA needs static shapes, so the TPU-native contract is
+    fixed-size+mask: selected rows are compacted to the FRONT of an
+    output the same size as the input; the tail is zero-padded.  The
+    number of valid rows equals ``index.sum()`` (host-checkable).
+    """
+    idx = index.astype(bool)
+    n = data.shape[axis]
+    order = jnp.argsort(~idx, stable=True)  # selected first, stable
+    gathered = jnp.take(data, order, axis=axis)
+    keep = jnp.arange(n) < idx.sum()
+    shape = [1] * data.ndim
+    shape[axis] = n
+    return gathered * keep.reshape(shape).astype(data.dtype)
+
+
+@register_op("_contrib_index_copy", differentiable=False)
+def index_copy(old, idx, new_tensor):
+    """Reference: src/operator/contrib/index_copy.cc."""
+    return old.at[idx.astype(jnp.int32)].set(new_tensor)
+
+
+@register_op("_contrib_index_array", differentiable=False)
+def index_array(data, *, axes=None):
+    """Reference: src/operator/contrib/index_array.cc — per-element
+    coordinates."""
+    shape = data.shape
+    axes = tuple(range(len(shape))) if axes is None else tuple(axes)
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij")
+    return jnp.stack([grids[a] for a in axes], axis=-1).astype(jnp.int64)
+
+
+@register_op("_contrib_fft", differentiable=False)
+def fft(data, *, compute_size=128):
+    """Reference: src/operator/contrib/fft/fft.cc — complex output packed
+    as interleaved (real, imag) along the last axis, like cuFFT."""
+    out = jnp.fft.fft(data.astype(jnp.float32))
+    return jnp.stack([out.real, out.imag], axis=-1).reshape(
+        *data.shape[:-1], 2 * data.shape[-1])
+
+
+@register_op("_contrib_ifft", differentiable=False)
+def ifft(data, *, compute_size=128):
+    """Reference: fft/ifft.cc — input interleaved (real, imag)."""
+    n = data.shape[-1] // 2
+    pairs = data.reshape(*data.shape[:-1], n, 2)
+    comp = pairs[..., 0] + 1j * pairs[..., 1]
+    return jnp.fft.ifft(comp).real.astype(jnp.float32) * n
+
+
+@register_op("_contrib_allclose", differentiable=False)
+def allclose(a, b, *, rtol=1e-5, atol=1e-8, equal_nan=False):
+    """Reference: src/operator/contrib/allclose_op.cc."""
+    return jnp.allclose(a, b, rtol=rtol, atol=atol,
+                        equal_nan=equal_nan).astype(jnp.float32).reshape(1)
+
+
+@register_op("_contrib_gradientmultiplier")
+def gradientmultiplier(data, *, scalar=1.0):
+    """Reference: src/operator/contrib/gradientmultiplier_op.cc —
+    identity forward, gradient scaled by ``scalar``."""
+
+    @jax.custom_vjp
+    def _f(x):
+        return x
+
+    def _fwd(x):
+        return x, None
+
+    def _bwd(_, ct):
+        return (ct * scalar,)
+
+    _f.defvjp(_fwd, _bwd)
+    return _f(data)
+
+
+@register_op("_contrib_hawkesll", num_outputs=2)
+def hawkesll(mu, alpha, beta, state, lags, marks, valid_length, max_time):
+    """Reference: src/operator/contrib/hawkes_ll-inl.h:119-185.
+
+    Marked self-exciting Hawkes process log-likelihood.  Per valid event
+    with inter-arrival gap d: intensity lambda_k = mu_k +
+    alpha_k*beta_k*state_k*exp(-beta_k*d); the per-gap compensator is
+    sum_k [mu_k*d + alpha_k*state_k*(1-exp(-beta_k*d))] (:149), and the
+    remaining compensator integrates from the last event to max_time
+    (:184).  Returns (ll per sample, decayed state at max_time).
+    """
+    mu = mu.astype(jnp.float32)
+    k = mu.shape[-1]
+    n, t = lags.shape
+    marks_i = marks.astype(jnp.int32)
+    valid = (jnp.arange(t)[None, :] < valid_length.reshape(-1, 1))
+
+    def scan_body(carry, inp):
+        st, ll, elapsed = carry
+        lag, mark, is_valid = inp
+        d = (lag * is_valid).reshape(-1, 1)
+        ed = jnp.exp(-beta * d)
+        decayed = st * ed
+        lam = mu + alpha * beta * decayed
+        lam_m = jnp.take_along_axis(lam, mark.reshape(-1, 1), axis=1)[:, 0]
+        comp = (mu * d + alpha * st * (1 - ed)).sum(-1)
+        ll = ll + jnp.where(is_valid, jnp.log(lam_m + 1e-30) - comp, 0.0)
+        add = jax.nn.one_hot(mark, k, dtype=mu.dtype) * \
+            is_valid[:, None].astype(mu.dtype)
+        st = decayed + add
+        elapsed = elapsed + d[:, 0]
+        return (st, ll, elapsed), None
+
+    st0 = state.astype(jnp.float32)
+    ll0 = jnp.zeros((n,), jnp.float32)
+    (st, ll, elapsed), _ = jax.lax.scan(
+        scan_body, (st0, ll0, jnp.zeros((n,), jnp.float32)),
+        (lags.T.astype(jnp.float32), marks_i.T, valid.T.astype(bool)))
+    d_rem = jnp.maximum(max_time.reshape(-1, 1) - elapsed[:, None], 0.0)
+    ed_rem = jnp.exp(-beta * d_rem)
+    rem_comp = (mu * d_rem + alpha * st * (1 - ed_rem)).sum(-1)
+    return ll - rem_comp, st * ed_rem

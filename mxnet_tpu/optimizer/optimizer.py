@@ -168,6 +168,28 @@ class Optimizer:
             g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
         return g
 
+    # ------------------------------------------------ fused SPMD interface
+    # make_train_step compiles fwd+bwd+update into ONE XLA program (the
+    # analog of the reference's fused optimizer ops,
+    # src/operator/optimizer_op.cc + contrib/multi_lars.cc); the optimizer
+    # contributes a pure per-parameter rule.  Hyper-parameters are read
+    # from self at trace time; lr schedulers are evaluated at self.lr's
+    # trace-time value (step-dependent schedules re-trace on lr change).
+    def fused_state(self, w):
+        """Initial per-parameter state as a tuple of jax arrays; mirrors
+        create_state so eager and fused paths keep identical layouts."""
+        return tuple(s._data for s in self.create_state(0, nd.NDArray(w)))
+
+    def fused_update(self, w, g, state, t, key=None):
+        """Pure update: (w, g, state, t[, key]) -> (new_w, new_state).
+
+        w/g/state are jax arrays (or tracers inside pjit); t is the
+        traced step count (1-based) for bias-corrected rules; key is a
+        PRNG key for stochastic rules (SGLD).
+        """
+        raise MXNetError(
+            f"{type(self).__name__} does not provide a fused SPMD rule")
+
 
 def _jit(fn):
     """jit with scalar hyper-params as traced args (no recompile per lr)."""
@@ -218,6 +240,15 @@ class SGD(Optimizer):
             weight._adopt(new_w)
             mom._adopt(new_m)
 
+    def fused_update(self, w, g, state, t, key=None):
+        g = self._prep(g)
+        if self.momentum == 0.0:
+            return _sgd_step(w, g, self.learning_rate, self.wd), ()
+        (mom,) = state
+        new_w, new_m = _sgd_mom_step(w, mom, g, self.learning_rate,
+                                     self.wd, self.momentum)
+        return new_w, (new_m,)
+
 
 @register
 class Test(Optimizer):
@@ -228,6 +259,9 @@ class Test(Optimizer):
 
     def update(self, index, weight, grad, state):
         weight._adopt(weight._data + grad._data * self.rescale_grad)
+
+    def fused_update(self, w, g, state, t, key=None):
+        return w + g * self.rescale_grad, state
 
 
 @_jit
@@ -263,6 +297,15 @@ class NAG(Optimizer):
                                      self.momentum)
             weight._adopt(new_w)
             mom._adopt(new_m)
+
+    def fused_update(self, w, g, state, t, key=None):
+        g = self._prep(g)
+        if self.momentum == 0.0:
+            return _sgd_step(w, g, self.learning_rate, self.wd), ()
+        (mom,) = state
+        new_w, new_m = _nag_step(w, mom, g, self.learning_rate, self.wd,
+                                 self.momentum)
+        return new_w, (new_m,)
 
 
 @_jit
@@ -302,6 +345,17 @@ class Signum(Optimizer):
                 self.wd_lh)
             weight._adopt(new_w)
             mom._adopt(new_m)
+
+    def fused_update(self, w, g, state, t, key=None):
+        g = self._prep(g)
+        lr, wd = self.learning_rate, self.wd
+        if self.momentum == 0.0:
+            return ((1 - lr * self.wd_lh) * w
+                    - lr * jnp.sign(g + wd * w)), ()
+        (mom,) = state
+        new_w, new_m = _signum_step(w, mom, g, lr, wd, self.momentum,
+                                    self.wd_lh)
+        return new_w, (new_m,)
 
 
 @_jit
@@ -345,6 +399,13 @@ class Adam(Optimizer):
         m._adopt(new_m)
         v._adopt(new_v)
 
+    def fused_update(self, w, g, state, t, key=None):
+        m, v = state
+        new_w, new_m, new_v = _adam_step(
+            w, m, v, self._prep(g), self.learning_rate, self.wd,
+            self.beta1, self.beta2, self.epsilon, t)
+        return new_w, (new_m, new_v)
+
 
 @_jit
 def _adamw_step(w, m, v, g, lr, eta, wd, beta1, beta2, eps, t):
@@ -378,6 +439,13 @@ class AdamW(Adam):
         m._adopt(new_m)
         v._adopt(new_v)
 
+    def fused_update(self, w, g, state, t, key=None):
+        m, v = state
+        new_w, new_m, new_v = _adamw_step(
+            w, m, v, self._prep(g), self.learning_rate, self.eta,
+            self.wd, self.beta1, self.beta2, self.epsilon, t)
+        return new_w, (new_m, new_v)
+
 
 @_jit
 def _adagrad_step(w, hist, g, lr, wd, eps):
@@ -406,6 +474,13 @@ class AdaGrad(Optimizer):
                                      self.float_stable_eps)
         weight._adopt(new_w)
         hist._adopt(new_h)
+
+    def fused_update(self, w, g, state, t, key=None):
+        (hist,) = state
+        new_w, new_h = _adagrad_step(w, hist, self._prep(g),
+                                     self.learning_rate, self.wd,
+                                     self.float_stable_eps)
+        return new_w, (new_h,)
 
 
 @_jit
@@ -467,6 +542,24 @@ class RMSProp(Optimizer):
             weight._adopt(jnp.clip(weight._data, -self.clip_weights,
                                    self.clip_weights))
 
+    def fused_update(self, w, g, state, t, key=None):
+        g = self._prep(g)
+        lr, wd = self.learning_rate, self.wd
+        if self.centered:
+            n, gavg, delta = state
+            new_w, new_n, new_g, new_d = _rmsprop_alex_step(
+                w, n, gavg, delta, g, lr, wd, self.gamma1, self.gamma2,
+                self.epsilon)
+            new_state = (new_n, new_g, new_d)
+        else:
+            (n,) = state
+            new_w, new_n = _rmsprop_step(w, n, g, lr, wd, self.gamma1,
+                                         self.epsilon)
+            new_state = (new_n,)
+        if self.clip_weights:
+            new_w = jnp.clip(new_w, -self.clip_weights, self.clip_weights)
+        return new_w, new_state
+
 
 @_jit
 def _adadelta_step(w, acc_g, acc_delta, g, wd, rho, eps):
@@ -500,6 +593,13 @@ class AdaDelta(Optimizer):
         weight._adopt(new_w)
         acc_g._adopt(new_ag)
         acc_delta._adopt(new_ad)
+
+    def fused_update(self, w, g, state, t, key=None):
+        acc_g, acc_delta = state
+        new_w, new_ag, new_ad = _adadelta_step(
+            w, acc_g, acc_delta, self._prep(g), self.wd, self.rho,
+            self.epsilon)
+        return new_w, (new_ag, new_ad)
 
 
 @_jit
@@ -536,6 +636,13 @@ class Adamax(Optimizer):
         weight._adopt(new_w)
         m._adopt(new_m)
         u._adopt(new_u)
+
+    def fused_update(self, w, g, state, t, key=None):
+        m, u = state
+        new_w, new_m, new_u = _adamax_step(
+            w, m, u, self._prep(g), self.learning_rate, self.wd,
+            self.beta1, self.beta2, t)
+        return new_w, (new_m, new_u)
 
 
 @_jit
@@ -586,6 +693,20 @@ class Nadam(Optimizer):
         m._adopt(new_m)
         v._adopt(new_v)
 
+    def fused_state(self, w):
+        # m_schedule is per-parameter carried state in the fused path
+        # (the eager path keeps it as a python attribute)
+        return (jnp.zeros_like(w), jnp.zeros_like(w),
+                jnp.ones((), dtype=jnp.float32))
+
+    def fused_update(self, w, g, state, t, key=None):
+        m, v, m_schedule = state
+        new_w, new_m, new_v, new_ms = _nadam_step(
+            w, m, v, self._prep(g), self.learning_rate, self.wd,
+            self.beta1, self.beta2, self.epsilon, t, m_schedule,
+            self.schedule_decay)
+        return new_w, (new_m, new_v, new_ms)
+
 
 @_jit
 def _ftrl_step(w, z, n, g, lr, wd, lamda1, beta):
@@ -623,6 +744,13 @@ class Ftrl(Optimizer):
         weight._adopt(new_w)
         zst._adopt(new_z)
         n._adopt(new_n)
+
+    def fused_update(self, w, g, state, t, key=None):
+        z, n = state
+        new_w, new_z, new_n = _ftrl_step(
+            w, z, n, self._prep(g), self.learning_rate, self.wd,
+            self.lamda1, self.beta)
+        return new_w, (new_z, new_n)
 
 
 @_jit
@@ -662,6 +790,13 @@ class FTML(Optimizer):
         d._adopt(new_d)
         s._adopt(new_s)
         zz._adopt(new_z)
+
+    def fused_update(self, w, g, state, t, key=None):
+        d, s, z = state
+        new_w, new_d, new_s, new_z = _ftml_step(
+            w, d, s, z, self._prep(g), self.learning_rate, self.wd,
+            self.beta1, self.beta2, self.epsilon, t)
+        return new_w, (new_d, new_s, new_z)
 
 
 @_jit
@@ -704,6 +839,13 @@ class LARS(Optimizer):
         weight._adopt(new_w)
         mom._adopt(new_m)
 
+    def fused_update(self, w, g, state, t, key=None):
+        (mom,) = state
+        new_w, new_m = _lars_step(
+            w, mom, self._prep(g), self.learning_rate, self.wd,
+            self.momentum, self.eta, self.epsilon)
+        return new_w, (new_m,)
+
 
 @register
 class LBSGD(SGD):
@@ -743,6 +885,15 @@ class SGLD(Optimizer):
             weight._data - lr / 2 * (g + wd * weight._data)
             + noise._data.astype(weight._data.dtype))
 
+    def fused_update(self, w, g, state, t, key=None):
+        if key is None:
+            raise MXNetError("SGLD fused rule needs a PRNG key")
+        lr, wd = self.learning_rate, self.wd
+        g = self._prep(g)
+        noise = math.sqrt(lr) * jax.random.normal(
+            key, w.shape, dtype=jnp.float32).astype(w.dtype)
+        return w - lr / 2 * (g + wd * w) + noise, state
+
 
 @_jit
 def _dcasgd_step(w, mom, prev_w, g, lr, wd, momentum, lamda):
@@ -775,6 +926,13 @@ class DCASGD(Optimizer):
         weight._adopt(new_w)
         mom._adopt(new_m)
         prev_w._adopt(new_prev)
+
+    def fused_update(self, w, g, state, t, key=None):
+        mom, prev_w = state
+        new_w, new_m, new_prev = _dcasgd_step(
+            w, mom, prev_w, self._prep(g), self.learning_rate, self.wd,
+            self.momentum, self.lamda)
+        return new_w, (new_m, new_prev)
 
 
 # ================================================================ Updater

@@ -107,35 +107,46 @@ def functionalize(block, train=False):
     return params, apply_fn
 
 
-def _sgd_tree_update(params, grads, mom, lr, momentum, wd):
-    new_mom = jax.tree_util.tree_map(
-        lambda m, g, w: momentum * m + g + wd * w, mom, grads, params)
-    new_params = jax.tree_util.tree_map(
-        lambda w, m: w - lr * m, params, new_mom)
-    return new_params, new_mom
+def _build_optimizer(optimizer, learning_rate, momentum, wd, beta1, beta2,
+                     epsilon, opt_kwargs):
+    """Resolve the ``optimizer`` argument to an Optimizer instance with a
+    fused rule, filtering convenience kwargs to what its ctor accepts."""
+    import inspect
 
+    from .. import optimizer as opt_mod
 
-def _adam_tree_update(params, grads, state, lr, b1, b2, eps, wd, t):
-    m, v = state
-    # couple wd into the gradient BEFORE the moment updates — same rule
-    # as the eager Adam optimizer (optimizer.py _adam_step) and the
-    # reference's adam_update op, so both paths train identically
-    grads = jax.tree_util.tree_map(lambda g, w: g + wd * w, grads, params)
-    new_m = jax.tree_util.tree_map(
-        lambda mm, g: b1 * mm + (1 - b1) * g, m, grads)
-    new_v = jax.tree_util.tree_map(
-        lambda vv, g: b2 * vv + (1 - b2) * g * g, v, grads)
-    lr_t = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
-    new_p = jax.tree_util.tree_map(
-        lambda w, mm, vv: w - lr_t * mm / (jnp.sqrt(vv) + eps),
-        params, new_m, new_v)
-    return new_p, (new_m, new_v)
+    if isinstance(optimizer, opt_mod.Optimizer):
+        return optimizer
+    klass = opt_mod.Optimizer.opt_registry.get(str(optimizer).lower())
+    if klass is None:
+        raise MXNetError(f"unknown optimizer {optimizer!r}")
+    sig = inspect.signature(klass.__init__)
+    accepted = set(sig.parameters)
+    base_accepted = set(
+        inspect.signature(opt_mod.Optimizer.__init__).parameters)
+    if not any(p.kind is inspect.Parameter.VAR_KEYWORD
+               for p in sig.parameters.values()):
+        base_accepted = set()
+    # the convenience defaults are filtered to what the ctor accepts;
+    # explicit opt_kwargs must match exactly (typos should not pass)
+    unknown = {k for k in opt_kwargs
+               if k not in accepted and k not in base_accepted}
+    if unknown:
+        raise MXNetError(
+            f"optimizer {optimizer!r} does not accept {sorted(unknown)}")
+    kwargs = dict(learning_rate=learning_rate, wd=wd, momentum=momentum,
+                  beta1=beta1, beta2=beta2, epsilon=epsilon)
+    kwargs = {k: v for k, v in kwargs.items()
+              if k in accepted or k in base_accepted}
+    kwargs.update(opt_kwargs)
+    return klass(**kwargs)
 
 
 def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
                     momentum=0.9, wd=0.0, beta1=0.9, beta2=0.999,
                     epsilon=1e-8, mesh=None, data_axis="data",
-                    param_spec=None, donate=True, compute_dtype=None):
+                    param_spec=None, donate=True, compute_dtype=None,
+                    loss_scale=None, **opt_kwargs):
     """Build ONE fully-fused jitted SPMD train step.
 
     Returns (step_fn, params, opt_state) where
@@ -147,6 +158,16 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
     src/operator/optimizer_op.cc).  Under a mesh, x/y shard on the batch
     axis and params replicate (or shard per `param_spec` for tp/ZeRO);
     XLA inserts the gradient all-reduce over ICI.
+
+    optimizer: any registry name ('sgd', 'adam', 'lars', 'ftml', ...) or
+    an Optimizer instance — its pure ``fused_update`` rule is traced into
+    the program (reference analog: server-side optimizer,
+    kvstore_dist_server.h:346, and fused optimizer_op kernels).
+
+    loss_scale: None, a static float, or 'dynamic' — dynamic loss scaling
+    doubles the scale every 2000 consecutive finite steps and halves it
+    on overflow, skipping the update (reference: contrib/amp loss scaler
+    + all_finite, src/operator/contrib/all_finite.cc).
     """
     params, apply_fn = functionalize(block, train=True)
     if mesh is None:
@@ -155,6 +176,9 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
         # parity, but the fused step must live in device memory)
         dev = jax.devices()[0]
         params = jax.device_put(params, dev)
+
+    opt = _build_optimizer(optimizer, learning_rate, momentum, wd, beta1,
+                           beta2, epsilon, opt_kwargs)
 
     def loss_of(param_dict, x, y, key):
         if compute_dtype is not None:
@@ -167,30 +191,75 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
                           nd.NDArray(y))
         return jnp.mean(loss_nd._data)
 
-    if optimizer == "sgd":
-        opt_state = jax.tree_util.tree_map(jnp.zeros_like, params)
+    dynamic_scaling = loss_scale == "dynamic"
+    static_scale = float(loss_scale) if (
+        loss_scale is not None and not dynamic_scaling) else 1.0
 
-        def step(params_, opt_state_, x, y, key, t):
-            loss, grads = jax.value_and_grad(loss_of)(params_, x, y, key)
-            new_p, new_m = _sgd_tree_update(
-                params_, grads, opt_state_, learning_rate, momentum, wd)
-            return loss, new_p, new_m
-
-    elif optimizer == "adam":
-        opt_state = (
-            jax.tree_util.tree_map(jnp.zeros_like, params),
-            jax.tree_util.tree_map(jnp.zeros_like, params),
+    names = list(params)
+    opt_state = {n: opt.fused_state(v) for n, v in params.items()}
+    if dynamic_scaling:
+        opt_state["_loss_scale"] = (
+            jnp.float32(2.0 ** 16),  # initial scale (reference amp)
+            jnp.zeros((), jnp.int32),  # consecutive-finite counter
         )
 
-        def step(params_, opt_state_, x, y, key, t):
-            loss, grads = jax.value_and_grad(loss_of)(params_, x, y, key)
-            new_p, new_s = _adam_tree_update(
-                params_, grads, opt_state_, learning_rate, beta1, beta2,
-                epsilon, wd, t)
-            return loss, new_p, new_s
+    def _apply_updates(params_, opt_state_, grads, t, key):
+        new_p, new_s = {}, {}
+        for i, n in enumerate(names):
+            sub = jax.random.fold_in(key, i)
+            new_p[n], new_s[n] = opt.fused_update(
+                params_[n], grads[n], opt_state_[n], t, key=sub)
+        return new_p, new_s
 
-    else:
-        raise MXNetError(f"fused step supports sgd/adam, got {optimizer}")
+    def step(params_, opt_state_, x, y, key, t):
+        if dynamic_scaling:
+            scale, good = opt_state_["_loss_scale"]
+
+            def scaled_loss(p, x_, y_, k_):
+                return loss_of(p, x_, y_, k_) * scale
+
+            sloss, sgrads = jax.value_and_grad(scaled_loss)(
+                params_, x, y, key)
+            inv = 1.0 / scale
+            grads = jax.tree_util.tree_map(lambda g: g * inv, sgrads)
+            finite = jnp.array(True)
+            for g in jax.tree_util.tree_leaves(grads):
+                finite = finite & jnp.isfinite(g).all()
+            up_p, up_s = _apply_updates(
+                {n: params_[n] for n in names},
+                {n: opt_state_[n] for n in names}, grads, t, key)
+            # overflow: skip the update, halve the scale; after 2000
+            # consecutive finite steps, double it (reference amp scaler)
+            new_p = {n: jnp.where(finite, up_p[n], params_[n])
+                     for n in names}
+            new_s = {
+                n: jax.tree_util.tree_map(
+                    lambda u, o: jnp.where(finite, u, o),
+                    up_s[n], opt_state_[n])
+                for n in names
+            }
+            good = jnp.where(finite, good + 1, 0)
+            scale = jnp.where(
+                finite,
+                jnp.where(good >= 2000, scale * 2.0, scale),
+                jnp.maximum(scale * 0.5, 1.0))
+            good = jnp.where(good >= 2000, 0, good)
+            new_s["_loss_scale"] = (scale.astype(jnp.float32), good)
+            return sloss / scale, new_p, new_s
+
+        if static_scale != 1.0:
+            def scaled_loss(p, x_, y_, k_):
+                return loss_of(p, x_, y_, k_) * static_scale
+
+            loss, grads = jax.value_and_grad(scaled_loss)(params_, x, y,
+                                                          key)
+            loss = loss / static_scale
+            grads = jax.tree_util.tree_map(
+                lambda g: g / static_scale, grads)
+        else:
+            loss, grads = jax.value_and_grad(loss_of)(params_, x, y, key)
+        new_p, new_s = _apply_updates(params_, opt_state_, grads, t, key)
+        return loss, new_p, new_s
 
     donate_argnums = (0, 1) if donate else ()
     if mesh is not None:
@@ -204,12 +273,14 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
                 n: NamedSharding(mesh, param_spec.get(n, P()))
                 for n in params
             }
-            # optimizer state (per-param moments) shards like its param
-            if isinstance(opt_state, tuple):
-                opt_shard = tuple(
-                    {n: p_shard[n] for n in params} for _ in opt_state)
-            else:
-                opt_shard = {n: p_shard[n] for n in params}
+            # optimizer state (per-param moments) shards like its param;
+            # scalar entries (loss-scale state) replicate
+            opt_shard = {
+                n: jax.tree_util.tree_map(
+                    lambda s, sh=p_shard.get(n, repl): sh
+                    if getattr(s, "ndim", 0) else repl, opt_state[n])
+                for n in opt_state
+            }
         step_fn = jax.jit(
             step,
             in_shardings=(p_shard, opt_shard, batch_sharding,

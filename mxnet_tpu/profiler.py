@@ -282,9 +282,11 @@ class _Span:
     def stop(self):
         if self._start_ts is None:
             return
-        dur = _now_us() - self._start_ts
-        cat = f"{self._cat}:{self.domain}" if self.domain else self._cat
-        _record(self.name, cat, "X", self._start_ts, dur)
+        if is_running():  # user scopes respect the run/pause window too
+            dur = _now_us() - self._start_ts
+            cat = f"{self._cat}:{self.domain}" if self.domain \
+                else self._cat
+            _record(self.name, cat, "X", self._start_ts, dur)
         self._start_ts = None
 
     def __enter__(self):
@@ -338,8 +340,9 @@ class Counter:
 
     def set_value(self, value):
         self._value = value
-        _record(self.name, f"counter:{self.domain}", "C", _now_us(),
-                args={self.name: value})
+        if is_running():
+            _record(self.name, f"counter:{self.domain}", "C", _now_us(),
+                    args={self.name: value})
 
     def increment(self, delta=1):
         self.set_value(self._value + delta)
@@ -367,8 +370,9 @@ class Marker:
         self.name = name
 
     def mark(self, scope="process"):
-        _record(self.name, f"marker:{self.domain}", "i", _now_us(),
-                args={"scope": scope})
+        if is_running():
+            _record(self.name, f"marker:{self.domain}", "i", _now_us(),
+                    args={"scope": scope})
 
 
 @atexit.register

@@ -124,19 +124,24 @@ def simple_forward(sym, ctx=None, is_train=False, **inputs):
     return outs[0] if len(outs) == 1 else outs
 
 
-def numeric_grad(f, args, eps=1e-3, out_grads=None):
+def numeric_grad(f, args, eps=1e-3, out_grads=None, wrt=None):
     """Central-difference gradients of ``f(*args) -> array`` w.r.t. each
     numpy array in ``args``.
 
     out_grads: cotangent(s) to contract the output jacobian with; defaults
-    to all-ones (matching executor.backward default).  Reference:
-    test_utils.py numeric_grad used by check_numeric_gradient (:981).
+    to all-ones (matching executor.backward default).  wrt: arg indices
+    to differentiate (others return zero gradients without paying the
+    2-evaluations-per-element cost).  Reference: test_utils.py
+    numeric_grad used by check_numeric_gradient (:981).
     """
     import jax
 
-    args = [onp.asarray(a, dtype=onp.float64) if onp.issubdtype(
-        onp.asarray(a).dtype, onp.floating) else onp.asarray(a)
-        for a in args]
+    # owned C-contiguous float64 copies: perturbation writes below go
+    # through reshape(-1) views and must reach the evaluated buffer
+    # (and must never mutate the caller's arrays)
+    args = [onp.array(a, dtype=onp.float64, order="C", copy=True)
+            if onp.issubdtype(onp.asarray(a).dtype, onp.floating)
+            else onp.asarray(a) for a in args]
 
     def eval_f(xs):
         # full fp32 matmul precision: on TPU the MXU default is bf16,
@@ -155,7 +160,8 @@ def numeric_grad(f, args, eps=1e-3, out_grads=None):
 
     grads = []
     for i, a in enumerate(args):
-        if not onp.issubdtype(a.dtype, onp.floating):
+        if not onp.issubdtype(a.dtype, onp.floating) or \
+                (wrt is not None and i not in wrt):
             grads.append(onp.zeros_like(a, dtype=onp.float64))
             continue
         g = onp.zeros_like(a)
@@ -217,8 +223,10 @@ def check_numeric_gradient(sym_or_fn, location, aux_states=None,
                                   is_train=use_forward_train, **loc)
 
         loc_list = [location[k] for k in names]
-        numeric = numeric_grad(f, loc_list, eps=numeric_eps)
-        numeric = {k: g for k, g in zip(names, numeric)}
+        keep_idx = {i for i, k in enumerate(names) if k in grad_nodes}
+        numeric = numeric_grad(f, loc_list, eps=numeric_eps, wrt=keep_idx)
+        numeric = {k: g for k, g in zip(names, numeric)
+                   if k in grad_nodes}
     else:
         fn = sym_or_fn
         if isinstance(fn, str):
@@ -242,7 +250,8 @@ def check_numeric_gradient(sym_or_fn, location, aux_states=None,
         analytic = {i: a.grad.asnumpy() for i, a in enumerate(arrs)
                     if i in keep}
         numeric = {i: g for i, g in
-                   enumerate(numeric_grad(fn, location, eps=numeric_eps))
+                   enumerate(numeric_grad(fn, location, eps=numeric_eps,
+                                          wrt=keep))
                    if i in keep}
 
     for k in analytic:

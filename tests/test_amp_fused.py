@@ -1,0 +1,189 @@
+"""Fused optimizer rules + AMP subsystem tests.
+
+Reference models: tests/python/unittest/test_optimizer.py (rule parity)
+and tests/python/gpu/test_contrib_amp.py (amp init / loss scaling).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu import optimizer as opt_mod
+from mxnet_tpu.contrib import amp
+
+onp.random.seed(3)
+
+FUSED_OPTS = ["sgd", "nag", "signum", "adam", "adamw", "adagrad",
+              "rmsprop", "adadelta", "adamax", "nadam", "ftrl", "ftml",
+              "lars", "dcasgd", "lbsgd", "test"]
+
+
+def _mk(name):
+    kwargs = {"learning_rate": 0.05, "wd": 0.01}
+    if name in ("sgd", "nag", "signum", "lars", "dcasgd", "lbsgd"):
+        kwargs["momentum"] = 0.9
+    return opt_mod.create(name, **kwargs)
+
+
+@pytest.mark.parametrize("name", FUSED_OPTS)
+def test_fused_matches_eager(name):
+    """The fused pure rule and the eager NDArray update must produce
+    bit-identical trajectories (they share the same jitted step fns)."""
+    import jax
+
+    eager_opt = _mk(name)
+    fused_opt = _mk(name)
+    w0 = onp.random.randn(4, 3).astype("float32")
+    grads = [onp.random.randn(4, 3).astype("float32") for _ in range(4)]
+
+    # eager trajectory
+    w_e = mx.nd.array(w0)
+    state_e = eager_opt.create_state(0, w_e)
+    for g in grads:
+        eager_opt.update(0, w_e, mx.nd.array(g), state_e)
+
+    # fused trajectory
+    w_f = mx.nd.array(w0)._data
+    state_f = fused_opt.fused_state(w_f)
+    for t, g in enumerate(grads, start=1):
+        w_f, state_f = fused_opt.fused_update(
+            w_f, mx.nd.array(g)._data, state_f, float(t),
+            key=jax.random.key(0))
+
+    onp.testing.assert_allclose(w_e.asnumpy(), onp.asarray(w_f),
+                                rtol=2e-5, atol=2e-6)
+
+
+def test_sgld_fused_runs():
+    import jax
+
+    o = opt_mod.create("sgld", learning_rate=0.01)
+    w = mx.nd.array(onp.random.randn(5).astype("float32"))._data
+    new_w, state = o.fused_update(w, w * 0 + 1.0, (), 1.0,
+                                  key=jax.random.key(1))
+    assert onp.isfinite(onp.asarray(new_w)).all()
+
+
+@pytest.mark.parametrize("optimizer", ["lars", "ftml", "nadam"])
+def test_make_train_step_any_optimizer(optimizer):
+    from mxnet_tpu.parallel import make_train_step
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+    net.initialize(init=mx.init.Xavier())
+    net(mx.nd.zeros((2, 8)))
+    step_fn, params, opt_state = make_train_step(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), optimizer=optimizer,
+        learning_rate=0.05, donate=False)
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.asarray(onp.random.rand(16, 8).astype("float32"))
+    y = jnp.asarray(onp.random.randint(0, 4, (16,)).astype("float32"))
+    key = jax.random.key(0)
+    losses = []
+    for t in range(1, 13):
+        loss, params, opt_state = step_fn(params, opt_state, x, y, key,
+                                          float(t))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_make_train_step_dynamic_loss_scale():
+    from mxnet_tpu.parallel import make_train_step
+
+    net = gluon.nn.Dense(4)
+    net.initialize(init=mx.init.Xavier())
+    net(mx.nd.zeros((2, 8)))
+    step_fn, params, opt_state = make_train_step(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), optimizer="sgd",
+        learning_rate=0.05, compute_dtype="bfloat16",
+        loss_scale="dynamic", donate=False)
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.asarray(onp.random.rand(8, 8).astype("float32"))
+    y = jnp.asarray(onp.random.randint(0, 4, (8,)).astype("float32"))
+    key = jax.random.key(0)
+    scale0 = float(opt_state["_loss_scale"][0])
+    losses = []
+    for t in range(1, 9):
+        loss, params, opt_state = step_fn(params, opt_state, x, y, key,
+                                          float(t))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert onp.isfinite(losses).all()
+    scale, good = opt_state["_loss_scale"]
+    assert float(scale) == scale0  # no overflow, window not reached
+    assert int(good) == 8
+
+
+def test_amp_eager_cast_policy():
+    amp.init("bfloat16")
+    try:
+        a = mx.nd.ones((4, 5))
+        b = mx.nd.ones((5, 3))
+        out = mx.nd.dot(a, b)  # TARGET_DTYPE op -> bf16
+        import jax.numpy as jnp
+
+        assert out._data.dtype == jnp.bfloat16
+        sm = mx.nd.softmax(out)  # FP32 op -> fp32 inputs
+        assert sm._data.dtype == jnp.float32
+        # widest cast: bf16 + fp32 -> fp32
+        mixed = mx.nd.broadcast_add(out, sm)
+        assert mixed._data.dtype == jnp.float32
+    finally:
+        amp._off()
+
+
+def test_amp_trainer_loss_scaling_and_overflow_skip():
+    net = gluon.nn.Dense(3)
+    net.initialize(init=mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    amp.init_trainer(trainer)
+    x = mx.nd.array(onp.random.rand(4, 6).astype("float32"))
+    y = mx.nd.array(onp.random.rand(4, 3).astype("float32"))
+    loss_fn = gluon.loss.L2Loss()
+    with autograd.record():
+        with amp.scale_loss(loss_fn(net(x), y), trainer) as scaled:
+            scaled.backward()
+    w_before = net.weight.data().asnumpy().copy()
+    trainer.step(4)
+    assert not onp.allclose(net.weight.data().asnumpy(), w_before)
+
+    # forge an overflow: poison one gradient with inf
+    with autograd.record():
+        with amp.scale_loss(loss_fn(net(x), y), trainer) as scaled:
+            scaled.backward()
+    g = net.weight.data()._grad
+    g._adopt(g._data.at[0, 0].set(onp.inf))
+    scale_before = trainer._amp_loss_scaler.loss_scale
+    w_before = net.weight.data().asnumpy().copy()
+    trainer.step(4)
+    assert trainer._amp_loss_scaler.loss_scale == scale_before / 2
+    onp.testing.assert_array_equal(net.weight.data().asnumpy(), w_before)
+
+
+def test_convert_hybrid_block():
+    import jax.numpy as jnp
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4), gluon.nn.BatchNorm())
+    net.initialize()
+    net(mx.nd.zeros((2, 8)))
+    amp.convert_hybrid_block(net, "bfloat16")
+    params = net.collect_params()
+    dense_w = [p for n, p in params.items() if n.endswith("_weight")]
+    bn_gamma = [p for n, p in params.items() if n.endswith("gamma")]
+    assert dense_w[0].data()._data.dtype == jnp.bfloat16
+    assert bn_gamma[0].data()._data.dtype == jnp.float32
+
+
+def test_all_finite_op():
+    ok = mx.nd.invoke("all_finite", [mx.nd.ones((3,))])
+    assert float(ok.asnumpy()[0]) == 1.0
+    bad = mx.nd.array(onp.array([1.0, onp.inf], dtype="float32"))
+    ok = mx.nd.invoke("multi_all_finite", [mx.nd.ones((2,)), bad],
+                      num_arrays=2)
+    assert float(ok.asnumpy()[0]) == 0.0

@@ -159,6 +159,9 @@ SPECS = {
     "softmax_cross_entropy": ([_n((4, 5)),
                                onp.array([0, 2, 1, 4], dtype="float32")],
                               {}, [0]),
+    "_contrib_boolean_mask": ([_n((4, 3)),
+                               onp.array([1, 0, 1, 1], dtype="float32")],
+                              {}, [0]),
 }
 
 # ops legitimately excluded from the finite-difference sweep
@@ -184,6 +187,7 @@ EXCLUDE_REASON = {
         "GridGenerator", "SpatialTransformer", "Correlation", "IdentityAttachKLSparseReg",
         "identity_attach_kl_sparse_reg", "khatri_rao", "amp_cast",
         "amp_multicast", "split_v2", "_linalg_gelqf", "_linalg_syevd",
+        "_contrib_hawkesll", "_contrib_gradientmultiplier",
     },
 }
 
