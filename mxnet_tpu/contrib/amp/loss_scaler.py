@@ -11,14 +11,11 @@ import logging
 
 class LossScaler:
     def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
-                 scale_window=2000, tolerance=0.0):
+                 scale_window=2000):
         self.loss_scale = float(init_scale)
         self._scale_factor = scale_factor
         self._scale_window = scale_window
         self._unskipped = 0
-        self._tolerance = tolerance
-        self._total = 0
-        self._skipped = 0
 
     def has_overflow(self, params):
         """True when any gradient of ``params`` is non-finite."""
@@ -32,9 +29,7 @@ class LossScaler:
         return float(ok.asnumpy()[0]) == 0.0
 
     def update_scale(self, overflow):
-        self._total += 1
         if overflow:
-            self._skipped += 1
             self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
             self._unskipped = 0
             logging.info("AMP: gradient overflow, lowering loss scale to "

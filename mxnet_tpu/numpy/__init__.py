@@ -1,0 +1,11 @@
+"""mx.np — NumPy-compatible array namespace.
+
+Reference parity: python/mxnet/numpy/ (multiarray.py 268 defs, linalg,
+random) over src/operator/numpy/ (15,457 LoC).  See multiarray.py for
+the TPU-native design notes.
+"""
+from ..ops import numpy_ops  # noqa: F401  (registration side effects)
+from .multiarray import *  # noqa: F401,F403
+from .multiarray import ndarray, array  # noqa: F401
+from . import linalg  # noqa: F401
+from . import random  # noqa: F401

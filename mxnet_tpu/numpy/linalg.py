@@ -1,0 +1,85 @@
+"""mx.np.linalg (reference: python/mxnet/numpy/linalg.py over
+src/operator/numpy/linalg/).
+
+Factorizations route through jnp.linalg inside registered ops so
+autograd tapes them where jax defines gradients.
+"""
+from __future__ import annotations
+
+from .multiarray import _f
+
+__all__ = ["norm", "svd", "cholesky", "qr", "inv", "pinv", "det",
+           "slogdet", "solve", "eigh", "eigvalsh", "matrix_rank",
+           "matrix_power", "multi_dot", "lstsq", "tensorinv",
+           "tensorsolve"]
+
+
+def norm(x, ord=None, axis=None, keepdims=False):  # noqa: A002
+    return _f("_npi_norm", x, ord=ord, axis=axis, keepdims=keepdims)
+
+
+def svd(a):
+    return _f("_npi_svd", a)
+
+
+def cholesky(a):
+    return _f("_npi_cholesky", a)
+
+
+def qr(a):
+    return _f("_npi_qr", a)
+
+
+def inv(a):
+    return _f("_npi_inv", a)
+
+
+def pinv(a, rcond=1e-15):
+    return _f("_npi_pinv", a, rcond=rcond)
+
+
+def det(a):
+    return _f("_npi_det", a)
+
+
+def slogdet(a):
+    return _f("_npi_slogdet", a)
+
+
+def solve(a, b):
+    return _f("_npi_solve", a, b)
+
+
+def eigh(a):
+    return _f("_npi_eigh", a)
+
+
+def eigvalsh(a):
+    return _f("_npi_eigvalsh", a)
+
+
+def matrix_rank(a, tol=None):
+    return _f("_npi_matrix_rank", a, tol=tol)
+
+
+def matrix_power(a, n):
+    return _f("_npi_matrix_power", a, n=n)
+
+
+def multi_dot(arrays):
+    out = arrays[0]
+    for a in arrays[1:]:
+        out = _f("_npi_dot", out, a)
+    return out
+
+
+def lstsq(a, b, rcond=None):
+    return _f("_npi_lstsq", a, b, rcond=rcond)
+
+
+def tensorinv(a, ind=2):
+    return _f("_npi_tensorinv", a, ind=ind)
+
+
+def tensorsolve(a, b, axes=None):
+    return _f("_npi_tensorsolve", a, b, axes=axes)

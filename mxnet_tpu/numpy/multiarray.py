@@ -1,0 +1,866 @@
+"""mx.np ndarray and function namespace.
+
+Reference parity: python/mxnet/numpy/multiarray.py (268 defs) over the
+src/operator/numpy/ op set.  The np ndarray subclasses the core NDArray
+(same jax.Array payload, same autograd tape) and differs in semantics:
+numpy-style operators and dtype promotion, boolean indexing, zero-dim
+arrays from reductions, and numpy-style repr.  Every differentiable
+function routes through the op registry so ``autograd.record`` tapes it.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import ndarray as _nd
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray.ndarray import NDArray, invoke
+
+class ndarray(NDArray):
+    """numpy-semantics array (reference numpy/multiarray.py:ndarray)."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        try:
+            return f"array({self.asnumpy()!r}".replace(
+                "array(array(", "array(") + ")"
+        except Exception:
+            return f"array(<traced {self._data}>)"
+
+    def __getitem__(self, key):
+        out = super().__getitem__(key)
+        return _np(out)
+
+    def asnumpy(self):
+        return onp.asarray(self._data)
+
+    def item(self, *args):
+        return self.asnumpy().item(*args)
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    @property
+    def T(self):
+        return _np(super().transpose())
+
+    def astype(self, dtype, copy=True):
+        return _np(super().astype(dtype, copy=copy))
+
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return _np(invoke("Reshape", [self], shape=shape))
+
+    def flatten(self, order="C"):
+        return self.reshape((-1,))
+
+    def as_nd_ndarray(self):
+        """Drop to the classic nd interface (reference
+        multiarray.py:as_nd_ndarray)."""
+        out = NDArray(self._data)
+        out._node, out._oidx = self._node, self._oidx
+        out._is_var, out._grad = self._is_var, self._grad
+        return out
+
+    def as_np_ndarray(self):
+        return self
+
+
+def _np(a):
+    """Re-type an NDArray (or raw array) as np.ndarray, preserving the
+    autograd linkage."""
+    if isinstance(a, ndarray):
+        return a
+    if isinstance(a, NDArray):
+        out = ndarray(a._data)
+        out._node, out._oidx = a._node, a._oidx
+        out._is_var, out._grad = a._is_var, a._grad
+        out._grad_req = a._grad_req
+        return out
+    return ndarray(jnp.asarray(a))
+
+
+def _wrap_dunders():
+    names = [
+        "__add__", "__radd__", "__sub__", "__rsub__", "__mul__",
+        "__rmul__", "__truediv__", "__rtruediv__", "__mod__", "__rmod__",
+        "__pow__", "__rpow__", "__floordiv__", "__rfloordiv__",
+        "__neg__", "__abs__", "__matmul__",
+    ]
+    for name in names:
+        base = getattr(NDArray, name, None)
+        if base is None:
+            continue
+
+        def make(meth):
+            def f(self, *args):
+                out = meth(self, *args)
+                return _np(out) if isinstance(out, NDArray) else out
+
+            f.__name__ = meth.__name__
+            return f
+
+        setattr(ndarray, name, make(base))
+
+
+_wrap_dunders()
+
+
+def _add_cmp_dunders():
+    # numpy semantics: comparisons yield BOOL arrays (the classic nd
+    # interface returns 1.0/0.0 floats, matching the reference split
+    # between mx.nd and mx.np); non-differentiable, so no tape needed
+    for name, fn in [("__eq__", jnp.equal), ("__ne__", jnp.not_equal),
+                     ("__lt__", jnp.less), ("__le__", jnp.less_equal),
+                     ("__gt__", jnp.greater),
+                     ("__ge__", jnp.greater_equal)]:
+        def make(fn):
+            def f(self, other):
+                o = other._data if isinstance(other, NDArray) else other
+                return ndarray(fn(self._data, o))
+
+            return f
+
+        setattr(ndarray, name, make(fn))
+    ndarray.__hash__ = None
+
+
+_add_cmp_dunders()
+
+
+def _in(x):
+    """Coerce a function argument to something invoke accepts."""
+    if isinstance(x, NDArray):
+        return x
+    return ndarray(jnp.asarray(x))
+
+
+def _f(op, *inputs, **params):
+    """Invoke a registered op, np-typing the output(s)."""
+    out = invoke(op, [_in(i) for i in inputs], **params)
+    if isinstance(out, (list, tuple)):
+        return tuple(_np(o) for o in out)
+    return _np(out)
+
+
+def _direct(fn, *arrays, **kw):
+    """Non-differentiable direct jnp call (logic/int ops — no tape)."""
+    vals = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+            for a in arrays]
+    out = fn(*vals, **kw)
+    if isinstance(out, (list, tuple)):
+        return tuple(ndarray(o) for o in out)
+    return ndarray(out)
+
+
+# ------------------------------------------------------------- creation
+def array(obj, dtype=None, ctx=None):
+    if isinstance(obj, NDArray):
+        data = obj._data
+        if dtype is not None:
+            data = data.astype(dtype)
+        return ndarray(data)
+    return _np(_nd.array(obj, dtype=dtype or "float32",
+                         ctx=ctx or current_context()))
+
+
+def zeros(shape, dtype="float32", ctx=None, order="C"):
+    return _np(_nd.zeros(shape, ctx=ctx, dtype=dtype))
+
+
+def ones(shape, dtype="float32", ctx=None, order="C"):
+    return _np(_nd.ones(shape, ctx=ctx, dtype=dtype))
+
+
+def full(shape, fill_value, dtype=None, ctx=None, order="C"):
+    return _np(_nd.full(shape, fill_value, ctx=ctx,
+                        dtype=dtype or "float32"))
+
+
+def empty(shape, dtype="float32", ctx=None, order="C"):
+    return zeros(shape, dtype=dtype, ctx=ctx)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None):
+    return _np(_nd.arange(start, stop, step, dtype=dtype or "float32",
+                          ctx=ctx))
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False,
+             dtype=None, axis=0, ctx=None):
+    out = _np(_nd.linspace(start, stop, num, endpoint=endpoint,
+                           dtype=dtype or "float32", ctx=ctx))
+    if retstep:
+        step = (stop - start) / (num - 1 if endpoint else num)
+        return out, step
+    return out
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             ctx=None):
+    return _direct(jnp.logspace, start, stop, num=num, endpoint=endpoint,
+                   base=base, dtype=dtype or "float32")
+
+
+def eye(N, M=None, k=0, dtype="float32", ctx=None):
+    return _np(_nd.eye(N, M, k, dtype=dtype, ctx=ctx))
+
+
+def identity(n, dtype="float32", ctx=None):
+    return eye(n, dtype=dtype, ctx=ctx)
+
+
+def zeros_like(a, dtype=None):
+    return _f("zeros_like", a) if dtype is None else \
+        _direct(jnp.zeros_like, a, dtype=dtype)
+
+
+def ones_like(a, dtype=None):
+    return _f("ones_like", a) if dtype is None else \
+        _direct(jnp.ones_like, a, dtype=dtype)
+
+
+def full_like(a, fill_value, dtype=None):
+    return _f("_npi_full_like", a, fill_value=fill_value, dtype=dtype)
+
+
+def copy(a):
+    return _f("_copy", a)
+
+
+def tri(N, M=None, k=0, dtype="float32", ctx=None):
+    return _f("_npi_tri", N=N, M=M, k=k, dtype=dtype)
+
+
+def meshgrid(*xi, indexing="xy"):
+    return list(_f("_npi_meshgrid", *xi, num_args=len(xi),
+                   indexing=indexing))
+
+
+def indices(dimensions, dtype="int32", ctx=None):
+    return _f("_npi_indices", dimensions=tuple(dimensions), dtype=dtype)
+
+
+# --------------------------------------------------------------- unary
+_UNARY = {
+    "sin": "sin", "cos": "cos", "tan": "tan", "arcsin": "arcsin",
+    "arccos": "arccos", "arctan": "arctan", "sinh": "sinh",
+    "cosh": "cosh", "tanh": "tanh", "arcsinh": "arcsinh",
+    "arccosh": "arccosh", "arctanh": "arctanh", "exp": "exp",
+    "expm1": "expm1", "log": "log", "log2": "log2", "log10": "log10",
+    "log1p": "log1p", "sqrt": "sqrt", "cbrt": "cbrt", "square": "square",
+    "absolute": "abs", "abs": "abs", "fabs": "abs", "sign": "sign",
+    "floor": "floor", "ceil": "ceil", "trunc": "trunc", "rint": "rint",
+    "fix": "fix", "negative": "negative", "reciprocal": "reciprocal",
+    "degrees": "degrees", "radians": "radians", "sigmoid": "sigmoid",
+}
+
+
+def _make_unary(npname, opname):
+    def f(x, out=None, **kwargs):
+        return _f(opname, x)
+
+    f.__name__ = npname
+    f.__doc__ = f"numpy-semantics {npname} (op {opname})."
+    return f
+
+
+for _npname, _opname in _UNARY.items():
+    globals()[_npname] = _make_unary(_npname, _opname)
+
+
+def around(a, decimals=0):
+    if decimals == 0:
+        return _f("round", a)
+    factor = 10.0 ** decimals
+    return _np((_f("round", _in(a) * factor)) / factor)
+
+
+round_ = around
+
+
+# -------------------------------------------------------------- binary
+_BINARY = {
+    "add": "broadcast_add", "subtract": "broadcast_sub",
+    "multiply": "broadcast_mul", "divide": "broadcast_div",
+    "power": "broadcast_power", "maximum": "broadcast_maximum",
+    "minimum": "broadcast_minimum", "hypot": "broadcast_hypot",
+    "arctan2": "arctan2", "mod": "broadcast_mod",
+    "remainder": "broadcast_mod",
+    "true_divide": "_npi_true_divide",
+    "floor_divide": "_npi_floor_divide", "fmod": "_npi_fmod",
+    "copysign": "_npi_copysign", "heaviside": "_npi_heaviside",
+    "ldexp": "_npi_ldexp", "cross": "_npi_cross",
+}
+
+
+def _make_binary(npname, opname):
+    def f(x1, x2, out=None, **kwargs):
+        return _f(opname, x1, x2)
+
+    f.__name__ = npname
+    return f
+
+
+for _npname, _opname in _BINARY.items():
+    globals()[_npname] = _make_binary(_npname, _opname)
+
+
+# ---------------------------------------------------------- comparisons
+def _make_cmp(npname, fn):
+    def f(x1, x2):
+        return _direct(fn, x1, x2)
+
+    f.__name__ = npname
+    return f
+
+
+for _npname, _fn in [
+    ("equal", jnp.equal), ("not_equal", jnp.not_equal),
+    ("greater", jnp.greater), ("greater_equal", jnp.greater_equal),
+    ("less", jnp.less), ("less_equal", jnp.less_equal),
+]:
+    globals()[_npname] = _make_cmp(_npname, _fn)
+
+
+def logical_and(x1, x2):
+    return _f("_npi_logical_and", x1, x2)
+
+
+def logical_or(x1, x2):
+    return _f("_npi_logical_or", x1, x2)
+
+
+def logical_xor(x1, x2):
+    return _f("_npi_logical_xor", x1, x2)
+
+
+def logical_not(x):
+    return _direct(jnp.logical_not, x)
+
+
+# ------------------------------------------------------------ reductions
+def sum(a, axis=None, dtype=None, keepdims=False):  # noqa: A001
+    return _f("sum", a, axis=axis, keepdims=keepdims)
+
+
+def mean(a, axis=None, dtype=None, keepdims=False):
+    return _f("mean", a, axis=axis, keepdims=keepdims)
+
+
+def prod(a, axis=None, keepdims=False):
+    return _f("prod", a, axis=axis, keepdims=keepdims)
+
+
+def max(a, axis=None, keepdims=False):  # noqa: A001
+    return _f("max", a, axis=axis, keepdims=keepdims)
+
+
+def min(a, axis=None, keepdims=False):  # noqa: A001
+    return _f("min", a, axis=axis, keepdims=keepdims)
+
+
+def amax(a, axis=None, keepdims=False):
+    return max(a, axis=axis, keepdims=keepdims)
+
+
+def amin(a, axis=None, keepdims=False):
+    return min(a, axis=axis, keepdims=keepdims)
+
+
+def std(a, axis=None, ddof=0, keepdims=False):
+    return _f("_npi_std", a, axis=axis, ddof=ddof, keepdims=keepdims)
+
+
+def var(a, axis=None, ddof=0, keepdims=False):
+    return _f("_npi_var", a, axis=axis, ddof=ddof, keepdims=keepdims)
+
+
+def average(a, axis=None, weights=None, returned=False):
+    if weights is None:
+        out = mean(a, axis=axis)
+        return (out, None) if returned else out
+    return _f("_npi_average", a, weights, axis=axis, returned=returned)
+
+
+def median(a, axis=None, keepdims=False):
+    return _f("_npi_median", a, axis=axis, keepdims=keepdims)
+
+
+def percentile(a, q, axis=None, interpolation="linear", keepdims=False):
+    return _f("_npi_percentile", a, q=q, axis=axis,
+              interpolation=interpolation, keepdims=keepdims)
+
+
+def quantile(a, q, axis=None, interpolation="linear", keepdims=False):
+    return _f("_npi_quantile", a, q=q, axis=axis,
+              interpolation=interpolation, keepdims=keepdims)
+
+
+def cumsum(a, axis=None, dtype=None):
+    return _f("cumsum", a, axis=axis, dtype=dtype)
+
+
+def cumprod(a, axis=None, dtype=None):
+    return _f("_npi_cumprod", a, axis=axis, dtype=dtype)
+
+
+def argmax(a, axis=None, keepdims=False):
+    return _f("argmax", a, axis=axis, keepdims=keepdims)
+
+
+def argmin(a, axis=None, keepdims=False):
+    return _f("argmin", a, axis=axis, keepdims=keepdims)
+
+
+def all(a, axis=None, keepdims=False):  # noqa: A001
+    return _direct(jnp.all, a, axis=axis, keepdims=keepdims)
+
+
+def any(a, axis=None, keepdims=False):  # noqa: A001
+    return _direct(jnp.any, a, axis=axis, keepdims=keepdims)
+
+
+def count_nonzero(a, axis=None):
+    return _direct(jnp.count_nonzero, a, axis=axis)
+
+
+def clip(a, a_min, a_max):
+    return _f("clip", a, a_min=a_min, a_max=a_max)
+
+
+# ------------------------------------------------------------ contraction
+def dot(a, b, out=None):
+    return _f("_npi_dot", a, b)
+
+
+def matmul(a, b):
+    return _f("_npi_matmul", a, b)
+
+
+def einsum(subscripts, *operands, optimize=True):
+    return _f("_npi_einsum", *operands, subscripts=subscripts,
+              optimize=optimize)
+
+
+def tensordot(a, b, axes=2):
+    if isinstance(axes, (list, tuple)):
+        a_ax, b_ax = axes
+        a_ax = (a_ax,) if isinstance(a_ax, int) else tuple(a_ax)
+        b_ax = (b_ax,) if isinstance(b_ax, int) else tuple(b_ax)
+        return _f("_npi_tensordot", a, b, a_axes_summed=a_ax,
+                  b_axes_summed=b_ax)
+    return _f("_npi_tensordot", a, b, axes=int(axes))
+
+
+def vdot(a, b):
+    return _f("_npi_vdot", a, b)
+
+
+def inner(a, b):
+    return _f("_npi_inner", a, b)
+
+
+def outer(a, b):
+    return _f("_npi_outer", a, b)
+
+
+def kron(a, b):
+    return _f("_npi_kron", a, b)
+
+
+def trace(a, offset=0, axis1=0, axis2=1):
+    return _f("_npi_trace", a, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def tril(m, k=0):
+    return _f("_npi_tril", m, k=k)
+
+
+def triu(m, k=0):
+    return _f("_npi_triu", m, k=k)
+
+
+# ------------------------------------------------------------ shape ops
+def reshape(a, newshape, order="C"):
+    return _f("Reshape", a, shape=tuple(newshape)
+              if isinstance(newshape, (list, tuple)) else (newshape,))
+
+
+def transpose(a, axes=None):
+    return _f("transpose", a, axes=tuple(axes) if axes else None)
+
+
+def swapaxes(a, axis1, axis2):
+    return _f("SwapAxis", a, dim1=axis1, dim2=axis2)
+
+
+def moveaxis(a, source, destination):
+    return _f("_npi_moveaxis", a, source=source, destination=destination)
+
+
+def rollaxis(a, axis, start=0):
+    return _f("_npi_rollaxis", a, axis=axis, start=start)
+
+
+def expand_dims(a, axis):
+    return _f("expand_dims", a, axis=axis)
+
+
+def squeeze(a, axis=None):
+    return _f("_npi_squeeze", a, axis=axis)
+
+
+def concatenate(seq, axis=0, out=None):
+    return _f("Concat", *seq, dim=axis or 0, num_args=len(seq))
+
+
+def stack(arrays, axis=0, out=None):
+    return _f("stack", *arrays, axis=axis, num_args=len(arrays))
+
+
+def hstack(tup):
+    return _f("_npi_hstack", *tup, num_args=len(tup))
+
+
+def vstack(tup):
+    return _f("_npi_vstack", *tup, num_args=len(tup))
+
+
+def dstack(tup):
+    return _f("_npi_dstack", *tup, num_args=len(tup))
+
+
+def column_stack(tup):
+    return _f("_npi_column_stack", *tup, num_args=len(tup))
+
+
+def split(ary, indices_or_sections, axis=0):
+    a = _in(ary)
+    n = a.shape[axis]
+    if isinstance(indices_or_sections, int):
+        if n % indices_or_sections:
+            raise MXNetError("array split does not result in an equal "
+                             "division")
+        out = _f("split", a, num_outputs=indices_or_sections, axis=axis)
+    else:
+        pieces = []
+        prev = 0
+        bounds = list(indices_or_sections) + [n]
+        for b in bounds:
+            b = n if b > n else int(b)
+            pieces.append(_np(invoke(
+                "slice_axis", [a], axis=axis, begin=prev, end=b)))
+            prev = b
+            if b >= n:
+                break
+        return pieces
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def array_split(ary, indices_or_sections, axis=0):
+    a = _in(ary)
+    n = a.shape[axis]
+    if isinstance(indices_or_sections, int):
+        k = indices_or_sections
+        sizes = [n // k + (1 if i < n % k else 0) for i in range(k)]
+        bounds = []
+        acc = 0
+        for s in sizes[:-1]:
+            acc += s
+            bounds.append(acc)
+        return split(ary, bounds, axis=axis)
+    return split(ary, indices_or_sections, axis=axis)
+
+
+def hsplit(ary, indices_or_sections):
+    return split(ary, indices_or_sections, axis=1)
+
+
+def vsplit(ary, indices_or_sections):
+    return split(ary, indices_or_sections, axis=0)
+
+
+def tile(a, reps):
+    return _f("tile", a, reps=tuple(reps) if isinstance(
+        reps, (list, tuple)) else (reps,))
+
+
+def repeat(a, repeats, axis=None):
+    return _f("repeat", a, repeats=repeats, axis=axis)
+
+
+def flip(a, axis=None):
+    if axis is None:
+        out = _in(a)
+        for ax in range(out.ndim):
+            out = invoke("flip", [out], axis=ax)
+        return _np(out)
+    return _f("flip", a, axis=axis)
+
+
+def flipud(a):
+    return _f("_npi_flipud", a)
+
+
+def fliplr(a):
+    return _f("_npi_fliplr", a)
+
+
+def roll(a, shift, axis=None):
+    return _f("_npi_roll", a, shift=shift, axis=axis)
+
+
+def rot90(m, k=1, axes=(0, 1)):
+    return _f("_npi_rot90", m, k=k, axes=tuple(axes))
+
+
+def ravel(a, order="C"):
+    return reshape(a, (-1,))
+
+
+def broadcast_to(a, shape):
+    return _f("broadcast_to", a, shape=tuple(shape))
+
+
+def broadcast_arrays(*args):
+    shape = onp.broadcast_shapes(*[tuple(_in(a).shape) for a in args])
+    return [broadcast_to(a, shape) for a in args]
+
+
+def atleast_1d(*arys):
+    out = [_np(invoke("Reshape", [_in(a)], shape=(1,)))
+           if _in(a).ndim == 0 else _np(_in(a)) for a in arys]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_2d(*arys):
+    out = []
+    for a in arys:
+        a = _in(a)
+        while a.ndim < 2:
+            a = invoke("expand_dims", [a], axis=0)
+        out.append(_np(a))
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_3d(*arys):
+    out = []
+    for a in arys:
+        a = _in(a)
+        while a.ndim < 3:
+            a = invoke("expand_dims", [a], axis=a.ndim)
+        out.append(_np(a))
+    return out[0] if len(out) == 1 else out
+
+
+# -------------------------------------------------------- search & sort
+def sort(a, axis=-1, kind=None, order=None):
+    return _f("sort", a, axis=axis)
+
+
+def argsort(a, axis=-1, kind=None, order=None):
+    return _f("argsort", a, axis=axis)
+
+
+def unique(ar, return_index=False, return_inverse=False,
+           return_counts=False, axis=None):
+    out = _f("_npi_unique", ar, return_index=return_index,
+             return_inverse=return_inverse, return_counts=return_counts,
+             axis=axis)
+    return out
+
+
+def nonzero(a):
+    mat = _f("_npi_nonzero", a)
+    return tuple(_np(mat[:, i]) for i in range(_in(a).ndim or 1))
+
+
+def flatnonzero(a):
+    return nonzero(ravel(a))[0]
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition)
+    return _f("where", condition, x, y)
+
+
+def searchsorted(a, v, side="left"):
+    return _f("_npi_searchsorted", a, v, side=side)
+
+
+def digitize(x, bins, right=False):
+    return _f("_npi_digitize", x, bins, right=right)
+
+
+def bincount(x, weights=None, minlength=0):
+    if weights is None:
+        return _direct(jnp.bincount, _in(x)._data.astype(jnp.int32),
+                       minlength=minlength)
+    return _f("_npi_bincount", x, weights, minlength=minlength)
+
+
+def histogram(a, bins=10, range=None):  # noqa: A002
+    h, e = _f("_npi_histogram", a, bins=bins, range=range)
+    return h, e
+
+
+def take(a, indices, axis=None, mode="clip"):
+    if axis is None:
+        return _f("take", ravel(a), indices, axis=0, mode=mode)
+    return _f("take", a, indices, axis=axis, mode=mode)
+
+
+def diag(v, k=0):
+    return _f("diag", v, k=k)
+
+
+def diff(a, n=1, axis=-1):
+    return _f("_npi_diff", a, n=n, axis=axis)
+
+
+def ediff1d(ary, to_end=None, to_begin=None):
+    return _f("_npi_ediff1d", ary, to_end=to_end, to_begin=to_begin)
+
+
+def interp(x, xp, fp, left=None, right=None):
+    return _f("_npi_interp", x, xp, fp, left=left, right=right)
+
+
+def polyval(p, x):
+    return _f("_npi_polyval", p, x)
+
+
+# ------------------------------------------------------------ logic ops
+def isnan(x):
+    return _f("_npi_isnan", x)
+
+
+def isinf(x):
+    return _f("_npi_isinf", x)
+
+
+def isfinite(x):
+    return _f("_npi_isfinite", x)
+
+
+def isposinf(x):
+    return _f("_npi_isposinf", x)
+
+
+def isneginf(x):
+    return _f("_npi_isneginf", x)
+
+
+def nan_to_num(x, copy=True, nan=0.0, posinf=None, neginf=None):
+    return _f("_npi_nan_to_num", x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def array_equal(a1, a2):
+    return bool(_f("_npi_array_equal", a1, a2).item())
+
+
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return bool(_f("_contrib_allclose", a, b, rtol=rtol, atol=atol,
+                   equal_nan=equal_nan).item())
+
+
+def isclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return _direct(jnp.isclose, a, b, rtol=rtol, atol=atol,
+                   equal_nan=equal_nan)
+
+
+def may_share_memory(a, b, max_work=None):
+    return False
+
+
+def shares_memory(a, b, max_work=None):
+    return False
+
+
+# --------------------------------------------------------------- windows
+def hanning(M, dtype="float32", ctx=None):
+    return _f("_npi_hanning", M=M, dtype=dtype)
+
+
+def hamming(M, dtype="float32", ctx=None):
+    return _f("_npi_hamming", M=M, dtype=dtype)
+
+
+def blackman(M, dtype="float32", ctx=None):
+    return _f("_npi_blackman", M=M, dtype=dtype)
+
+
+# ------------------------------------------------------------- misc math
+def maximum_(x1, x2):
+    return _f("broadcast_maximum", x1, x2)
+
+
+def deg2rad(x):
+    return _f("_npi_deg2rad", x)
+
+
+def rad2deg(x):
+    return _f("_npi_rad2deg", x)
+
+
+def lcm(x1, x2):
+    return _f("_npi_lcm", x1, x2)
+
+
+def gcd(x1, x2):
+    return _f("_npi_gcd", x1, x2)
+
+
+def frexp(x):
+    return _f("_npi_frexp", x)
+
+
+def insert(arr, obj, values, axis=None):
+    return _f("_npi_insert", arr, values, obj=obj, axis=axis)
+
+
+def delete(arr, obj, axis=None):
+    return _f("_npi_delete", arr, obj=obj, axis=axis)
+
+
+def resize(a, new_shape):
+    return _f("_npi_resize", a, new_shape=tuple(new_shape)
+              if isinstance(new_shape, (list, tuple)) else (new_shape,))
+
+
+def corrcoef(x):
+    return _f("_npi_corrcoef", x)
+
+
+def pad(array, pad_width, mode="constant", constant_values=0):  # noqa: A002
+    a = _in(array)
+    if isinstance(pad_width, int):
+        pad_width = [(pad_width, pad_width)] * a.ndim
+    return _direct(jnp.pad, a, pad_width=tuple(tuple(p) for p in
+                                               pad_width), mode=mode,
+                   **({"constant_values": constant_values}
+                      if mode == "constant" else {}))
+
+
+# constants
+pi = onp.pi
+e = onp.e
+euler_gamma = onp.euler_gamma
+inf = onp.inf
+nan = onp.nan
+newaxis = None
+float32 = onp.float32
+float64 = onp.float64
+float16 = onp.float16
+int32 = onp.int32
+int64 = onp.int64
+int8 = onp.int8
+uint8 = onp.uint8
+bool_ = onp.bool_
+bfloat16 = jnp.bfloat16
+_np_version = onp.__version__

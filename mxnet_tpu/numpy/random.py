@@ -1,0 +1,95 @@
+"""mx.np.random (reference: python/mxnet/numpy/random.py over
+src/operator/numpy/random/)."""
+from __future__ import annotations
+
+from .. import random as _random
+from ..ndarray.ndarray import invoke
+from .multiarray import _np
+
+__all__ = ["uniform", "normal", "randn", "rand", "randint", "choice",
+           "shuffle", "gamma", "exponential", "beta", "multinomial",
+           "seed"]
+
+
+def seed(s):
+    _random.seed(s)
+
+
+def _shape(size):
+    if size is None:
+        return (1,)
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None):
+    out = _np(invoke("_random_uniform", [], low=float(low),
+                     high=float(high), shape=_shape(size), dtype=dtype))
+    return out if size is not None else _np(out.reshape(()))
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None):
+    out = _np(invoke("_random_normal", [], loc=float(loc),
+                     scale=float(scale), shape=_shape(size), dtype=dtype))
+    return out if size is not None else _np(out.reshape(()))
+
+
+def randn(*size):
+    return normal(size=size or None)
+
+
+def rand(*size):
+    return uniform(size=size or None)
+
+
+def randint(low, high=None, size=None, dtype=None, ctx=None):
+    if high is None:  # numpy one-arg form: sample [0, low)
+        low, high = 0, low
+    return _np(invoke("_random_randint", [], low=low, high=high,
+                      shape=_shape(size), dtype=dtype or "int32"))
+
+
+def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None):
+    return _np(invoke("_random_gamma", [], alpha=float(shape),
+                      beta=float(scale), shape=_shape(size), dtype=dtype))
+
+
+def exponential(scale=1.0, size=None, ctx=None):
+    return _np(invoke("_random_exponential", [], lam=1.0 / float(scale),
+                      shape=_shape(size)))
+
+
+def beta(a, b, size=None, dtype=None, ctx=None):
+    # beta(a,b) = ga/(ga+gb) with ga~Gamma(a,1), gb~Gamma(b,1)
+    ga = invoke("_random_gamma", [], alpha=float(a), beta=1.0,
+                shape=_shape(size))
+    gb = invoke("_random_gamma", [], alpha=float(b), beta=1.0,
+                shape=_shape(size))
+    return _np(ga / (ga + gb))
+
+
+def multinomial(n, pvals, size=None):
+    import numpy as onp
+
+    out = onp.random.multinomial(n, onp.asarray(pvals), size=size)
+    from .multiarray import array
+
+    return array(out, dtype="int64")
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None):
+    import numpy as onp
+
+    if hasattr(a, "asnumpy"):
+        a = a.asnumpy()
+    out = onp.random.choice(a, size=size, replace=replace,
+                            p=onp.asarray(p) if p is not None else None)
+    from .multiarray import array
+
+    return array(out)
+
+
+def shuffle(x):
+    out = invoke("_shuffle", [x])
+    x._adopt(out._data)

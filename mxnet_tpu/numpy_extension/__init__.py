@@ -1,0 +1,152 @@
+"""mx.npx — numpy_extension: operators outside the NumPy standard.
+
+Reference parity: python/mxnet/numpy_extension/ + the ``npx`` namespace
+(set_np/reset_np semantics flags in python/mxnet/util.py, nn ops like
+npx.softmax/convolution routed to the shared op registry).
+"""
+from __future__ import annotations
+
+from .. import ndarray as _nd
+from ..ndarray.ndarray import invoke, waitall  # noqa: F401
+from ..numpy.multiarray import _f, _np
+from ..util import is_np_array, set_np, use_np  # noqa: F401
+
+
+def reset_np():
+    """Reference: util.py reset_np — leave numpy semantics."""
+    set_np(shape=False, array=False)
+
+
+def seed(s):
+    from .. import random as _random
+
+    _random.seed(s)
+
+
+def softmax(data, axis=-1, length=None, temperature=None):
+    return _f("softmax", data, axis=axis, temperature=temperature)
+
+
+def log_softmax(data, axis=-1):
+    return _f("log_softmax", data, axis=axis)
+
+
+def relu(data):
+    return _f("relu", data)
+
+
+def sigmoid(data):
+    return _f("sigmoid", data)
+
+
+def activation(data, act_type="relu"):
+    return _f("Activation", data, act_type=act_type)
+
+
+def leaky_relu(data, act_type="leaky", slope=0.25):
+    return _f("LeakyReLU", data, act_type=act_type, slope=slope)
+
+
+def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-3,
+               momentum=0.9, fix_gamma=False, use_global_stats=False,
+               axis=1):
+    return _f("BatchNorm", x, gamma, beta, running_mean, running_var,
+              eps=eps, momentum=momentum, fix_gamma=fix_gamma,
+              use_global_stats=use_global_stats, axis=axis)
+
+
+def convolution(data, weight, bias=None, kernel=None, stride=None,
+                dilate=None, pad=None, num_filter=1, num_group=1,
+                no_bias=False, layout=None):
+    args = [data, weight] + ([bias] if bias is not None else [])
+    return _f("Convolution", *args, kernel=kernel, stride=stride,
+              dilate=dilate, pad=pad, num_filter=num_filter,
+              num_group=num_group, no_bias=no_bias or bias is None,
+              layout=layout)
+
+
+def fully_connected(x, weight, bias=None, num_hidden=1, no_bias=False,
+                    flatten=True):
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return _f("FullyConnected", *args, num_hidden=num_hidden,
+              no_bias=no_bias or bias is None, flatten=flatten)
+
+
+def pooling(data, kernel=(1, 1), stride=None, pad=None, pool_type="max",
+            global_pool=False):
+    return _f("Pooling", data, kernel=kernel, stride=stride, pad=pad,
+              pool_type=pool_type, global_pool=global_pool)
+
+
+def dropout(data, p=0.5, mode="training"):
+    return _f("Dropout", data, p=p, mode=mode)
+
+
+def one_hot(data, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    return _f("one_hot", data, depth=depth, on_value=on_value,
+              off_value=off_value, dtype=dtype)
+
+
+def pick(data, index, axis=-1, mode="clip", keepdims=False):
+    return _f("pick", data, index, axis=axis, mode=mode,
+              keepdims=keepdims)
+
+
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False,
+         dtype="float32"):
+    return _f("topk", data, axis=axis, k=k, ret_typ=ret_typ,
+              is_ascend=is_ascend, dtype=dtype)
+
+
+def reshape_like(lhs, rhs):
+    return _f("reshape_like", lhs, rhs)
+
+
+def arange_like(data, start=0.0, step=1.0, axis=None):
+    import jax.numpy as jnp
+
+    from ..numpy.multiarray import _direct, _in
+
+    a = _in(data)
+    if axis is None:
+        n = a.size
+    else:
+        n = a.shape[axis]
+    return _direct(lambda: jnp.arange(start, start + step * n, step,
+                                      dtype=jnp.float32))
+
+
+def gamma(data):
+    return _f("gamma", data)
+
+
+def gammaln(data):
+    return _f("gammaln", data)
+
+
+def erf(data):
+    return _f("erf", data)
+
+
+def erfinv(data):
+    return _f("erfinv", data)
+
+
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    args = [data] + ([sequence_length]
+                     if sequence_length is not None else [])
+    return _f("SequenceMask", *args,
+              use_sequence_length=use_sequence_length, value=value,
+              axis=axis)
+
+
+def load(fname):
+    return {k: _np(v) for k, v in _nd.load(fname).items()}
+
+
+def save(fname, data):
+    if isinstance(data, dict):
+        _nd.save(fname, {k: v for k, v in data.items()})
+    else:
+        _nd.save(fname, data)

@@ -15,5 +15,6 @@ from . import (  # noqa: F401  (import-for-registration)
     sequence_ops,
     linalg_ops,
     contrib_ops,
+    numpy_ops,
 )
 from .registry import OpDef, alias_op, get_op, list_ops, register_op  # noqa: F401

@@ -1,0 +1,473 @@
+"""NumPy-semantics operators backing ``mx.np`` (the ``_npi_*`` family).
+
+Reference parity: src/operator/numpy/ (15,457 LoC — einsum with path
+optimization np_einsum_op.cc, tensordot np_tensordot_op.cc, unique
+np_unique_op.cc, nonzero np_nonzero_op.cc, window ops np_window_op.cc,
+tri ops np_tri_op.cc, cumprod/diff/trace/...).  TPU-native: jnp already
+implements numpy semantics, so most ops are direct registrations; the
+dynamic-shape ops (unique, nonzero) follow the fixed-size+mask idiom
+from SURVEY.md §7 — XLA-compatible padded outputs plus a valid count.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+# ------------------------------------------------------------- contraction
+
+
+@register_op("_npi_einsum")
+def einsum(*operands, subscripts, optimize=True):
+    """Reference: src/operator/numpy/np_einsum_op.cc (with path
+    optimizer).  XLA's dot-general fusion takes the role of the
+    hand-rolled contraction-path search; ``optimize`` picks the
+    opt_einsum path strategy."""
+    return jnp.einsum(subscripts, *operands,
+                      optimize="optimal" if optimize else False)
+
+
+@register_op("_npi_tensordot")
+def tensordot(a, b, *, a_axes_summed=None, b_axes_summed=None, axes=2):
+    """Reference: src/operator/numpy/np_tensordot_op.cc."""
+    if a_axes_summed is not None:
+        axes = (tuple(a_axes_summed), tuple(b_axes_summed))
+    return jnp.tensordot(a, b, axes=axes)
+
+
+@register_op("_npi_dot")
+def np_dot(a, b):
+    return jnp.dot(a, b)
+
+
+@register_op("_npi_vdot")
+def vdot(a, b):
+    return jnp.vdot(a, b)
+
+
+@register_op("_npi_inner")
+def inner(a, b):
+    return jnp.inner(a, b)
+
+
+@register_op("_npi_outer")
+def outer(a, b):
+    return jnp.outer(a, b)
+
+
+@register_op("_npi_kron")
+def kron(a, b):
+    return jnp.kron(a, b)
+
+
+# ----------------------------------------------- dynamic-shape (masked)
+@register_op("_npi_unique", num_outputs=lambda p: 1
+             + bool(p.get("return_index")) + bool(p.get("return_inverse"))
+             + bool(p.get("return_counts")), differentiable=False)
+def unique(data, *, return_index=False, return_inverse=False,
+           return_counts=False, axis=None, size=None, fill_value=0):
+    """Reference: src/operator/numpy/np_unique_op.cc.
+
+    XLA contract: with ``size`` given (or traced input), outputs are
+    padded/truncated to ``size`` (jnp.unique fixed-size mode); eagerly
+    without ``size``, exact dynamic shapes come back (host path, like
+    the reference's CPU-only kernel).
+    """
+    kw = dict(return_index=return_index, return_inverse=return_inverse,
+              return_counts=return_counts, axis=axis)
+    if size is not None:
+        kw.update(size=size, fill_value=fill_value)
+    out = jnp.unique(data, **kw)
+    if not (return_index or return_inverse or return_counts):
+        return out
+    return tuple(out)
+
+
+@register_op("_npi_nonzero", differentiable=False)
+def nonzero(data, *, size=None, fill_value=-1):
+    """Reference: src/operator/numpy/np_nonzero_op.cc — returns an
+    (nnz, ndim) int64 index matrix (the reference's transposed layout).
+    Fixed-size+mask under trace (rows of ``fill_value`` pad the tail)."""
+    idx = jnp.nonzero(data, size=size, fill_value=fill_value)
+    # reference emits int64; on 32-bit jax default this stays int32
+    return jnp.stack(idx, axis=-1).astype("int64" if jax.config.x64_enabled
+                                          else "int32")
+
+
+# ------------------------------------------------------------ cumulative
+@register_op("_npi_cumprod")
+def cumprod(data, *, axis=None, dtype=None):
+    return jnp.cumprod(data, axis=axis, dtype=dtype)
+
+
+@register_op("_npi_diff")
+def diff(data, *, n=1, axis=-1):
+    """Reference: src/operator/numpy/np_diff_op.cc."""
+    return jnp.diff(data, n=n, axis=axis)
+
+
+@register_op("_npi_ediff1d")
+def ediff1d(data, *, to_end=None, to_begin=None):
+    return jnp.ediff1d(data, to_end=to_end, to_begin=to_begin)
+
+
+@register_op("_npi_trace")
+def trace(data, *, offset=0, axis1=0, axis2=1):
+    """Reference: src/operator/numpy/np_trace_op.cc."""
+    return jnp.trace(data, offset=offset, axis1=axis1, axis2=axis2)
+
+
+# --------------------------------------------------------------- tri ops
+@register_op("_npi_tri", differentiable=False)
+def tri(*, N, M=None, k=0, dtype="float32"):
+    """Reference: src/operator/numpy/np_tri_op.cc."""
+    return jnp.tri(N, M, k, dtype=dtype)
+
+
+@register_op("_npi_tril")
+def tril(data, *, k=0):
+    return jnp.tril(data, k=k)
+
+
+@register_op("_npi_triu")
+def triu(data, *, k=0):
+    return jnp.triu(data, k=k)
+
+
+# ------------------------------------------------------------ window ops
+@register_op("_npi_hanning", differentiable=False)
+def hanning(*, M, dtype="float32"):
+    """Reference: src/operator/numpy/np_window_op.cc."""
+    return jnp.hanning(M).astype(dtype)
+
+
+@register_op("_npi_hamming", differentiable=False)
+def hamming(*, M, dtype="float32"):
+    return jnp.hamming(M).astype(dtype)
+
+
+@register_op("_npi_blackman", differentiable=False)
+def blackman(*, M, dtype="float32"):
+    return jnp.blackman(M).astype(dtype)
+
+
+# ------------------------------------------------------- rearrangement
+@register_op("_npi_roll")
+def roll(data, *, shift=None, axis=None):
+    return jnp.roll(data, shift, axis=axis)
+
+
+@register_op("_npi_rot90")
+def rot90(data, *, k=1, axes=(0, 1)):
+    return jnp.rot90(data, k=k, axes=tuple(axes))
+
+
+@register_op("_npi_flipud")
+def flipud(data):
+    return jnp.flipud(data)
+
+
+@register_op("_npi_fliplr")
+def fliplr(data):
+    return jnp.fliplr(data)
+
+
+@register_op("_npi_moveaxis")
+def moveaxis(data, *, source, destination):
+    return jnp.moveaxis(data, source, destination)
+
+
+@register_op("_npi_rollaxis")
+def rollaxis(data, *, axis, start=0):
+    return jnp.rollaxis(data, axis, start)
+
+
+@register_op("_npi_column_stack")
+def column_stack(*arrays, num_args=1):
+    return jnp.column_stack(arrays)
+
+
+@register_op("_npi_hstack")
+def hstack(*arrays, num_args=1):
+    return jnp.hstack(arrays)
+
+
+@register_op("_npi_vstack")
+def vstack(*arrays, num_args=1):
+    return jnp.vstack(arrays)
+
+
+@register_op("_npi_dstack")
+def dstack(*arrays, num_args=1):
+    return jnp.dstack(arrays)
+
+
+@register_op("_npi_atleast_1d", num_outputs=lambda p: p.get("num_args", 1))
+def atleast_1d(*arrays, num_args=1):
+    out = jnp.atleast_1d(*arrays)
+    return out
+
+
+@register_op("_npi_squeeze")
+def np_squeeze(data, *, axis=None):
+    return jnp.squeeze(data, axis=axis)
+
+
+# ----------------------------------------------------------- statistics
+@register_op("_npi_std")
+def std(data, *, axis=None, ddof=0, keepdims=False):
+    return jnp.std(data, axis=axis, ddof=ddof, keepdims=keepdims)
+
+
+@register_op("_npi_var")
+def var(data, *, axis=None, ddof=0, keepdims=False):
+    return jnp.var(data, axis=axis, ddof=ddof, keepdims=keepdims)
+
+
+@register_op("_npi_average")
+def average(a, weights=None, *, axis=None, returned=False):
+    if returned:
+        out, wsum = jnp.average(a, axis=axis, weights=weights,
+                                returned=True)
+        return out, wsum
+    return jnp.average(a, axis=axis, weights=weights)
+
+
+@register_op("_npi_median", differentiable=False)
+def median(data, *, axis=None, keepdims=False):
+    return jnp.median(data, axis=axis, keepdims=keepdims)
+
+
+@register_op("_npi_percentile", differentiable=False)
+def percentile(data, *, q, axis=None, interpolation="linear",
+               keepdims=False):
+    return jnp.percentile(data, jnp.asarray(q), axis=axis,
+                          method=interpolation, keepdims=keepdims)
+
+
+@register_op("_npi_quantile", differentiable=False)
+def quantile(data, *, q, axis=None, interpolation="linear",
+             keepdims=False):
+    return jnp.quantile(data, jnp.asarray(q), axis=axis,
+                        method=interpolation, keepdims=keepdims)
+
+
+@register_op("_npi_histogram", differentiable=False, num_outputs=2)
+def histogram(data, *, bins=10, range=None):
+    """Reference: src/operator/tensor/histogram.cc."""
+    hist, edges = jnp.histogram(data, bins=bins, range=range)
+    return hist, edges
+
+
+@register_op("_npi_bincount", differentiable=False)
+def bincount(data, weights=None, *, minlength=0, length=None):
+    return jnp.bincount(data.astype(jnp.int32), weights=weights,
+                        minlength=minlength, length=length)
+
+
+@register_op("_npi_corrcoef", differentiable=False)
+def corrcoef(x):
+    return jnp.corrcoef(x)
+
+
+# ------------------------------------------------------------- logic ops
+@register_op("_npi_isnan", differentiable=False)
+def isnan(data):
+    return jnp.isnan(data)
+
+
+@register_op("_npi_isinf", differentiable=False)
+def isinf(data):
+    return jnp.isinf(data)
+
+
+@register_op("_npi_isfinite", differentiable=False)
+def isfinite(data):
+    return jnp.isfinite(data)
+
+
+@register_op("_npi_isposinf", differentiable=False)
+def isposinf(data):
+    return jnp.isposinf(data)
+
+
+@register_op("_npi_isneginf", differentiable=False)
+def isneginf(data):
+    return jnp.isneginf(data)
+
+
+@register_op("_npi_logical_and", differentiable=False)
+def logical_and(a, b):
+    return jnp.logical_and(a, b)
+
+
+@register_op("_npi_logical_or", differentiable=False)
+def logical_or(a, b):
+    return jnp.logical_or(a, b)
+
+
+@register_op("_npi_logical_xor", differentiable=False)
+def logical_xor(a, b):
+    return jnp.logical_xor(a, b)
+
+
+@register_op("_npi_array_equal", differentiable=False)
+def array_equal(a, b):
+    return jnp.array_equal(a, b)
+
+
+# ------------------------------------------------------------- misc math
+@register_op("_npi_interp", differentiable=False)
+def interp(x, xp, fp, *, left=None, right=None):
+    return jnp.interp(x, xp, fp, left=left, right=right)
+
+
+@register_op("_npi_cross")
+def cross(a, b, *, axisa=-1, axisb=-1, axisc=-1, axis=None):
+    return jnp.cross(a, b, axisa=axisa, axisb=axisb, axisc=axisc,
+                     axis=axis)
+
+
+@register_op("_npi_heaviside")
+def heaviside(x1, x2):
+    return jnp.heaviside(x1, x2)
+
+
+@register_op("_npi_copysign")
+def copysign(x1, x2):
+    return jnp.copysign(x1, x2)
+
+
+@register_op("_npi_frexp", num_outputs=2, differentiable=False)
+def frexp(x):
+    m, e = jnp.frexp(x)
+    return m, e
+
+
+@register_op("_npi_ldexp")
+def ldexp(x1, x2):
+    return jnp.ldexp(x1, x2.astype(jnp.int32))
+
+
+@register_op("_npi_nan_to_num")
+def nan_to_num(data, *, copy=True, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(data, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@register_op("_npi_deg2rad")
+def deg2rad(data):
+    return jnp.deg2rad(data)
+
+
+@register_op("_npi_rad2deg")
+def rad2deg(data):
+    return jnp.rad2deg(data)
+
+
+@register_op("_npi_polyval")
+def polyval(p, x):
+    return jnp.polyval(p, x)
+
+
+@register_op("_npi_lcm", differentiable=False)
+def lcm(a, b):
+    return jnp.lcm(a.astype(jnp.int32), b.astype(jnp.int32))
+
+
+@register_op("_npi_gcd", differentiable=False)
+def gcd(a, b):
+    return jnp.gcd(a.astype(jnp.int32), b.astype(jnp.int32))
+
+
+@register_op("_npi_fmod")
+def fmod(a, b):
+    return jnp.fmod(a, b)
+
+
+@register_op("_npi_floor_divide")
+def floor_divide(a, b):
+    return jnp.floor_divide(a, b)
+
+
+@register_op("_npi_true_divide")
+def true_divide(a, b):
+    return jnp.true_divide(a, b)
+
+
+@register_op("_npi_searchsorted", differentiable=False)
+def searchsorted(a, v, *, side="left"):
+    return jnp.searchsorted(a, v, side=side)
+
+
+@register_op("_npi_digitize", differentiable=False)
+def digitize(x, bins, *, right=False):
+    return jnp.digitize(x, bins, right=right)
+
+
+@register_op("_npi_meshgrid", num_outputs=lambda p: p.get("num_args", 1),
+             differentiable=False)
+def meshgrid(*arrays, num_args=1, indexing="xy"):
+    return tuple(jnp.meshgrid(*arrays, indexing=indexing))
+
+
+@register_op("_npi_indices", differentiable=False)
+def indices(*, dimensions, dtype="int32"):
+    return jnp.indices(tuple(dimensions)).astype(dtype)
+
+
+@register_op("_npi_may_share_memory", differentiable=False)
+def may_share_memory(a, b):
+    return jnp.zeros((1,), dtype=bool)  # functional arrays never share
+
+
+@register_op("_npi_insert", differentiable=False)
+def np_insert(arr, values, *, obj, axis=None):
+    return jnp.insert(arr, obj, values, axis=axis)
+
+
+@register_op("_npi_delete", differentiable=False)
+def np_delete(arr, *, obj, axis=None):
+    return jnp.delete(arr, obj, axis=axis)
+
+
+@register_op("_npi_resize", differentiable=False)
+def np_resize(arr, *, new_shape):
+    return jnp.resize(arr, tuple(new_shape))
+
+
+@register_op("_npi_full_like", differentiable=False)
+def full_like(a, *, fill_value, dtype=None):
+    return jnp.full_like(a, fill_value, dtype=dtype)
+
+
+# --------------------------------------------------------------- linalg
+# Reference: src/operator/numpy/linalg/ — consumed by mx.np.linalg.
+def _reg(name, fn, nout=1, diff=True):
+    register_op(name, num_outputs=nout, differentiable=diff)(fn)
+
+
+_reg("_npi_norm", lambda x, *, ord=None, axis=None, keepdims=False:
+     jnp.linalg.norm(x, ord=ord, axis=axis, keepdims=keepdims))
+_reg("_npi_svd", lambda a: tuple(jnp.linalg.svd(a, full_matrices=False)),
+     nout=3)
+_reg("_npi_cholesky", lambda a: jnp.linalg.cholesky(a))
+_reg("_npi_qr", lambda a: tuple(jnp.linalg.qr(a)), nout=2)
+_reg("_npi_inv", lambda a: jnp.linalg.inv(a))
+_reg("_npi_pinv", lambda a, *, rcond=1e-15: jnp.linalg.pinv(a,
+                                                            rcond=rcond))
+_reg("_npi_det", lambda a: jnp.linalg.det(a))
+_reg("_npi_slogdet", lambda a: tuple(jnp.linalg.slogdet(a)), nout=2)
+_reg("_npi_solve", lambda a, b: jnp.linalg.solve(a, b))
+_reg("_npi_eigh", lambda a: tuple(jnp.linalg.eigh(a)), nout=2)
+_reg("_npi_eigvalsh", lambda a: jnp.linalg.eigvalsh(a))
+_reg("_npi_matrix_rank",
+     lambda a, *, tol=None: jnp.linalg.matrix_rank(a, tol=tol),
+     diff=False)
+_reg("_npi_matrix_power", lambda a, *, n: jnp.linalg.matrix_power(a, n))
+_reg("_npi_lstsq", lambda a, b, *, rcond=None:
+     tuple(jnp.linalg.lstsq(a, b, rcond=rcond)), nout=4, diff=False)
+_reg("_npi_tensorinv", lambda a, *, ind=2: jnp.linalg.tensorinv(a,
+                                                                ind=ind))
+_reg("_npi_tensorsolve", lambda a, b, *, axes=None:
+     jnp.linalg.tensorsolve(a, b, axes=axes))

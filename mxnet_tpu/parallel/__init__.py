@@ -116,6 +116,12 @@ def _build_optimizer(optimizer, learning_rate, momentum, wd, beta1, beta2,
     from .. import optimizer as opt_mod
 
     if isinstance(optimizer, opt_mod.Optimizer):
+        if opt_kwargs:
+            # same contract as gluon.Trainer: hyper-params belong to the
+            # instance, silently dropping them would mislead
+            raise MXNetError(
+                "optimizer kwargs must not be given when optimizer is an "
+                f"Optimizer instance (got {sorted(opt_kwargs)})")
         return optimizer
     klass = opt_mod.Optimizer.opt_registry.get(str(optimizer).lower())
     if klass is None:
@@ -239,12 +245,15 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
                 for n in names
             }
             good = jnp.where(finite, good + 1, 0)
-            scale = jnp.where(
+            new_scale = jnp.where(
                 finite,
                 jnp.where(good >= 2000, scale * 2.0, scale),
                 jnp.maximum(scale * 0.5, 1.0))
             good = jnp.where(good >= 2000, 0, good)
-            new_s["_loss_scale"] = (scale.astype(jnp.float32), good)
+            new_s["_loss_scale"] = (new_scale.astype(jnp.float32), good)
+            # unscale with the scale the loss was COMPUTED with, not the
+            # adjusted one, or the reported loss jumps 2x on every
+            # scale-change step
             return sloss / scale, new_p, new_s
 
         if static_scale != 1.0:

@@ -1,0 +1,304 @@
+"""mx.np / mx.npx tests — ported slice of the reference
+tests/python/unittest/test_numpy_op.py + test_numpy_ndarray.py."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+np = mx.np
+npx = mx.npx
+
+onp.random.seed(11)
+
+
+def _r(*shape):
+    return onp.random.randn(*shape).astype("float32")
+
+
+def test_namespace_imports():
+    assert mx.np is np and mx.npx is npx
+    assert isinstance(np.ones((2, 2)), np.ndarray)
+
+
+def test_array_roundtrip_and_repr():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    assert a.shape == (2, 2)
+    onp.testing.assert_array_equal(a.asnumpy(),
+                                   [[1.0, 2.0], [3.0, 4.0]])
+    assert "array" in repr(a)
+    assert a.tolist() == [[1.0, 2.0], [3.0, 4.0]]
+
+
+def test_operators_return_np_ndarray():
+    a = np.ones((3,))
+    for out in (a + 1, a * 2, a - a, a / 2, a ** 2, -a, abs(a), a @ a):
+        assert isinstance(out, np.ndarray), out
+    assert (a == a).asnumpy().all()
+    assert not (a < a).asnumpy().any()
+
+
+@pytest.mark.parametrize("subscripts,shapes", [
+    ("ij,jk->ik", [(3, 4), (4, 5)]),
+    ("ij,ij->i", [(3, 4), (3, 4)]),
+    ("ii", [(5, 5)]),
+    ("ij->ji", [(3, 4)]),
+    ("bij,bjk->bik", [(2, 3, 4), (2, 4, 5)]),
+    ("i,j->ij", [(3,), (4,)]),
+    ("ijk,jil->kl", [(2, 3, 4), (3, 2, 5)]),
+])
+def test_einsum_matches_numpy(subscripts, shapes):
+    arrays = [_r(*s) for s in shapes]
+    out = np.einsum(subscripts, *[np.array(a) for a in arrays])
+    expect = onp.einsum(subscripts, *arrays)
+    onp.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_einsum_gradient():
+    a = np.array(_r(3, 4))
+    b = np.array(_r(4, 5))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        out = np.einsum("ij,jk->ik", a, b)
+        s = out.sum()
+    s.backward()
+    onp.testing.assert_allclose(
+        a.grad.asnumpy(),
+        onp.ones((3, 5)) @ b.asnumpy().T, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("axes", [2, 1, ([1], [0]), ([0, 1], [0, 1])])
+def test_tensordot_matches_numpy(axes):
+    shapes = {2: [(3, 4), (3, 4)], 1: [(3, 4), (4, 5)]}
+    if isinstance(axes, int):
+        a, b = shapes[axes]
+        if axes == 2:
+            a, b = (3, 4), (3, 4)
+            an, bn = _r(*a), _r(*b)
+        else:
+            an, bn = _r(3, 4), _r(4, 5)
+    else:
+        an, bn = _r(3, 4), _r(3, 4) if axes == ([0, 1], [0, 1]) else _r(4, 5)
+        if axes == ([1], [0]):
+            bn = _r(4, 5)
+    out = np.tensordot(np.array(an), np.array(bn), axes=axes)
+    expect = onp.tensordot(an, bn, axes=axes)
+    onp.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_unique_modes():
+    x = onp.array([1, 2, 2, 3, 3, 3, 0], dtype="float32")
+    u = np.unique(np.array(x))
+    onp.testing.assert_array_equal(u.asnumpy(), [0, 1, 2, 3])
+    u, idx, inv, cnt = np.unique(np.array(x), return_index=True,
+                                 return_inverse=True, return_counts=True)
+    eu, eidx, einv, ecnt = onp.unique(x, return_index=True,
+                                      return_inverse=True,
+                                      return_counts=True)
+    onp.testing.assert_array_equal(u.asnumpy(), eu)
+    onp.testing.assert_array_equal(idx.asnumpy(), eidx)
+    onp.testing.assert_array_equal(inv.asnumpy().reshape(-1), einv)
+    onp.testing.assert_array_equal(cnt.asnumpy(), ecnt)
+
+
+def test_nonzero_and_where():
+    x = onp.array([[1, 0, 2], [0, 3, 0]], dtype="float32")
+    r, c = np.nonzero(np.array(x))
+    er, ec = onp.nonzero(x)
+    onp.testing.assert_array_equal(r.asnumpy(), er)
+    onp.testing.assert_array_equal(c.asnumpy(), ec)
+    out = np.where(np.array(x) > 0, np.array(x), np.zeros(x.shape))
+    onp.testing.assert_array_equal(out.asnumpy(), onp.where(x > 0, x, 0))
+
+
+def test_boolean_indexing():
+    x = np.array(_r(4, 3))
+    mask = x > 0
+    sel = x[mask]
+    expect = x.asnumpy()[x.asnumpy() > 0]
+    onp.testing.assert_allclose(sel.asnumpy(), expect, rtol=1e-6)
+
+
+def test_tri_family_and_windows():
+    onp.testing.assert_array_equal(np.tri(3, 4, k=1).asnumpy(),
+                                   onp.tri(3, 4, k=1, dtype="float32"))
+    m = _r(4, 4)
+    onp.testing.assert_array_equal(np.tril(np.array(m), k=-1).asnumpy(),
+                                   onp.tril(m, k=-1))
+    onp.testing.assert_array_equal(np.triu(np.array(m)).asnumpy(),
+                                   onp.triu(m))
+    for fn, ofn in [(np.hanning, onp.hanning), (np.hamming, onp.hamming),
+                    (np.blackman, onp.blackman)]:
+        onp.testing.assert_allclose(fn(8).asnumpy(),
+                                    ofn(8).astype("float32"), atol=1e-6)
+
+
+def test_cumprod_diff_trace():
+    x = _r(3, 4)
+    onp.testing.assert_allclose(np.cumprod(np.array(x), axis=1).asnumpy(),
+                                onp.cumprod(x, axis=1), rtol=1e-5)
+    onp.testing.assert_allclose(np.diff(np.array(x), axis=0).asnumpy(),
+                                onp.diff(x, axis=0), rtol=1e-6)
+    onp.testing.assert_allclose(np.trace(np.array(x)).asnumpy(),
+                                onp.trace(x), rtol=1e-6)
+
+
+def test_stats():
+    x = _r(4, 5)
+    onp.testing.assert_allclose(np.std(np.array(x), axis=1).asnumpy(),
+                                x.std(axis=1), rtol=1e-4, atol=1e-5)
+    onp.testing.assert_allclose(np.var(np.array(x), ddof=1).asnumpy(),
+                                x.var(ddof=1), rtol=1e-4, atol=1e-5)
+    onp.testing.assert_allclose(np.median(np.array(x)).asnumpy(),
+                                onp.median(x), rtol=1e-5)
+    onp.testing.assert_allclose(
+        np.percentile(np.array(x), q=30).asnumpy(),
+        onp.percentile(x, 30), rtol=1e-4)
+    h, e = np.histogram(np.array(x), bins=5)
+    eh, ee = onp.histogram(x, bins=5)
+    onp.testing.assert_array_equal(h.asnumpy(), eh)
+    onp.testing.assert_allclose(e.asnumpy(), ee, rtol=1e-5)
+
+
+def test_shape_manipulation():
+    x = _r(2, 3, 4)
+    a = np.array(x)
+    onp.testing.assert_array_equal(
+        np.moveaxis(a, 0, 2).asnumpy(), onp.moveaxis(x, 0, 2))
+    onp.testing.assert_array_equal(np.roll(a, 2, axis=1).asnumpy(),
+                                   onp.roll(x, 2, axis=1))
+    onp.testing.assert_array_equal(
+        np.rot90(a, axes=(1, 2)).asnumpy(), onp.rot90(x, axes=(1, 2)))
+    onp.testing.assert_array_equal(np.flip(a, axis=1).asnumpy(),
+                                   onp.flip(x, axis=1))
+    onp.testing.assert_array_equal(np.ravel(a).asnumpy(), x.ravel())
+    parts = np.split(np.array(_r(6, 2)), 3)
+    assert len(parts) == 3 and parts[0].shape == (2, 2)
+    parts = np.array_split(np.array(_r(7, 2)), 3)
+    assert [p.shape[0] for p in parts] == [3, 2, 2]
+
+
+def test_stacking():
+    a, b = _r(2, 3), _r(2, 3)
+    onp.testing.assert_array_equal(
+        np.concatenate([np.array(a), np.array(b)], axis=0).asnumpy(),
+        onp.concatenate([a, b], axis=0))
+    onp.testing.assert_array_equal(
+        np.stack([np.array(a), np.array(b)], axis=1).asnumpy(),
+        onp.stack([a, b], axis=1))
+    onp.testing.assert_array_equal(
+        np.hstack([np.array(a), np.array(b)]).asnumpy(),
+        onp.hstack([a, b]))
+    onp.testing.assert_array_equal(
+        np.vstack([np.array(a), np.array(b)]).asnumpy(),
+        onp.vstack([a, b]))
+
+
+def test_linalg():
+    a = _r(4, 4)
+    spd = a @ a.T + 4 * onp.eye(4, dtype="float32")
+    onp.testing.assert_allclose(
+        np.linalg.inv(np.array(spd)).asnumpy(), onp.linalg.inv(spd),
+        rtol=1e-3, atol=1e-4)
+    onp.testing.assert_allclose(
+        np.linalg.cholesky(np.array(spd)).asnumpy(),
+        onp.linalg.cholesky(spd), rtol=1e-4, atol=1e-5)
+    sign, logdet = np.linalg.slogdet(np.array(spd))
+    esign, elogdet = onp.linalg.slogdet(spd)
+    assert float(sign.item()) == esign
+    onp.testing.assert_allclose(logdet.item(), elogdet, rtol=1e-4)
+    onp.testing.assert_allclose(
+        np.linalg.norm(np.array(a)).asnumpy(), onp.linalg.norm(a),
+        rtol=1e-5)
+    u, s, vt = np.linalg.svd(np.array(a))
+    onp.testing.assert_allclose(
+        (u.asnumpy() * s.asnumpy()) @ vt.asnumpy(), a, rtol=1e-3,
+        atol=1e-4)
+    x = np.linalg.solve(np.array(spd), np.array(_r(4, 2)))
+    assert x.shape == (4, 2)
+
+
+def test_linalg_gradient_taped():
+    a = np.array(_r(3, 3) + 3 * onp.eye(3, dtype="float32"))
+    a.attach_grad()
+    with autograd.record():
+        out = np.linalg.norm(a)
+    out.backward()
+    onp.testing.assert_allclose(
+        a.grad.asnumpy(), a.asnumpy() / onp.linalg.norm(a.asnumpy()),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_np_random():
+    u = np.random.uniform(0, 1, size=(100,))
+    assert u.shape == (100,) and (u.asnumpy() >= 0).all()
+    n = np.random.normal(0, 1, size=(50, 2))
+    assert n.shape == (50, 2)
+    r = np.random.randint(0, 10, size=(20,))
+    assert ((r.asnumpy() >= 0) & (r.asnumpy() < 10)).all()
+    np.random.seed(0)
+    a = np.random.uniform(size=(5,)).asnumpy()
+    np.random.seed(0)
+    b = np.random.uniform(size=(5,)).asnumpy()
+    onp.testing.assert_array_equal(a, b)
+
+
+def test_npx_nn_ops():
+    x = np.array(_r(4, 10))
+    out = npx.softmax(x)
+    onp.testing.assert_allclose(out.asnumpy().sum(-1), onp.ones(4),
+                                rtol=1e-5)
+    w = np.array(_r(3, 10))
+    fc = npx.fully_connected(x, w, num_hidden=3, no_bias=True)
+    onp.testing.assert_allclose(fc.asnumpy(), x.asnumpy() @
+                                w.asnumpy().T, rtol=1e-4, atol=1e-4)
+    oh = npx.one_hot(np.array(onp.array([0, 2], "float32")), 3)
+    onp.testing.assert_array_equal(
+        oh.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+def test_npx_set_np_roundtrip():
+    assert not npx.is_np_array()
+    npx.set_np()
+    assert npx.is_np_array()
+    npx.reset_np()
+    assert not npx.is_np_array()
+
+
+def test_np_save_load(tmp_path):
+    f = str(tmp_path / "a.npz")
+    npx.save(f, {"w": np.ones((2, 2))})
+    back = npx.load(f)
+    assert isinstance(back["w"], np.ndarray)
+    onp.testing.assert_array_equal(back["w"].asnumpy(), onp.ones((2, 2)))
+
+
+def test_logic_and_misc():
+    x = onp.array([1.0, onp.inf, onp.nan, -onp.inf], dtype="float32")
+    a = np.array(x)
+    onp.testing.assert_array_equal(np.isnan(a).asnumpy(), onp.isnan(x))
+    onp.testing.assert_array_equal(np.isinf(a).asnumpy(), onp.isinf(x))
+    onp.testing.assert_array_equal(np.isfinite(a).asnumpy(),
+                                   onp.isfinite(x))
+    assert np.allclose(np.ones((2,)), np.ones((2,)) + 1e-9)
+    assert np.array_equal(np.ones((2,)), np.ones((2,)))
+    got = np.nan_to_num(a, nan=0.0, posinf=9.0, neginf=-9.0).asnumpy()
+    onp.testing.assert_array_equal(got, [1.0, 9.0, 0.0, -9.0])
+    onp.testing.assert_array_equal(
+        np.searchsorted(np.array([1.0, 3.0, 5.0]),
+                        np.array([2.0, 6.0])).asnumpy(), [1, 3])
+
+
+def test_np_autograd_through_mixed_ops():
+    """np ops tape through record() exactly like nd ops."""
+    a = np.array(_r(3, 3))
+    a.attach_grad()
+    with autograd.record():
+        out = np.sum(np.tril(a) * 2.0)
+    out.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(),
+                                2 * onp.tri(3, dtype="float32"),
+                                rtol=1e-6)

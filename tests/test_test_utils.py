@@ -132,6 +132,23 @@ SPECS = {
     "_minimum_scalar": ([_n((3, 4))], dict(scalar=0.1)),
     "_hypot_scalar": ([_u((3, 4))], dict(scalar=1.0)),
     "_npi_matmul": ([_n((4, 5)), _n((5, 3))], {}),
+    "_npi_dot": ([_n((4, 5)), _n((5, 3))], {}),
+    "_npi_einsum": ([_n((3, 4)), _n((4, 5))],
+                    dict(subscripts="ij,jk->ik")),
+    "_npi_cross": ([_n((4, 3)), _n((4, 3))], {}),
+    "_npi_moveaxis": ([_n((2, 3, 4))], dict(source=0, destination=2)),
+    "_npi_rollaxis": ([_n((2, 3, 4))], dict(axis=2, start=0)),
+    "_npi_roll": ([_n((3, 4))], dict(shift=2, axis=1)),
+    "_npi_norm": ([_n((3, 4))], {}),
+    "_npi_det": ([_spd(3)], {}),
+    "_npi_inv": ([_spd(3)], {}),
+    "_npi_solve": ([_spd(3), _n((3, 2))], {}),
+    "_npi_cholesky": ([_spd(3)], {}),
+    "_npi_matrix_power": ([_spd(3)], dict(n=2)),
+    "_npi_tensorinv": ([_spd(4).reshape(2, 2, 2, 2)
+                        + onp.eye(4, dtype="float32").reshape(2, 2, 2, 2)],
+                       dict(ind=2)),
+    "_npi_tensorsolve": ([_spd(4).reshape(2, 2, 2, 2), _n((2, 2))], {}),
     # linalg family (SPD inputs where factorizations need them)
     "_linalg_gemm": ([_n((3, 4)), _n((4, 5)), _n((3, 5))], {}),
     "_linalg_gemm2": ([_n((3, 4)), _n((4, 5))], {}),
@@ -188,6 +205,8 @@ EXCLUDE_REASON = {
         "identity_attach_kl_sparse_reg", "khatri_rao", "amp_cast",
         "amp_multicast", "split_v2", "_linalg_gelqf", "_linalg_syevd",
         "_contrib_hawkesll", "_contrib_gradientmultiplier",
+        "_npi_svd", "_npi_qr", "_npi_eigh", "_npi_slogdet",
+        "_npi_eigvalsh", "_npi_ldexp", "_npi_floor_divide",
     },
 }
 
