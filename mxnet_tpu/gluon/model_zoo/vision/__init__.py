@@ -11,6 +11,7 @@ from .lenet import *  # noqa: F401,F403
 from .mobilenet import *  # noqa: F401,F403
 from .resnet import *  # noqa: F401,F403
 from .squeezenet import *  # noqa: F401,F403
+from .ssd import *  # noqa: F401,F403
 from .vgg import *  # noqa: F401,F403
 
 from ....base import MXNetError
@@ -52,6 +53,9 @@ _models = {
     "mobilenetv2_0.5": mobilenet_v2_0_5,
     "mobilenetv2_0.25": mobilenet_v2_0_25,
     "lenet": lenet,
+    "ssd_300_vgg16_reduced": ssd_300_vgg16_reduced,
+    "ssd_512_vgg16": ssd_512_vgg16,
+    "ssd_300_resnet18": ssd_300_resnet18,
 }
 
 

@@ -157,8 +157,15 @@ for _name in _METHOD_OPS:
         setattr(NDArray, _name, _make_method(_name))
 
 
-def _nd_transpose(self, *axes):
-    if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+def _nd_transpose(self, *axes, **kwargs):
+    kw_axes = kwargs.pop("axes", None)
+    if kwargs:
+        raise TypeError(
+            f"transpose() got unexpected keyword arguments "
+            f"{sorted(kwargs)}")
+    if kw_axes is not None:  # reference kwarg form
+        axes = tuple(kw_axes)
+    elif len(axes) == 1 and isinstance(axes[0], (list, tuple)):
         axes = tuple(axes[0])
     return invoke("transpose", [self], axes=axes or None)
 

@@ -16,5 +16,6 @@ from . import (  # noqa: F401  (import-for-registration)
     linalg_ops,
     contrib_ops,
     numpy_ops,
+    detection_ops,
 )
 from .registry import OpDef, alias_op, get_op, list_ops, register_op  # noqa: F401

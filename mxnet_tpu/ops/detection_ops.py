@@ -1,0 +1,542 @@
+"""Object-detection operators: multibox family, NMS, RoI ops, proposals.
+
+Reference parity: src/operator/contrib/multibox_prior.cc,
+multibox_target.cc, multibox_detection.cc, bounding_box.cc (box_nms /
+box_iou), src/operator/roi_pooling.cc, src/operator/contrib/roi_align.cc,
+src/operator/contrib/proposal.cc.
+
+TPU-native design: every op is static-shaped.  Greedy bipartite matching
+and NMS — sequential scans in the reference CPU kernels — become
+``lax.fori_loop``s over masks; "remove a box" is "flag it suppressed",
+and dropped detections are reported with id = -1 exactly like the
+reference's output convention, so downstream code is shape-stable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+def _corner_iou(a, b):
+    """IOU of (..., 4) corner boxes vs (..., 4): broadcasted."""
+    tl = jnp.maximum(a[..., :2], b[..., :2])
+    br = jnp.minimum(a[..., 2:4], b[..., 2:4])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0.0) * \
+        jnp.maximum(a[..., 3] - a[..., 1], 0.0)
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0.0) * \
+        jnp.maximum(b[..., 3] - b[..., 1], 0.0)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+@register_op("_contrib_MultiBoxPrior",
+             aliases=("MultiBoxPrior", "_contrib_multibox_prior"),
+             differentiable=False)
+def multibox_prior(data, *, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Reference: src/operator/contrib/multibox_prior.cc:32-70.
+
+    Anchor layout per cell: [sizes × ratios[0]] then [sizes[0] ×
+    ratios[1:]]; w carries the in_height/in_width aspect correction of
+    the reference.  Output (1, H*W*A, 4) corner boxes in [0, 1] coords.
+    """
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    ys = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+    xs = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+    cy, cx = jnp.meshgrid(ys, xs, indexing="ij")  # (h, w)
+
+    whs = []
+    r0 = float(ratios[0]) ** 0.5
+    for s in sizes:
+        whs.append((s * h / w * r0 / 2, s / r0 / 2))
+    for r in ratios[1:]:
+        rs = float(r) ** 0.5
+        whs.append((sizes[0] * h / w * rs / 2, sizes[0] / rs / 2))
+    half_w = jnp.array([p[0] for p in whs], jnp.float32)  # (A,)
+    half_h = jnp.array([p[1] for p in whs], jnp.float32)
+
+    cx = cx[..., None]
+    cy = cy[..., None]
+    boxes = jnp.stack([
+        cx - half_w, cy - half_h, cx + half_w, cy + half_h], axis=-1)
+    boxes = boxes.reshape(1, h * w * len(whs), 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+def _encode_loc(anchors, gt, variances):
+    """AssignLocTargets (multibox_target.cc:32-54)."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) * 0.5
+    ay = (anchors[:, 1] + anchors[:, 3]) * 0.5
+    gw = gt[:, 2] - gt[:, 0]
+    gh = gt[:, 3] - gt[:, 1]
+    gx = (gt[:, 0] + gt[:, 2]) * 0.5
+    gy = (gt[:, 1] + gt[:, 3]) * 0.5
+    vx, vy, vw, vh = variances
+    return jnp.stack([
+        (gx - ax) / jnp.maximum(aw, 1e-12) / vx,
+        (gy - ay) / jnp.maximum(ah, 1e-12) / vy,
+        jnp.log(jnp.maximum(gw, 1e-12) / jnp.maximum(aw, 1e-12)) / vw,
+        jnp.log(jnp.maximum(gh, 1e-12) / jnp.maximum(ah, 1e-12)) / vh,
+    ], axis=-1)
+
+
+@register_op("_contrib_MultiBoxTarget",
+             aliases=("MultiBoxTarget", "_contrib_multibox_target"),
+             num_outputs=3, differentiable=False)
+def multibox_target(anchor, label, cls_pred, *, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5,
+                    minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Reference: src/operator/contrib/multibox_target.cc:79-280.
+
+    anchor (1, N, 4), label (B, M, 5) rows [cls, xmin, ymin, xmax, ymax]
+    with cls = -1 padding, cls_pred (B, num_classes, N).  Returns
+    (loc_target (B, N*4), loc_mask (B, N*4), cls_target (B, N)).
+    Matching: greedy bipartite (each gt claims its best anchor), then
+    per-anchor threshold matching, then optional hard-negative mining
+    ranked by background probability.
+    """
+    anchors = anchor.reshape(-1, 4)
+    n = anchors.shape[0]
+    m = label.shape[1]
+
+    def one_sample(lab, cpred):
+        gt_cls = lab[:, 0]
+        gt_valid = gt_cls >= 0  # (M,)
+        gt_boxes = lab[:, 1:5]
+        ious = _corner_iou(anchors[:, None, :], gt_boxes[None, :, :])
+        ious = jnp.where(gt_valid[None, :], ious, -1.0)  # (N, M)
+
+        # stage 1: greedy bipartite — iterate M times, each time pick
+        # the globally best (anchor, gt) among unmatched pairs
+        def bip_body(_, state):
+            a_match, iou_cache, gt_taken = state
+            masked = jnp.where((a_match[:, None] < 0) &
+                               (~gt_taken[None, :]), ious, -1.0)
+            flat = jnp.argmax(masked)
+            bi, bk = flat // m, flat % m
+            ok = masked[bi, bk] > 1e-6
+            a_match = a_match.at[bi].set(jnp.where(ok, bk, a_match[bi]))
+            iou_cache = iou_cache.at[bi].set(
+                jnp.where(ok, masked[bi, bk], iou_cache[bi]))
+            gt_taken = gt_taken.at[bk].set(gt_taken[bk] | ok)
+            return a_match, iou_cache, gt_taken
+
+        a_match = jnp.full((n,), -1, jnp.int32)
+        iou_cache = jnp.full((n,), -1.0, jnp.float32)
+        gt_taken = jnp.zeros((m,), bool)
+        a_match, iou_cache, gt_taken = lax.fori_loop(
+            0, m, bip_body, (a_match, iou_cache, gt_taken))
+
+        # stage 2: threshold matching for the rest
+        best_gt = jnp.argmax(ious, axis=1).astype(jnp.int32)
+        best_iou = jnp.max(ious, axis=1)
+        thr_pos = (a_match < 0) & (best_iou > overlap_threshold) \
+            if overlap_threshold > 0 else jnp.zeros((n,), bool)
+        positive = (a_match >= 0) | thr_pos
+        matched_gt = jnp.where(a_match >= 0, a_match, best_gt)
+        matched_iou = jnp.where(a_match >= 0, iou_cache, best_iou)
+
+        # stage 3: negatives
+        if negative_mining_ratio > 0:
+            num_pos = positive.sum()
+            num_neg = jnp.minimum(
+                (num_pos * negative_mining_ratio).astype(jnp.int32),
+                n - num_pos)
+            num_neg = jnp.maximum(num_neg,
+                                  int(minimum_negative_samples))
+            logits = cpred  # (num_classes, N)
+            mx = jnp.max(logits, axis=0)
+            bg_prob = jnp.exp(logits[0] - mx) / \
+                jnp.sum(jnp.exp(logits - mx), axis=0)
+            cand = (~positive) & (matched_iou < negative_mining_thresh)
+            score = jnp.where(cand, bg_prob, jnp.inf)  # hardest first
+            order = jnp.argsort(score)
+            rank = jnp.empty_like(order).at[order].set(jnp.arange(n))
+            negative = cand & (rank < num_neg)
+        else:
+            negative = ~positive
+
+        cls_t = jnp.where(
+            positive,
+            jnp.take(gt_cls, matched_gt, mode="clip") + 1.0,
+            jnp.where(negative, 0.0, float(ignore_label)))
+        gt_for_anchor = jnp.take(gt_boxes, matched_gt, axis=0,
+                                 mode="clip")
+        loc_t = jnp.where(positive[:, None],
+                          _encode_loc(anchors, gt_for_anchor, variances),
+                          0.0)
+        loc_m = jnp.where(positive[:, None],
+                          jnp.ones((n, 4), jnp.float32), 0.0)
+        return loc_t.reshape(-1), loc_m.reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(one_sample)(label, cls_pred)
+    return loc_t, loc_m, cls_t
+
+
+def _decode_loc(anchors, pred, variances, clip):
+    """multibox_detection.cc:51-70 — center-offset decoding."""
+    al, at, ar, ab = (anchors[:, 0], anchors[:, 1], anchors[:, 2],
+                      anchors[:, 3])
+    aw, ah = ar - al, ab - at
+    ax, ay = (al + ar) * 0.5, (at + ab) * 0.5
+    vx, vy, vw, vh = variances
+    px, py, pw, ph = pred[:, 0], pred[:, 1], pred[:, 2], pred[:, 3]
+    ox = px * vx * aw + ax
+    oy = py * vy * ah + ay
+    ow = jnp.exp(pw * vw) * aw / 2
+    oh = jnp.exp(ph * vh) * ah / 2
+    out = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=-1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+def _nms_scan(boxes, scores, ids, valid, nms_threshold, force_suppress,
+              topk):
+    """Suppression scan over score-sorted boxes: returns keep mask (in
+    sorted order) and the sort order.
+
+    With topk > 0 only the top-k sorted boxes enter the O(k^2) IOU
+    matrix and the suppression loop (the reference's nms_topk
+    pre-filter) — essential at SSD scale (8,732 anchors)."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    k = min(topk, n) if topk > 0 else n
+    b = jnp.take(boxes, order[:k], axis=0)
+    s_ids = jnp.take(ids, order[:k])
+    s_valid_k = jnp.take(valid, order[:k])
+    ious = _corner_iou(b[:, None, :], b[None, :, :])
+    same_cls = (s_ids[:, None] == s_ids[None, :]) | force_suppress
+
+    def body(i, alive):
+        sup = (ious[i] > nms_threshold) & same_cls[i] & \
+            (jnp.arange(k) > i)
+        keep_i = alive[i] & s_valid_k[i]
+        return jnp.where(keep_i & sup, False, alive)
+
+    alive = lax.fori_loop(0, k, body, jnp.ones((k,), bool))
+    keep = jnp.zeros((n,), bool).at[:k].set(alive & s_valid_k)
+    return keep, order
+
+
+@register_op("_contrib_MultiBoxDetection",
+             aliases=("MultiBoxDetection", "_contrib_multibox_detection"),
+             differentiable=False)
+def multibox_detection(cls_prob, loc_pred, anchor, *, clip=True,
+                       threshold=0.01, background_id=0,
+                       nms_threshold=0.5, force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Reference: src/operator/contrib/multibox_detection.cc.
+
+    cls_prob (B, num_classes, N) softmax probs, loc_pred (B, N*4),
+    anchor (1, N, 4) -> (B, N, 6) rows [id, score, xmin, ymin, xmax,
+    ymax]; suppressed/invalid rows have id = -1.
+    """
+    anchors = anchor.reshape(-1, 4)
+    n = anchors.shape[0]
+
+    def one_sample(cp, lp):
+        # best non-background class per anchor
+        probs = cp  # (C, N)
+        mask = jnp.arange(probs.shape[0]) != background_id
+        fg = jnp.where(mask[:, None], probs, -1.0)
+        best_cls = jnp.argmax(fg, axis=0)
+        score = jnp.max(fg, axis=0)
+        valid = score > threshold
+        # reference id: class index shifted down past background (bg=0)
+        ids = (best_cls - 1).astype(jnp.float32)
+        boxes = _decode_loc(anchors, lp.reshape(n, 4), variances, clip)
+        keep, order = _nms_scan(boxes, score, best_cls, valid,
+                                nms_threshold, force_suppress, nms_topk)
+        s_boxes = jnp.take(boxes, order, axis=0)
+        s_score = jnp.take(score, order)
+        s_ids = jnp.take(ids, order)
+        out = jnp.concatenate([
+            jnp.where(keep, s_ids, -1.0)[:, None],
+            jnp.where(keep, s_score, 0.0)[:, None],
+            jnp.where(keep[:, None], s_boxes, 0.0)], axis=-1)
+        return out
+
+    return jax.vmap(one_sample)(cls_prob, loc_pred)
+
+
+@register_op("_contrib_box_nms", aliases=("box_nms", "_contrib_box_non_maximum_suppression"),
+             differentiable=False)
+def box_nms(data, *, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner",
+            out_format="corner"):
+    """Reference: src/operator/contrib/bounding_box.cc box_nms.
+
+    data (..., N, K): boxes at coord_start..+4, score at score_index,
+    optional class at id_index.  Suppressed rows are overwritten with
+    -1 (the reference convention); shape is preserved.
+    """
+    shape = data.shape
+    flat = data.reshape(-1, shape[-2], shape[-1])
+
+    def one(batch):
+        boxes = lax.dynamic_slice_in_dim(batch, coord_start, 4, axis=1)
+        if in_format == "center":
+            cx, cy, w, h = (boxes[:, 0], boxes[:, 1], boxes[:, 2],
+                            boxes[:, 3])
+            boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2,
+                               cy + h / 2], axis=-1)
+        scores = batch[:, score_index]
+        ids = batch[:, id_index].astype(jnp.int32) if id_index >= 0 \
+            else jnp.zeros(batch.shape[0], jnp.int32)
+        valid = scores > valid_thresh
+        if id_index >= 0 and background_id >= 0:
+            valid = valid & (ids != background_id)
+        keep, order = _nms_scan(boxes, scores, ids, valid,
+                                overlap_thresh, force_suppress
+                                or id_index < 0, topk)
+        sorted_rows = jnp.take(batch, order, axis=0)
+        if out_format != in_format:
+            sb = lax.dynamic_slice_in_dim(sorted_rows, coord_start, 4,
+                                          axis=1)
+            if out_format == "corner":  # center -> corner
+                conv = jnp.concatenate(
+                    [sb[:, :2] - sb[:, 2:4] / 2,
+                     sb[:, :2] + sb[:, 2:4] / 2], axis=-1)
+            else:  # corner -> center
+                conv = jnp.concatenate(
+                    [(sb[:, :2] + sb[:, 2:4]) / 2,
+                     sb[:, 2:4] - sb[:, :2]], axis=-1)
+            sorted_rows = lax.dynamic_update_slice_in_dim(
+                sorted_rows, conv, coord_start, axis=1)
+        # reference compacts survivors to the front, -1-fills the tail
+        compact = jnp.argsort(~keep, stable=True)
+        keep_c = jnp.take(keep, compact)
+        rows_c = jnp.take(sorted_rows, compact, axis=0)
+        return jnp.where(keep_c[:, None], rows_c, -1.0)
+
+    return jax.vmap(one)(flat).reshape(shape)
+
+
+@register_op("_contrib_box_iou", aliases=("box_iou",),
+             differentiable=False)
+def box_iou(lhs, rhs, *, format="corner"):  # noqa: A002
+    """Reference: bounding_box.cc box_iou."""
+    def to_corner(b):
+        if format == "center":
+            return jnp.concatenate([b[..., :2] - b[..., 2:4] / 2,
+                                    b[..., :2] + b[..., 2:4] / 2],
+                                   axis=-1)
+        return b
+
+    a = to_corner(lhs)
+    b = to_corner(rhs)
+    a_shape = a.shape[:-1]
+    b_shape = b.shape[:-1]
+    a2 = a.reshape((-1, 4))
+    b2 = b.reshape((-1, 4))
+    out = _corner_iou(a2[:, None, :], b2[None, :, :])
+    return out.reshape(a_shape + b_shape)
+
+
+@register_op("ROIPooling", aliases=("_contrib_ROIPooling", "roi_pooling"))
+def roi_pooling(data, rois, *, pooled_size, spatial_scale):
+    """Reference: src/operator/roi_pooling.cc.
+
+    data (B, C, H, W); rois (R, 5) rows [batch_idx, x1, y1, x2, y2] in
+    image coords.  Exact max-pool over quantized bins, realized as
+    masked max-reductions (static shapes; a bin's pixel set is a mask,
+    not a slice).
+    """
+    ph, pw = pooled_size
+    b, c, h, w = data.shape
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        img = data[bidx]  # (C, H, W)
+
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+        # mask (H, ph): pixel y belongs to output row i
+        ystart = jnp.floor(y1 + jnp.arange(ph) * bin_h)
+        yend = jnp.ceil(y1 + (jnp.arange(ph) + 1) * bin_h)
+        xstart = jnp.floor(x1 + jnp.arange(pw) * bin_w)
+        xend = jnp.ceil(x1 + (jnp.arange(pw) + 1) * bin_w)
+        my = (ys[:, None] >= ystart[None, :]) & \
+            (ys[:, None] < yend[None, :]) & \
+            (ys[:, None] >= 0) & (ys[:, None] < h)
+        mx = (xs[:, None] >= xstart[None, :]) & \
+            (xs[:, None] < xend[None, :]) & \
+            (xs[:, None] >= 0) & (xs[:, None] < w)
+        neg = jnp.finfo(data.dtype).min
+        masked = jnp.where(my.T[None, :, :, None], img[:, None, :, :],
+                           neg)  # (C, ph, H, W)
+        rowmax = jnp.where(mx.T[None, None, :, :],
+                           jnp.max(masked, axis=2)[:, :, None, :],
+                           neg)  # (C, ph, pw, W)
+        out = jnp.max(rowmax, axis=3)
+        return jnp.where(out == neg, 0.0, out)  # empty bins -> 0
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register_op("_contrib_ROIAlign", aliases=("roi_align",))
+def roi_align(data, rois, *, pooled_size, spatial_scale, sample_ratio=-1,
+              position_sensitive=False, aligned=False):
+    """Reference: src/operator/contrib/roi_align.cc — average of
+    bilinear samples per bin (sample_ratio^2 points, default 2x2)."""
+    ph, pw = pooled_size
+    b, c, h, w = data.shape
+    sr = sample_ratio if sample_ratio > 0 else 2
+    off = 0.5 if aligned else 0.0
+
+    def bilinear(img, y, x):
+        y = jnp.clip(y, 0.0, h - 1.0)
+        x = jnp.clip(x, 0.0, w - 1.0)
+        y0 = jnp.floor(y).astype(jnp.int32)
+        x0 = jnp.floor(x).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        wy = y - y0
+        wx = x - x0
+        v00 = img[:, y0, x0]
+        v01 = img[:, y0, x1]
+        v10 = img[:, y1, x0]
+        v11 = img[:, y1, x1]
+        return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale - off
+        y1 = roi[2] * spatial_scale - off
+        x2 = roi[3] * spatial_scale - off
+        y2 = roi[4] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        img = data[bidx]
+        iy = jnp.arange(ph, dtype=jnp.float32)
+        ix = jnp.arange(pw, dtype=jnp.float32)
+        sy = jnp.arange(sr, dtype=jnp.float32)
+        ys = y1 + (iy[:, None] + (sy[None, :] + 0.5) / sr) * bin_h
+        xs = x1 + (ix[:, None] + (sy[None, :] + 0.5) / sr) * bin_w
+        # (ph, sr) x (pw, sr) grids
+        yy = ys[:, None, :, None]  # (ph, 1, sr, 1)
+        xx = xs[None, :, None, :]  # (1, pw, 1, sr)
+        yg = jnp.broadcast_to(yy, (ph, pw, sr, sr)).reshape(-1)
+        xg = jnp.broadcast_to(xx, (ph, pw, sr, sr)).reshape(-1)
+        vals = jax.vmap(lambda y, x: bilinear(img, y, x))(yg, xg)
+        vals = vals.reshape(ph, pw, sr * sr, c).mean(axis=2)
+        out = jnp.transpose(vals, (2, 0, 1))  # (C, ph, pw)
+        if position_sensitive:
+            # R-FCN: input channel layout (out_c, ph, pw); bin (i, j) of
+            # output channel k reads input channel k*ph*pw + i*pw + j
+            out_c = c // (ph * pw)
+            grouped = out.reshape(out_c, ph, pw, ph, pw)
+            iy2 = jnp.arange(ph)
+            ix2 = jnp.arange(pw)
+            out = grouped[:, iy2[:, None], ix2[None, :],
+                          iy2[:, None], ix2[None, :]]
+        return out
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register_op("_contrib_Proposal", aliases=("_contrib_proposal",),
+             differentiable=False)
+def proposal(cls_prob, bbox_pred, im_info, *, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+             feature_stride=16, output_score=False, iou_loss=False):
+    """Reference: src/operator/contrib/proposal.cc (RPN proposals).
+
+    cls_prob (B, 2*A, H, W), bbox_pred (B, 4*A, H, W), im_info (B, 3)
+    -> rois (B*post_nms_top_n, 5) [batch_idx, x1, y1, x2, y2].
+    """
+    bsz, _, h, w = cls_prob.shape
+    a = len(scales) * len(ratios)
+    base = float(feature_stride)
+    # generate base anchors (centered at (stride-1)/2 like the reference)
+    ctr = (base - 1) / 2
+    anchors = []
+    for r in ratios:
+        size = base * base
+        ws = jnp.round(jnp.sqrt(size / r))
+        hs = jnp.round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            anchors.append([ctr - (wss - 1) / 2, ctr - (hss - 1) / 2,
+                            ctr + (wss - 1) / 2, ctr + (hss - 1) / 2])
+    base_anchors = jnp.array(anchors, jnp.float32)  # (A, 4)
+    shift_x = jnp.arange(w, dtype=jnp.float32) * base
+    shift_y = jnp.arange(h, dtype=jnp.float32) * base
+    sy, sx = jnp.meshgrid(shift_y, shift_x, indexing="ij")
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1).reshape(-1, 1, 4)
+    all_anchors = (base_anchors[None] + shifts).reshape(-1, 4)  # (HWA, 4)
+
+    def one(cp, bp, info):
+        scores = cp[a:].transpose(1, 2, 0).reshape(-1)  # fg scores
+        deltas = bp.transpose(1, 2, 0).reshape(-1, 4)
+        aw = all_anchors[:, 2] - all_anchors[:, 0] + 1
+        ah = all_anchors[:, 3] - all_anchors[:, 1] + 1
+        ax = all_anchors[:, 0] + aw * 0.5
+        ay = all_anchors[:, 1] + ah * 0.5
+        cx = deltas[:, 0] * aw + ax
+        cy = deltas[:, 1] * ah + ay
+        cw = jnp.exp(deltas[:, 2]) * aw
+        ch = jnp.exp(deltas[:, 3]) * ah
+        boxes = jnp.stack([cx - cw / 2, cy - ch / 2, cx + cw / 2,
+                           cy + ch / 2], axis=-1)
+        boxes = jnp.clip(boxes, 0.0,
+                         jnp.array([info[1] - 1, info[0] - 1,
+                                    info[1] - 1, info[0] - 1]))
+        min_sz = rpn_min_size * info[2]  # scaled coords (reference
+        # proposal.cc FilterBox: min_size * im_info[2])
+        keep_sz = ((boxes[:, 2] - boxes[:, 0] + 1 >= min_sz)
+                   & (boxes[:, 3] - boxes[:, 1] + 1 >= min_sz))
+        scores = jnp.where(keep_sz, scores, -jnp.inf)
+        keep, order = _nms_scan(boxes, scores,
+                                jnp.zeros(scores.shape, jnp.int32),
+                                jnp.isfinite(scores), threshold, True,
+                                rpn_pre_nms_top_n)
+        sboxes = jnp.take(boxes, order, axis=0)
+        rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        out = jnp.zeros((rpn_post_nms_top_n, 4), jnp.float32)
+        sel = keep & (rank < rpn_post_nms_top_n)
+        out = out.at[jnp.where(sel, rank, rpn_post_nms_top_n)
+                     .clip(0, rpn_post_nms_top_n - 1)].set(
+            jnp.where(sel[:, None], sboxes, 0.0)[..., :],
+            mode="drop")
+        sscores = jnp.take(scores, order)
+        out_s = jnp.zeros((rpn_post_nms_top_n,), jnp.float32)
+        out_s = out_s.at[jnp.where(sel, rank, rpn_post_nms_top_n)
+                         .clip(0, rpn_post_nms_top_n - 1)].set(
+            jnp.where(sel, sscores, 0.0), mode="drop")
+        return out, out_s
+
+    boxes, scores = jax.vmap(one)(cls_prob, bbox_pred, im_info)
+    bidx = jnp.repeat(jnp.arange(bsz, dtype=jnp.float32),
+                      rpn_post_nms_top_n)
+    rois = jnp.concatenate([bidx[:, None],
+                            boxes.reshape(-1, 4)], axis=-1)
+    if output_score:
+        return rois, scores.reshape(-1, 1)
+    return rois

@@ -1,0 +1,251 @@
+"""Detection op tests with hand-computed fixtures + SSD end-to-end.
+
+Reference model: tests/python/unittest/test_operator.py multibox/NMS
+cases and example/ssd training flow.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+onp.random.seed(5)
+
+
+def test_multibox_prior_fixture():
+    """2x2 feature map, one size, one ratio — anchors hand-computed."""
+    data = mx.nd.zeros((1, 3, 2, 2))
+    out = mx.nd.invoke("_contrib_MultiBoxPrior", [data], sizes=(0.5,),
+                       ratios=(1.0,))
+    a = out.asnumpy()
+    assert a.shape == (1, 4, 4)
+    # cell (0,0): center (0.25, 0.25), half extent 0.25
+    onp.testing.assert_allclose(a[0, 0], [0.0, 0.0, 0.5, 0.5], atol=1e-6)
+    # cell (0,1): center (0.75, 0.25)
+    onp.testing.assert_allclose(a[0, 1], [0.5, 0.0, 1.0, 0.5], atol=1e-6)
+    # multiple sizes/ratios -> sizes + ratios - 1 anchors per cell
+    out = mx.nd.invoke("_contrib_MultiBoxPrior", [data],
+                       sizes=(0.5, 0.25), ratios=(1.0, 2.0, 0.5))
+    assert out.shape == (1, 2 * 2 * 4, 4)
+
+
+def test_multibox_prior_clip_and_aspect():
+    data = mx.nd.zeros((1, 3, 1, 2))  # h=1, w=2 -> aspect correction
+    out = mx.nd.invoke("_contrib_MultiBoxPrior", [data], sizes=(1.0,),
+                       ratios=(1.0,), clip=True).asnumpy()
+    # w_half = size * h/w / 2 = 0.25; clipped to [0, 1]
+    onp.testing.assert_allclose(out[0, 0], [0.0, 0.0, 0.5, 1.0],
+                                atol=1e-6)
+
+
+def test_box_iou():
+    a = mx.nd.array(onp.array([[0, 0, 2, 2]], dtype="float32"))
+    b = mx.nd.array(onp.array([[1, 1, 3, 3], [0, 0, 2, 2],
+                               [4, 4, 5, 5]], dtype="float32"))
+    iou = mx.nd.invoke("_contrib_box_iou", [a, b]).asnumpy()
+    onp.testing.assert_allclose(iou[0], [1.0 / 7, 1.0, 0.0], atol=1e-6)
+
+
+def test_box_nms_fixture():
+    """3 boxes: two overlapping (iou>0.5), one separate."""
+    rows = onp.array([
+        [0, 0.9, 0.0, 0.0, 1.0, 1.0],   # kept (highest score)
+        [0, 0.8, 0.05, 0.05, 1.0, 1.0],  # suppressed by box 0
+        [0, 0.7, 2.0, 2.0, 3.0, 3.0],   # kept (no overlap)
+    ], dtype="float32")
+    out = mx.nd.invoke("_contrib_box_nms", [mx.nd.array(rows[None])],
+                       overlap_thresh=0.5, valid_thresh=0.0,
+                       id_index=0, score_index=1,
+                       coord_start=2).asnumpy()[0]
+    assert out[0, 1] == pytest.approx(0.9)
+    assert out[1, 1] == pytest.approx(0.7)  # sorted, survivor
+    assert (out[2] == -1).all()  # suppressed row overwritten with -1
+
+
+def test_box_nms_class_aware():
+    rows = onp.array([
+        [0, 0.9, 0.0, 0.0, 1.0, 1.0],
+        [1, 0.8, 0.05, 0.05, 1.0, 1.0],  # different class: survives
+    ], dtype="float32")
+    out = mx.nd.invoke("_contrib_box_nms", [mx.nd.array(rows[None])],
+                       overlap_thresh=0.5, id_index=0, score_index=1,
+                       coord_start=2).asnumpy()[0]
+    assert (out[:, 1] > 0).all()
+    out = mx.nd.invoke("_contrib_box_nms", [mx.nd.array(rows[None])],
+                       overlap_thresh=0.5, id_index=0, score_index=1,
+                       coord_start=2, force_suppress=True).asnumpy()[0]
+    assert (out[1] == -1).all()
+
+
+def test_multibox_target_fixture():
+    """One anchor exactly on the gt: positive with zero loc target."""
+    anchors = mx.nd.array(onp.array(
+        [[[0.1, 0.1, 0.4, 0.4], [0.6, 0.6, 0.9, 0.9]]], dtype="float32"))
+    labels = mx.nd.array(onp.array(
+        [[[1, 0.1, 0.1, 0.4, 0.4]]], dtype="float32"))
+    cls_pred = mx.nd.zeros((1, 3, 2))
+    loc_t, loc_m, cls_t = mx.nd.invoke(
+        "_contrib_MultiBoxTarget", [anchors, labels, cls_pred],
+        overlap_threshold=0.5, negative_mining_ratio=-1.0)
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 2.0  # class 1 -> target 2 (0 is background)
+    assert ct[1] == 0.0  # negative
+    onp.testing.assert_allclose(loc_t.asnumpy()[0][:4], onp.zeros(4),
+                                atol=1e-5)
+    onp.testing.assert_array_equal(loc_m.asnumpy()[0],
+                                   [1, 1, 1, 1, 0, 0, 0, 0])
+
+
+def test_multibox_target_encoding():
+    """Shifted gt: verify the (dx/var/aw, log(gw/aw)/var) encoding."""
+    anchors = mx.nd.array(onp.array([[[0.0, 0.0, 0.5, 0.5]]],
+                                    dtype="float32"))
+    labels = mx.nd.array(onp.array([[[0, 0.1, 0.1, 0.5, 0.5]]],
+                                   dtype="float32"))
+    cls_pred = mx.nd.zeros((1, 2, 1))
+    loc_t, _, cls_t = mx.nd.invoke(
+        "_contrib_MultiBoxTarget", [anchors, labels, cls_pred],
+        overlap_threshold=0.5, negative_mining_ratio=-1.0)
+    # anchor center (.25,.25) w=h=.5; gt center (.3,.3) w=h=.4
+    expect = [(0.3 - 0.25) / 0.5 / 0.1, (0.3 - 0.25) / 0.5 / 0.1,
+              onp.log(0.4 / 0.5) / 0.2, onp.log(0.4 / 0.5) / 0.2]
+    onp.testing.assert_allclose(loc_t.asnumpy()[0], expect, rtol=1e-4)
+    assert cls_t.asnumpy()[0, 0] == 1.0
+
+
+def test_multibox_target_negative_mining():
+    n = 8
+    anchors = onp.zeros((1, n, 4), dtype="float32")
+    anchors[0, :, 0] = onp.linspace(0, 0.7, n)
+    anchors[0, :, 1] = 0.0
+    anchors[0, :, 2] = anchors[0, :, 0] + 0.1
+    anchors[0, :, 3] = 0.1
+    labels = onp.array([[[0, 0.0, 0.0, 0.1, 0.1]]], dtype="float32")
+    cls_pred = onp.random.randn(1, 3, n).astype("float32")
+    _, _, cls_t = mx.nd.invoke(
+        "_contrib_MultiBoxTarget",
+        [mx.nd.array(anchors), mx.nd.array(labels),
+         mx.nd.array(cls_pred)],
+        overlap_threshold=0.5, negative_mining_ratio=3.0,
+        negative_mining_thresh=0.5)
+    ct = cls_t.asnumpy()[0]
+    assert (ct == 1).sum() == 1          # one positive
+    assert (ct == 0).sum() == 3          # 3:1 mined negatives
+    assert (ct == -1).sum() == n - 4     # rest ignored
+
+
+def test_multibox_detection_decode_and_nms():
+    anchors = mx.nd.array(onp.array([[[0.2, 0.2, 0.4, 0.4],
+                                      [0.6, 0.6, 0.8, 0.8]]],
+                                    dtype="float32"))
+    # zero offsets -> boxes = anchors
+    loc_pred = mx.nd.zeros((1, 8))
+    cls_prob = mx.nd.array(onp.array(
+        [[[0.1, 0.8], [0.2, 0.1], [0.7, 0.1]]], dtype="float32"))
+    out = mx.nd.invoke(
+        "_contrib_MultiBoxDetection", [cls_prob, loc_pred, anchors],
+        threshold=0.05, nms_threshold=0.5).asnumpy()[0]
+    # anchor0: class2 (id 1) p=0.7 ; anchor1: class1 (id 0) p=0.1
+    assert out[0, 0] == 1.0 and out[0, 1] == pytest.approx(0.7)
+    onp.testing.assert_allclose(out[0, 2:], [0.2, 0.2, 0.4, 0.4],
+                                atol=1e-5)
+    assert out[1, 0] == 0.0 and out[1, 1] == pytest.approx(0.1)
+
+
+def test_roi_pooling_fixture():
+    """4x4 single-channel image, one 2x2-pooled whole-image roi."""
+    img = onp.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    rois = onp.array([[0, 0, 0, 3, 3]], dtype="float32")
+    out = mx.nd.invoke("ROIPooling",
+                       [mx.nd.array(img), mx.nd.array(rois)],
+                       pooled_size=(2, 2), spatial_scale=1.0).asnumpy()
+    onp.testing.assert_array_equal(out[0, 0], [[5, 7], [13, 15]])
+
+
+def test_roi_pooling_gradient():
+    from mxnet_tpu import test_utils as tu
+
+    img = onp.random.rand(1, 2, 6, 6).astype("float32")
+    rois = onp.array([[0, 1, 1, 4, 4]], dtype="float32")
+    tu.check_numeric_gradient(
+        "ROIPooling", [img, rois], rtol=5e-2, atol=1e-2, wrt=[0],
+        pooled_size=(2, 2), spatial_scale=1.0)
+
+
+def test_roi_align_fixture():
+    img = onp.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    rois = onp.array([[0, 0, 0, 2, 2]], dtype="float32")
+    out = mx.nd.invoke("_contrib_ROIAlign",
+                       [mx.nd.array(img), mx.nd.array(rois)],
+                       pooled_size=(1, 1), spatial_scale=1.0,
+                       sample_ratio=1).asnumpy()
+    # single sample at center (1.0, 1.0) -> value 5.0
+    onp.testing.assert_allclose(out[0, 0], [[5.0]], atol=1e-5)
+
+
+def test_proposal_shapes():
+    b, a, h, w = 1, 9, 4, 4
+    cls_prob = mx.nd.array(
+        onp.random.rand(b, 2 * a, h, w).astype("float32"))
+    bbox_pred = mx.nd.array(
+        onp.random.randn(b, 4 * a, h, w).astype("float32") * 0.1)
+    im_info = mx.nd.array(onp.array([[64, 64, 1.0]], dtype="float32"))
+    rois = mx.nd.invoke("_contrib_Proposal",
+                        [cls_prob, bbox_pred, im_info],
+                        scales=(2, 4, 8), ratios=(0.5, 1, 2),
+                        rpn_post_nms_top_n=10, rpn_min_size=1)
+    assert rois.shape == (10, 5)
+    r = rois.asnumpy()
+    assert (r[:, 0] == 0).all()
+    assert (r[:, 1:] >= 0).all() and (r[:, [1, 3]] <= 63).all()
+
+
+def test_ssd_trains_and_detects():
+    """The VERDICT 'done' criterion: an SSD from the zoo runs a train
+    step (loss decreases) and NMS inference."""
+    net = gluon.model_zoo.vision.get_model("ssd_300_resnet18",
+                                           num_classes=2)
+    net.initialize(init=mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01})
+    cls_loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.nd.array(onp.random.rand(2, 3, 96, 96).astype("float32"))
+    labels = mx.nd.array(onp.array([
+        [[0, 0.1, 0.1, 0.45, 0.45]],
+        [[1, 0.5, 0.5, 0.95, 0.95]]], dtype="float32"))
+
+    losses = []
+    for _ in range(10):
+        with autograd.record():
+            cls_preds, loc_preds, anchors = net(x)
+            loc_t, loc_m, cls_t = net.training_targets(
+                anchors, cls_preds, labels)
+            lc = cls_loss(cls_preds.reshape((-1, 3)),
+                          cls_t.reshape((-1,)))
+            # ignore_label=-1 rows masked out; normalize by positives
+            keep = (cls_t.reshape((-1,)) >= 0)
+            npos = (cls_t > 0).sum() + 1e-6
+            lc = (lc * keep).sum() / npos
+            ll = (mx.nd.smooth_l1((loc_preds - loc_t) * loc_m,
+                                  scalar=1.0)).sum() / npos
+            loss = lc + ll
+        loss.backward()
+        trainer.step(2)
+        losses.append(float(loss.asnumpy()))
+    assert sum(losses[-3:]) / 3 < losses[0], losses
+
+    cls_preds, loc_preds, anchors = net(x)
+    det = net.detect(cls_preds, loc_preds, anchors)
+    assert det.shape[0] == 2 and det.shape[2] == 6
+    d = det.asnumpy()
+    kept = d[d[:, :, 0] >= 0]
+    assert (kept[:, 1] >= 0).all() and (kept[:, 1] <= 1).all()
+
+
+def test_ssd_vgg16_builds():
+    net = gluon.model_zoo.vision.ssd_300_vgg16_reduced(num_classes=4)
+    net.initialize(init=mx.init.Xavier())
+    cls_preds, loc_preds, anchors = net(mx.nd.zeros((1, 3, 128, 128)))
+    assert cls_preds.shape[0] == 1 and cls_preds.shape[2] == 5
+    assert anchors.shape[1] * 4 == loc_preds.shape[1]
+    assert cls_preds.shape[1] == anchors.shape[1]

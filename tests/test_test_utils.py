@@ -26,13 +26,16 @@ def _n(shape, scale=1.0):
     return (onp.random.randn(*shape) * scale).astype("float32")
 
 
+_spec_rng = onp.random.RandomState(42)
+
+
 def _spd(n):
-    a = onp.random.randn(n, n).astype("float32")
+    a = _spec_rng.randn(n, n).astype("float32")
     return (a @ a.T + n * onp.eye(n, dtype="float32")).astype("float32")
 
 
 def _tril(n):
-    return onp.tril(onp.random.randn(n, n).astype("float32") +
+    return onp.tril(_spec_rng.randn(n, n).astype("float32") +
                     2 * onp.eye(n, dtype="float32"))
 
 
@@ -149,6 +152,14 @@ SPECS = {
                         + onp.eye(4, dtype="float32").reshape(2, 2, 2, 2)],
                        dict(ind=2)),
     "_npi_tensorsolve": ([_spd(4).reshape(2, 2, 2, 2), _n((2, 2))], {}),
+    "ROIPooling": ([_u((1, 2, 6, 6)),
+                    onp.array([[0, 1, 1, 4, 4]], dtype="float32")],
+                   dict(pooled_size=(2, 2), spatial_scale=1.0), [0]),
+    "_contrib_ROIAlign": ([_u((1, 2, 6, 6)),
+                           onp.array([[0, 1, 1, 4, 4]],
+                                     dtype="float32")],
+                          dict(pooled_size=(2, 2), spatial_scale=1.0),
+                          [0]),
     # linalg family (SPD inputs where factorizations need them)
     "_linalg_gemm": ([_n((3, 4)), _n((4, 5)), _n((3, 5))], {}),
     "_linalg_gemm2": ([_n((3, 4)), _n((4, 5))], {}),
