@@ -17,7 +17,10 @@ import jax
 
 class _RngState(threading.local):
     def __init__(self):
-        self.key = jax.random.key(0)
+        # lazy: creating a key initializes the XLA backend, which must
+        # not happen at import time (jax.distributed.initialize has to
+        # run first in multi-host processes)
+        self.key = None
         self.trace_key = None
         self.trace_counter = 0
 
@@ -36,6 +39,8 @@ def take_key():
         k = jax.random.fold_in(_S.trace_key, _S.trace_counter)
         _S.trace_counter += 1
         return k
+    if _S.key is None:
+        _S.key = jax.random.key(0)
     _S.key, sub = jax.random.split(_S.key)
     return sub
 

@@ -31,13 +31,19 @@ __all__ = [
 
 
 def _cpu_devices():
-    return jax.devices("cpu") if jax.default_backend() != "cpu" else jax.devices()
+    # local_devices: under jax.distributed, jax.devices() spans every
+    # process and remote devices are non-addressable — eager placement
+    # must stay on this worker's own devices
+    devs = (jax.local_devices(backend="cpu")
+            if jax.default_backend() != "cpu" else jax.local_devices())
+    return devs
 
 
 def _accel_devices():
-    """All non-CPU jax devices (TPU chips); empty list on CPU-only hosts."""
+    """This process's non-CPU jax devices (TPU chips); empty on
+    CPU-only hosts."""
     try:
-        devs = jax.devices()
+        devs = jax.local_devices()
     except RuntimeError:
         return []
     return [d for d in devs if d.platform != "cpu"]

@@ -14,6 +14,7 @@ rules directly.
 from __future__ import annotations
 
 from .. import kvstore as kvs
+from .. import ndarray as nd
 from .. import optimizer as opt
 from ..base import MXNetError
 from .parameter import Parameter, ParameterDict
@@ -77,15 +78,21 @@ class Trainer:
             if update_on_kvstore is None:
                 update_on_kvstore = True
             if update_on_kvstore:
-                self._kvstore.set_optimizer(self._optimizer)
+                # share the LOCAL updater instance with the store so
+                # optimizer state lives in exactly one place
+                # (save_states/load_states stay consistent)
+                self._kvstore._set_updater(self._updaters[0])
         elif isinstance(kvstore, kvs.KVStore):
             self._kvstore = kvstore
             if update_on_kvstore:
-                self._kvstore.set_optimizer(self._optimizer)
+                self._kvstore._set_updater(self._updaters[0])
         else:
             # single-process local/device: one logical copy — no kvstore
             self._kvstore = None
             update_on_kvstore = False
+        if self._kvstore is not None and self._compression_params:
+            self._kvstore.set_gradient_compression(
+                self._compression_params)
         self._update_on_kvstore = bool(update_on_kvstore)
         self._kv_initialized = True
 
@@ -100,13 +107,36 @@ class Trainer:
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
 
+    def _kv_lazy_init(self, i, value):
+        if i not in self._kvstore._store:
+            self._kvstore.init(i, value)
+
     def allreduce_grads(self):
-        """Sum gradients across workers (reference trainer.py:334).  On a
-        single logical copy this is the identity; under jax.distributed
-        the gradients were already reduced by XLA collectives inside the
-        step program."""
+        """Sum gradients across workers (reference trainer.py:334).
+
+        With a dist kvstore and update_on_kvstore=False, gradients are
+        pushed/pulled through the store — each worker ends up holding
+        the GLOBAL gradient sum before the local update (the reference's
+        kvstore.pushpull path).  Single-process: identity."""
         if not self._kv_initialized:
             self._init_kvstore()
+        if self._kvstore is None or self._update_on_kvstore:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            g = param._data._grad
+            if g is None:
+                continue
+            # push EVERY allocated grad, fresh or stale (zeros for
+            # stale): the push sequence must be identical on every
+            # worker or the collectives deadlock/mismatch
+            self._kv_lazy_init(i, nd.zeros(g.shape, dtype=g.dtype))
+            if param._data._fresh_grad:
+                self._kvstore.push(i, g)
+            else:
+                self._kvstore.push(i, nd.zeros(g.shape, dtype=g.dtype))
+            self._kvstore.pull(i, out=g)
 
     def step(self, batch_size, ignore_stale_grad=False):
         """rescale + allreduce + update (reference trainer.py:305)."""
@@ -128,6 +158,13 @@ class Trainer:
             # AMP dynamic loss scaling: skip the whole update on overflow
             # (reference contrib/amp trainer integration + all_finite op)
             overflow = scaler.has_overflow(self._params)
+            if self._kvstore is not None and \
+                    self._kvstore.num_workers > 1:
+                # the skip decision must be GLOBAL, or workers issue
+                # mismatched collectives below and deadlock
+                flag = nd.array([1.0 if overflow else 0.0])
+                total = self._kvstore._allreduce(flag._data)
+                overflow = float(total[0]) > 0
             scaler.update_scale(overflow)
             if overflow:
                 for param in self._params:
@@ -143,9 +180,9 @@ class Trainer:
                     continue
                 raise MXNetError(
                     f"Parameter {param.name} has not been initialized")
-            if param._data._grad is None or not param._data._fresh_grad:
-                if ignore_stale_grad:
-                    continue
+            stale = (param._data._grad is None
+                     or not param._data._fresh_grad)
+            if stale and not ignore_stale_grad:
                 raise MXNetError(
                     f"Gradient of Parameter `{param.name}` on context "
                     "has not been updated by backward since last `step`. "
@@ -153,7 +190,21 @@ class Trainer:
                     "use a subset of the Parameters for the last forward "
                     "pass. Set ignore_stale_grad=True to suppress this "
                     "warning.")
-            updater(i, param._data._grad, param._data)
+            if self._update_on_kvstore and self._kvstore is not None:
+                # "server-side" update: push grad (allreduced across
+                # workers), shared updater mutates the stored weight,
+                # pull the new weight back (model.py:150 analog).
+                # Stale grads push zeros — the collective sequence must
+                # match on every worker.
+                self._kv_lazy_init(i, param._data)
+                g = param._data._grad if not stale else nd.zeros(
+                    param._data.shape, dtype=param._data.dtype)
+                self._kvstore.push(i, g)
+                self._kvstore.pull(i, out=param._data)
+            elif stale:
+                continue
+            else:
+                updater(i, param._data._grad, param._data)
             param._data._fresh_grad = False
 
     def save_states(self, fname):
@@ -181,4 +232,6 @@ class Trainer:
             updater.optimizer = self._updaters[0].optimizer
         self._optimizer = self._updaters[0].optimizer
         if self._update_on_kvstore and self._kvstore is not None:
-            self._kvstore.set_optimizer(self._optimizer)
+            # keep the ONE shared updater instance (set_optimizer would
+            # install a fresh empty-state updater and fork the state)
+            self._kvstore._set_updater(self._updaters[0])

@@ -17,6 +17,7 @@ API boundary, exactly like the reference.  Gradient compression maps to
 """
 from __future__ import annotations
 
+import os
 import pickle
 
 import jax
@@ -26,7 +27,47 @@ from . import ndarray as nd
 from .base import MXNetError
 from . import optimizer as opt
 
-__all__ = ["KVStore", "create"]
+__all__ = ["KVStore", "DistKVStore", "create", "init_distributed"]
+
+_dist_initialized = False
+
+
+def init_distributed(coordinator=None, num_workers=None, rank=None):
+    """Connect this process to the multi-host runtime.
+
+    Reads the reference's ps-lite bootstrap env vars
+    (DMLC_PS_ROOT_URI/DMLC_PS_ROOT_PORT/DMLC_NUM_WORKER/DMLC_WORKER_ID,
+    docs distributed_training.md:262-276) and wires them into
+    ``jax.distributed.initialize`` — the TPU-native replacement for the
+    ps-lite scheduler handshake.  Safe to call twice.  Launch workers
+    with ``tools/launch.py`` (reference tools/launch.py:29).
+    """
+    global _dist_initialized
+    if _dist_initialized:
+        return
+    from jax._src import distributed as _jd
+
+    if _jd.global_state.coordinator_address is not None or \
+            _jd.global_state.client is not None:
+        # user already called jax.distributed.initialize themselves
+        _dist_initialized = True
+        return
+    if num_workers is None:
+        num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    if num_workers <= 1:
+        # 1-worker no-op: do NOT latch, so a later explicit call with a
+        # real coordinator still takes effect
+        return
+    if coordinator is None:
+        uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        port = os.environ.get("DMLC_PS_ROOT_PORT", "9091")
+        coordinator = f"{uri}:{port}"
+    if rank is None:
+        rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_workers,
+                               process_id=rank)
+    _dist_initialized = True
 
 
 def _key_list(key):
@@ -100,6 +141,7 @@ class KVStore:
                 agg = agg + v._data
             if self._compression is not None:
                 agg = self._compression.compress(k, agg)
+            agg = self._reduce(k, agg)
             agg_nd = nd.NDArray(agg)
             if self._updater is not None:
                 self._updater(self._key_index(k), agg_nd, self._store[k])
@@ -107,6 +149,11 @@ class KVStore:
                 # no updater: stored value becomes the pushed aggregate
                 # (reference KVStore default-merge semantics)
                 self._store[k]._adopt(agg.astype(self._store[k]._data.dtype))
+
+    def _reduce(self, key, agg):
+        """Cross-worker reduction hook; identity for single-process
+        stores, a global allreduce in DistKVStore."""
+        return agg
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, single = _key_list(key)
@@ -182,8 +229,73 @@ class KVStore:
         pass  # no server processes in the TPU design
 
 
+class DistKVStore(KVStore):
+    """Multi-process KVStore: push/pull cross worker boundaries.
+
+    Reference parity: KVStoreDist (src/kvstore/kvstore_dist.h:44) +
+    KVStoreDistServer (kvstore_dist_server.h:155).  TPU-native: there
+    are no server processes — sync-mode aggregation ("wait for all
+    workers, merge, update", kvstore_dist_server.h:346-359) IS a global
+    allreduce over the process group, and the "server-side optimizer"
+    is the same updater run identically on every worker against the
+    replicated store.  ``dist_async`` shares this bulk-synchronous
+    engine (the stale-update PS semantics have no XLA analog; the
+    reference treats async as a throughput knob, not a contract).
+    """
+
+    def __init__(self, kv_type="dist_sync"):
+        init_distributed()
+        super().__init__(kv_type)
+        self._rank = jax.process_index()
+        self._size = jax.process_count()
+
+    @staticmethod
+    def _widen(arr):
+        # half-precision widens for the wire reduction; f32/f64/integer
+        # dtypes travel as-is (an f32 round-trip would corrupt them)
+        if arr.dtype in (jnp.float16, jnp.bfloat16):
+            return arr.astype(jnp.float32), arr.dtype
+        return arr, None
+
+    def _allreduce(self, arr):
+        if self._size == 1:
+            return arr
+        from jax.experimental import multihost_utils
+
+        a, narrow = self._widen(arr)
+        out = multihost_utils.process_allgather(a).sum(axis=0)
+        return out.astype(narrow) if narrow is not None else out
+
+    def _broadcast0(self, arr):
+        """Rank-0's value everywhere (init consistency, like the server
+        owning the initial weights)."""
+        if self._size == 1:
+            return arr
+        from jax.experimental import multihost_utils
+
+        a, narrow = self._widen(arr)
+        out = multihost_utils.broadcast_one_to_all(a)
+        return out.astype(narrow) if narrow is not None else out
+
+    def init(self, key, value):
+        keys, _ = _key_list(key)
+        super().init(key, value)
+        for k in keys:
+            self._store[k]._adopt(self._broadcast0(self._store[k]._data))
+
+    def _reduce(self, key, agg):
+        return self._allreduce(agg)  # NETWORK boundary (was ZPush/ZPull)
+
+
 def create(name="local"):
-    """Factory (reference src/kvstore/kvstore.cc:40-70)."""
+    """Factory (reference src/kvstore/kvstore.cc:40-70).
+
+    ``dist_*`` returns a DistKVStore; outside a launched job
+    (DMLC_NUM_WORKER absent/1 and jax.distributed uninitialized) it
+    degrades to a single-worker group — rank 0 of 1 — which is the
+    reference behavior for a 1-worker launch, not a silent fallback to
+    ``local`` semantics.
+    """
     if not isinstance(name, str):
         raise MXNetError("name must be a string")
     valid = ("local", "device", "local_allreduce_cpu",
@@ -191,4 +303,6 @@ def create(name="local"):
              "dist_sync_device", "dist_device_sync", "dist")
     if name not in valid:
         raise MXNetError(f"unknown KVStore type {name}")
+    if name.startswith("dist"):
+        return DistKVStore(name)
     return KVStore(name)

@@ -180,7 +180,7 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
         # commit params to the accelerator once; otherwise every step
         # re-streams them host->HBM (Context default is cpu for reference
         # parity, but the fused step must live in device memory)
-        dev = jax.devices()[0]
+        dev = jax.local_devices()[0]
         params = jax.device_put(params, dev)
 
     opt = _build_optimizer(optimizer, learning_rate, momentum, wd, beta1,

@@ -1,0 +1,107 @@
+"""Multi-worker dist_sync KVStore invariants — run under tools/launch.py.
+
+Ported from the reference's tests/nightly/dist_sync_kvstore.py:36-60:
+every worker pushes a known per-rank value; sync semantics demand that
+every worker pulls exactly the sum over workers, for several shapes and
+dtypes, across repeated rounds, with and without an updater.
+
+    python tools/launch.py -n 3 --cpu python tests/dist_sync_kvstore.py
+"""
+import os
+import sys
+
+import numpy as onp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import kvstore as kvs  # noqa: E402
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    n = kv.num_workers
+    r = kv.rank
+    expected_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    assert n == expected_workers, (n, expected_workers)
+    assert 0 <= r < n
+
+    shapes = {"3": (3, 3), "big": (128, 96), "vec": (7,)}
+    # --- init consistency: rank-0's init value wins everywhere
+    for k, shape in shapes.items():
+        kv.init(k, mx.nd.full(shape, float(r + 1)))
+    kv.barrier()
+    out = mx.nd.zeros(shapes["3"])
+    kv.pull("3", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.ones(shapes["3"]),
+                                err_msg="init must broadcast rank-0")
+
+    # --- sync push/pull invariant over several rounds
+    total = n * (n + 1) / 2  # sum over ranks of (rank+1)
+    for rnd in range(3):
+        for k, shape in shapes.items():
+            kv.push(k, mx.nd.full(shape, float(r + 1)))
+        kv.barrier()
+        for k, shape in shapes.items():
+            out = mx.nd.zeros(shape)
+            kv.pull(k, out=out)
+            onp.testing.assert_allclose(
+                out.asnumpy(), onp.full(shape, total),
+                err_msg=f"round {rnd} key {k}")
+        kv.barrier()
+
+    # --- pushpull fused
+    kv.init("pp", mx.nd.zeros((4, 4)))
+    out = mx.nd.zeros((4, 4))
+    kv.pushpull("pp", mx.nd.full((4, 4), float(r + 1)), out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((4, 4), total))
+
+    # --- fp16 path (reference tests fp16 keys crossing bigarray_bound)
+    kv.init("h", mx.nd.zeros((64, 65)).astype("float16"))
+    kv.push("h", mx.nd.full((64, 65), float(r + 1)).astype("float16"))
+    kv.barrier()
+    out = mx.nd.zeros((64, 65)).astype("float16")
+    kv.pull("h", out=out)
+    onp.testing.assert_allclose(out.asnumpy().astype("float32"),
+                                onp.full((64, 65), total), rtol=1e-3)
+
+    # --- multi-device push: per-worker list aggregates locally first
+    kv.init("md", mx.nd.zeros((5,)))
+    kv.push("md", [mx.nd.ones((5,)), mx.nd.ones((5,))])
+    kv.barrier()
+    out = mx.nd.zeros((5,))
+    kv.pull("md", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((5,), 2.0 * n))
+
+    # --- updater path: the "server-side optimizer" runs identically on
+    # every worker (kvstore_dist_server.h:346 ApplyUpdates analog)
+    kv2_updates = []
+
+    def upd(key, grad, stored):
+        kv2_updates.append(key)
+        stored._adopt(stored._data + 0.5 * grad._data)
+
+    kv._set_updater(upd)
+    kv.init("u", mx.nd.zeros((2, 2)))
+    kv.push("u", mx.nd.ones((2, 2)))
+    kv.barrier()
+    out = mx.nd.zeros((2, 2))
+    kv.pull("u", out=out)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                onp.full((2, 2), 0.5 * n))
+
+    # --- gradient compression: quantized to {-t, 0, t} before reduce
+    kvc = kvs.create("dist_sync")
+    kvc.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kvc.init("c", mx.nd.zeros((4,)))
+    kvc.push("c", mx.nd.full((4,), 10.0))
+    kvc.barrier()
+    out = mx.nd.zeros((4,))
+    kvc.pull("c", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((4,), 0.5 * n))
+
+    print(f"[worker {r}] dist_sync_kvstore OK ({n} workers)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

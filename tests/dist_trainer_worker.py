@@ -1,0 +1,68 @@
+"""Gluon Trainer over dist_sync — run under tools/launch.py.
+
+Each worker trains on its OWN data shard; the dist kvstore allreduces
+updates so all workers hold identical weights (the reference's
+convergence-parity contract, example/image-classification/README.md:
+326-330).  Exercises both update_on_kvstore regimes.
+"""
+import os
+import sys
+
+import numpy as onp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+
+
+def run(update_on_kvstore):
+    kv = mx.kv.create("dist_sync")
+    r, n = kv.rank, kv.num_workers
+    onp.random.seed(123)  # same data pool on every worker
+    X = onp.random.rand(32 * n, 8).astype("float32")
+    W_true = onp.random.rand(8, 1).astype("float32")
+    Y = X @ W_true
+
+    net = gluon.nn.Dense(1)
+    net.initialize(init=mx.init.Constant(0.1) if hasattr(mx.init, "Constant")
+                   else mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05}, kvstore=kv,
+                            update_on_kvstore=update_on_kvstore)
+    loss_fn = gluon.loss.L2Loss()
+    # per-worker shard
+    xs = mx.nd.array(X[r * 32:(r + 1) * 32])
+    ys = mx.nd.array(Y[r * 32:(r + 1) * 32])
+    losses = []
+    for _ in range(20):
+        with autograd.record():
+            loss = loss_fn(net(xs), ys)
+        loss.backward()
+        trainer.step(32 * n)  # global batch: grads are summed over workers
+        losses.append(float(loss.mean().asnumpy()))
+    assert losses[-1] < losses[0], losses
+
+    # weights identical on every worker (sync contract)
+    from jax.experimental import multihost_utils
+
+    w = net.weight.data()._data
+    allw = multihost_utils.process_allgather(w)
+    for i in range(1, n):
+        onp.testing.assert_allclose(onp.asarray(allw[0]),
+                                    onp.asarray(allw[i]), rtol=1e-6,
+                                    err_msg=f"worker {i} diverged "
+                                            f"(update_on_kvstore="
+                                            f"{update_on_kvstore})")
+    return losses[-1]
+
+
+def main():
+    run(update_on_kvstore=True)
+    run(update_on_kvstore=False)
+    kv = mx.kv.create("dist_sync")
+    print(f"[worker {kv.rank}] dist trainer OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Multi-process distributed tests: spawn real worker processes on one
+host (the reference CI pattern: tools/launch.py -n N --launcher local,
+ci/docker/runtime_functions.sh:1367-1374)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _launch(n, script, timeout=600):
+    env = dict(os.environ)
+    # children must pick their own backend; drop the pytest CPU-mesh
+    # forcing so the launcher controls it
+    env.pop("XLA_FLAGS", None)
+    cmd = [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+           "-n", str(n), "--cpu", sys.executable,
+           os.path.join(_REPO, "tests", script)]
+    return subprocess.run(cmd, env=env, cwd=_REPO, timeout=timeout,
+                          capture_output=True, text=True)
+
+
+@pytest.mark.parametrize("n", [3])
+def test_dist_sync_kvstore_multiprocess(n):
+    res = _launch(n, "dist_sync_kvstore.py")
+    sys.stdout.write(res.stdout[-2000:])
+    sys.stderr.write(res.stderr[-4000:])
+    assert res.returncode == 0
+    for r in range(n):
+        assert f"[worker {r}] dist_sync_kvstore OK" in res.stdout
+
+
+def test_dist_trainer_multiprocess():
+    res = _launch(2, "dist_trainer_worker.py")
+    sys.stdout.write(res.stdout[-2000:])
+    sys.stderr.write(res.stderr[-4000:])
+    assert res.returncode == 0
+    for r in range(2):
+        assert f"[worker {r}] dist trainer OK" in res.stdout
+
+
+def test_dist_sync_single_process_degrades_to_one_worker_group():
+    """Outside a launched job, dist_sync is a 1-worker group (not local
+    silently): rank/size are real and push/pull still allreduce."""
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("dist_sync")
+    assert kv.num_workers == 1 and kv.rank == 0
+    import numpy as onp
+
+    kv.init("w", mx.nd.ones((2,)))
+    kv.push("w", mx.nd.full((2,), 3.0))
+    out = mx.nd.zeros((2,))
+    kv.pull("w", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), [3.0, 3.0])

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Distributed job launcher (reference: tools/launch.py:29,71 — the
+dmlc-core tracker driving ssh/mpi/yarn/sge/local process groups).
+
+TPU-native: workers connect to each other through jax.distributed (a
+gRPC coordinator on worker 0) instead of a ps-lite scheduler, so the
+launcher only has to start N processes with the right DMLC_* env vars —
+the same contract the reference bootstraps from
+(docs distributed_training.md:262-276).
+
+Local mode (the reference's `--launcher local`, used by CI to test
+dist_sync without a cluster, ci/docker/runtime_functions.sh:1367-1374):
+
+    python tools/launch.py -n 4 python train.py ...
+
+--cpu forces the workers onto the CPU backend with a virtual device
+each — the way to exercise multi-worker semantics on one host (the
+driver's 8-device CPU mesh pattern).  ssh/mpi launchers for real pods
+are intentionally thin wrappers users drive through their own schedulers.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="launch a local multi-worker mxnet_tpu job")
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--launcher", default="local", choices=["local"])
+    ap.add_argument("--cpu", action="store_true",
+                    help="force workers onto the CPU backend (local "
+                         "multi-process testing)")
+    ap.add_argument("--env", action="append", default=[],
+                    help="extra KEY=VALUE for workers")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+
+    port = _free_port()
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env.update({
+            "DMLC_ROLE": "worker",
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+            "DMLC_NUM_WORKER": str(args.num_workers),
+            "DMLC_NUM_SERVER": "0",
+            "DMLC_WORKER_ID": str(rank),
+        })
+        if args.cpu:
+            env["JAX_PLATFORMS"] = "cpu"
+            # the accelerator plugin registers at interpreter start and
+            # would pre-initialize the backend, breaking
+            # jax.distributed.initialize in the workers
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+        for kv in args.env:
+            k, _, v = kv.partition("=")
+            env[k] = v
+        procs.append(subprocess.Popen(args.command, env=env))
+
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
