@@ -1,2 +1,3 @@
 """Data iterators (reference: python/mxnet/io/)."""
 from .io import *  # noqa: F401,F403
+from .image_record_iter import ImageRecordIter  # noqa: F401

@@ -1,0 +1,250 @@
+// Native data-plane hot path: RecordIO parsing + threaded JPEG decode +
+// augment + batch assembly.
+//
+// Reference parity: src/io/iter_image_recordio_2.cc:880 (threaded
+// record->decode->augment->batch pipeline) + image_aug_default.cc
+// (crop/resize/mirror chain) + dmlc recordio framing.  The reference
+// runs this in C++ worker threads because Python cannot feed GPUs; the
+// same holds for TPU hosts, so the decode loop lives here and Python
+// drives it through ctypes (the GIL is released for the whole batch).
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 recordio_native.cc -o
+//        librecordio_native.so -ljpeg -lpthread
+#include <cstddef>
+#include <cstdio>
+
+#include <jpeglib.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <csetjmp>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;  // dmlc recordio magic
+
+inline uint32_t DecodeLFlag(uint32_t rec) { return (rec >> 29U) & 7U; }
+inline uint32_t DecodeLength(uint32_t rec) {
+  return rec & ((1U << 29U) - 1U);
+}
+
+struct JerrMgr {
+  jpeg_error_mgr pub;
+  jmp_buf setjmp_buffer;
+};
+
+void JerrExit(j_common_ptr cinfo) {
+  JerrMgr* err = reinterpret_cast<JerrMgr*>(cinfo->err);
+  longjmp(err->setjmp_buffer, 1);
+}
+
+// decode one JPEG into rgb (h*w*3); returns 0 on success
+int DecodeJpeg(const uint8_t* data, int64_t len, std::vector<uint8_t>* out,
+               int* h, int* w) {
+  jpeg_decompress_struct cinfo;
+  JerrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = JerrExit;
+  if (setjmp(jerr.setjmp_buffer)) {
+    jpeg_destroy_decompress(&cinfo);
+    return 1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(data),
+               static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *h = cinfo.output_height;
+  *w = cinfo.output_width;
+  out->resize(static_cast<size_t>(*h) * *w * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out->data() +
+                   static_cast<size_t>(cinfo.output_scanline) * *w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+// bilinear resize rgb (sh, sw) -> (dh, dw); int64 pixel indexing —
+// legal JPEG dims reach 65535 and h*w*3 overflows 32-bit int
+void ResizeBilinear(const uint8_t* src, int sh, int sw, uint8_t* dst,
+                    int dh, int dw) {
+  const float sy = dh > 1 ? float(sh - 1) / (dh - 1) : 0.f;
+  const float sx = dw > 1 ? float(sw - 1) / (dw - 1) : 0.f;
+  const int64_t ssw = sw, sdw = dw;
+  for (int y = 0; y < dh; ++y) {
+    float fy = y * sy;
+    int64_t y0 = static_cast<int64_t>(fy);
+    int64_t y1 = std::min<int64_t>(y0 + 1, sh - 1);
+    float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = x * sx;
+      int64_t x0 = static_cast<int64_t>(fx);
+      int64_t x1 = std::min<int64_t>(x0 + 1, sw - 1);
+      float wx = fx - x0;
+      for (int c = 0; c < 3; ++c) {
+        float v00 = src[(y0 * ssw + x0) * 3 + c];
+        float v01 = src[(y0 * ssw + x1) * 3 + c];
+        float v10 = src[(y1 * ssw + x0) * 3 + c];
+        float v11 = src[(y1 * ssw + x1) * 3 + c];
+        float v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                  v10 * wy * (1 - wx) + v11 * wy * wx;
+        dst[(static_cast<int64_t>(y) * sdw + x) * 3 + c] =
+            static_cast<uint8_t>(v + 0.5f);
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse dmlc recordio framing: fills offsets/sizes (payload only, with
+// continuation parts merged logically impossible without copy — this
+// returns per-part extents; python merges rare multi-part records).
+// Returns number of records, or -1 on framing error.
+int64_t rec_parse(const uint8_t* buf, int64_t len, int64_t* offsets,
+                  int64_t* sizes, uint32_t* lflags, int64_t max_records) {
+  int64_t pos = 0;
+  int64_t n = 0;
+  while (pos + 8 <= len && n < max_records) {
+    uint32_t magic;
+    std::memcpy(&magic, buf + pos, 4);
+    if (magic != kMagic) return -1;
+    uint32_t lrec;
+    std::memcpy(&lrec, buf + pos + 4, 4);
+    uint32_t l = DecodeLength(lrec);
+    offsets[n] = pos + 8;
+    sizes[n] = l;
+    lflags[n] = DecodeLFlag(lrec);
+    ++n;
+    int64_t upsize = ((l + 3U) >> 2U) << 2U;
+    pos += 8 + upsize;
+  }
+  return n;
+}
+
+// Decode + augment one batch of JPEGs in parallel.
+//  jpegs: concatenated jpeg bytes; joff/jlen: per-image extents (n)
+//  out: float32 batch buffer (n, 3, H, W) NCHW, normalized with
+//       mean/std per channel; rand_* arrays drive augmentation:
+//  crop_x/crop_y in [0,1] relative crop origin, mirror in {0,1},
+//  resize_short: if > 0, resize shorter side to it before cropping.
+// Returns count of failed decodes (their slots are zero-filled).
+int64_t decode_augment_batch(
+    const uint8_t* jpegs, const int64_t* joff, const int64_t* jlen,
+    int64_t n, float* out, int64_t out_h, int64_t out_w,
+    const float* mean, const float* std_, const float* crop_x,
+    const float* crop_y, const uint8_t* mirror, int resize_short,
+    int num_threads) {
+  std::atomic<int64_t> fail{0};
+  std::atomic<int64_t> next{0};
+  int nt = num_threads > 0
+               ? num_threads
+               : std::max(1U, std::thread::hardware_concurrency());
+  auto worker = [&]() {
+    std::vector<uint8_t> rgb, resized, cropped;
+    for (;;) {
+      int64_t i = next.fetch_add(1);
+      if (i >= n) break;
+      int h = 0, w = 0;
+      float* dst = out + i * 3 * out_h * out_w;
+      if (DecodeJpeg(jpegs + joff[i], jlen[i], &rgb, &h, &w) != 0) {
+        std::memset(dst, 0, sizeof(float) * 3 * out_h * out_w);
+        fail.fetch_add(1);
+        continue;
+      }
+      const uint8_t* cur = rgb.data();
+      if (resize_short > 0) {
+        int nh, nw;
+        if (h < w) {
+          nh = resize_short;
+          nw = static_cast<int>(1.0 * w * resize_short / h + 0.5);
+        } else {
+          nw = resize_short;
+          nh = static_cast<int>(1.0 * h * resize_short / w + 0.5);
+        }
+        resized.resize(static_cast<size_t>(nh) * nw * 3);
+        ResizeBilinear(cur, h, w, resized.data(), nh, nw);
+        cur = resized.data();
+        h = nh;
+        w = nw;
+      }
+      // crop to (out_h, out_w) at relative origin; if the image is
+      // smaller, bilinear-resize the full frame instead
+      if (h >= out_h && w >= out_w) {
+        int x0 = static_cast<int>(crop_x[i] * (w - out_w));
+        int y0 = static_cast<int>(crop_y[i] * (h - out_h));
+        cropped.resize(static_cast<size_t>(out_h) * out_w * 3);
+        for (int y = 0; y < out_h; ++y) {
+          std::memcpy(cropped.data() + static_cast<size_t>(y) * out_w * 3,
+                      cur + ((y0 + y) * static_cast<int64_t>(w) + x0) * 3,
+                      static_cast<size_t>(out_w) * 3);
+        }
+        cur = cropped.data();
+      } else {
+        cropped.resize(static_cast<size_t>(out_h) * out_w * 3);
+        ResizeBilinear(cur, h, w, cropped.data(), out_h, out_w);
+        cur = cropped.data();
+      }
+      // HWC uint8 -> NCHW float32 normalized (+ optional mirror)
+      for (int c = 0; c < 3; ++c) {
+        float m = mean ? mean[c] : 0.f;
+        float s = std_ ? std_[c] : 1.f;
+        float* plane = dst + static_cast<int64_t>(c) * out_h * out_w;
+        for (int y = 0; y < out_h; ++y) {
+          for (int x = 0; x < out_w; ++x) {
+            int sx = mirror && mirror[i] ? (out_w - 1 - x) : x;
+            plane[y * out_w + x] =
+                (cur[(y * out_w + sx) * 3 + c] - m) / s;
+          }
+        }
+      }
+    }
+  };
+  std::vector<std::thread> threads;
+  for (int t = 0; t < nt; ++t) threads.emplace_back(worker);
+  for (auto& th : threads) th.join();
+  return fail.load();
+}
+
+// plain decode of one jpeg into caller buffer (h*w*3, caller queried
+// size via rec_jpeg_size)
+int rec_jpeg_size(const uint8_t* data, int64_t len, int* h, int* w) {
+  jpeg_decompress_struct cinfo;
+  JerrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = JerrExit;
+  if (setjmp(jerr.setjmp_buffer)) {
+    jpeg_destroy_decompress(&cinfo);
+    return 1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(data),
+               static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  *h = cinfo.image_height;
+  *w = cinfo.image_width;
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+int rec_jpeg_decode(const uint8_t* data, int64_t len, uint8_t* out,
+                    int h, int w) {
+  std::vector<uint8_t> rgb;
+  int dh = 0, dw = 0;
+  if (DecodeJpeg(data, len, &rgb, &dh, &dw) != 0) return 1;
+  if (dh != h || dw != w) return 2;
+  std::memcpy(out, rgb.data(), rgb.size());
+  return 0;
+}
+
+}  // extern "C"

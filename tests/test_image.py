@@ -1,0 +1,261 @@
+"""mx.image + ImageRecordIter + im2rec tests (reference:
+tests/python/unittest/test_image.py + test_io.py ImageRecordIter)."""
+import io as _io
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+from PIL import Image
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as img_mod
+from mxnet_tpu import recordio
+
+onp.random.seed(21)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _jpeg_bytes(h=64, w=48, value=None):
+    arr = (onp.random.rand(h, w, 3) * 255).astype("uint8") \
+        if value is None else onp.full((h, w, 3), value, "uint8")
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, "JPEG", quality=95)
+    return buf.getvalue(), arr
+
+
+def _make_rec(path, n=24, h=64, w=48):
+    rec = recordio.MXRecordIO(path, "w")
+    for i in range(n):
+        jpg, _ = _jpeg_bytes(h, w)
+        header = recordio.IRHeader(0, float(i % 5), i, 0)
+        rec.write(recordio.pack(header, jpg))
+    rec.close()
+
+
+def test_imdecode_imread_roundtrip(tmp_path):
+    jpg, arr = _jpeg_bytes(32, 32, value=128)
+    img = img_mod.imdecode(jpg)
+    assert img.shape == (32, 32, 3) and img.dtype == onp.uint8
+    onp.testing.assert_allclose(img.asnumpy(), arr, atol=3)
+    p = str(tmp_path / "a.jpg")
+    with open(p, "wb") as f:
+        f.write(jpg)
+    img2 = img_mod.imread(p)
+    onp.testing.assert_array_equal(img.asnumpy(), img2.asnumpy())
+
+
+def test_resize_and_crops():
+    jpg, _ = _jpeg_bytes(60, 40)
+    img = img_mod.imdecode(jpg)
+    r = img_mod.imresize(img, 20, 30)
+    assert r.shape == (30, 20, 3)
+    rs = img_mod.resize_short(img, 30)
+    assert min(rs.shape[:2]) == 30
+    c, rect = img_mod.center_crop(img, (32, 32))
+    assert c.shape == (32, 32, 3)
+    c2, rect2 = img_mod.random_crop(img, (32, 32))
+    assert c2.shape == (32, 32, 3)
+    c3, _ = img_mod.random_size_crop(img, (24, 24), (0.5, 1.0),
+                                     (0.8, 1.25))
+    assert c3.shape == (24, 24, 3)
+
+
+def test_color_normalize_and_augmenters():
+    jpg, _ = _jpeg_bytes(40, 40)
+    img = img_mod.imdecode(jpg)
+    normed = img_mod.color_normalize(
+        img.astype("float32"),
+        onp.array([123.0, 117.0, 104.0], "float32"),
+        onp.array([58.0, 57.0, 57.0], "float32"))
+    assert abs(float(normed.asnumpy().mean())) < 3
+    for aug in [img_mod.HorizontalFlipAug(1.0),
+                img_mod.BrightnessJitterAug(0.3),
+                img_mod.ContrastJitterAug(0.3),
+                img_mod.SaturationJitterAug(0.3),
+                img_mod.HueJitterAug(0.1),
+                img_mod.RandomGrayAug(1.0),
+                img_mod.LightingAug(0.1, onp.ones(3), onp.eye(3))]:
+        out = aug(img.astype("float32"))
+        assert out.shape == img.shape
+
+
+def test_create_augmenter_chain():
+    augs = img_mod.CreateAugmenter((3, 32, 32), resize=36, rand_crop=True,
+                                   rand_mirror=True, mean=True, std=True,
+                                   brightness=0.1, pca_noise=0.05)
+    jpg, _ = _jpeg_bytes(50, 70)
+    img = img_mod.imdecode(jpg)
+    for aug in augs:
+        img = aug(img)
+    assert img.shape == (32, 32, 3)
+    assert abs(float(img.asnumpy().mean())) < 3  # normalized
+
+
+def test_image_iter_from_rec(tmp_path):
+    rec = str(tmp_path / "data.rec")
+    _make_rec(rec, n=10)
+    it = img_mod.ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                           path_imgrec=rec, shuffle=True)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 32, 32)
+    assert batch.label[0].shape == (4,)
+    n = 1 + sum(1 for _ in it)
+    assert n == 3  # 10 imgs / bs 4 -> 3 batches (last padded)
+
+
+def test_image_record_iter_native(tmp_path):
+    rec = str(tmp_path / "train.rec")
+    _make_rec(rec, n=32, h=70, w=90)
+    it = mx.io.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, 48, 48), batch_size=8,
+        shuffle=True, rand_crop=True, rand_mirror=True, resize=56,
+        mean_r=123.0, mean_g=117.0, mean_b=104.0,
+        std_r=58.0, std_g=57.0, std_b=57.0, preprocess_threads=2,
+        seed=1)
+    batches = list(it)
+    assert len(batches) == 4
+    b = batches[0]
+    assert b.data[0].shape == (8, 3, 48, 48)
+    assert b.label[0].shape == (8,)
+    arr = b.data[0].asnumpy()
+    assert abs(arr.mean()) < 2.0  # normalized
+    assert onp.isfinite(arr).all()
+    # reset reproduces the epoch (same seed ordering state advances)
+    it.reset()
+    b2 = it.next()
+    assert b2.data[0].shape == (8, 3, 48, 48)
+    it.close()
+
+
+def test_image_record_iter_sharding(tmp_path):
+    rec = str(tmp_path / "s.rec")
+    _make_rec(rec, n=20)
+    labels = []
+    for part in range(2):
+        it = mx.io.ImageRecordIter(
+            path_imgrec=rec, data_shape=(3, 32, 32), batch_size=5,
+            part_index=part, num_parts=2)
+        for b in it:
+            labels.extend(b.label[0].asnumpy().tolist())
+        it.close()
+    assert len(labels) == 20  # both shards cover all records
+
+
+def test_native_parser_matches_python(tmp_path):
+    from mxnet_tpu import _native
+
+    if _native.get_lib() is None:
+        pytest.skip("native lib unavailable")
+    rec = str(tmp_path / "p.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    payloads = [os.urandom(l) for l in (1, 7, 64, 1000)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    with open(rec, "rb") as f:
+        buf = f.read()
+    recs = _native.parse_records(buf)
+    assert [bytes(r) for r in recs] == payloads
+
+
+def test_im2rec_cli(tmp_path):
+    # build a tiny image-folder dataset
+    for cls in ("cat", "dog"):
+        d = tmp_path / "imgs" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            jpg, _ = _jpeg_bytes(40, 40)
+            (d / f"{i}.jpg").write_bytes(jpg)
+    prefix = str(tmp_path / "ds")
+    res = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "im2rec.py"),
+         prefix, str(tmp_path / "imgs"), "--no-shuffle"],
+        capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    assert os.path.exists(prefix + ".rec")
+    assert os.path.exists(prefix + ".idx")
+    it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 32, 32), batch_size=6)
+    b = it.next()
+    assert b.data[0].shape == (6, 3, 32, 32)
+    labs = sorted(b.label[0].asnumpy().tolist())
+    assert labs == [0, 0, 0, 1, 1, 1]
+    it.close()
+
+
+def test_pipeline_throughput_smoke(tmp_path):
+    """The decode+augment pipeline clears a minimal throughput bar on
+    synthetic data (full-rate benchmark: benchmark/bench_image_pipeline)."""
+    import time
+
+    rec = str(tmp_path / "tp.rec")
+    _make_rec(rec, n=64, h=256, w=256)
+    it = mx.io.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, 224, 224), batch_size=32,
+        rand_crop=True, rand_mirror=True, preprocess_threads=4)
+    t0 = time.perf_counter()
+    n = 0
+    for b in it:
+        n += b.data[0].shape[0] - b.pad
+    dt = time.perf_counter() - t0
+    assert n == 64
+    from mxnet_tpu import _native
+
+    if _native.get_lib() is not None:  # rate bound only on the C++ path
+        assert n / dt > 50, f"pipeline too slow: {n / dt:.0f} img/s"
+    it.close()
+
+
+def test_multipart_record_roundtrip(tmp_path):
+    """Payloads containing the framing magic must survive both parsers
+    (the writer splits them into cflag 1/2/3 parts, stripping magic)."""
+    import struct
+
+    from mxnet_tpu import _native
+    from mxnet_tpu.io.image_record_iter import ImageRecordIter
+
+    magic = struct.pack("<I", 0xCED7230A)
+    payload = b"head" + magic + b"mid" + magic + b"tail"
+    rec = str(tmp_path / "m.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    w.write(payload)
+    w.write(b"plain")
+    w.close()
+    # reference reader
+    r = recordio.MXRecordIO(rec, "r")
+    assert r.read() == payload and r.read() == b"plain"
+    r.close()
+    with open(rec, "rb") as f:
+        buf = f.read()
+    if _native.get_lib() is not None:
+        recs = _native.parse_records(buf)
+        assert [bytes(x) for x in recs] == [payload, b"plain"]
+    # pure-python fallback parser
+    it = object.__new__(ImageRecordIter)
+    import mmap as _mmap
+
+    it._file = open(rec, "rb")
+    it._mm = _mmap.mmap(it._file.fileno(), 0, access=_mmap.ACCESS_READ)
+    recs = [bytes(x) for x in it._parse_python()]
+    assert recs == [payload, b"plain"]
+    it._mm.close()
+    it._file.close()
+
+
+def test_round_batch_false_partial_batch(tmp_path):
+    rec = str(tmp_path / "rb.rec")
+    _make_rec(rec, n=10)
+    it = mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(3, 32, 32),
+                               batch_size=4, round_batch=False)
+    batches = list(it)
+    assert [b.data[0].shape[0] for b in batches] == [4, 4, 2]
+    assert [b.label[0].shape[0] for b in batches] == [4, 4, 2]
+    assert all(b.pad == 0 for b in batches)
+    # exhausted iterator raises instead of hanging
+    import pytest as _pytest
+
+    with _pytest.raises(StopIteration):
+        it.next()
+    it.close()

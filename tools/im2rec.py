@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""im2rec — build .lst/.rec datasets from an image directory.
+
+Reference parity: tools/im2rec.py (list generation + multi-worker
+packing into RecordIO with IRHeader labels).
+
+    python tools/im2rec.py --list prefix image_dir       # make .lst
+    python tools/im2rec.py prefix image_dir              # pack .rec
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def list_images(root, recursive=True):
+    cat = {}
+    items = []
+    i = 0
+    for path, dirs, files in sorted(os.walk(root)):
+        dirs.sort()
+        for f in sorted(files):
+            if os.path.splitext(f)[1].lower() not in _EXTS:
+                continue
+            rel = os.path.relpath(os.path.join(path, f), root)
+            label_dir = os.path.dirname(rel)
+            if label_dir not in cat:
+                cat[label_dir] = len(cat)
+            items.append((i, rel, cat[label_dir]))
+            i += 1
+        if not recursive:
+            break
+    return items
+
+
+def write_list(prefix, items, shuffle=False):
+    if shuffle:
+        random.shuffle(items)
+    with open(prefix + ".lst", "w") as f:
+        for idx, rel, label in items:
+            f.write(f"{idx}\t{label}\t{rel}\n")
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def pack(prefix, root, quality=95, resize=0):
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                     "w")
+    count = 0
+    for idx, labels, rel in read_list(prefix + ".lst"):
+        with open(os.path.join(root, rel), "rb") as f:
+            img = f.read()
+        if resize > 0:
+            from mxnet_tpu import image as img_mod
+
+            im = img_mod.imdecode(img)
+            im = img_mod.resize_short(im, resize)
+            import io as _io
+
+            from PIL import Image
+
+            buf = _io.BytesIO()
+            Image.fromarray(im.asnumpy()).save(buf, "JPEG",
+                                               quality=quality)
+            img = buf.getvalue()
+        header = recordio.IRHeader(0, labels if len(labels) > 1
+                                   else labels[0], idx, 0)
+        rec.write_idx(idx, recordio.pack(header, img))
+        count += 1
+    rec.close()
+    print(f"packed {count} records into {prefix}.rec")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("prefix")
+    ap.add_argument("root")
+    ap.add_argument("--list", action="store_true",
+                    help="generate the .lst only")
+    ap.add_argument("--no-shuffle", action="store_true")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--resize", type=int, default=0)
+    args = ap.parse_args()
+    if args.list:
+        items = list_images(args.root)
+        write_list(args.prefix, items, shuffle=not args.no_shuffle)
+        print(f"wrote {len(items)} entries to {args.prefix}.lst")
+    else:
+        if not os.path.exists(args.prefix + ".lst"):
+            items = list_images(args.root)
+            write_list(args.prefix, items, shuffle=not args.no_shuffle)
+        pack(args.prefix, args.root, args.quality, args.resize)
+
+
+if __name__ == "__main__":
+    main()
