@@ -94,6 +94,9 @@ def parse_records(buf):
                       _ptr(lflags, ctypes.c_uint32), max_records)
     if n < 0:
         raise IOError("invalid recordio framing")
+    if n > 0 and int(offsets[n - 1] + sizes[n - 1]) > len(arr):
+        raise IOError(
+            "truncated recordio buffer: last record extends past EOF")
     records = []
     i = 0
     mv = memoryview(buf)

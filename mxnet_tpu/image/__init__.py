@@ -439,18 +439,36 @@ class ImageIter(DataIter):
         self.label_width = label_width
         self.dtype = dtype
         self._shuffle = shuffle
-        self._records = []  # list of (label_array, jpeg_bytes | path)
+        if last_batch_handle not in ("pad", "discard"):
+            raise MXNetError(
+                f"last_batch_handle={last_batch_handle!r} not supported "
+                "(pad | discard)")
+        self._last_batch_handle = last_batch_handle
+        self._records = []  # list of (label_array|None, payload | path)
+        self._mm = None
         if path_imgrec:
-            rec = recordio.MXRecordIO(path_imgrec, "r")
-            while True:
-                s = rec.read()
-                if s is None:
-                    break
-                header, img = recordio.unpack(s)
-                label = onp.atleast_1d(onp.asarray(header.label,
-                                                   "float32"))
-                self._records.append((label, img))
-            rec.close()
+            # mmap + frame once: records are memoryviews into the file
+            # (no up-front copy of a possibly-huge .rec); labels are
+            # unpacked lazily per sample
+            import mmap as _mmap
+
+            from .. import _native
+
+            self._rec_file = open(path_imgrec, "rb")
+            self._mm = _mmap.mmap(self._rec_file.fileno(), 0,
+                                  access=_mmap.ACCESS_READ)
+            if _native.get_lib() is not None:
+                payloads = _native.parse_records(self._mm)
+            else:
+                reader = recordio.MXRecordIO(path_imgrec, "r")
+                payloads = []
+                while True:
+                    s = reader.read()
+                    if s is None:
+                        break
+                    payloads.append(s)
+                reader.close()
+            self._records = [(None, p) for p in payloads]
         elif path_imglist:
             with open(path_imglist) as f:
                 for line in f:
@@ -495,7 +513,13 @@ class ImageIter(DataIter):
         label, src = self._records[self._order[self._cursor]]
         self._cursor += 1
         if isinstance(src, (bytes, memoryview)):
-            img = imdecode(src)
+            if label is None:  # .rec payload: unpack header lazily
+                header, img_bytes = recordio.unpack(bytes(src))
+                label = onp.atleast_1d(onp.asarray(header.label,
+                                                   "float32"))
+                img = imdecode(img_bytes)
+            else:
+                img = imdecode(src)
         else:
             img = imread(src)
         return label, img
@@ -518,7 +542,8 @@ class ImageIter(DataIter):
                 labels[i, :len(label)] = label[:self.label_width]
                 i += 1
         except StopIteration:
-            if i == 0:
+            if i == 0 or (i < self.batch_size
+                          and self._last_batch_handle == "discard"):
                 raise
         pad = self.batch_size - i
         data = nd.array(batch.transpose(0, 3, 1, 2))  # NCHW

@@ -17,9 +17,7 @@ without the native lib.
 from __future__ import annotations
 
 import mmap
-import os
 import queue
-import struct
 import threading
 
 import numpy as onp
@@ -87,33 +85,29 @@ class ImageRecordIter(DataIter):
         self.reset()
 
     def _parse_python(self):
+        # pure-python fallback: ONE source of framing truth —
+        # MXRecordIO.read (continuation reassembly, truncation checks)
         records = []
-        mv = memoryview(self._mm)
-        magic_bytes = struct.pack("<I", 0xCED7230A)
-        pos = 0
-        n = len(self._mm)
-        parts = None  # open multi-part record
-        while pos + 8 <= n:
-            magic, lrec = struct.unpack_from("<II", self._mm, pos)
-            if magic != 0xCED7230A:
-                raise IOError("invalid recordio framing")
-            cflag = (lrec >> 29) & 0x7
-            length = lrec & ((1 << 29) - 1)
-            payload = mv[pos + 8:pos + 8 + length]
-            pos += 8 + ((length + 3) >> 2 << 2)
-            if cflag == 0:
-                records.append(payload)
-            elif cflag == 1:  # start of a split record
-                parts = [bytes(payload)]
-            else:  # 2 = middle, 3 = end: rejoin with the stripped magic
-                parts.append(bytes(payload))
-                if cflag == 3:
-                    records.append(memoryview(magic_bytes.join(parts)))
-                    parts = None
+        reader = recordio.MXRecordIO(self._file.name, "r")
+        try:
+            while True:
+                rec = reader.read()
+                if rec is None:
+                    break
+                records.append(memoryview(rec))
+        finally:
+            reader.close()
         return records
 
     # ----------------------------------------------------------- pipeline
     def _producer(self):
+        try:
+            self._producer_impl()
+        except Exception as exc:  # surface in next(), don't hang it
+            if not self._stop.is_set():
+                self._queue.put(("error", exc))
+
+    def _producer_impl(self):
         bs = self.batch_size
         c, h, w = self.data_shape
         order = self._order
@@ -125,8 +119,9 @@ class ImageRecordIter(DataIter):
             i += take
             pad = bs - take
             if pad and self._round_batch:
-                # wrap around to fill, report pad (reference round_batch)
-                idx = onp.concatenate([idx, order[:pad]])
+                # wrap around to fill, report pad; onp.resize cycles
+                # when the dataset/shard is smaller than a batch
+                idx = onp.concatenate([idx, onp.resize(order, pad)])
             # round_batch=False: final batch is genuinely smaller, pad=0
             out_rows = len(idx)
             jpegs, labels = [], []
@@ -226,6 +221,10 @@ class ImageRecordIter(DataIter):
         if item is None:
             self._done = True
             raise StopIteration
+        if isinstance(item, tuple) and len(item) == 2 and \
+                item[0] == "error":
+            self._done = True
+            raise item[1]
         batch, labels, pad = item
         data = nd.array(batch.astype(self._dtype)
                         if self._dtype != "float32" else batch,

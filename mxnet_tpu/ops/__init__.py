@@ -17,5 +17,6 @@ from . import (  # noqa: F401  (import-for-registration)
     contrib_ops,
     numpy_ops,
     detection_ops,
+    flash_attention,
 )
 from .registry import OpDef, alias_op, get_op, list_ops, register_op  # noqa: F401

@@ -1,0 +1,190 @@
+"""Flash attention — Pallas TPU kernel with online softmax.
+
+SURVEY.md §5.7 mandate: the reference has no fused attention (only
+bucketing + contrib div_sqrt_dim, src/operator/contrib/transformer.cc);
+long-context on TPU requires an O(seq) -memory attention kernel.  This
+is the single-chip building block; ring context parallelism composes it
+across chips (mxnet_tpu.parallel.ring).
+
+Design (standard flash-attention-2 schedule on the MXU):
+  grid = (batch*heads, q_blocks); the kernel walks k/v blocks in VMEM,
+  keeping the running max m, normalizer l and accumulator acc in f32
+  scratch; one rescale per block keeps everything numerically exact.
+Backward recomputes attention blockwise via jax (flash-style remat —
+no O(S^2) residuals are saved), which XLA fuses well; the forward is
+the latency/memory critical path the kernel owns.
+
+Falls back to a fused jnp implementation off-TPU or for shapes that
+don't tile (seq % block != 0) — same math, same vjp.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+_BLOCK_Q = 128
+_BLOCK_K = 128
+
+
+def _naive_attention(q, k, v, causal, sm_scale):
+    """Reference math in fp32: softmax(q k^T * scale [+ mask]) v."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        qlen, klen = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((qlen, klen), bool), klen - qlen)
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, sm_scale,
+                  block_k, seq_k):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32)  # (block_q, d)
+    block_q = q.shape[0]
+    qi = pl.program_id(1)
+    q_off = qi * block_q
+
+    m = jnp.full((block_q,), -jnp.inf, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    acc = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+
+    num_kb = seq_k // block_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.dslice(kb * block_k, block_k),
+                      :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.dslice(kb * block_k, block_k),
+                      :].astype(jnp.float32)
+        s = q @ k_blk.T * sm_scale  # (block_q, block_k)
+        if causal:
+            qpos = q_off + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # guard fully-masked rows: exp(-inf - -inf) -> use safe max
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe[:, None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[:, None] + p @ v_blk
+        return m_new, l, acc
+
+    if causal:
+        # skip key blocks entirely above the diagonal
+        last_kb = jnp.minimum((q_off + block_q + block_k - 1) // block_k,
+                              num_kb)
+    else:
+        last_kb = num_kb
+    m, l, acc = jax.lax.fori_loop(0, last_kb, body, (m, l, acc))
+    out = acc / jnp.maximum(l, 1e-30)[:, None]
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _flash_forward_pallas(q, k, v, causal, sm_scale, block_q=_BLOCK_Q,
+                          block_k=_BLOCK_K, interpret=False):
+    from jax.experimental import pallas as pl
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bh = b * h
+    q3 = q.reshape(bh, sq, d)
+    k3 = k.reshape(bh, sk, d)
+    v3 = v.reshape(bh, sk, d)
+    grid = (bh, sq // block_q)
+    kernel = functools.partial(_flash_kernel, causal=causal,
+                               sm_scale=sm_scale, block_k=block_k,
+                               seq_k=sk)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b_, i: (b_, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b_, i: (b_, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b_, i: (b_, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b_, i: (b_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        interpret=interpret,
+    )(q3, k3, v3)
+    return out.reshape(b, h, sq, d)
+
+
+def _can_use_pallas(q, k, block_q, block_k):
+    sq, sk = q.shape[2], k.shape[2]
+    if sq % block_q or sk % block_k:
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, sm_scale, interpret):
+    if interpret or _can_use_pallas(q, k, _BLOCK_Q, _BLOCK_K):
+        return _flash_forward_pallas(q, k, v, causal, sm_scale,
+                                     interpret=interpret)
+    return _naive_attention(q, k, v, causal, sm_scale)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, interpret):
+    return _flash(q, k, v, causal, sm_scale, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, sm_scale, interpret, res, g):
+    # flash-style rematerialized backward (no saved attention matrix);
+    # jax.vjp of the fp32 reference math, checkpointed
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _naive_attention(q_, k_, v_, causal, sm_scale),
+        q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None,
+                    interpret=False):
+    """Fused attention over (batch, heads, seq, head_dim) operands."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _flash(q, k, v, causal, float(sm_scale), interpret)
+
+
+@register_op("_contrib_dot_product_attention",
+             aliases=("dot_product_attention",))
+def dot_product_attention(q, k, v, *, num_heads=1, causal=False,
+                          sm_scale=None, interpret=False):
+    """Multi-head attention over (batch, seq, num_heads*head_dim)
+    inputs, flash-backed (the modern replacement for the reference's
+    contrib attention helpers)."""
+    b, sq, hd = q.shape
+    sk = k.shape[1]
+    d = hd // num_heads
+
+    def split(x, s):
+        return x.reshape(b, s, num_heads, d).transpose(0, 2, 1, 3)
+
+    out = flash_attention(split(q, sq), split(k, sk), split(v, sk),
+                          causal=causal, sm_scale=sm_scale,
+                          interpret=interpret)
+    return out.transpose(0, 2, 1, 3).reshape(b, sq, hd)
+
+
+@register_op("_contrib_div_sqrt_dim")
+def div_sqrt_dim(data):
+    """Reference: src/operator/contrib/transformer.cc:33-40."""
+    return data / (data.shape[-1] ** 0.5)

@@ -30,7 +30,7 @@ from ..base import MXNetError
 
 __all__ = ["get_mesh", "functionalize", "make_train_step",
            "DataParallelTrainer", "Mesh", "NamedSharding", "P",
-           "NORM_STAT_SUFFIXES", "amp_cast_params"]
+           "NORM_STAT_SUFFIXES", "amp_cast_params", "ring"]
 
 #: parameter-name suffixes that stay fp32 under mixed precision (the AMP
 #: policy the reference encodes in contrib/amp/lists: norm affine+stats)

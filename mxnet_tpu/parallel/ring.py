@@ -1,0 +1,102 @@
+"""Ring attention — context/sequence parallelism over the device mesh.
+
+SURVEY.md §5.7 TPU-native mandate: sequence scaling comes from sharding
+the sequence axis over a mesh axis and rotating K/V blocks around the
+ring with ``lax.ppermute`` while queries stay put — each device only
+ever holds S/n keys, so attention memory is O(S/n) per chip and the
+permutes ride the ICI torus.  The online-softmax accumulator (m, l,
+acc) makes the blockwise combination exact, the same trick the local
+flash kernel uses (ops/flash_attention.py).
+
+Public API:
+  ring_attention(q, k, v, mesh, axis_name="seq", causal=False)
+      — shard_map'd exact attention; q/k/v are (batch, heads, seq, d)
+        GLOBAL arrays (sharded or to-be-sharded on seq).
+  ring_attention_sharded(...)
+      — the per-device body, for composition inside existing
+        shard_map/pjit programs.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_attention", "ring_attention_sharded"]
+
+
+def ring_attention_sharded(q, k, v, axis_name, causal=False,
+                           sm_scale=None):
+    """Per-device ring attention body (call inside shard_map).
+
+    q, k, v: (batch, heads, seq_local, d) local shards; the sequence
+    axis is sharded over ``axis_name``.  Returns the local output
+    shard.  Exact: the K/V ring rotation + online softmax reproduces
+    full softmax(QK^T)V.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, h, s_loc, d = q.shape
+
+    qf = q.astype(jnp.float32)
+    m = jnp.full((b, h, s_loc), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, h, s_loc), jnp.float32)
+    acc = jnp.zeros((b, h, s_loc, d), jnp.float32)
+
+    q_pos = my * s_loc + jnp.arange(s_loc)  # global query positions
+
+    def step(carry, i):
+        m, l, acc, k_cur, v_cur = carry
+        # k block currently held came from device (my - i) mod n
+        src = (my - i) % n
+        k_pos = src * s_loc + jnp.arange(s_loc)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf,
+                       k_cur.astype(jnp.float32)) * sm_scale
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.where(jnp.isfinite(s),
+                      jnp.exp(s - m_safe[..., None]), 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
+        # rotate the k/v ring one hop (ICI neighbor exchange)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (m_new, l, acc, k_nxt, v_nxt), None
+
+    # scan (not fori_loop): the online-softmax carry must be reverse-
+    # mode differentiable for the backward pass
+    (m, l, acc, _, _), _ = lax.scan(step, (m, l, acc, k, v),
+                                    jnp.arange(n))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis_name="seq", causal=False,
+                   sm_scale=None):
+    """Exact attention with the sequence axis sharded over
+    ``mesh[axis_name]`` — O(seq/n) activation memory per device."""
+    from jax import shard_map
+
+    spec = P(None, None, axis_name, None)
+    fn = functools.partial(ring_attention_sharded, axis_name=axis_name,
+                           causal=causal, sm_scale=sm_scale)
+    mapped = shard_map(
+        lambda q_, k_, v_: fn(q_, k_, v_),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    out = jax.jit(
+        mapped,
+        in_shardings=(NamedSharding(mesh, spec),) * 3,
+        out_shardings=NamedSharding(mesh, spec))(q, k, v)
+    return out

@@ -127,6 +127,10 @@ class MXRecordIO:
         cflag = (lrec >> 29) & 0x7
         length = lrec & 0x1FFFFFFF
         buf = self.fp.read(length)
+        if len(buf) != length:
+            raise MXNetError(
+                f"truncated record: expected {length} payload bytes, "
+                f"got {len(buf)}")
         pad = _pad_size(length)
         if pad:
             self.fp.read(pad)

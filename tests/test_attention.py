@@ -1,0 +1,140 @@
+"""Flash attention + ring context parallelism tests (§5.7 mandate).
+
+The Pallas kernel runs in interpreter mode on the CPU test mesh; the
+ring runs over the 8-device shard_map mesh — both are checked against
+the fp32 reference math.
+"""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.flash_attention import (_naive_attention,
+                                           flash_attention)
+from mxnet_tpu.parallel import get_mesh
+from mxnet_tpu.parallel import ring as ring_mod
+
+onp.random.seed(13)
+
+
+def _qkv(b=2, h=2, s=256, d=64, dtype="float32"):
+    q = onp.random.randn(b, h, s, d).astype(dtype) * 0.3
+    k = onp.random.randn(b, h, s, d).astype(dtype) * 0.3
+    v = onp.random.randn(b, h, s, d).astype(dtype) * 0.3
+    return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_kernel_matches_naive(causal):
+    q, k, v = _qkv()
+    ref = _naive_attention(q, k, v, causal, 1.0 / 8.0)
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-4, atol=2e-5)
+
+
+def test_flash_kernel_bf16():
+    q, k, v = _qkv(s=128)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = flash_attention(qb, kb, vb, interpret=True)
+    ref = _naive_attention(q, k, v, False, 1.0 / 8.0)
+    assert out.dtype == jnp.bfloat16
+    onp.testing.assert_allclose(onp.asarray(out, dtype="float32"),
+                                onp.asarray(ref), rtol=5e-2, atol=5e-2)
+
+
+def test_flash_gradient_matches_naive():
+    q, k, v = _qkv(b=1, h=1, s=128, d=64)
+
+    def loss_flash(q_, k_, v_):
+        return (flash_attention(q_, k_, v_, causal=True,
+                                interpret=True) ** 2).sum()
+
+    def loss_naive(q_, k_, v_):
+        return (_naive_attention(q_, k_, v_, True, 1.0 / 8.0) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gn = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gn):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=1e-3, atol=1e-4)
+
+
+def test_flash_fallback_odd_shapes():
+    # 100 % 128 != 0 -> naive fallback, still correct
+    q, k, v = _qkv(s=100)
+    out = flash_attention(q, k, v)
+    ref = _naive_attention(q, k, v, False, 1.0 / 8.0)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_dot_product_attention_op():
+    b, s, nh, d = 2, 64, 4, 16
+    q = mx.nd.array(onp.random.randn(b, s, nh * d).astype("float32"))
+    k = mx.nd.array(onp.random.randn(b, s, nh * d).astype("float32"))
+    v = mx.nd.array(onp.random.randn(b, s, nh * d).astype("float32"))
+    out = mx.nd.invoke("_contrib_dot_product_attention", [q, k, v],
+                       num_heads=nh)
+    assert out.shape == (b, s, nh * d)
+    # gradient flows through the custom vjp
+    q.attach_grad()
+    from mxnet_tpu import autograd
+
+    with autograd.record():
+        o = mx.nd.invoke("_contrib_dot_product_attention", [q, k, v],
+                         num_heads=nh)
+        loss = (o * o).sum()
+    loss.backward()
+    assert onp.abs(q.grad.asnumpy()).max() > 0
+
+
+def test_div_sqrt_dim():
+    x = mx.nd.ones((2, 16))
+    out = mx.nd.invoke("_contrib_div_sqrt_dim", [x])
+    onp.testing.assert_allclose(out.asnumpy(), onp.ones((2, 16)) / 4.0)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(causal):
+    """Ring CP over the 8-device mesh == full attention (SURVEY.md
+    §5.7: 'correctness test vs naive attention on the CPU mesh')."""
+    mesh = get_mesh((8,), ("seq",))
+    b, h, s, d = 2, 2, 128, 32  # 16 tokens per device
+    q, k, v = _qkv(b, h, s, d)
+    out = ring_mod.ring_attention(q, k, v, mesh, axis_name="seq",
+                                  causal=causal)
+    ref = _naive_attention(q, k, v, causal, 1.0 / (d ** 0.5))
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_memory_contract():
+    """Each device's shard is seq/n — the point of the ring."""
+    mesh = get_mesh((8,), ("seq",))
+    q, k, v = _qkv(1, 1, 64, 16)
+    out = ring_mod.ring_attention(q, k, v, mesh)
+    shard_shapes = {tuple(s.data.shape)
+                    for s in out.addressable_shards}
+    assert shard_shapes == {(1, 1, 8, 16)}
+
+
+def test_ring_attention_gradients():
+    mesh = get_mesh((8,), ("seq",))
+    b, h, s, d = 1, 1, 64, 16
+    q, k, v = _qkv(b, h, s, d)
+
+    def loss_ring(q_, k_, v_):
+        return (ring_mod.ring_attention(q_, k_, v_, mesh) ** 2).sum()
+
+    def loss_naive(q_, k_, v_):
+        return (_naive_attention(q_, k_, v_, False,
+                                 1.0 / (d ** 0.5)) ** 2).sum()
+
+    gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    gn = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gr, gn):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b_),
+                                    rtol=1e-3, atol=1e-4)

@@ -259,3 +259,39 @@ def test_round_batch_false_partial_batch(tmp_path):
     with _pytest.raises(StopIteration):
         it.next()
     it.close()
+
+
+def test_producer_error_surfaces_not_hangs(tmp_path):
+    """A corrupt record raises in next() instead of deadlocking."""
+    rec = str(tmp_path / "bad.rec")
+    _make_rec(rec, n=6)
+    # append garbage framing
+    with open(rec, "ab") as f:
+        import struct
+
+        f.write(struct.pack("<II", 0xCED7230A, 10 ** 6))  # truncated
+    with pytest.raises(Exception):
+        it = mx.io.ImageRecordIter(path_imgrec=rec,
+                                   data_shape=(3, 32, 32), batch_size=4)
+        list(it)
+
+
+def test_round_batch_small_shard(tmp_path):
+    """Dataset smaller than batch_size still fills a full batch."""
+    rec = str(tmp_path / "tiny.rec")
+    _make_rec(rec, n=3)
+    it = mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(3, 32, 32),
+                               batch_size=8)
+    b = it.next()
+    assert b.data[0].shape == (8, 3, 32, 32)
+    assert b.label[0].shape == (8,)
+    assert b.pad == 5
+    it.close()
+
+
+def test_image_iter_discard(tmp_path):
+    rec = str(tmp_path / "d.rec")
+    _make_rec(rec, n=10)
+    it = img_mod.ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                           path_imgrec=rec, last_batch_handle="discard")
+    assert sum(1 for _ in it) == 2  # partial final batch dropped
