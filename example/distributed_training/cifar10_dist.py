@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Distributed data-parallel training with dist_sync (reference:
+example/distributed_training/cifar10_dist.py).
+
+    python tools/launch.py -n 4 --cpu \
+        python example/distributed_training/cifar10_dist.py --synthetic
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as onp
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64,
+                    help="per-worker batch")
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--synthetic", action="store_true",
+                    help="random data (no dataset download)")
+    ap.add_argument("--steps", type=int, default=30)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    kv = mx.kv.create("dist_sync")
+    logging.info("worker %d/%d", kv.rank, kv.num_workers)
+
+    net = gluon.model_zoo.vision.get_resnet(1, 18, classes=10)
+    net.initialize(init=mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr}, kvstore=kv,
+                            update_on_kvstore=False)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    rng = onp.random.RandomState(1000 + kv.rank)  # per-worker shard
+    global_batch = args.batch_size * kv.num_workers
+    for step in range(args.steps):
+        x = mx.nd.array(rng.rand(args.batch_size, 3, 32, 32)
+                        .astype("float32"))
+        y = mx.nd.array(rng.randint(0, 10, args.batch_size)
+                        .astype("float32"))
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(global_batch)
+        if step % 10 == 0:
+            logging.info("worker %d step %d loss %.4f", kv.rank, step,
+                         float(loss.mean().asnumpy()))
+    kv.barrier()
+    logging.info("worker %d done", kv.rank)
+
+
+if __name__ == "__main__":
+    main()

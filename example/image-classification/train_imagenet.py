@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""ImageNet-style training from RecordIO (reference:
+example/image-classification/train_imagenet.py + common/fit.py).
+
+Feeds ImageRecordIter (native decode pipeline) into the fused SPMD
+train step — the BASELINE ResNet-50 recipe:
+
+    python example/image-classification/train_imagenet.py \
+        --data-train train.rec --network resnet50_v1 --batch-size 128
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon  # noqa: E402
+from mxnet_tpu.parallel import get_mesh, make_train_step  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-train", required=True)
+    ap.add_argument("--network", default="resnet50_v1")
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--image-shape", default="3,224,224")
+    ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--optimizer", default="sgd",
+                    help="any registry optimizer, e.g. lars")
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--loss-scale", default=None,
+                    help="'dynamic' or a float")
+    ap.add_argument("--kv-store", default="device",
+                    help="device | dist_sync (under tools/launch.py)")
+    ap.add_argument("--data-parallel-mesh", action="store_true",
+                    help="shard the batch over all local chips")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import jax
+    import jax.numpy as jnp
+
+    kv = mx.kv.create(args.kv_store)
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    it = mx.io.ImageRecordIter(
+        path_imgrec=args.data_train, data_shape=shape,
+        batch_size=args.batch_size, shuffle=True, rand_crop=True,
+        rand_mirror=True, resize=256 if shape[1] >= 224 else -1,
+        mean_r=123.68, mean_g=116.28, mean_b=103.53,
+        std_r=58.395, std_g=57.12, std_b=57.375,
+        part_index=kv.rank, num_parts=kv.num_workers)
+
+    ctx = mx.gpu(0)
+    net = gluon.model_zoo.vision.get_model(args.network,
+                                           classes=args.num_classes)
+    net.initialize(init=mx.init.Xavier(), ctx=ctx)
+    net(mx.nd.zeros((1,) + shape, ctx=ctx))
+    mesh = get_mesh() if args.data_parallel_mesh else None
+    step_fn, params, opt_state = make_train_step(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        optimizer=args.optimizer, learning_rate=args.lr, momentum=0.9,
+        compute_dtype=args.dtype if args.dtype != "float32" else None,
+        loss_scale=args.loss_scale, mesh=mesh, donate=False)
+
+    key = jax.random.key(0)
+    t = 0
+    for epoch in range(args.epochs):
+        it.reset()
+        tic = time.time()
+        n = 0
+        for batch in it:
+            x = jnp.asarray(batch.data[0].asnumpy())
+            y = jnp.asarray(batch.label[0].asnumpy())
+            t += 1
+            loss, params, opt_state = step_fn(params, opt_state, x, y,
+                                              key, float(t))
+            n += x.shape[0]
+            if t % 50 == 0:
+                jax.block_until_ready(loss)
+                logging.info("epoch %d iter %d: loss=%.4f %.1f img/s",
+                             epoch, t, float(loss), n / (time.time() - tic))
+    jax.block_until_ready(loss)
+    logging.info("done: final loss %.4f", float(loss))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Train LeNet/MLP on MNIST (reference:
+example/image-classification/train_mnist.py).
+
+    python example/image-classification/train_mnist.py --network lenet
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+
+
+def build(network):
+    net = gluon.nn.HybridSequential()
+    if network == "mlp":
+        net.add(gluon.nn.Flatten(),
+                gluon.nn.Dense(128, activation="relu"),
+                gluon.nn.Dense(64, activation="relu"),
+                gluon.nn.Dense(10))
+    else:  # lenet
+        net.add(gluon.nn.Conv2D(20, 5, activation="tanh"),
+                gluon.nn.MaxPool2D(2, 2),
+                gluon.nn.Conv2D(50, 5, activation="tanh"),
+                gluon.nn.MaxPool2D(2, 2),
+                gluon.nn.Flatten(),
+                gluon.nn.Dense(500, activation="tanh"),
+                gluon.nn.Dense(10))
+    return net
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="lenet", choices=["mlp", "lenet"])
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=0.02)
+    ap.add_argument("--data-dir", default=None,
+                    help="directory with the MNIST idx files")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    ctx = mx.gpu(0)
+    train = gluon.data.DataLoader(
+        gluon.data.vision.MNIST(root=args.data_dir, train=True).transform_first(
+            lambda x: x.astype("float32") / 255.0),
+        batch_size=args.batch_size, shuffle=True)
+    val = gluon.data.DataLoader(
+        gluon.data.vision.MNIST(root=args.data_dir, train=False).transform_first(
+            lambda x: x.astype("float32") / 255.0),
+        batch_size=args.batch_size)
+
+    net = build(args.network)
+    net.initialize(init=mx.init.Xavier(), ctx=ctx)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        for data, label in train:
+            data = data.as_in_context(ctx).transpose((0, 3, 1, 2)) \
+                if args.network == "lenet" and data.ndim == 4 else \
+                data.as_in_context(ctx)
+            label = label.as_in_context(ctx)
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            metric.update([label], [out])
+        name, acc = metric.get()
+        logging.info("epoch %d: train %s=%.4f", epoch, name, acc)
+
+    metric.reset()
+    for data, label in val:
+        data = data.as_in_context(ctx).transpose((0, 3, 1, 2)) \
+            if args.network == "lenet" and data.ndim == 4 else \
+            data.as_in_context(ctx)
+        out = net(data)
+        metric.update([label.as_in_context(ctx)], [out])
+    logging.info("validation %s=%.4f", *metric.get())
+
+
+if __name__ == "__main__":
+    main()

@@ -67,6 +67,7 @@ _LAZY = {
     "engine": ".engine",
     "contrib": ".contrib",
     "amp": ".contrib.amp",
+    "operator": ".operator",
 }
 
 

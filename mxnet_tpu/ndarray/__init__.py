@@ -172,6 +172,7 @@ def _nd_transpose(self, *axes, **kwargs):
 
 NDArray.transpose = _nd_transpose
 
+from ..operator import custom as Custom  # noqa: E402,F401
 from . import random  # noqa: E402,F401
 from . import linalg  # noqa: E402,F401
 from . import contrib  # noqa: E402,F401

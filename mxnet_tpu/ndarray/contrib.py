@@ -24,3 +24,7 @@ def _expose_contrib():
 
 
 _expose_contrib()
+
+# higher-order control flow (reference python/mxnet/ndarray/contrib.py
+# foreach/while_loop/cond over src/operator/control_flow.cc)
+from ..ops.control_flow_ops import cond, foreach, while_loop  # noqa: E402,F401

@@ -1,0 +1,158 @@
+"""Custom operator API — user-defined ops in Python.
+
+Reference parity: python/mxnet/operator.py (CustomOp :488, CustomOpProp
+:712, register :1114 → src/operator/custom/custom-inl.h, which executes
+the Python callbacks outside the engine threads).
+
+TPU-native design: custom ops run EAGERLY on the host (they are
+arbitrary Python, by definition outside the compiled program — the
+reference makes the same tradeoff, custom-inl.h:178 async-executes them
+off the engine).  Autograd integration goes through the same tape as
+built-in ops: the user's ``backward`` becomes the node's pull-back.
+Inside jit-traced code (hybridize), custom ops raise — matching the
+reference's inability to fuse them into CachedOp segments.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from . import autograd
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered",
+           "custom"]
+
+_REGISTRY: dict[str, type] = {}
+
+
+class CustomOp:
+    """Base class for custom op implementations (reference
+    operator.py:488)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honoring the grad request."""
+        if req == "null":
+            return
+        if req == "add":
+            dst._adopt(dst._data + src._data)
+        else:  # write / inplace
+            dst._adopt(src._data.astype(dst._data.dtype))
+
+
+class CustomOpProp:
+    """Op metadata + factory (reference operator.py:712)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        t = in_type[0]
+        return ([t] * len(self.list_arguments()),
+                [t] * len(self.list_outputs()),
+                [t] * len(self.list_auxiliary_states()))
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp under ``op_type`` (reference
+    operator.py:1114)."""
+
+    def _do(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("can only register CustomOpProp subclasses")
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return _do
+
+
+def get_all_registered():
+    return dict(_REGISTRY)
+
+
+def custom(*inputs, op_type, **params):
+    """Invoke a registered custom op (the ``mx.nd.Custom`` entry point).
+
+    Runs the user's ``forward`` eagerly; when autograd is recording, a
+    tape node wraps the user's ``backward``.
+    """
+    import jax.numpy as jnp
+
+    from . import ndarray as nd
+    from .ndarray.ndarray import NDArray
+
+    if op_type not in _REGISTRY:
+        raise MXNetError(f"custom op {op_type!r} is not registered")
+    import jax
+
+    for i in inputs:
+        if isinstance(i, NDArray) and isinstance(i._data,
+                                                 jax.core.Tracer):
+            raise MXNetError(
+                "custom ops run eagerly on the host and cannot be "
+                "traced into a compiled program (reference parity: "
+                "CustomOp executes outside the engine)")
+    prop = _REGISTRY[op_type](**{k: str(v) for k, v in params.items()})
+    in_nd = [i if isinstance(i, NDArray) else nd.array(i)
+             for i in inputs]
+    in_shapes = [list(i.shape) for i in in_nd]
+    _, out_shapes, aux_shapes = prop.infer_shape(in_shapes)
+    _, out_types, aux_types = prop.infer_type([i.dtype for i in in_nd])
+    op = prop.create_operator(None, in_shapes,
+                              [i.dtype for i in in_nd])
+    out_nd = [nd.zeros(tuple(s), dtype=t)
+              for s, t in zip(out_shapes, out_types)]
+    aux = [nd.zeros(tuple(s), dtype=t)
+           for s, t in zip(aux_shapes, aux_types)]
+    op.forward(is_train=autograd.is_training(),
+               req=["write"] * len(out_nd), in_data=in_nd,
+               out_data=out_nd, aux=aux)
+
+    if autograd.is_recording() and any(
+            i._is_var or i._node is not None for i in in_nd):
+        def vjp_fn(out_grads):
+            if not isinstance(out_grads, tuple):
+                out_grads = (out_grads,)
+            in_grad = [nd.zeros(i.shape, dtype=i.dtype) for i in in_nd]
+            og = [NDArray(jnp.asarray(g)) for g in out_grads]
+            op.backward(req=["write"] * len(in_nd), out_grad=og,
+                        in_data=in_nd, out_data=out_nd,
+                        in_grad=in_grad, aux=aux)
+            return tuple(g._data for g in in_grad)
+
+        node = autograd.TapeNode(
+            vjp_fn, list(in_nd),
+            [(o.shape, o.dtype) for o in out_nd],
+            op_name=f"Custom[{op_type}]")
+        for idx, o in enumerate(out_nd):
+            o._node = node
+            o._oidx = idx
+    return out_nd[0] if len(out_nd) == 1 else out_nd

@@ -18,5 +18,7 @@ from . import (  # noqa: F401  (import-for-registration)
     numpy_ops,
     detection_ops,
     flash_attention,
+    quantization_ops,
+    control_flow_ops,
 )
 from .registry import OpDef, alias_op, get_op, list_ops, register_op  # noqa: F401

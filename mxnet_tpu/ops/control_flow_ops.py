@@ -1,0 +1,163 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+Reference parity: src/operator/control_flow.cc:1089-1255 (_foreach,
+_while_loop, _cond higher-order ops executing subgraph Symbols) and the
+python surface mx.nd.contrib.foreach/while_loop/cond
+(python/mxnet/ndarray/contrib.py).
+
+TPU-native design: under jit tracing the bodies lower to lax.scan /
+lax.while_loop / lax.cond — compiler-friendly control flow with no
+Python in the loop.  Under eager autograd recording, the loop runs as a
+taped Python loop instead (lax.while_loop is not reverse-mode
+differentiable; the unrolled tape is, exactly like the reference's
+imperative path).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+
+
+def _to_nd(x):
+    from ..ndarray.ndarray import NDArray
+
+    return x if isinstance(x, NDArray) else NDArray(jnp.asarray(x))
+
+
+def _data(x):
+    from ..ndarray.ndarray import NDArray
+
+    return x._data if isinstance(x, NDArray) else jnp.asarray(x)
+
+
+def _states_list(states):
+    single = not isinstance(states, (list, tuple))
+    return ([states] if single else list(states)), single
+
+
+def foreach(body, data, init_states):
+    """Scan ``body(data_slice, states) -> (out, new_states)`` over axis 0
+    of ``data`` (reference control_flow.cc _foreach).
+
+    Eager+recording: taped Python loop.  Otherwise: lax.scan (one
+    compiled loop, O(1) program size in sequence length).
+    """
+    from .. import autograd
+    from ..ndarray.ndarray import NDArray
+
+    datas, data_single = _states_list(data)
+    states, states_single = _states_list(init_states)
+    n = datas[0].shape[0]
+
+    if autograd.is_recording():
+        outs = []
+        cur = [_to_nd(s) for s in states]
+        for i in range(n):
+            sl = [d[i] for d in datas]
+            o, cur = body(sl[0] if data_single else sl,
+                          cur[0] if states_single else cur)
+            cur, _ = _states_list(cur)
+            outs.append(o)
+        from ..ndarray.ndarray import stack as nd_stack
+
+        if isinstance(outs[0], (list, tuple)):
+            stacked = [nd_stack(*[o[k] for o in outs], axis=0)
+                       for k in range(len(outs[0]))]
+        else:
+            stacked = nd_stack(*outs, axis=0)
+        return stacked, (cur[0] if states_single else cur)
+
+    def scan_body(carry, xs):
+        sl = [NDArray(x) for x in xs]
+        st = [NDArray(c) for c in carry]
+        o, new_st = body(sl[0] if data_single else sl,
+                         st[0] if states_single else st)
+        new_st, _ = _states_list(new_st)
+        o_list, o_single = _states_list(o)
+        return (tuple(_data(s) for s in new_st),
+                tuple(_data(x) for x in o_list))
+
+    carry, ys = lax.scan(scan_body, tuple(_data(s) for s in states),
+                         tuple(_data(d) for d in datas))
+    outs = [NDArray(y) for y in ys]
+    states_out = [NDArray(c) for c in carry]
+    out = outs[0] if len(outs) == 1 else outs
+    return out, (states_out[0] if states_single else states_out)
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Reference control_flow.cc _while_loop: run ``func`` while ``cond``
+    holds, stacking per-step outputs padded to ``max_iterations``.
+
+    Returns (outputs, final_loop_vars).  Python loop (the reference's
+    imperative semantics — step outputs make the trip count data-
+    dependent, which XLA cannot express with stacked outputs; loops
+    without outputs should use lax.while_loop directly).
+    """
+    from ..ndarray.ndarray import NDArray, stack as nd_stack, zeros
+
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations")
+    vars_, single = _states_list(loop_vars)
+    vars_ = [_to_nd(v) for v in vars_]
+    outs = []
+    steps = 0
+    while steps < max_iterations:
+        c = cond(vars_[0] if single else vars_)
+        c_val = bool(c.asnumpy().reshape(()) if isinstance(c, NDArray)
+                     else c)
+        if not c_val:
+            break
+        o, new_vars = func(vars_[0] if single else vars_)
+        new_vars, _ = _states_list(new_vars)
+        vars_ = [_to_nd(v) for v in new_vars]
+        if o is not None:
+            o_list, _ = _states_list(o)
+            outs.append(o_list)
+        steps += 1
+    if outs:
+        stacked = []
+        for k in range(len(outs[0])):
+            rows = [o[k] for o in outs]
+            # pad to max_iterations like the reference's static output
+            pad_rows = [zeros(rows[0].shape, dtype=rows[0].dtype)
+                        for _ in range(max_iterations - len(rows))]
+            stacked.append(nd_stack(*(rows + pad_rows), axis=0))
+        out = stacked[0] if len(stacked) == 1 else stacked
+    else:
+        out = []
+    return out, (vars_[0] if single else vars_)
+
+
+def cond(pred, then_func, else_func):
+    """Reference control_flow.cc _cond.
+
+    Eagerly evaluates the predicate and runs one branch (imperative
+    semantics: the tape records only the taken branch, like the
+    reference); traced values route through lax.cond.
+    """
+    from .. import autograd
+    from ..ndarray.ndarray import NDArray
+
+    p = pred() if callable(pred) else pred
+    p_val = p._data if isinstance(p, NDArray) else jnp.asarray(p)
+    if autograd.is_recording() or not isinstance(
+            p_val, jax.core.Tracer):
+        take_then = bool(jnp.asarray(p_val).reshape(()))
+        return then_func() if take_then else else_func()
+
+    def wrap(branch):
+        def f(_):
+            out = branch()
+            o_list, single = _states_list(out)
+            return tuple(_data(o) for o in o_list)
+
+        return f
+
+    outs = lax.cond(p_val.reshape(()).astype(bool), wrap(then_func),
+                    wrap(else_func), operand=None)
+    outs = [NDArray(o) for o in outs]
+    return outs[0] if len(outs) == 1 else outs

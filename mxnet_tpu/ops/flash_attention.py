@@ -10,9 +10,11 @@ Design (standard flash-attention-2 schedule on the MXU):
   grid = (batch*heads, q_blocks); the kernel walks k/v blocks in VMEM,
   keeping the running max m, normalizer l and accumulator acc in f32
   scratch; one rescale per block keeps everything numerically exact.
-Backward recomputes attention blockwise via jax (flash-style remat —
-no O(S^2) residuals are saved), which XLA fuses well; the forward is
-the latency/memory critical path the kernel owns.
+Backward: recomputation in query chunks — each chunk re-derives its
+attention rows (O(chunk * seq) live memory, not O(seq^2)) and
+contributes dq directly while dk/dv accumulate across chunks.
+Causal masking uses bottom-right alignment (query i attends keys
+j <= i + seq_k - seq_q), identical across kernel/fallback/backward.
 
 Falls back to a fused jnp implementation off-TPU or for shapes that
 don't tile (seq % block != 0) — same math, same vjp.
@@ -50,7 +52,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, sm_scale,
     q = q_ref[0].astype(jnp.float32)  # (block_q, d)
     block_q = q.shape[0]
     qi = pl.program_id(1)
-    q_off = qi * block_q
+    seq_q = pl.num_programs(1) * block_q
+    # bottom-right causal alignment: shift query positions by sk - sq
+    q_off = qi * block_q + (seq_k - seq_q)
 
     m = jnp.full((block_q,), -jnp.inf, jnp.float32)
     l = jnp.zeros((block_q,), jnp.float32)
@@ -131,9 +135,14 @@ def _can_use_pallas(q, k, block_q, block_k):
         return False
 
 
+def _tiles(q, k, block_q=_BLOCK_Q, block_k=_BLOCK_K):
+    return q.shape[2] % block_q == 0 and k.shape[2] % block_k == 0
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash(q, k, v, causal, sm_scale, interpret):
-    if interpret or _can_use_pallas(q, k, _BLOCK_Q, _BLOCK_K):
+    if _tiles(q, k) and (interpret or _can_use_pallas(q, k, _BLOCK_Q,
+                                                      _BLOCK_K)):
         return _flash_forward_pallas(q, k, v, causal, sm_scale,
                                      interpret=interpret)
     return _naive_attention(q, k, v, causal, sm_scale)
@@ -143,14 +152,47 @@ def _flash_fwd(q, k, v, causal, sm_scale, interpret):
     return _flash(q, k, v, causal, sm_scale, interpret), (q, k, v)
 
 
+_BWD_CHUNK = 512
+
+
 def _flash_bwd(causal, sm_scale, interpret, res, g):
-    # flash-style rematerialized backward (no saved attention matrix);
-    # jax.vjp of the fp32 reference math, checkpointed
+    # recompute in query chunks: O(chunk * seq_k) live attention rows
+    # instead of the full O(seq^2) matrix
     q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _naive_attention(q_, k_, v_, causal, sm_scale),
-        q, k, v)
-    return vjp(g)
+    sq = q.shape[2]
+    chunk = min(_BWD_CHUNK, sq)
+    if sq % chunk:
+        chunk = sq  # ragged: single chunk (still correct)
+    nchunks = sq // chunk
+    sk = k.shape[2]
+
+    def chunk_attn(q_c, k_, v_, off):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_c.astype(jnp.float32),
+                       k_.astype(jnp.float32)) * sm_scale
+        if causal:
+            qpos = off + jnp.arange(chunk) + (sk - sq)
+            kpos = jnp.arange(sk)
+            s = jnp.where((qpos[:, None] >= kpos[None, :])[None, None],
+                          s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p,
+                          v_.astype(jnp.float32)).astype(q_c.dtype)
+
+    dq = jnp.zeros_like(q)
+    dk = jnp.zeros_like(k, shape=k.shape).astype(jnp.float32)
+    dv = jnp.zeros_like(v, shape=v.shape).astype(jnp.float32)
+    for ci in range(nchunks):
+        off = ci * chunk
+        q_c = jax.lax.dynamic_slice_in_dim(q, off, chunk, axis=2)
+        g_c = jax.lax.dynamic_slice_in_dim(g, off, chunk, axis=2)
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_, off=off: chunk_attn(q_, k_, v_, off),
+            q_c, k, v)
+        dq_c, dk_c, dv_c = vjp(g_c)
+        dq = jax.lax.dynamic_update_slice_in_dim(dq, dq_c, off, axis=2)
+        dk = dk + dk_c.astype(jnp.float32)
+        dv = dv + dv_c.astype(jnp.float32)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

@@ -1,0 +1,174 @@
+"""INT8 quantization operators.
+
+Reference parity: src/operator/quantization/ (6,057 LoC — quantize.cc,
+quantize_v2.cc, dequantize.cc, requantize.cc, quantized_conv/fc/pooling/
+flatten).  TPU-native: int8 matmul/conv accumulate in int32 on the MXU
+via ``preferred_element_type`` — the same int8→int32 contract the
+reference gets from cuDNN/MKLDNN int8 kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+_INT8_RANGE = 127.0
+
+
+def _minmax_scale(mn, mx):
+    amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    return jnp.where(amax > 0, _INT8_RANGE / amax, 1.0), amax
+
+
+@register_op("_contrib_quantize", num_outputs=3, differentiable=False)
+def quantize(data, min_range, max_range, *, out_type="uint8"):
+    """Reference: quantization/quantize.cc — float -> quantized with the
+    given range.  uint8: affine [min,max] -> [0,255]; int8: symmetric."""
+    mn = min_range.reshape(())
+    mx = max_range.reshape(())
+    if out_type == "uint8":
+        scale = 255.0 / jnp.maximum(mx - mn, 1e-12)
+        q = jnp.clip(jnp.round((data - mn) * scale), 0, 255).astype(
+            jnp.uint8)
+    else:
+        scale, amax = _minmax_scale(mn, mx)
+        q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+    return q, mn.reshape(1), mx.reshape(1)
+
+
+@register_op("_contrib_quantize_v2", num_outputs=3, differentiable=False)
+def quantize_v2(data, *, out_type="int8", min_calib_range=None,
+                max_calib_range=None):
+    """Reference: quantization/quantize_v2.cc — calibrated or on-the-fly
+    range, symmetric int8."""
+    if min_calib_range is not None and max_calib_range is not None:
+        mn = jnp.float32(min_calib_range)
+        mx = jnp.float32(max_calib_range)
+    else:
+        mn = data.min().astype(jnp.float32)
+        mx = data.max().astype(jnp.float32)
+    scale, amax = _minmax_scale(mn, mx)
+    q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+    return q, (-amax).reshape(1), amax.reshape(1)
+
+
+@register_op("_contrib_dequantize", differentiable=False)
+def dequantize(data, min_range, max_range, *, out_type="float32"):
+    """Reference: quantization/dequantize.cc."""
+    mn = min_range.reshape(())
+    mx = max_range.reshape(())
+    if data.dtype == jnp.uint8:
+        scale = jnp.maximum(mx - mn, 1e-12) / 255.0
+        return data.astype(jnp.float32) * scale + mn
+    amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    # int8 maps to ±127, int32 accumulators to ±(2^31-1) — the
+    # reference's quantized range convention per dtype
+    denom = _INT8_RANGE if data.dtype == jnp.int8 else \
+        jnp.float32(2 ** 31 - 1)
+    return data.astype(jnp.float32) * (amax / denom)
+
+
+@register_op("_contrib_requantize", num_outputs=3, differentiable=False)
+def requantize(data, min_range, max_range, *, out_type="int8",
+               min_calib_range=None, max_calib_range=None):
+    """Reference: quantization/requantize.cc — int32 accumulators back
+    to int8 with a (possibly calibrated) output range."""
+    mn = min_range.reshape(())
+    mx = max_range.reshape(())
+    real = data.astype(jnp.float32) * (
+        jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+        / jnp.float32(2 ** 31 - 1))
+    if min_calib_range is not None and max_calib_range is not None:
+        omax = jnp.float32(max(abs(min_calib_range),
+                               abs(max_calib_range)))
+    else:
+        omax = jnp.maximum(jnp.abs(real).max(), 1e-12)
+    q = jnp.clip(jnp.round(real * (_INT8_RANGE / omax)), -127,
+                 127).astype(jnp.int8)
+    return q, (-omax).reshape(1), omax.reshape(1)
+
+
+@register_op("_contrib_quantized_fully_connected", num_outputs=3,
+             differentiable=False)
+def quantized_fully_connected(data, weight, bias, data_min, data_max,
+                              weight_min, weight_max, bias_min, bias_max,
+                              *, num_hidden, no_bias=False, flatten=True):
+    """Reference: quantization/quantized_fully_connected.cc — int8 x
+    int8 -> int32 accumulation (MXU native via preferred_element_type)."""
+    d = data.reshape(data.shape[0], -1) if flatten else data
+    acc = lax.dot_general(
+        d.astype(jnp.int8), weight.astype(jnp.int8),
+        (((d.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    d_amax = jnp.maximum(jnp.abs(data_min), jnp.abs(data_max)).reshape(())
+    w_amax = jnp.maximum(jnp.abs(weight_min),
+                         jnp.abs(weight_max)).reshape(())
+    out_scale = (d_amax / _INT8_RANGE) * (w_amax / _INT8_RANGE)
+    if not no_bias:
+        b_amax = jnp.maximum(jnp.abs(bias_min),
+                             jnp.abs(bias_max)).reshape(())
+        b_real = bias.astype(jnp.float32) * (b_amax / _INT8_RANGE)
+        acc = acc + jnp.round(b_real / jnp.maximum(out_scale, 1e-30)
+                              ).astype(jnp.int32)
+    omax = out_scale * jnp.float32(2 ** 31 - 1)
+    return acc, (-omax).reshape(1), omax.reshape(1)
+
+
+@register_op("_contrib_quantized_conv", num_outputs=3,
+             differentiable=False)
+def quantized_conv(data, weight, bias, data_min, data_max, weight_min,
+                   weight_max, bias_min, bias_max, *, kernel, num_filter,
+                   stride=None, pad=None, dilate=None, num_group=1,
+                   no_bias=False, layout=None):
+    """Reference: quantization/quantized_conv.cc — int8 conv with int32
+    accumulation."""
+    nd_ = len(kernel)
+    stride = tuple(stride) if stride else (1,) * nd_
+    pad = tuple(pad) if pad else (0,) * nd_
+    dilate = tuple(dilate) if dilate else (1,) * nd_
+    dn = lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if nd_ == 2 else ("NCW", "OIW", "NCW"))
+    acc = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    d_amax = jnp.maximum(jnp.abs(data_min), jnp.abs(data_max)).reshape(())
+    w_amax = jnp.maximum(jnp.abs(weight_min),
+                         jnp.abs(weight_max)).reshape(())
+    out_scale = (d_amax / _INT8_RANGE) * (w_amax / _INT8_RANGE)
+    if not no_bias:
+        b_amax = jnp.maximum(jnp.abs(bias_min),
+                             jnp.abs(bias_max)).reshape(())
+        b_real = bias.astype(jnp.float32) * (b_amax / _INT8_RANGE)
+        b_q = jnp.round(b_real / jnp.maximum(out_scale, 1e-30)).astype(
+            jnp.int32)
+        acc = acc + b_q.reshape((1, -1) + (1,) * nd_)
+    omax = out_scale * jnp.float32(2 ** 31 - 1)
+    return acc, (-omax).reshape(1), omax.reshape(1)
+
+
+@register_op("_contrib_quantized_pooling", num_outputs=3,
+             differentiable=False)
+def quantized_pooling(data, data_min, data_max, *, kernel=(),
+                      pool_type="max", global_pool=False, stride=None,
+                      pad=None, pooling_convention="valid"):
+    """Reference: quantization/quantized_pooling.cc — pooling preserves
+    the quantization range."""
+    from .conv import pooling as _pooling
+
+    out = _pooling(data.astype(jnp.int32), kernel=kernel,
+                   pool_type=pool_type, global_pool=global_pool,
+                   stride=stride, pad=pad,
+                   pooling_convention=pooling_convention)
+    return out.astype(data.dtype), data_min, data_max
+
+
+@register_op("_contrib_quantized_flatten", num_outputs=3,
+             differentiable=False)
+def quantized_flatten(data, data_min, data_max):
+    return data.reshape(data.shape[0], -1), data_min, data_max

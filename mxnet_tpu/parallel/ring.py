@@ -82,10 +82,8 @@ def ring_attention_sharded(q, k, v, axis_name, causal=False,
     return out.astype(q.dtype)
 
 
-def ring_attention(q, k, v, mesh, axis_name="seq", causal=False,
-                   sm_scale=None):
-    """Exact attention with the sequence axis sharded over
-    ``mesh[axis_name]`` — O(seq/n) activation memory per device."""
+@functools.lru_cache(maxsize=64)
+def _build_ring_fn(mesh, axis_name, causal, sm_scale):
     from jax import shard_map
 
     spec = P(None, None, axis_name, None)
@@ -95,8 +93,19 @@ def ring_attention(q, k, v, mesh, axis_name="seq", causal=False,
         lambda q_, k_, v_: fn(q_, k_, v_),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
-    out = jax.jit(
+    return jax.jit(
         mapped,
         in_shardings=(NamedSharding(mesh, spec),) * 3,
-        out_shardings=NamedSharding(mesh, spec))(q, k, v)
-    return out
+        out_shardings=NamedSharding(mesh, spec))
+
+
+def ring_attention(q, k, v, mesh, axis_name="seq", causal=False,
+                   sm_scale=None):
+    """Exact attention with the sequence axis sharded over
+    ``mesh[axis_name]`` — O(seq/n) activation memory per device.
+
+    The jitted shard_map program is cached per (mesh, axis, causal,
+    scale) so repeated calls hit the compilation cache."""
+    fn = _build_ring_fn(mesh, axis_name, bool(causal),
+                        float(sm_scale) if sm_scale is not None else None)
+    return fn(q, k, v)

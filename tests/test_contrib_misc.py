@@ -1,0 +1,266 @@
+"""Control-flow ops, custom op API, quantization (reference:
+test_contrib_control_flow.py, test_operator custom-op cases,
+test_quantization.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.base import MXNetError
+
+onp.random.seed(31)
+
+
+# ------------------------------------------------------------ control flow
+def test_foreach_scan():
+    data = mx.nd.array(onp.arange(12).reshape(4, 3).astype("float32"))
+    init = mx.nd.zeros((3,))
+
+    def body(x, state):
+        new = state + x
+        return new * 2, new
+
+    out, final = mx.nd.contrib.foreach(body, data, init)
+    # manual
+    st = onp.zeros(3)
+    outs = []
+    for row in onp.arange(12).reshape(4, 3):
+        st = st + row
+        outs.append(st * 2)
+    onp.testing.assert_allclose(out.asnumpy(), onp.stack(outs), rtol=1e-6)
+    onp.testing.assert_allclose(final.asnumpy(), st, rtol=1e-6)
+
+
+def test_foreach_gradient():
+    data = mx.nd.array(onp.random.rand(5, 2).astype("float32"))
+    init = mx.nd.ones((2,))
+    data.attach_grad()
+    with autograd.record():
+        out, final = mx.nd.contrib.foreach(
+            lambda x, s: (x * s, s + x), data, init)
+        loss = out.sum() + final.sum()
+    loss.backward()
+    assert onp.isfinite(data.grad.asnumpy()).all()
+    assert onp.abs(data.grad.asnumpy()).max() > 0
+
+
+def test_while_loop():
+    def cond(v):
+        return v[0] < 5
+
+    def func(v):
+        i, acc = v
+        return acc, [i + 1, acc + i]
+
+    outs, final = mx.nd.contrib.while_loop(
+        cond, func, [mx.nd.zeros((1,)), mx.nd.zeros((1,))],
+        max_iterations=10)
+    i, acc = final
+    assert float(i.asnumpy()[0]) == 5
+    assert float(acc.asnumpy()[0]) == 0 + 1 + 2 + 3 + 4
+    assert outs.shape == (10, 1)  # padded to max_iterations
+
+
+def test_cond():
+    x = mx.nd.array([2.0])
+    out = mx.nd.contrib.cond(
+        x.sum() > 1, lambda: x * 10, lambda: x - 10)
+    assert float(out.asnumpy()[0]) == 20.0
+    out = mx.nd.contrib.cond(
+        x.sum() > 100, lambda: x * 10, lambda: x - 10)
+    assert float(out.asnumpy()[0]) == -8.0
+
+
+def test_foreach_under_jit():
+    """foreach lowers to lax.scan inside hybridized blocks."""
+    class ScanBlock(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            out, _ = mx.nd.contrib.foreach(
+                lambda xi, s: (xi + s, s + 1.0), x,
+                mx.nd.zeros(x.shape[1:]))
+            return out
+
+    blk = ScanBlock()
+    blk.initialize()
+    blk.hybridize()
+    x = mx.nd.array(onp.ones((4, 2), "float32"))
+    out = blk(x)
+    onp.testing.assert_allclose(
+        out.asnumpy(), onp.ones((4, 2)) + onp.arange(4)[:, None],
+        rtol=1e-6)
+
+
+# -------------------------------------------------------------- custom op
+def test_custom_op_forward_backward():
+    @mx.operator.register("scale2")
+    class Scale2Prop(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            class Scale2(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0] * 2)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0] * 2)
+
+            return Scale2()
+
+    x = mx.nd.array(onp.random.rand(3, 4).astype("float32"))
+    out = mx.nd.Custom(x, op_type="scale2")
+    onp.testing.assert_allclose(out.asnumpy(), 2 * x.asnumpy(), rtol=1e-6)
+
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.Custom(x, op_type="scale2")
+        loss = (y * y).sum()
+    loss.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 8 * x.asnumpy(),
+                                rtol=1e-5)
+
+
+def test_custom_op_unregistered_raises():
+    with pytest.raises(MXNetError):
+        mx.nd.Custom(mx.nd.ones((2,)), op_type="nope")
+
+
+# ------------------------------------------------------------ quantization
+def test_quantize_dequantize_roundtrip():
+    x = mx.nd.array((onp.random.rand(16, 16) * 4 - 2).astype("float32"))
+    q, mn, mx_ = mx.nd.invoke("_contrib_quantize_v2", [x])
+    assert q.asnumpy().dtype == onp.int8
+    back = mx.nd.invoke("_contrib_dequantize", [q, mn, mx_])
+    onp.testing.assert_allclose(back.asnumpy(), x.asnumpy(), atol=0.02)
+
+
+def test_quantize_uint8():
+    x = mx.nd.array(onp.linspace(0, 1, 32).astype("float32"))
+    q, mn, mx_ = mx.nd.invoke(
+        "_contrib_quantize", [x, mx.nd.array([0.0]), mx.nd.array([1.0])],
+        out_type="uint8")
+    assert q.asnumpy().dtype == onp.uint8
+    back = mx.nd.invoke("_contrib_dequantize", [q, mn, mx_])
+    onp.testing.assert_allclose(back.asnumpy(), x.asnumpy(), atol=0.01)
+
+
+def test_quantized_fully_connected_matches_float():
+    b, in_dim, units = 4, 32, 8
+    x = (onp.random.rand(b, in_dim) * 2 - 1).astype("float32")
+    w = (onp.random.rand(units, in_dim) * 0.4 - 0.2).astype("float32")
+    bias = (onp.random.rand(units) * 0.1).astype("float32")
+    xq, xmin, xmax = mx.nd.invoke("_contrib_quantize_v2",
+                                  [mx.nd.array(x)])
+    wq, wmin, wmax = mx.nd.invoke("_contrib_quantize_v2",
+                                  [mx.nd.array(w)])
+    bq, bmin, bmax = mx.nd.invoke("_contrib_quantize_v2",
+                                  [mx.nd.array(bias)])
+    acc, omin, omax = mx.nd.invoke(
+        "_contrib_quantized_fully_connected",
+        [xq, wq, bq, xmin, xmax, wmin, wmax, bmin, bmax],
+        num_hidden=units)
+    out = mx.nd.invoke("_contrib_dequantize", [acc, omin, omax])
+    expect = x @ w.T + bias
+    onp.testing.assert_allclose(out.asnumpy(), expect, atol=0.05,
+                                rtol=0.05)
+
+
+def test_quantized_conv_matches_float():
+    x = (onp.random.rand(2, 3, 8, 8) - 0.5).astype("float32")
+    w = (onp.random.rand(4, 3, 3, 3) * 0.4 - 0.2).astype("float32")
+    bias = onp.zeros(4, "float32")
+    xq, xmin, xmax = mx.nd.invoke("_contrib_quantize_v2",
+                                  [mx.nd.array(x)])
+    wq, wmin, wmax = mx.nd.invoke("_contrib_quantize_v2",
+                                  [mx.nd.array(w)])
+    bq, bmin, bmax = mx.nd.invoke("_contrib_quantize_v2",
+                                  [mx.nd.array(bias)])
+    acc, omin, omax = mx.nd.invoke(
+        "_contrib_quantized_conv",
+        [xq, wq, bq, xmin, xmax, wmin, wmax, bmin, bmax],
+        kernel=(3, 3), num_filter=4, pad=(1, 1))
+    out = mx.nd.invoke("_contrib_dequantize", [acc, omin, omax])
+    expect = mx.nd.invoke(
+        "Convolution", [mx.nd.array(x), mx.nd.array(w),
+                        mx.nd.array(bias)],
+        kernel=(3, 3), num_filter=4, pad=(1, 1)).asnumpy()
+    onp.testing.assert_allclose(out.asnumpy(), expect, atol=0.05,
+                                rtol=0.1)
+
+
+def test_quantize_net_end_to_end():
+    from mxnet_tpu.contrib.quantization import quantize_net
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(10))
+    net.initialize(init=mx.init.Xavier())
+    x = mx.nd.array(onp.random.rand(8, 16).astype("float32"))
+    ref = net(x).asnumpy()
+    quantize_net(net, [x], calib_mode="naive")
+    from mxnet_tpu.contrib.quantization import QuantizedDense
+
+    kinds = [type(c).__name__ for c in net._children.values()]
+    assert kinds.count("QuantizedDense") == 2
+    out = net(x).asnumpy()
+    # int8 PTQ: small relative error vs float
+    err = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-6)
+    assert err < 0.1, err
+
+
+def test_calib_entropy_reasonable():
+    from mxnet_tpu.contrib.quantization import calib_entropy
+
+    samples = [mx.nd.array(onp.random.randn(1000).astype("float32"))]
+    mn, mx_ = calib_entropy(samples)
+    assert mn < 0 < mx_
+    assert mx_ <= float(onp.abs(samples[0].asnumpy()).max()) + 1e-6
+
+
+def test_quantize_net_attribute_style():
+    """Attribute-resolved children (self.fc = Dense) must be swapped
+    too, not only _children entries."""
+    from mxnet_tpu.contrib.quantization import (QuantizedDense,
+                                                quantize_net)
+
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.fc1 = gluon.nn.Dense(16, activation="relu")
+                self.fc2 = gluon.nn.Dense(4)
+
+        def hybrid_forward(self, F, x):
+            return self.fc2(self.fc1(x))
+
+    net = Net()
+    net.initialize(init=mx.init.Xavier())
+    x = mx.nd.array(onp.random.rand(4, 8).astype("float32"))
+    ref = net(x).asnumpy()
+    quantize_net(net, [x])
+    assert isinstance(net.fc1, QuantizedDense)
+    assert isinstance(net.fc2, QuantizedDense)
+    out = net(x).asnumpy()
+    err = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-6)
+    assert err < 0.1, err
+
+
+def test_flash_causal_cross_length():
+    """Bottom-right causal alignment is identical between the pallas
+    kernel and the fallback when seq_q != seq_k."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.flash_attention import (_naive_attention,
+                                               flash_attention)
+
+    q = mx.nd.array(onp.random.randn(1, 1, 128, 32).astype("float32"))
+    k = mx.nd.array(onp.random.randn(1, 1, 256, 32).astype("float32"))
+    out = flash_attention(q._data, k._data, k._data, causal=True,
+                          interpret=True)
+    ref = _naive_attention(q._data, k._data, k._data, True,
+                           1.0 / (32 ** 0.5))
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-4, atol=2e-5)

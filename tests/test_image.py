@@ -262,18 +262,21 @@ def test_round_batch_false_partial_batch(tmp_path):
 
 
 def test_producer_error_surfaces_not_hangs(tmp_path):
-    """A corrupt record raises in next() instead of deadlocking."""
+    """A mid-epoch corrupt record payload raises in next() (through the
+    producer error queue) instead of deadlocking."""
     rec = str(tmp_path / "bad.rec")
-    _make_rec(rec, n=6)
-    # append garbage framing
-    with open(rec, "ab") as f:
-        import struct
-
-        f.write(struct.pack("<II", 0xCED7230A, 10 ** 6))  # truncated
+    w = recordio.MXRecordIO(rec, "w")
+    jpg, _ = _jpeg_bytes(40, 40)
+    w.write(recordio.pack(recordio.IRHeader(0, 0.0, 0, 0), jpg))
+    w.write(b"xx")  # valid framing, payload too short for IRHeader
+    w.close()
+    it = mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(3, 32, 32),
+                               batch_size=2)
     with pytest.raises(Exception):
-        it = mx.io.ImageRecordIter(path_imgrec=rec,
-                                   data_shape=(3, 32, 32), batch_size=4)
         list(it)
+    # exhausted-with-error iterator stays raising, not hanging
+    with pytest.raises(Exception):
+        it.next()
 
 
 def test_round_batch_small_shard(tmp_path):

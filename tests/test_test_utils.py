@@ -155,6 +155,9 @@ SPECS = {
     "ROIPooling": ([_u((1, 2, 6, 6)),
                     onp.array([[0, 1, 1, 4, 4]], dtype="float32")],
                    dict(pooled_size=(2, 2), spatial_scale=1.0), [0]),
+    "_contrib_dot_product_attention": ([_n((2, 8, 16)), _n((2, 8, 16)),
+                                        _n((2, 8, 16))],
+                                       dict(num_heads=2)),
     "_contrib_ROIAlign": ([_u((1, 2, 6, 6)),
                            onp.array([[0, 1, 1, 4, 4]],
                                      dtype="float32")],
