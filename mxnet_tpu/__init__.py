@@ -15,6 +15,12 @@ Typical use matches the reference:
 __version__ = "0.1.0"
 
 from . import base  # noqa: F401
+from . import config  # noqa: F401
+
+if config.get_env("MXNET_ENFORCE_DETERMINISM"):
+    import jax as _jax
+
+    _jax.config.update("jax_default_matmul_precision", "highest")
 from .base import MXNetError  # noqa: F401
 from .context import (  # noqa: F401
     Context,

@@ -1,0 +1,173 @@
+"""Environment-variable registry + declarative parameter structs.
+
+Reference parity: SURVEY.md §5.6 — the ~100 ``MXNET_*``/``DMLC_*``
+knobs read via dmlc::GetEnv (docs env_var.md) and the
+``dmlc::Parameter`` declarative structs every op/iterator uses for
+kwarg parsing, defaults, range checks and doc generation.
+
+TPU-native: XLA owns scheduling/memory, so engine-thread and
+memory-pool knobs are accepted for compatibility but documented as
+no-ops; the live knobs configure the host-side data plane, profiler
+autostart and distributed bootstrap.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Optional
+
+from .base import MXNetError
+
+__all__ = ["register_env", "get_env", "list_env", "describe_env",
+           "ParamStruct", "field"]
+
+_ENV: dict[str, "EnvVar"] = {}
+
+
+@dataclasses.dataclass
+class EnvVar:
+    name: str
+    default: Any
+    type: Callable
+    doc: str
+    live: bool = True  # False = accepted for reference compat, no-op
+
+
+def register_env(name, default, typ=str, doc="", live=True):
+    _ENV[name] = EnvVar(name, default, typ, doc, live)
+    return _ENV[name]
+
+
+def get_env(name):
+    """Typed read of a registered env var (dmlc::GetEnv analog)."""
+    if name not in _ENV:
+        raise MXNetError(f"env var {name} is not registered")
+    ev = _ENV[name]
+    raw = os.environ.get(name)
+    if raw is None:
+        return ev.default
+    try:
+        if ev.type is bool:
+            return raw.lower() in ("1", "true", "yes", "on")
+        return ev.type(raw)
+    except (TypeError, ValueError) as e:
+        raise MXNetError(f"invalid value {raw!r} for {name}") from e
+
+
+def list_env():
+    return sorted(_ENV)
+
+
+def describe_env():
+    """The env_var.md-style table, generated from the registry."""
+    lines = ["| Variable | Default | Live | Description |",
+             "|---|---|---|---|"]
+    for name in list_env():
+        ev = _ENV[name]
+        lines.append(f"| {name} | {ev.default!r} | "
+                     f"{'yes' if ev.live else 'compat no-op'} | "
+                     f"{ev.doc} |")
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------- the framework knobs
+register_env("MXNET_CPU_WORKER_NTHREADS", 0, int,
+             "Host decode/augment worker threads (0 = all cores); feeds "
+             "ImageRecordIter preprocess_threads default.")
+register_env("MXNET_TPU_PREFETCH_BUFFER", 4, int,
+             "Batches kept ready ahead of the training loop "
+             "(ImageRecordIter prefetch_buffer default).")
+register_env("MXNET_PROFILER_AUTOSTART", False, bool,
+             "Start the profiler at import (reference knob; wired to "
+             "mx.profiler.set_state('run')).")
+register_env("MXNET_PROFILER_MODE", "imperative", str,
+             "Default profiler scope (symbolic/imperative/all).")
+register_env("MXNET_ENFORCE_DETERMINISM", False, bool,
+             "Force full fp32 matmul precision on the MXU (slower, "
+             "reproducible to the ulp).")
+register_env("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000, int,
+             "Reference key-sharding bound; informational under the "
+             "allreduce design (no server shards to balance).", live=False)
+register_env("MXNET_ENGINE_TYPE", "XLA", str,
+             "Reference engine selector; the XLA async runtime is the "
+             "only engine.", live=False)
+register_env("MXNET_EXEC_BULK_EXEC_TRAIN", True, bool,
+             "Reference bulking knob; XLA fusion subsumes op bulking.",
+             live=False)
+register_env("MXNET_GPU_MEM_POOL_TYPE", "Naive", str,
+             "Reference allocator strategy; XLA owns HBM pooling.",
+             live=False)
+register_env("DMLC_NUM_WORKER", 1, int,
+             "Distributed worker count (tools/launch.py contract).")
+register_env("DMLC_WORKER_ID", 0, int, "This worker's rank.")
+register_env("DMLC_PS_ROOT_URI", "127.0.0.1", str,
+             "Coordinator address (worker 0).")
+register_env("DMLC_PS_ROOT_PORT", "9091", str, "Coordinator port.")
+
+
+# ------------------------------------------------------------ ParamStruct
+_MISSING = object()
+
+
+def field(default=_MISSING, *, doc="", low=None, high=None, choices=None):
+    """Declare one parameter (DMLC_DECLARE_FIELD analog)."""
+    return {"default": default, "doc": doc, "low": low, "high": high,
+            "choices": choices}
+
+
+class ParamStruct:
+    """Declarative parameter struct (dmlc::Parameter analog).
+
+    Subclasses declare fields as class attributes via ``field()``;
+    ``__init__(**kwargs)`` parses with defaults/range/choice checks and
+    ``describe()`` generates the doc table — the same triple duty the
+    reference structs serve (parse, validate, document).
+    """
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        cls._fields = {}
+        for base in reversed(cls.__mro__):
+            for k, v in vars(base).items():
+                if isinstance(v, dict) and "default" in v and "doc" in v:
+                    cls._fields[k] = v
+
+    def __init__(self, **kwargs):
+        for name, spec in self._fields.items():
+            if name in kwargs:
+                val = kwargs.pop(name)
+            elif spec["default"] is not _MISSING:
+                val = spec["default"]
+            else:
+                raise MXNetError(
+                    f"{type(self).__name__}: required parameter "
+                    f"{name!r} missing")
+            if spec["low"] is not None and val < spec["low"]:
+                raise MXNetError(
+                    f"{type(self).__name__}.{name}={val} below minimum "
+                    f"{spec['low']}")
+            if spec["high"] is not None and val > spec["high"]:
+                raise MXNetError(
+                    f"{type(self).__name__}.{name}={val} above maximum "
+                    f"{spec['high']}")
+            if spec["choices"] is not None and val not in spec["choices"]:
+                raise MXNetError(
+                    f"{type(self).__name__}.{name}={val!r} not in "
+                    f"{spec['choices']}")
+            setattr(self, name, val)
+        if kwargs:
+            raise MXNetError(
+                f"{type(self).__name__}: unknown parameters "
+                f"{sorted(kwargs)}")
+
+    @classmethod
+    def describe(cls):
+        lines = [f"Parameters of {cls.__name__}:"]
+        for name, spec in cls._fields.items():
+            d = "" if spec["default"] is _MISSING else \
+                f" (default {spec['default']!r})"
+            lines.append(f"  {name}{d}: {spec['doc']}")
+        return "\n".join(lines)
+
+    def as_dict(self):
+        return {k: getattr(self, k) for k in self._fields}

@@ -116,6 +116,20 @@ def quantize_net(net, calib_data, calib_mode="naive",
     if calib_mode not in ("naive", "entropy"):
         raise MXNetError(f"unknown calib_mode {calib_mode!r}")
 
+    # hybridized (jit-cached) forwards bypass child hooks: run the
+    # calibration passes eagerly, restoring hybridization after
+    hybrid_states = []
+
+    def _collect_hybrid(block):
+        if getattr(block, "_active", False):
+            hybrid_states.append(block)
+        for child in block._children.values():
+            _collect_hybrid(child)
+
+    _collect_hybrid(net)
+    for b in hybrid_states:
+        b.hybridize(False)
+
     # record per-layer input activations via forward hooks
     taps: dict[str, list] = {}
     handles = []
@@ -155,4 +169,6 @@ def quantize_net(net, calib_data, calib_mode="naive",
                 _swap(child)
 
     _swap(net)
+    for b in hybrid_states:
+        b.hybridize(True)
     return net

@@ -13,6 +13,7 @@ from .parameter import (  # noqa: F401
 )
 from . import nn  # noqa: F401
 from . import loss  # noqa: F401
+from . import contrib  # noqa: F401
 
 import importlib as _importlib
 

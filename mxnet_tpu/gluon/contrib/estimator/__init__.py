@@ -1,0 +1,6 @@
+"""Gluon Estimator (reference: python/mxnet/gluon/contrib/estimator/)."""
+from .estimator import Estimator  # noqa: F401
+from .event_handler import (BatchBegin, BatchEnd, CheckpointHandler,  # noqa: F401
+                            EarlyStoppingHandler, EpochBegin, EpochEnd,
+                            LoggingHandler, StoppingHandler, TrainBegin,
+                            TrainEnd)

@@ -1,0 +1,114 @@
+"""Estimator — the gluon training-loop driver.
+
+Reference parity: gluon/contrib/estimator/estimator.py:40 (Estimator)
+and :283 (fit loop dispatching event handlers)."""
+from __future__ import annotations
+
+from .... import autograd, metric as metric_mod
+from ....base import MXNetError
+from ...trainer import Trainer
+from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
+                            LoggingHandler, StoppingHandler, TrainBegin,
+                            TrainEnd)
+
+__all__ = ["Estimator"]
+
+
+class Estimator:
+    def __init__(self, net, loss, train_metrics=None, trainer=None,
+                 context=None, val_metrics=None):
+        self.net = net
+        self.loss = loss
+        self.train_metrics = self._norm_metrics(train_metrics)
+        self.val_metrics = self.__init_val_metrics(val_metrics,
+                                                   train_metrics)
+        self.trainer = trainer or Trainer(
+            net.collect_params(), "sgd", {"learning_rate": 0.001})
+        self.max_epoch = None
+
+    @staticmethod
+    def _norm_metrics(metrics):
+        if metrics is None:
+            return [metric_mod.Accuracy()]
+        if isinstance(metrics, metric_mod.EvalMetric):
+            return [metrics]
+        return list(metrics)
+
+    def __init_val_metrics(self, val_metrics, train_metrics):
+        if val_metrics is not None:
+            return self._norm_metrics(val_metrics)
+        # independent copies: evaluate() must not reset/overwrite the
+        # train metrics mid-fit
+        import copy
+
+        return [copy.deepcopy(m) for m in self.train_metrics]
+
+    def _dispatch(self, handlers, event, *args, **kwargs):
+        for h in handlers:
+            fn = getattr(h, event, None)
+            if fn is not None:
+                fn(self, *args, **kwargs)
+
+    def evaluate(self, val_data):
+        for m in self.val_metrics:
+            m.reset()
+        for batch in val_data:
+            data, label = self._unpack(batch)
+            out = self.net(data)
+            for m in self.val_metrics:
+                m.update([label], [out])
+        return {m.get()[0]: m.get()[1] for m in self.val_metrics}
+
+    @staticmethod
+    def _unpack(batch):
+        if isinstance(batch, (list, tuple)) and len(batch) == 2:
+            return batch[0], batch[1]
+        if hasattr(batch, "data"):
+            return batch.data[0], batch.label[0]
+        raise MXNetError("cannot unpack batch")
+
+    def fit(self, train_data, val_data=None, epochs=None,
+            event_handlers=None, batches=None):
+        if epochs is None and batches is None:
+            raise MXNetError(
+                "fit() needs a stopping condition: pass epochs and/or "
+                "batches (reference estimator raises the same)")
+        handlers = list(event_handlers or [])
+        stopper = StoppingHandler(max_epoch=epochs, max_batch=batches)
+        handlers.append(stopper)
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler())
+        begin = [h for h in handlers if isinstance(h, TrainBegin)]
+        end = [h for h in handlers if isinstance(h, TrainEnd)]
+        e_begin = [h for h in handlers if isinstance(h, EpochBegin)]
+        e_end = [h for h in handlers if isinstance(h, EpochEnd)]
+        b_begin = [h for h in handlers if isinstance(h, BatchBegin)]
+        b_end = [h for h in handlers if isinstance(h, BatchEnd)]
+
+        self._dispatch(begin, "train_begin")
+        stop = False
+        while not stop:
+            self._dispatch(e_begin, "epoch_begin")
+            for m in self.train_metrics:
+                m.reset()
+            for batch in train_data:
+                self._dispatch(b_begin, "batch_begin", batch=batch)
+                data, label = self._unpack(batch)
+                with autograd.record():
+                    out = self.net(data)
+                    loss = self.loss(out, label)
+                loss.backward()
+                self.trainer.step(data.shape[0])
+                for m in self.train_metrics:
+                    m.update([label], [out])
+                self._dispatch(b_end, "batch_end", batch=batch)
+                stop = any(getattr(h, "stop_training", False)
+                           for h in handlers)
+                if stop:
+                    break
+            if val_data is not None:
+                self.evaluate(val_data)
+            self._dispatch(e_end, "epoch_end")
+            stop = stop or any(getattr(h, "stop_training", False)
+                               for h in handlers)
+        self._dispatch(end, "train_end")

@@ -1,0 +1,161 @@
+"""Estimator event handlers (reference:
+gluon/contrib/estimator/event_handler.py)."""
+from __future__ import annotations
+
+import logging
+import os
+
+__all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+           "BatchBegin", "BatchEnd", "StoppingHandler", "LoggingHandler",
+           "CheckpointHandler", "EarlyStoppingHandler"]
+
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop after max_epoch/max_batch (reference event_handler.py:94)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch >= self.max_batch:
+            self.stop_training = True
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            self.stop_training = True
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochEnd, BatchEnd):
+    """Periodic metric logging (reference event_handler.py:154)."""
+
+    def __init__(self, log_interval="epoch", metrics=None):
+        self.log_interval = log_interval
+        self.metrics = metrics or []
+        self._batches = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        logging.info("training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        logging.info("training end: %s", self._fmt(estimator))
+
+    def _fmt(self, estimator):
+        return " ".join(f"{m.get()[0]}={m.get()[1]:.4f}"
+                        for m in (self.metrics
+                                  or estimator.train_metrics))
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self._batches += 1
+        if isinstance(self.log_interval, int) and \
+                self._batches % self.log_interval == 0:
+            logging.info("batch %d: %s", self._batches,
+                         self._fmt(estimator))
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        logging.info("epoch end: %s", self._fmt(estimator))
+
+
+class CheckpointHandler(TrainBegin, EpochEnd):
+    """Save parameters every epoch (reference event_handler.py:349)."""
+
+    def __init__(self, model_dir, model_prefix="model", save_best=False,
+                 monitor=None, mode="min"):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.save_best = save_best
+        self.monitor = monitor
+        self.mode = mode
+        self._best = None
+        self._epoch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        os.makedirs(self.model_dir, exist_ok=True)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        path = os.path.join(self.model_dir,
+                            f"{self.model_prefix}-epoch{self._epoch}"
+                            ".params")
+        estimator.net.save_parameters(path)
+        if self.save_best and self.monitor is not None:
+            _, val = self.monitor.get()
+            better = (self._best is None
+                      or (val < self._best if self.mode == "min"
+                          else val > self._best))
+            if better:
+                self._best = val
+                estimator.net.save_parameters(os.path.join(
+                    self.model_dir, f"{self.model_prefix}-best.params"))
+        self._epoch += 1
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd):
+    """Stop when a metric stops improving (reference
+    event_handler.py:533)."""
+
+    def __init__(self, monitor, min_delta=0, patience=0, mode="min"):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.mode = mode
+        self._best = None
+        self._waited = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self._best = None
+        self._waited = 0
+        self.stop_training = False
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        _, val = self.monitor.get()
+        improved = (self._best is None
+                    or (val < self._best - self.min_delta
+                        if self.mode == "min"
+                        else val > self._best + self.min_delta))
+        if improved:
+            self._best = val
+            self._waited = 0
+        else:
+            self._waited += 1
+            if self._waited > self.patience:
+                self.stop_training = True

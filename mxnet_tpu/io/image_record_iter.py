@@ -42,8 +42,8 @@ class ImageRecordIter(DataIter):
     def __init__(self, path_imgrec, data_shape, batch_size, shuffle=False,
                  rand_crop=False, rand_mirror=False, resize=-1,
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0,
-                 std_g=1.0, std_b=1.0, preprocess_threads=4,
-                 prefetch_buffer=4, label_width=1, round_batch=True,
+                 std_g=1.0, std_b=1.0, preprocess_threads=None,
+                 prefetch_buffer=None, label_width=1, round_batch=True,
                  part_index=0, num_parts=1, seed=0, dtype="float32",
                  **kwargs):
         super().__init__(batch_size)
@@ -57,8 +57,13 @@ class ImageRecordIter(DataIter):
         self._resize = resize
         self._mean = onp.array([mean_r, mean_g, mean_b], "float32")
         self._std = onp.array([std_r, std_g, std_b], "float32")
-        self._threads = preprocess_threads
-        self._prefetch = prefetch_buffer
+        from .. import config as _config
+
+        self._threads = (preprocess_threads if preprocess_threads
+                         is not None
+                         else _config.get_env("MXNET_CPU_WORKER_NTHREADS"))
+        self._prefetch = (prefetch_buffer if prefetch_buffer is not None
+                          else _config.get_env("MXNET_TPU_PREFETCH_BUFFER"))
         self._round_batch = round_batch
         self._rng = onp.random.RandomState(seed)
         self._dtype = dtype

@@ -42,11 +42,45 @@ class Module(BaseModule):
         ]
         self._aux_names = symbol.list_auxiliary_states()
         self._exec = None
+        self._data_shapes = None
+        self._label_shapes = None
         self._optimizer = None
         self._updater = None
         self._arg_params = None  # preloaded checkpoint weights (load())
         self._aux_params = None
         self._grad_req = None
+
+    # ------------------------------------------------------- descriptors
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        self._check_binded()
+        shape_kwargs = {n: tuple(s) for n, s in self._data_shapes}
+        if self._label_shapes:
+            shape_kwargs.update(
+                {n: tuple(s) for n, s in self._label_shapes})
+        _, out_shapes, _ = self._symbol.infer_shape_partial(
+            **shape_kwargs)
+        return list(zip(self._symbol.list_outputs(), out_shapes))
 
     # ------------------------------------------------------------- bind
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -55,6 +89,10 @@ class Module(BaseModule):
         if self.binded and not force_rebind:
             return
         self.for_training = for_training
+        self._data_shapes = [(d[0], tuple(d[1])) for d in data_shapes]
+        self._label_shapes = ([(d[0], tuple(d[1]))
+                               for d in label_shapes]
+                              if label_shapes else None)
         shape_kwargs = {}
         for desc in data_shapes:
             name, shape = desc[0], desc[1]

@@ -33,15 +33,16 @@ class CSRNDArray(BaseSparseNDArray):
     @property
     def indices(self):
         a = onp.asarray(self._data)
-        idx = [onp.nonzero(row)[0] for row in a]
-        return array(onp.concatenate(idx) if idx else onp.zeros(0),
-                     dtype="int64")
+        # row-major nonzero scan == concatenated per-row column indices
+        _, cols = onp.nonzero(a)
+        return array(cols, dtype="int64")
 
     @property
     def indptr(self):
         a = onp.asarray(self._data)
-        counts = [0] + [int((row != 0).sum()) for row in a]
-        return array(onp.cumsum(counts), dtype="int64")
+        counts = onp.count_nonzero(a, axis=1)
+        return array(onp.concatenate([[0], onp.cumsum(counts)]),
+                     dtype="int64")
 
     @property
     def data(self):
@@ -106,15 +107,13 @@ def cast_storage(arr, stype):
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, tuple) and len(arg1) == 3:
         data, indices, indptr = arg1
-        import numpy as np
-
         dense = onp.zeros(shape, dtype=dtype or "float32")
         data = onp.asarray(data)
         indices = onp.asarray(indices, dtype=onp.int64)
         indptr = onp.asarray(indptr, dtype=onp.int64)
-        for r in range(shape[0]):
-            cols = indices[indptr[r]:indptr[r + 1]]
-            dense[r, cols] = data[indptr[r]:indptr[r + 1]]
+        # vectorized scatter: per-nnz row ids from the indptr deltas
+        rows = onp.repeat(onp.arange(shape[0]), onp.diff(indptr))
+        dense[rows, indices[:len(rows)]] = data[:len(rows)]
         return CSRNDArray(array(dense, ctx=ctx, dtype=dtype)._data)
     return CSRNDArray(array(arg1, ctx=ctx, dtype=dtype)._data)
 

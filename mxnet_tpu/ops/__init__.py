@@ -20,5 +20,6 @@ from . import (  # noqa: F401  (import-for-registration)
     flash_attention,
     quantization_ops,
     control_flow_ops,
+    optimizer_ops,
 )
 from .registry import OpDef, alias_op, get_op, list_ops, register_op  # noqa: F401

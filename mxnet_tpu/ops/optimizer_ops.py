@@ -1,0 +1,167 @@
+"""Optimizer update operators — optimizers as ops.
+
+Reference parity: src/operator/optimizer_op.cc (sgd_update,
+sgd_mom_update, adam_update, rmsprop_update, ftrl_update, signsgd/
+signum, nag_mom_update, + the multi-tensor variants used by
+DataParallel training and Horovod).  Each op delegates to the SAME
+jitted rule functions the Optimizer classes use, so all three surfaces
+(Optimizer.update, fused_update, these ops) share one implementation.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..optimizer.optimizer import (_adagrad_step, _adam_step,
+                                   _ftrl_step, _nag_step,
+                                   _rmsprop_alex_step, _rmsprop_step,
+                                   _sgd_mom_step, _sgd_step,
+                                   _signum_step)
+from .registry import register_op
+
+
+def _prep(grad, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+@register_op("sgd_update", differentiable=False)
+def sgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True):
+    """Reference: optimizer_op.cc sgd_update."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return _sgd_step(weight, g, lr, wd)
+
+
+@register_op("sgd_mom_update", num_outputs=2, differentiable=False)
+def sgd_mom_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0,
+                   lazy_update=True):
+    """Reference: optimizer_op.cc sgd_mom_update.  Returns (weight,
+    mom) — functional outputs instead of the reference's in-place
+    mutation (XLA has no aliasing op outputs at this surface)."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return _sgd_mom_step(weight, mom, g, lr, wd, momentum)
+
+
+@register_op("nag_mom_update", num_outputs=2, differentiable=False)
+def nag_mom_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return _nag_step(weight, mom, g, lr, wd, momentum)
+
+
+@register_op("adam_update", num_outputs=3, differentiable=False)
+def adam_update(weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, t=1.0, lazy_update=True):
+    """Reference: optimizer_op.cc adam_update (+ explicit t for the
+    bias correction the reference tracks per-weight internally)."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return _adam_step(weight, mean, var, g, lr, wd, beta1, beta2,
+                      epsilon, t)
+
+
+@register_op("rmsprop_update", num_outputs=2, differentiable=False)
+def rmsprop_update(weight, grad, n, *, lr, gamma1=0.95, epsilon=1e-8,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                   clip_weights=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_w, new_n = _rmsprop_step(weight, n, g, lr, wd, gamma1, epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n
+
+
+@register_op("rmspropalex_update", num_outputs=4, differentiable=False)
+def rmspropalex_update(weight, grad, n, g_avg, delta, *, lr, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return _rmsprop_alex_step(weight, n, g_avg, delta, g, lr, wd,
+                              gamma1, gamma2, epsilon)
+
+
+@register_op("ftrl_update", num_outputs=3, differentiable=False)
+def ftrl_update(weight, grad, z, n, *, lr, lamda1=0.01, beta=1.0,
+                wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return _ftrl_step(weight, z, n, g, lr, wd, lamda1, beta)
+
+
+@register_op("signsgd_update", differentiable=False)
+def signsgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return (1 - lr * wd) * weight - lr * jnp.sign(g)
+
+
+@register_op("signum_update", num_outputs=2, differentiable=False)
+def signum_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return _signum_step(weight, mom, g, lr, wd, momentum, wd_lh)
+
+
+@register_op("adagrad_update", num_outputs=2, differentiable=False,
+             aliases=("_sparse_adagrad_update",))
+def adagrad_update(weight, grad, history, *, lr, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return _adagrad_step(weight, history, g, lr, wd, epsilon)
+
+
+# ------------------------------------------------- multi-tensor variants
+@register_op("multi_sgd_update",
+             num_outputs=lambda p: p.get("num_weights", 1),
+             differentiable=False)
+def multi_sgd_update(*args, lrs, wds, num_weights=1, rescale_grad=1.0,
+                     clip_gradient=-1.0):
+    """Reference: optimizer_op.cc multi_sgd_update (one fused launch for
+    many small tensors — XLA fuses these anyway; kept for API parity)."""
+    weights = args[:num_weights]
+    grads = args[num_weights:2 * num_weights]
+    outs = []
+    for w, g, lr, wd in zip(weights, grads, lrs, wds):
+        outs.append(_sgd_step(w, _prep(g, rescale_grad, clip_gradient),
+                              lr, wd))
+    return tuple(outs)
+
+
+@register_op("multi_sgd_mom_update",
+             num_outputs=lambda p: 2 * p.get("num_weights", 1),
+             differentiable=False)
+def multi_sgd_mom_update(*args, lrs, wds, momentum=0.0, num_weights=1,
+                         rescale_grad=1.0, clip_gradient=-1.0):
+    weights = args[:num_weights]
+    grads = args[num_weights:2 * num_weights]
+    moms = args[2 * num_weights:3 * num_weights]
+    new_w, new_m = [], []
+    for w, g, m, lr, wd in zip(weights, grads, moms, lrs, wds):
+        nw, nm = _sgd_mom_step(w, m, _prep(g, rescale_grad,
+                                           clip_gradient), lr, wd,
+                               momentum)
+        new_w.append(nw)
+        new_m.append(nm)
+    return tuple(new_w) + tuple(new_m)
+
+
+@register_op("multi_sum_sq",
+             num_outputs=1, differentiable=False)
+def multi_sum_sq(*arrays, num_arrays=1):
+    """Reference: contrib/multi_sum_sq.cc (LARS norm helper)."""
+    return jnp.stack([jnp.sum(a.astype(jnp.float32) ** 2)
+                      for a in arrays])
+
+
+@register_op("multi_lars", differentiable=False)
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, *, eta, eps,
+               rescale_grad=1.0):
+    """Reference: contrib/multi_lars.cc — layer-wise LR scaling from
+    precomputed squared norms."""
+    w_norm = jnp.sqrt(weights_sum_sq)
+    g_norm = jnp.sqrt(grads_sum_sq) * rescale_grad
+    trust = jnp.where((w_norm > 0) & (g_norm > 0),
+                      eta * w_norm / (g_norm + wds * w_norm + eps), 1.0)
+    return lrs * trust

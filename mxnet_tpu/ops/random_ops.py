@@ -135,3 +135,86 @@ def uniform_like(data, *, low=0.0, high=1.0, key=None):
              key_param="key", differentiable=False)
 def normal_like(data, *, loc=0.0, scale=1.0, key=None):
     return jax.random.normal(key, data.shape, data.dtype) * scale + loc
+
+
+# ------------------------------------------------------- pdf op family
+# Reference: src/operator/random/pdf_op.cc — probability density (or
+# log-density) of samples under parameterized distributions.
+import jax.scipy.stats as _jstats  # noqa: E402
+import jax.numpy as _jnp  # noqa: E402
+
+
+def _pdf_out(logpdf, is_log):
+    return logpdf if is_log else _jnp.exp(logpdf)
+
+
+@register_op("_random_pdf_uniform", aliases=("random_pdf_uniform",))
+def pdf_uniform(sample, low, high, *, is_log=False):
+    inside = (sample >= low[..., None]) & (sample <= high[..., None])
+    logp = _jnp.where(inside,
+                      -_jnp.log(high[..., None] - low[..., None]),
+                      -_jnp.inf)
+    return _pdf_out(logp, is_log)
+
+
+@register_op("_random_pdf_normal", aliases=("random_pdf_normal",))
+def pdf_normal(sample, mu, sigma, *, is_log=False):
+    logp = _jstats.norm.logpdf(sample, mu[..., None], sigma[..., None])
+    return _pdf_out(logp, is_log)
+
+
+@register_op("_random_pdf_gamma", aliases=("random_pdf_gamma",))
+def pdf_gamma(sample, alpha, beta, *, is_log=False):
+    logp = _jstats.gamma.logpdf(sample, alpha[..., None],
+                                scale=1.0 / beta[..., None])
+    return _pdf_out(logp, is_log)
+
+
+@register_op("_random_pdf_exponential",
+             aliases=("random_pdf_exponential",))
+def pdf_exponential(sample, lam, *, is_log=False):
+    logp = _jstats.expon.logpdf(sample, scale=1.0 / lam[..., None])
+    return _pdf_out(logp, is_log)
+
+
+@register_op("_random_pdf_poisson", aliases=("random_pdf_poisson",))
+def pdf_poisson(sample, lam, *, is_log=False):
+    logp = _jstats.poisson.logpmf(sample, lam[..., None])
+    return _pdf_out(logp, is_log)
+
+
+@register_op("_random_pdf_negative_binomial",
+             aliases=("random_pdf_negative_binomial",))
+def pdf_negative_binomial(sample, k, p, *, is_log=False):
+    kk = k[..., None]
+    pp = p[..., None]
+    from jax.scipy.special import gammaln as _gammaln
+
+    logp = (_gammaln(sample + kk) - _gammaln(sample + 1.0)
+            - _gammaln(kk) + kk * _jnp.log(pp)
+            + sample * _jnp.log1p(-pp))
+    return _pdf_out(logp, is_log)
+
+
+@register_op("_random_pdf_generalized_negative_binomial",
+             aliases=("random_pdf_generalized_negative_binomial",))
+def pdf_gen_negative_binomial(sample, mu, alpha, *, is_log=False):
+    a = 1.0 / alpha[..., None]
+    m = mu[..., None]
+    p = a / (a + m)
+    from jax.scipy.special import gammaln as _gammaln
+
+    logp = (_gammaln(sample + a) - _gammaln(sample + 1.0) - _gammaln(a)
+            + a * _jnp.log(p) + sample * _jnp.log1p(-p))
+    return _pdf_out(logp, is_log)
+
+
+@register_op("_random_pdf_dirichlet", aliases=("random_pdf_dirichlet",))
+def pdf_dirichlet(sample, alpha, *, is_log=False):
+    from jax.scipy.special import gammaln as _gammaln
+
+    a = alpha
+    logp = (_jnp.sum((a - 1.0) * _jnp.log(sample), axis=-1)
+            + _gammaln(_jnp.sum(a, axis=-1))
+            - _jnp.sum(_gammaln(a), axis=-1))
+    return _pdf_out(logp, is_log)

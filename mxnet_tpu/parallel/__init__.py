@@ -30,7 +30,8 @@ from ..base import MXNetError
 
 __all__ = ["get_mesh", "functionalize", "make_train_step",
            "DataParallelTrainer", "Mesh", "NamedSharding", "P",
-           "NORM_STAT_SUFFIXES", "amp_cast_params", "ring"]
+           "NORM_STAT_SUFFIXES", "amp_cast_params", "auto_tp_spec",
+           "ring"]
 
 #: parameter-name suffixes that stay fp32 under mixed precision (the AMP
 #: policy the reference encodes in contrib/amp/lists: norm affine+stats)
@@ -105,6 +106,27 @@ def functionalize(block, train=False):
         return out._data
 
     return params, apply_fn
+
+
+def auto_tp_spec(block, tp_size, axis_name="model", min_dim=64):
+    """Derive a tensor-parallel ``param_spec`` for a model-zoo network.
+
+    Shards the leading (output-channel/units) axis of conv and dense
+    weights over ``axis_name`` wherever it divides by ``tp_size`` and is
+    at least ``min_dim`` (small layers replicate — the collective cost
+    outweighs the split).  Norm statistics and biases replicate.  The
+    reference has no TP (SURVEY.md §2.5: absent); this is the modern
+    mandate's default policy, overridable per-param by the caller.
+    """
+    probe, _ = functionalize(block)
+    spec = {}
+    for name, v in probe.items():
+        if _is_norm_stat(name) or name.endswith("_bias"):
+            continue
+        if name.endswith("_weight") and v.ndim >= 2 and \
+                v.shape[0] % tp_size == 0 and v.shape[0] >= min_dim:
+            spec[name] = P(*((axis_name,) + (None,) * (v.ndim - 1)))
+    return spec
 
 
 def _build_optimizer(optimizer, learning_rate, momentum, wd, beta1, beta2,

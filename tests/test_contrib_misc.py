@@ -264,3 +264,23 @@ def test_flash_causal_cross_length():
                            1.0 / (32 ** 0.5))
     onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
                                 rtol=2e-4, atol=2e-5)
+
+
+def test_quantize_net_hybridized():
+    """Hybridized nets are calibrated eagerly (jit bypasses hooks) and
+    re-hybridized after the swap."""
+    from mxnet_tpu.contrib.quantization import (QuantizedDense,
+                                                quantize_net)
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+    x = mx.nd.array(onp.random.rand(4, 8).astype("float32"))
+    ref = net(x).asnumpy()
+    quantize_net(net, [x])
+    kinds = [type(c).__name__ for c in net._children.values()]
+    assert kinds.count("QuantizedDense") == 2
+    out = net(x).asnumpy()
+    err = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-6)
+    assert err < 0.1, err

@@ -1,0 +1,250 @@
+"""Optimizer-as-ops, pdf ops, config/env registry, SequentialModule /
+PythonModule, gluon Estimator (reference: test_operator optimizer-op
+cases, test_random pdf cases, test_module sequential cases,
+test_gluon_estimator)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.base import MXNetError
+
+onp.random.seed(17)
+
+
+# --------------------------------------------------------- optimizer ops
+def test_sgd_update_op_matches_optimizer():
+    from mxnet_tpu import optimizer as opt_mod
+
+    w0 = onp.random.rand(5).astype("float32")
+    g = onp.random.rand(5).astype("float32")
+    out = mx.nd.invoke("sgd_update", [mx.nd.array(w0), mx.nd.array(g)],
+                       lr=0.1, wd=0.01)
+    opt = opt_mod.create("sgd", learning_rate=0.1, wd=0.01)
+    w_nd = mx.nd.array(w0)
+    opt.update(0, w_nd, mx.nd.array(g), opt.create_state(0, w_nd))
+    onp.testing.assert_allclose(out.asnumpy(), w_nd.asnumpy(),
+                                rtol=1e-6)
+
+
+def test_adam_update_op():
+    w = mx.nd.array(onp.ones(4, "float32"))
+    g = mx.nd.array(onp.full(4, 0.5, "float32"))
+    m = mx.nd.zeros((4,))
+    v = mx.nd.zeros((4,))
+    new_w, new_m, new_v = mx.nd.invoke(
+        "adam_update", [w, g, m, v], lr=0.01, t=1.0)
+    assert (new_w.asnumpy() < 1.0).all()
+    assert onp.allclose(new_m.asnumpy(), 0.05, rtol=1e-5)
+
+
+def test_multi_sgd_and_lars_ops():
+    ws = [mx.nd.ones((3,)), mx.nd.ones((2,))]
+    gs = [mx.nd.ones((3,)), mx.nd.ones((2,))]
+    outs = mx.nd.invoke("multi_sgd_update", ws + gs,
+                        lrs=(0.1, 0.2), wds=(0.0, 0.0), num_weights=2)
+    onp.testing.assert_allclose(outs[0].asnumpy(), onp.full(3, 0.9),
+                                rtol=1e-6)
+    onp.testing.assert_allclose(outs[1].asnumpy(), onp.full(2, 0.8),
+                                rtol=1e-6)
+    sq = mx.nd.invoke("multi_sum_sq", ws, num_arrays=2)
+    onp.testing.assert_allclose(sq.asnumpy(), [3.0, 2.0], rtol=1e-6)
+
+
+# --------------------------------------------------------------- pdf ops
+def test_pdf_normal_matches_scipy_formula():
+    x = onp.array([[0.0, 1.0, -1.0]], "float32")
+    p = mx.nd.invoke("_random_pdf_normal",
+                     [mx.nd.array(x), mx.nd.array([0.0]),
+                      mx.nd.array([1.0])]).asnumpy()
+    expect = onp.exp(-x ** 2 / 2) / onp.sqrt(2 * onp.pi)
+    onp.testing.assert_allclose(p, expect, rtol=1e-5)
+    logp = mx.nd.invoke("_random_pdf_normal",
+                        [mx.nd.array(x), mx.nd.array([0.0]),
+                         mx.nd.array([1.0])], is_log=True).asnumpy()
+    onp.testing.assert_allclose(logp, onp.log(expect), rtol=1e-5)
+
+
+def test_pdf_gamma_exponential_poisson():
+    s = onp.array([[0.5, 1.5]], "float32")
+    p = mx.nd.invoke("_random_pdf_exponential",
+                     [mx.nd.array(s), mx.nd.array([2.0])]).asnumpy()
+    onp.testing.assert_allclose(p, 2.0 * onp.exp(-2.0 * s), rtol=1e-5)
+    p = mx.nd.invoke("_random_pdf_gamma",
+                     [mx.nd.array(s), mx.nd.array([2.0]),
+                      mx.nd.array([1.0])]).asnumpy()
+    onp.testing.assert_allclose(p, s * onp.exp(-s), rtol=1e-4)
+    k = onp.array([[0.0, 2.0]], "float32")
+    p = mx.nd.invoke("_random_pdf_poisson",
+                     [mx.nd.array(k), mx.nd.array([1.0])]).asnumpy()
+    onp.testing.assert_allclose(
+        p, onp.exp(-1.0) / onp.array([[1.0, 2.0]]), rtol=1e-5)
+
+
+def test_pdf_dirichlet():
+    s = onp.array([[0.3, 0.7]], "float32")
+    a = onp.array([[1.0, 1.0]], "float32")
+    p = mx.nd.invoke("_random_pdf_dirichlet",
+                     [mx.nd.array(s), mx.nd.array(a)]).asnumpy()
+    onp.testing.assert_allclose(p, [1.0], rtol=1e-5)  # uniform simplex
+
+
+# ------------------------------------------------------------ config/env
+def test_env_registry():
+    from mxnet_tpu import config
+
+    assert config.get_env("MXNET_TPU_PREFETCH_BUFFER") == 4
+    os.environ["MXNET_TPU_PREFETCH_BUFFER"] = "9"
+    try:
+        assert config.get_env("MXNET_TPU_PREFETCH_BUFFER") == 9
+    finally:
+        del os.environ["MXNET_TPU_PREFETCH_BUFFER"]
+    with pytest.raises(MXNetError):
+        config.get_env("MXNET_NOT_REGISTERED")
+    table = config.describe_env()
+    assert "MXNET_ENGINE_TYPE" in table and "compat no-op" in table
+
+
+def test_param_struct():
+    from mxnet_tpu.config import ParamStruct, field
+
+    class IterParam(ParamStruct):
+        batch_size = field(doc="batch size", low=1)
+        shuffle = field(False, doc="shuffle data")
+        layout = field("NCHW", doc="data layout",
+                       choices=("NCHW", "NHWC"))
+
+    p = IterParam(batch_size=32)
+    assert p.batch_size == 32 and p.shuffle is False
+    with pytest.raises(MXNetError):
+        IterParam()  # required missing
+    with pytest.raises(MXNetError):
+        IterParam(batch_size=32, layout="HWCN")
+    assert "batch size" in IterParam.describe()
+
+
+# -------------------------------------------------- sequential / python module
+def _simple_symbol(num_hidden, prefix):
+    data = mx.sym.Variable("data")
+    return mx.sym.FullyConnected(data=data, num_hidden=num_hidden,
+                                 name=f"{prefix}_fc")
+
+
+def test_sequential_module_forward_backward():
+    from mxnet_tpu.module import Module, SequentialModule
+
+    m1 = Module(_simple_symbol(8, "a"), data_names=("data",),
+                label_names=None)
+    m2 = Module(_simple_symbol(4, "b"), data_names=("data",),
+                label_names=None)
+    seq = SequentialModule()
+    seq.add(m1).add(m2)
+    seq.bind(data_shapes=[("data", (2, 6))], inputs_need_grad=True)
+    seq.init_params(initializer=mx.init.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    from mxnet_tpu.io.io import DataBatch
+
+    batch = DataBatch(data=[mx.nd.ones((2, 6))], label=None)
+    seq.forward(batch)
+    out = seq.get_outputs()[0]
+    assert out.shape == (2, 4)
+    seq.backward(out_grads=[mx.nd.ones((2, 4))])
+    g = seq.get_input_grads()[0]
+    assert g.shape == (2, 6)
+    seq.update()
+    args, _ = seq.get_params()
+    assert any(k.startswith("a_fc") for k in args)
+    assert any(k.startswith("b_fc") for k in args)
+
+
+def test_python_loss_module():
+    from mxnet_tpu.io.io import DataBatch
+    from mxnet_tpu.module import PythonLossModule
+
+    mod = PythonLossModule(
+        grad_func=lambda label, scores: scores - label)
+    mod.bind(data_shapes=[("data", (2, 3))])
+    batch = DataBatch(data=[mx.nd.ones((2, 3))],
+                      label=[mx.nd.zeros((2, 3))])
+    mod.forward(batch)
+    onp.testing.assert_allclose(mod.get_outputs()[0].asnumpy(),
+                                onp.ones((2, 3)))
+    mod.backward()
+    onp.testing.assert_allclose(mod.get_input_grads()[0].asnumpy(),
+                                onp.ones((2, 3)))
+
+
+# -------------------------------------------------------------- estimator
+def test_estimator_fit_and_handlers(tmp_path):
+    from mxnet_tpu.gluon.contrib.estimator import (CheckpointHandler,
+                                                   Estimator)
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(3))
+    net.initialize(init=mx.init.Xavier())
+    est = Estimator(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        trainer=gluon.Trainer(net.collect_params(), "sgd",
+                              {"learning_rate": 0.1}))
+    X = mx.nd.array(onp.random.rand(64, 8).astype("float32"))
+    Y = mx.nd.array(onp.random.randint(0, 3, 64).astype("float32"))
+    data = [(X[i * 16:(i + 1) * 16], Y[i * 16:(i + 1) * 16])
+            for i in range(4)]
+    ckpt = CheckpointHandler(str(tmp_path), model_prefix="est")
+    est.fit(data, val_data=data, epochs=3, event_handlers=[ckpt])
+    assert os.path.exists(str(tmp_path / "est-epoch0.params"))
+    assert os.path.exists(str(tmp_path / "est-epoch2.params"))
+    name, acc = est.train_metrics[0].get()
+    assert name == "accuracy" and 0.0 <= acc <= 1.0
+
+
+def test_estimator_early_stopping():
+    from mxnet_tpu.gluon.contrib.estimator import (EarlyStoppingHandler,
+                                                   Estimator)
+    from mxnet_tpu import metric as metric_mod
+
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    acc = metric_mod.Accuracy()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=[acc])
+    stopper = EarlyStoppingHandler(monitor=acc, patience=0, mode="max")
+    X = mx.nd.array(onp.random.rand(8, 4).astype("float32"))
+    Y = mx.nd.zeros((8,))
+    est.fit([(X, Y)], epochs=50, event_handlers=[stopper])
+    # constant-label data: accuracy saturates, early stop fires long
+    # before 50 epochs
+    assert stopper.stop_training
+
+
+def test_estimator_requires_stop_condition():
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss())
+    with pytest.raises(MXNetError):
+        est.fit([(mx.nd.ones((2, 2)), mx.nd.zeros((2,)))])
+
+
+def test_estimator_val_metrics_independent():
+    from mxnet_tpu import metric as metric_mod
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    acc = metric_mod.Accuracy()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=[acc])
+    assert est.val_metrics[0] is not acc  # no aliasing
+
+
+def test_module_shapes_before_bind():
+    from mxnet_tpu.module import Module
+
+    mod = Module(_simple_symbol(4, "pre"), data_names=("data",),
+                 label_names=None)
+    assert mod.data_shapes is None and mod.label_shapes is None
