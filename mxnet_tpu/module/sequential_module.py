@@ -71,9 +71,14 @@ class SequentialModule(BaseModule):
             # wire this module's outputs into the next module's data
             # slots positionally (reference META_AUTO_WIRING)
             nxt = self._modules[i + 1]
+            outs = mod.output_shapes
+            if len(nxt.data_names) > len(outs):
+                raise MXNetError(
+                    f"SequentialModule wiring mismatch: module {i} "
+                    f"produces {len(outs)} output(s) but module "
+                    f"{i + 1} expects {len(nxt.data_names)} input(s)")
             cur_shapes = [
-                (dn, s) for dn, (_, s) in zip(nxt.data_names,
-                                              mod.output_shapes)]
+                (dn, s) for dn, (_, s) in zip(nxt.data_names, outs)]
         self.binded = True
 
     def init_params(self, initializer=None, arg_params=None,

@@ -165,8 +165,10 @@ def pdf_normal(sample, mu, sigma, *, is_log=False):
 
 @register_op("_random_pdf_gamma", aliases=("random_pdf_gamma",))
 def pdf_gamma(sample, alpha, beta, *, is_log=False):
+    # beta is the SCALE (matches random_gamma: gamma(alpha) * beta and
+    # the reference's sampler/pdf pairing)
     logp = _jstats.gamma.logpdf(sample, alpha[..., None],
-                                scale=1.0 / beta[..., None])
+                                scale=beta[..., None])
     return _pdf_out(logp, is_log)
 
 

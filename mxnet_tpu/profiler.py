@@ -375,17 +375,6 @@ class Marker:
                     args={"scope": scope})
 
 
-def _maybe_autostart():
-    """MXNET_PROFILER_AUTOSTART (reference env knob)."""
-    from . import config as _config
-
-    if _config.get_env("MXNET_PROFILER_AUTOSTART"):
-        set_state("run")
-
-
-_maybe_autostart()
-
-
 @atexit.register
 def _shutdown():
     global _jax_trace_active

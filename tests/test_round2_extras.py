@@ -76,6 +76,12 @@ def test_pdf_gamma_exponential_poisson():
                      [mx.nd.array(s), mx.nd.array([2.0]),
                       mx.nd.array([1.0])]).asnumpy()
     onp.testing.assert_allclose(p, s * onp.exp(-s), rtol=1e-4)
+    # beta is the SCALE, matching random_gamma's sampler convention
+    p2 = mx.nd.invoke("_random_pdf_gamma",
+                      [mx.nd.array(s), mx.nd.array([2.0]),
+                       mx.nd.array([2.0])]).asnumpy()
+    onp.testing.assert_allclose(p2, (s / 4.0) * onp.exp(-s / 2.0),
+                                rtol=1e-4)
     k = onp.array([[0.0, 2.0]], "float32")
     p = mx.nd.invoke("_random_pdf_poisson",
                      [mx.nd.array(k), mx.nd.array([1.0])]).asnumpy()
@@ -248,3 +254,30 @@ def test_module_shapes_before_bind():
     mod = Module(_simple_symbol(4, "pre"), data_names=("data",),
                  label_names=None)
     assert mod.data_shapes is None and mod.label_shapes is None
+
+
+def test_rtc_pallas_module():
+    from mxnet_tpu import rtc
+
+    def double_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    mod = rtc.PallasModule(double_kernel, [((8, 128), "float32")],
+                           interpret=True)
+    x = mx.nd.array(onp.random.rand(8, 128).astype("float32"))
+    y = mod(x)
+    onp.testing.assert_allclose(y.asnumpy(), 2 * x.asnumpy(), rtol=1e-6)
+
+
+def test_rtc_cuda_module_raises():
+    from mxnet_tpu import rtc
+
+    with pytest.raises(MXNetError, match="Pallas"):
+        rtc.CudaModule("__global__ void k() {}")
+
+
+def test_onnx_gated():
+    from mxnet_tpu.contrib import onnx as onnx_mod
+
+    with pytest.raises(MXNetError, match="onnx"):
+        onnx_mod.export_model(None, None, None)

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Communication/transfer bandwidth measurement (reference:
+tools/bandwidth/measure.py — kvstore push/pull bandwidth).
+
+Measures host->device transfer, device->host readback, kvstore
+push+pull, and (on a multi-device mesh) allreduce bandwidth.
+
+    python tools/bandwidth.py [--size-mb 64]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as onp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def _time(fn, runs=10):
+    fn()  # warmup
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        fn()
+    return (time.perf_counter() - t0) / runs
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size-mb", type=float, default=64)
+    ap.add_argument("--runs", type=int, default=10)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    nbytes = int(args.size_mb * 1e6)
+    host = onp.random.rand(nbytes // 4).astype("float32")
+    dev = jax.local_devices()[0]
+
+    def h2d():
+        jax.device_put(host, dev).block_until_ready()
+
+    dt = _time(h2d, args.runs)
+    print(json.dumps({"metric": "host_to_device",
+                      "GBps": round(nbytes / dt / 1e9, 3)}))
+
+    darr = jax.device_put(host, dev)
+
+    def d2h():
+        onp.asarray(darr)
+
+    dt = _time(d2h, args.runs)
+    print(json.dumps({"metric": "device_to_host",
+                      "GBps": round(nbytes / dt / 1e9, 3)}))
+
+    kv = mx.kv.create("device")
+    val = mx.nd.array(host[: (len(host) // 1024) * 1024].reshape(-1, 1024), ctx=mx.gpu(0))
+    kv.init("b", val)
+
+    def pushpull():
+        kv.push("b", val)
+        out = mx.nd.zeros(val.shape, ctx=mx.gpu(0))
+        kv.pull("b", out=out)
+        out.wait_to_read()
+
+    dt = _time(pushpull, args.runs)
+    print(json.dumps({"metric": "kvstore_pushpull",
+                      "GBps": round(2 * nbytes / dt / 1e9, 3)}))
+
+    devs = jax.local_devices()
+    if len(devs) > 1:
+        from mxnet_tpu.parallel import get_mesh
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = get_mesh((len(devs),), ("d",), devices=devs)
+        sharded = jax.device_put(
+            jnp.asarray(host), NamedSharding(mesh, P("d")))
+        psum = jax.jit(
+            lambda x: jax.lax.psum(x, "d"),
+            in_shardings=NamedSharding(mesh, P("d")),
+            out_shardings=NamedSharding(mesh, P("d")))
+        # simple allreduce-ish: sum over shards via jnp
+        allred = jax.jit(lambda x: x.sum() + 0 * x,
+                         in_shardings=NamedSharding(mesh, P("d")),
+                         out_shardings=NamedSharding(mesh, P("d")))
+
+        def reduce_fn():
+            jax.block_until_ready(allred(sharded))
+
+        dt = _time(reduce_fn, args.runs)
+        print(json.dumps({"metric": f"mesh_reduce_x{len(devs)}",
+                          "GBps": round(nbytes / dt / 1e9, 3)}))
+
+
+if __name__ == "__main__":
+    main()
