@@ -54,7 +54,7 @@ def _standard_inputs(large=False):
 
 def bench_op(opname, inputs, params, ctx, warmup, runs):
     nd_inputs = [mx.nd.array(x, ctx=ctx) for x in inputs]
-    for _ in range(warmup):
+    for _ in range(max(1, warmup)):  # >=1: compile before the clock
         out = mx.nd.invoke(opname, nd_inputs, **params)
     o = out[0] if isinstance(out, (list, tuple)) else out
     o.wait_to_read()
@@ -108,7 +108,12 @@ def main():
         try:
             dt = bench_op(name, spec[0], spec[1], ctx, args.warmup,
                           args.runs)
-        except Exception:
+        except Exception as e:
+            # auto-probed inputs legitimately miss some signatures, but
+            # an explicitly requested op failing must be visible
+            if args.ops:
+                print(json.dumps({"op": name, "error": repr(e)}),
+                      flush=True)
             continue
         print(json.dumps({"op": name, "avg_time_ms": round(dt * 1e3, 4),
                           "runs": args.runs}), flush=True)

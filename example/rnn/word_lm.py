@@ -113,9 +113,13 @@ def main():
             trainer.step(args.batch_size * args.bptt)
             total_loss += float(loss.sum().asnumpy())
             total_tok += args.batch_size * args.bptt
+        if total_tok == 0:
+            raise SystemExit(
+                "corpus too small for batch_size*(bptt+1) tokens")
         ppl = math.exp(total_loss / total_tok)
         logging.info("epoch %d: perplexity %.2f", epoch, ppl)
-    print(f"final_perplexity={ppl:.2f}")
+    if args.epochs > 0:
+        print(f"final_perplexity={ppl:.2f}")
 
 
 if __name__ == "__main__":

@@ -165,10 +165,11 @@ def pdf_normal(sample, mu, sigma, *, is_log=False):
 
 @register_op("_random_pdf_gamma", aliases=("random_pdf_gamma",))
 def pdf_gamma(sample, alpha, beta, *, is_log=False):
-    # beta is the SCALE (matches random_gamma: gamma(alpha) * beta and
-    # the reference's sampler/pdf pairing)
+    # beta is the RATE: reference PDF_Gamma computes a*log(b) - b*x
+    # (src/operator/random/pdf_op.h:121-136), even though its sampler
+    # treats beta as scale — the upstream inconsistency is preserved
     logp = _jstats.gamma.logpdf(sample, alpha[..., None],
-                                scale=beta[..., None])
+                                scale=1.0 / beta[..., None])
     return _pdf_out(logp, is_log)
 
 

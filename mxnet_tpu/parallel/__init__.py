@@ -11,6 +11,10 @@ compiled SPMD program over a ``jax.sharding.Mesh``:
           modern requirement).
   * sp  — sequence axis sharded over 'seq' (ring attention lives in
           mxnet_tpu.parallel.ring).
+  * pp  — GPipe microbatch pipeline over a 'pipe' axis
+          (mxnet_tpu.parallel.pipeline).
+  * ep  — mixture-of-experts routing over an 'expert' axis
+          (mxnet_tpu.parallel.moe).
   * Optimizer state shards with the params (ZeRO ≡ the reference's
     server-side optimizer, kvstore_dist_server.h:346).
 
@@ -31,7 +35,7 @@ from ..base import MXNetError
 __all__ = ["get_mesh", "functionalize", "make_train_step",
            "DataParallelTrainer", "Mesh", "NamedSharding", "P",
            "NORM_STAT_SUFFIXES", "amp_cast_params", "auto_tp_spec",
-           "ring"]
+           "ring", "pipeline", "moe"]
 
 #: parameter-name suffixes that stay fp32 under mixed precision (the AMP
 #: policy the reference encodes in contrib/amp/lists: norm affine+stats)
@@ -367,3 +371,6 @@ class DataParallelTrainer:
                 # gather off the mesh so eager single-device ops work
                 v = jnp.asarray(onp.asarray(self._params[p.name]))
                 p.data()._adopt(v)
+
+
+from . import moe, pipeline, ring  # noqa: E402  (submodule re-exports)

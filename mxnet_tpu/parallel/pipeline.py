@@ -1,0 +1,156 @@
+"""Pipeline parallelism — GPipe-style microbatch schedule over the mesh.
+
+The reference has no pipeline engine (its model parallelism is manual
+device placement, example/model-parallel); on TPU, pipeline parallelism
+is a first-class axis of the sharding design (SURVEY.md §5.8): stage
+parameters live stacked on a leading ``n_stages`` axis sharded over a
+'pipe' mesh axis, microbatch activations rotate stage-to-stage with
+``lax.ppermute`` hops that ride the ICI torus, and the whole schedule is
+one ``lax.scan`` inside one ``shard_map`` — a single XLA program, fully
+differentiable, so fwd+bwd+optimizer still fuse into one step.
+
+Schedule: classic GPipe fill-and-drain.  For S stages and M
+microbatches the scan runs ``M + S - 1`` ticks; at tick t stage 0
+injects microbatch t (while t < M) and stage S-1 retires microbatch
+t-(S-1) (once t >= S-1).  Bubble fraction is (S-1)/(M+S-1) — pick
+M >> S.
+
+Constraint (standard for pipelined transformer stacks): every stage
+maps activations of one fixed shape to the same shape, so the rotating
+buffer is static-shaped for XLA.  The stage body itself is arbitrary
+traceable code.
+
+Public API:
+  pipeline_apply(stage_fn, stacked_params, x, mesh, ...)
+      — run the pipeline over GLOBAL inputs; returns global outputs.
+  pipeline_apply_sharded(...)
+      — the per-device body, for composition inside an existing
+        shard_map program (e.g. combined dp×pp meshes).
+  stack_stage_params(param_dicts)
+      — stack per-stage parameter pytrees onto the leading stage axis.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["pipeline_apply", "pipeline_apply_sharded",
+           "stack_stage_params"]
+
+
+def stack_stage_params(param_dicts):
+    """Stack a list of per-stage parameter pytrees (identical
+    structure) into one pytree with a leading ``n_stages`` axis —
+    the axis that shards over the 'pipe' mesh dimension."""
+    if not param_dicts:
+        raise ValueError("need at least one stage")
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *param_dicts)
+
+
+def pipeline_apply_sharded(stage_fn, params, x, axis_name,
+                           n_microbatches):
+    """Per-device GPipe body (call inside shard_map).
+
+    params: this device's stage parameters with a leading local-stage
+    axis of size 1 (the 'pipe'-sharded slice of the stacked pytree).
+    x: the FULL batch (replicated across the pipe axis); reshaped to
+    (M, mb, ...) microbatches internally.  Returns the full output
+    batch, replicated (psum-masked from the last stage).
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    p = jax.tree_util.tree_map(lambda a: a[0], params)
+
+    batch = x.shape[0]
+    if batch % n_microbatches != 0:
+        raise ValueError(
+            f"batch {batch} not divisible by n_microbatches "
+            f"{n_microbatches}")
+    mb = batch // n_microbatches
+    mbs = x.reshape((n_microbatches, mb) + x.shape[1:])
+
+    state = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+    out = jnp.zeros_like(mbs)
+    n_ticks = n_microbatches + n - 1
+
+    def tick(carry, t):
+        state, out = carry
+        # stage 0 injects microbatch t (clipped load; masked select)
+        inj = lax.dynamic_index_in_dim(
+            mbs, jnp.clip(t, 0, n_microbatches - 1), 0, keepdims=False)
+        inp = jnp.where(jnp.logical_and(idx == 0, t < n_microbatches),
+                        inj, state)
+        y = stage_fn(p, inp)
+        # last stage retires microbatch t-(n-1) once the pipe is full
+        slot = jnp.clip(t - (n - 1), 0, n_microbatches - 1)
+        retired = lax.dynamic_update_index_in_dim(out, y, slot, 0)
+        out = jnp.where(jnp.logical_and(idx == n - 1, t >= n - 1),
+                        retired, out)
+        # rotate activations one hop down the pipe (ICI neighbor hop);
+        # stage 0's incoming value is ignored — it always injects
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        state = lax.ppermute(y, axis_name, perm)
+        return (state, out), None
+
+    (_, out), _ = lax.scan(tick, (state, out), jnp.arange(n_ticks))
+    # only the last stage holds real outputs; psum-mask replicates them
+    out = lax.psum(jnp.where(idx == n - 1, out, jnp.zeros_like(out)),
+                   axis_name)
+    return out.reshape(x.shape)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_pipeline_fn(stage_fn, mesh, axis_name, n_microbatches,
+                       treedef, leaf_ndims, x_ndim):
+    from jax import shard_map
+
+    param_spec = treedef.unflatten(
+        [P(axis_name, *([None] * (nd - 1))) for nd in leaf_ndims])
+    x_spec = P(*([None] * x_ndim))
+
+    def body(params, x):
+        return pipeline_apply_sharded(stage_fn, params, x, axis_name,
+                                      n_microbatches)
+
+    mapped = shard_map(body, mesh=mesh,
+                       in_specs=(param_spec, x_spec),
+                       out_specs=x_spec, check_vma=False)
+    return jax.jit(mapped)
+
+
+def pipeline_apply(stage_fn, stacked_params, x, mesh, axis_name="pipe",
+                   n_microbatches=None):
+    """Run ``stage_fn`` as an ``n_stages``-deep pipeline over
+    ``mesh[axis_name]``.
+
+    stage_fn(stage_params, act) -> act : one stage, shape-preserving.
+    stacked_params: pytree with leading axis n_stages == mesh size on
+    ``axis_name`` (see stack_stage_params).
+    x: (batch, ...) global input; n_microbatches must divide batch
+    (default: 4 microbatches per stage).
+    """
+    n = mesh.shape[axis_name]
+    if n_microbatches is None:
+        n_microbatches = 4 * n
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    for leaf in leaves:
+        if leaf.shape[0] != n:
+            raise ValueError(
+                f"stacked param leading axis {leaf.shape[0]} != pipe "
+                f"size {n}")
+    leaves, treedef = jax.tree_util.tree_flatten(stacked_params)
+    fn = _build_pipeline_fn(
+        stage_fn, mesh, axis_name, int(n_microbatches), treedef,
+        tuple(leaf.ndim for leaf in leaves), x.ndim)
+    stacked_params = jax.device_put(
+        stacked_params,
+        jax.tree_util.tree_map(
+            lambda leaf: NamedSharding(
+                mesh, P(axis_name, *([None] * (leaf.ndim - 1)))),
+            stacked_params))
+    return fn(stacked_params, x)

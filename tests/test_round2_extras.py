@@ -76,11 +76,12 @@ def test_pdf_gamma_exponential_poisson():
                      [mx.nd.array(s), mx.nd.array([2.0]),
                       mx.nd.array([1.0])]).asnumpy()
     onp.testing.assert_allclose(p, s * onp.exp(-s), rtol=1e-4)
-    # beta is the SCALE, matching random_gamma's sampler convention
+    # beta is the RATE: reference PDF_Gamma does a*log(b) - b*x
+    # (pdf_op.h:121-136); pdf(x; a=2, b=2) = b^a x e^{-b x}
     p2 = mx.nd.invoke("_random_pdf_gamma",
                       [mx.nd.array(s), mx.nd.array([2.0]),
                        mx.nd.array([2.0])]).asnumpy()
-    onp.testing.assert_allclose(p2, (s / 4.0) * onp.exp(-s / 2.0),
+    onp.testing.assert_allclose(p2, 4.0 * s * onp.exp(-2.0 * s),
                                 rtol=1e-4)
     k = onp.array([[0.0, 2.0]], "float32")
     p = mx.nd.invoke("_random_pdf_poisson",

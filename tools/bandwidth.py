@@ -81,11 +81,8 @@ def main():
         mesh = get_mesh((len(devs),), ("d",), devices=devs)
         sharded = jax.device_put(
             jnp.asarray(host), NamedSharding(mesh, P("d")))
-        psum = jax.jit(
-            lambda x: jax.lax.psum(x, "d"),
-            in_shardings=NamedSharding(mesh, P("d")),
-            out_shardings=NamedSharding(mesh, P("d")))
-        # simple allreduce-ish: sum over shards via jnp
+        # cross-shard reduce + broadcast back to every shard — the
+        # all-reduce the kvstore's gradient sync performs
         allred = jax.jit(lambda x: x.sum() + 0 * x,
                          in_shardings=NamedSharding(mesh, P("d")),
                          out_shardings=NamedSharding(mesh, P("d")))
