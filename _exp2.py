@@ -1,0 +1,162 @@
+"""Perf isolation: pure-JAX ResNet-50 train step, NHWC vs NCHW, vs framework.
+
+Scratch experiment — not part of the package (deleted before commit).
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+from jax import lax
+
+
+def conv(x, w, stride, layout):
+    if layout == "NHWC":
+        dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NHWC", "HWIO", "NHWC"))
+    else:
+        dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+    k = w.shape[0] if layout == "NHWC" else w.shape[2]
+    pad = (k - 1) // 2
+    return lax.conv_general_dilated(
+        x, w, (stride, stride), [(pad, pad), (pad, pad)],
+        dimension_numbers=dn)
+
+
+def bn(x, p, layout):
+    axis = 3 if layout == "NHWC" else 1
+    red = tuple(i for i in range(4) if i != axis)
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(red)
+    var = x32.var(red)
+    shape = [1] * 4
+    shape[axis] = x.shape[axis]
+    out = (x32 - mean.reshape(shape)) * (
+        lax.rsqrt(var + 1e-5) * p["gamma"].reshape(shape)
+    ) + p["beta"].reshape(shape)
+    return out.astype(x.dtype)
+
+
+def make_params(key, layout):
+    """ResNet-50 v1 params."""
+    params = {}
+    init = jax.nn.initializers.he_normal()
+
+    def cw(key, cin, cout, k):
+        if layout == "NHWC":
+            return init(key, (k, k, cin, cout), jnp.float32)
+        return init(key, (cout, cin, k, k), jnp.float32)
+
+    keys = iter(jax.random.split(key, 200))
+    params["c0"] = cw(next(keys), 3, 64, 7)
+    params["bn0"] = {"gamma": jnp.ones(64), "beta": jnp.zeros(64)}
+    blocks = [(3, 64, 256), (4, 128, 512), (6, 256, 1024), (3, 512, 2048)]
+    cin = 64
+    for si, (n, mid, out) in enumerate(blocks):
+        for bi in range(n):
+            pre = f"s{si}b{bi}"
+            params[pre + "c1"] = cw(next(keys), cin, mid, 1)
+            params[pre + "bn1"] = {"gamma": jnp.ones(mid), "beta": jnp.zeros(mid)}
+            params[pre + "c2"] = cw(next(keys), mid, mid, 3)
+            params[pre + "bn2"] = {"gamma": jnp.ones(mid), "beta": jnp.zeros(mid)}
+            params[pre + "c3"] = cw(next(keys), mid, out, 1)
+            params[pre + "bn3"] = {"gamma": jnp.ones(out), "beta": jnp.zeros(out)}
+            if bi == 0:
+                params[pre + "cd"] = cw(next(keys), cin, out, 1)
+                params[pre + "bnd"] = {"gamma": jnp.ones(out), "beta": jnp.zeros(out)}
+            cin = out
+    params["fc_w"] = jax.random.normal(next(keys), (2048, 1000)) * 0.01
+    params["fc_b"] = jnp.zeros(1000)
+    return params
+
+
+def forward(params, x, layout):
+    cast = lambda w: w.astype(jnp.bfloat16)  # noqa: E731
+    h = conv(x, cast(params["c0"]), 2, layout)
+    h = bn(h, params["bn0"], layout)
+    h = jax.nn.relu(h)
+    dims = (1, 2) if layout == "NHWC" else (2, 3)
+    h = lax.reduce_window(
+        h, -jnp.inf, lax.max,
+        (1, 3, 3, 1) if layout == "NHWC" else (1, 1, 3, 3),
+        (1, 2, 2, 1) if layout == "NHWC" else (1, 1, 2, 2),
+        [(0, 0), (1, 1), (1, 1), (0, 0)] if layout == "NHWC"
+        else [(0, 0), (0, 0), (1, 1), (1, 1)])
+    blocks = [(3, 64, 256), (4, 128, 512), (6, 256, 1024), (3, 512, 2048)]
+    for si, (n, mid, out) in enumerate(blocks):
+        for bi in range(n):
+            pre = f"s{si}b{bi}"
+            stride = 2 if (bi == 0 and si > 0) else 1
+            idn = h
+            h2 = conv(h, cast(params[pre + "c1"]), 1, layout)
+            h2 = jax.nn.relu(bn(h2, params[pre + "bn1"], layout))
+            h2 = conv(h2, cast(params[pre + "c2"]), stride, layout)
+            h2 = jax.nn.relu(bn(h2, params[pre + "bn2"], layout))
+            h2 = conv(h2, cast(params[pre + "c3"]), 1, layout)
+            h2 = bn(h2, params[pre + "bn3"], layout)
+            if bi == 0:
+                idn = conv(idn, cast(params[pre + "cd"]), stride, layout)
+                idn = bn(idn, params[pre + "bnd"], layout)
+            h = jax.nn.relu(h2 + idn)
+    h = h.mean(dims).astype(jnp.float32)
+    return h @ params["fc_w"] + params["fc_b"]
+
+
+def main():
+    layout = sys.argv[1] if len(sys.argv) > 1 else "NHWC"
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    mode = sys.argv[3] if len(sys.argv) > 3 else "train"
+    key = jax.random.PRNGKey(0)
+    params = make_params(key, layout)
+    if layout == "NHWC":
+        x = jnp.asarray(onp.random.rand(batch, 224, 224, 3),
+                        dtype=jnp.bfloat16)
+    else:
+        x = jnp.asarray(onp.random.rand(batch, 3, 224, 224),
+                        dtype=jnp.bfloat16)
+    y = jnp.asarray(onp.random.randint(0, 1000, size=(batch,)))
+
+    def loss_fn(params, x, y):
+        logits = forward(params, x, layout)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(logp, y[:, None], 1).mean()
+
+    if mode == "fwd":
+        f = jax.jit(lambda p, x: forward(p, x, layout))
+        out = jax.block_until_ready(f(params, x))
+        n = 30
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = f(params, x)
+        _ = float(out.sum())
+        dt = (time.perf_counter() - t0) / n
+        print(f"pure-jax {layout} bs{batch} fwd: {dt*1e3:.2f} ms "
+              f"({batch/dt:.0f} img/s)")
+        return
+
+    mom = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+    @jax.jit
+    def step(params, mom, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(params, x, y)
+        mom = jax.tree_util.tree_map(lambda m, g: 0.9 * m + g, mom, g)
+        params = jax.tree_util.tree_map(
+            lambda p, m: p - 0.1 * m, params, mom)
+        return loss, params, mom
+
+    loss, params, mom = step(params, mom, x, y)
+    _ = float(loss)
+    n = 30
+    t0 = time.perf_counter()
+    for _ in range(n):
+        loss, params, mom = step(params, mom, x, y)
+    _ = float(loss)
+    dt = (time.perf_counter() - t0) / n
+    print(f"pure-jax {layout} bs{batch} train: {dt*1e3:.2f} ms/step "
+          f"({batch/dt:.0f} img/s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+import time, sys, numpy as onp
+import jax, jax.numpy as jnp
+from jax import lax
+import _exp2 as e
+
+layout = "NHWC"
+
+def bn_onepass(x, p, layout):
+    axis = 3 if layout == "NHWC" else 1
+    red = tuple(i for i in range(4) if i != axis)
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(red)
+    meansq = (x32 * x32).mean(red)
+    var = meansq - mean * mean
+    shape = [1]*4; shape[axis] = x.shape[axis]
+    out = (x32 - mean.reshape(shape)) * (lax.rsqrt(var + 1e-5) * p["gamma"].reshape(shape)) + p["beta"].reshape(shape)
+    return out.astype(x.dtype)
+
+def run(tag, n=30):
+    params = e.make_params(jax.random.PRNGKey(0), layout)
+    x = jnp.asarray(onp.random.rand(128, 224, 224, 3), dtype=jnp.bfloat16)
+    y = jnp.asarray(onp.random.randint(0, 1000, size=(128,)))
+    def loss_fn(p, x, y):
+        logits = e.forward(p, x, layout)
+        return -jnp.take_along_axis(jax.nn.log_softmax(logits), y[:, None], 1).mean()
+    mom = jax.tree_util.tree_map(jnp.zeros_like, params)
+    @jax.jit
+    def step(params, mom, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(params, x, y)
+        mom = jax.tree_util.tree_map(lambda m, g: 0.9*m+g, mom, g)
+        params = jax.tree_util.tree_map(lambda p, m: p-0.1*m, params, mom)
+        return loss, params, mom
+    c = jax.jit(step).lower(params, mom, x, y).compile()
+    ca = c.cost_analysis()
+    if isinstance(ca, list): ca = ca[0]
+    by = float(ca.get("bytes accessed", 0))
+    loss, params, mom = step(params, mom, x, y); _ = float(loss)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        loss, params, mom = step(params, mom, x, y)
+    _ = float(loss)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{tag}: {dt*1e3:.2f} ms/step ({128/dt:.0f} img/s) bytes={by/1e9:.1f}GB", flush=True)
+
+mode = sys.argv[1]
+if mode == "onepass":
+    e.bn = bn_onepass
+    run("onepass-BN")
+else:
+    run(mode)

@@ -119,15 +119,24 @@ def predict_mode():
 
 class TapeNode:
     """One recorded op application: holds the vjp pull-back and the input
-    NDArrays (the reference's AGInfo, imperative.h:53-90)."""
+    NDArrays (the reference's AGInfo, imperative.h:53-90).
 
-    __slots__ = ("vjp_fn", "inputs", "out_avals", "op_name")
+    ``prim_fn``/``all_inputs`` additionally capture the pure primal
+    function and EVERY input (incl. non-differentiable ones, as NDArray
+    refs or raw jax values) so ``grad(..., create_graph=True)`` can
+    replay the subgraph functionally and differentiate it again."""
 
-    def __init__(self, vjp_fn, inputs, out_avals, op_name=""):
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "op_name", "prim_fn",
+                 "all_inputs")
+
+    def __init__(self, vjp_fn, inputs, out_avals, op_name="",
+                 prim_fn=None, all_inputs=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs  # list of NDArray (or None for non-diff inputs)
         self.out_avals = out_avals  # [(shape, dtype)] per output
         self.op_name = op_name
+        self.prim_fn = prim_fn
+        self.all_inputs = all_inputs
 
 
 def mark_variables(variables, gradients, grad_reqs="write"):
@@ -251,16 +260,99 @@ def _accum_var_grad(var, g, written):
     var._fresh_grad = True
 
 
+def _grad_create_graph(heads, variables, head_grads):
+    """Higher-order grad: replay the recorded subgraph as a pure jax
+    function of the variables, vjp it, and tape the resulting gradient
+    computation so it can be differentiated again (to any order).
+
+    The reference builds the gradient *graph* with the nnvm Gradient
+    pass (imperative.cc:280) so grad-of-grad falls out of graph
+    composition; here the tape's stored primal closures are replayed
+    under jax tracing, which is the functional equivalent.  Uses the
+    input values captured at record time — mutating an input between
+    recording and grad() is undefined (same caveat as the reference's
+    in-place writes invalidating AGInfo).
+    """
+    from .ndarray import NDArray
+
+    order = _toposort(heads)
+    for node in order:
+        if node.prim_fn is None or node.all_inputs is None:
+            raise MXNetError(
+                f"create_graph=True: node {node.op_name!r} was recorded "
+                "without replay info")
+    var_list = list(variables)
+    var_pos = {id(v): i for i, v in enumerate(var_list)}
+
+    def replay(*vvals):
+        env = {}
+
+        def value_of(x):
+            if not isinstance(x, NDArray):
+                return x  # raw jax value captured at record time
+            if id(x) in var_pos:
+                return vvals[var_pos[id(x)]]
+            n = getattr(x, "_node", None)
+            if n is not None and (id(n), x._oidx) in env:
+                return env[(id(n), x._oidx)]
+            return x._data
+
+        for node in order:  # child-first == dependencies before users
+            outs = node.prim_fn(*[value_of(i) for i in node.all_inputs])
+            outs = (outs,) if not isinstance(outs, (tuple, list)) \
+                else tuple(outs)
+            for i, o in enumerate(outs):
+                env[(id(node), i)] = o
+        return tuple(value_of(h) for h in heads)
+
+    hg = tuple(
+        (g._data if isinstance(g, NDArray) else jnp.asarray(g))
+        if g is not None else jnp.ones(h.shape, h.dtype)
+        for h, g in zip(heads, head_grads))
+
+    def grads_of(*vvals):
+        _, pull = jax.vjp(replay, *vvals)
+        return pull(hg)
+
+    vvals = tuple(v._data for v in var_list)
+    grad_vals, second_vjp = jax.vjp(grads_of, *vvals)
+    out = [NDArray(g) for g in grad_vals]
+    if is_recording():
+        node = TapeNode(
+            second_vjp,
+            [v if (getattr(v, "_is_var", False) or v._node is not None)
+             else None for v in var_list],
+            [(g.shape, g.dtype) for g in grad_vals],
+            op_name="_grad_of_grad",
+            prim_fn=grads_of,
+            all_inputs=list(var_list),
+        )
+        for i, o in enumerate(out):
+            o._node = node
+            o._oidx = i
+    return out
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):
     """Functional gradient API (reference autograd.py grad())."""
     from .ndarray import NDArray
 
-    if create_graph:
-        raise MXNetError("create_graph=True (higher order) not yet supported")
     single = isinstance(variables, NDArray)
     if single:
         variables = [variables]
+    if create_graph:
+        hs = [heads] if isinstance(heads, NDArray) else list(heads)
+        if head_grads is None:
+            hgs = [None] * len(hs)
+        elif isinstance(head_grads, NDArray):
+            hgs = [head_grads]
+        else:
+            hgs = list(head_grads)
+        if len(hgs) != len(hs):
+            raise MXNetError("heads and head_grads length mismatch")
+        grads = _grad_create_graph(hs, variables, hgs)
+        return grads[0] if single else grads
     saved = [
         (v._grad, getattr(v, "_grad_req", "write"), getattr(v, "_is_var", False))
         for v in variables

@@ -503,6 +503,9 @@ def _invoke_impl(opdef, inputs, out, params):
             [i if _needs_grad(i) else None for i in nd_inputs],
             [(v.shape, v.dtype) for v in vals],
             op_name=opdef.name,
+            prim_fn=_f,
+            all_inputs=[n if n is not None else a
+                        for n, a in zip(nd_inputs, arrs)],
         )
         for i, o in enumerate(outs):
             o._node = node
