@@ -310,12 +310,20 @@ def _grad_create_graph(heads, variables, head_grads):
         if g is not None else jnp.ones(h.shape, h.dtype)
         for h, g in zip(heads, head_grads))
 
+    single_var = len(var_list) == 1
+
     def grads_of(*vvals):
         _, pull = jax.vjp(replay, *vvals)
-        return pull(hg)
+        gs = pull(hg)
+        # Single-variable: return a bare value so the taped node has one
+        # output and backward()'s len(out_avals)==1 convention (bare
+        # cotangent, not a 1-tuple) matches second_vjp's expectation.
+        return gs[0] if single_var else gs
 
     vvals = tuple(v._data for v in var_list)
     grad_vals, second_vjp = jax.vjp(grads_of, *vvals)
+    if single_var:
+        grad_vals = (grad_vals,)
     out = [NDArray(g) for g in grad_vals]
     if is_recording():
         node = TapeNode(

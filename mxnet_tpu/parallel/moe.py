@@ -14,7 +14,10 @@ Public API:
   moe_apply(expert_fn, stacked_params, gate_w, x, ...)
       — full MoE layer; with ``mesh`` the expert axis is sharded and
         the dispatch/combine contractions ride the mesh collectives.
-  MoEDense — gluon-facing expert MLP constructor helper.
+
+Note: ``expert_fn`` (and pipeline ``stage_fn``) are compile-cache keys —
+pass a *stable* callable (module-level function or a lambda created
+once), not a fresh lambda per call, or every invocation recompiles.
 """
 from __future__ import annotations
 

@@ -153,3 +153,47 @@ def test_softmax_output_backward():
     sm /= sm.sum(1, keepdims=True)
     oh = onp.eye(3)[label.asnumpy().astype(int)]
     onp.testing.assert_allclose(data.grad.asnumpy(), sm - oh, rtol=1e-4)
+
+
+def test_second_order_single_variable():
+    # y = x^3 -> dy/dx = 3x^2 -> d2y/dx2 = 6x.  Single-variable
+    # create_graph=True path (reference: test_higher_order_grad.py).
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x * x).sum()
+        dx = autograd.grad(y, x, create_graph=True)
+        z = dx.sum()
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 6 * x.asnumpy(), rtol=1e-5)
+
+
+def test_second_order_multi_variable():
+    x = nd.array([1.0, 2.0])
+    w = nd.array([3.0, 4.0])
+    x.attach_grad()
+    w.attach_grad()
+    with autograd.record():
+        y = (x * x * w).sum()
+        gx, gw = autograd.grad(y, [x, w], create_graph=True)
+        z = (gx * gx).sum() + gw.sum()
+    z.backward()
+    # gx = 2*x*w, gw = x^2; z = sum(4 x^2 w^2) + sum(x^2)
+    onp.testing.assert_allclose(
+        x.grad.asnumpy(),
+        8 * x.asnumpy() * w.asnumpy() ** 2 + 2 * x.asnumpy(), rtol=1e-5)
+    onp.testing.assert_allclose(
+        w.grad.asnumpy(), 8 * x.asnumpy() ** 2 * w.asnumpy(), rtol=1e-5)
+
+
+def test_third_order_single_variable():
+    # y = x^4: y' = 4x^3, y'' = 12x^2, y''' = 24x.
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x * x * x).sum()
+        d1 = autograd.grad(y, x, create_graph=True)
+        d2 = autograd.grad(d1.sum(), x, create_graph=True)
+        z = d2.sum()
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 24 * x.asnumpy(), rtol=1e-5)
