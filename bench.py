@@ -4,16 +4,24 @@ Reference baseline (BASELINE.md): 363.69 img/s — MXNet 1.2 ResNet-50
 training, batch 128, single V100 (docs perf.md:243-254).  The driver runs
 this on the real TPU chip and records the JSON line.
 
-One fused XLA program per step (fwd+bwd+SGD momentum, donated buffers),
-bf16 activations/weights with fp32 BatchNorm statistics — the MXU-native
-configuration.
+One fused XLA program per step (fwd+bwd+SGD momentum, bf16 activations/
+weights, fp32 BatchNorm statistics with a custom-VJP fused backward —
+the cuDNN BatchNormBackward analog).
 
-Perf note (round 2): the model is initialized ON the accelerator
-(ctx=mx.gpu(0)) and the whole bench path never executes a single op on
-the JAX CPU backend.  Mixing host-backend eager compute into a TPU
-process forces per-dispatch synchronization with the device runtime and
-serializes the step stream (measured: 57 ms/step vs 1.9 ms/step for the
-identical executable).  Keep eager work on-device or in numpy.
+MEASUREMENT NOTE (round 3): on the `axon` TPU tunnel,
+``jax.block_until_ready`` returns WITHOUT draining execution — timing
+loops that only block are measuring enqueue rate, not device time
+(round-2's recorded 66,520 img/s was such an artifact; 50 ResNet steps
+"finishing" in 1 ms is beyond the chip's measured 171 TFLOP/s bf16
+matmul peak by ~40x, which is physically impossible).  This bench
+therefore times a K-step data-dependent chain and MATERIALIZES the final
+loss (host readback forces the full pipeline to drain), then reports the
+marginal cost per step from two K values, which cancels the constant
+readback latency.  Three trials, median.
+
+Also reported: achieved TFLOP/s from ``compiled.cost_analysis()`` and
+MFU relative to the chip's bf16 matmul peak measured in-process by an
+8192^3 probe (same honest methodology).
 """
 from __future__ import annotations
 
@@ -21,6 +29,44 @@ import json
 import time
 
 import numpy as onp
+
+
+def _median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def _matmul_peak_tflops():
+    """Measured bf16 matmul roofline of this chip (honest: the chained
+    product feeds the next iteration and the final scalar readback
+    drains the pipeline)."""
+    import jax
+    import jax.numpy as jnp
+
+    m = 8192
+    a = jnp.asarray(onp.random.rand(m, m), jnp.bfloat16)
+    b = jnp.asarray(onp.random.rand(m, m), jnp.bfloat16)
+
+    @jax.jit
+    def mm(s):
+        a, b = s
+        return (a @ b * 1e-6, b)
+
+    def run(k):
+        s = (a, b)
+        t0 = time.perf_counter()
+        for _ in range(k):
+            s = mm(s)
+        _ = float(s[0][0, 0])
+        return time.perf_counter() - t0
+
+    run(1)
+    trials = []
+    for _ in range(3):
+        t1, t2 = run(3), run(13)
+        trials.append((t2 - t1) / 10)
+    dt = _median(trials)
+    return 2 * m**3 / dt / 1e12
 
 
 def main():
@@ -32,39 +78,65 @@ def main():
     import jax.numpy as jnp
 
     batch = 128
+    layout = "NCHW"  # NHWC supported too; identical on this chip (XLA
+    #                  assigns physical layouts itself — measured r03)
     ctx = mx.gpu(0)  # falls back to cpu on accelerator-less hosts
-    net = gluon.model_zoo.vision.resnet50_v1(classes=1000)
+    net = gluon.model_zoo.vision.resnet50_v1(classes=1000, layout=layout)
     net.initialize(init=mx.init.Xavier(), ctx=ctx)
-    net(mx.nd.zeros((1, 3, 224, 224), ctx=ctx))  # resolve deferred shapes
+    shp = (1, 3, 224, 224) if layout == "NCHW" else (1, 224, 224, 3)
+    net(mx.nd.zeros(shp, ctx=ctx))  # resolve deferred shapes
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     step_fn, params, opt_state = make_train_step(
         net, loss_fn, optimizer="sgd", learning_rate=0.1, momentum=0.9,
         donate=False, compute_dtype="bfloat16")
 
-    x = jnp.asarray(onp.random.rand(batch, 3, 224, 224), dtype=jnp.bfloat16)
+    xshp = (batch, 3, 224, 224) if layout == "NCHW" else (batch, 224, 224, 3)
+    x = jnp.asarray(onp.random.rand(*xshp), dtype=jnp.bfloat16)
     y = jnp.asarray(
         onp.random.randint(0, 1000, size=(batch,)).astype("float32"))
     key = jax.random.key(0)
 
-    # warmup / compile
-    loss, params, opt_state = step_fn(params, opt_state, x, y, key, 1.0)
-    jax.block_until_ready(loss)
+    # static program cost (flops/bytes) for the MFU report
+    compiled = step_fn.lower(params, opt_state, x, y, key, 1.0).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    step_flops = float(ca.get("flops", 0.0))
+    step_bytes = float(ca.get("bytes accessed", 0.0))
 
-    n_steps = 50
-    t0 = time.perf_counter()
-    for i in range(n_steps):
-        loss, params, opt_state = step_fn(
-            params, opt_state, x, y, key, float(i + 2))
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-    throughput = batch * n_steps / dt
+    def run(k):
+        p, o = params, opt_state
+        t0 = time.perf_counter()
+        for i in range(k):
+            loss, p, o = step_fn(p, o, x, y, key, float(i + 1))
+        _ = float(loss)  # materialize: drains the device pipeline
+        return time.perf_counter() - t0
 
+    run(1)  # warmup (compile cached from .lower, but prime the path)
+    trials = []
+    for _ in range(3):
+        t1, t2 = run(3), run(13)
+        trials.append((t2 - t1) / 10)
+    dt = _median(trials)
+    throughput = batch / dt
+
+    peak = _matmul_peak_tflops()
+    achieved = step_flops / dt / 1e12
     baseline = 363.69  # V100 bs128 (BASELINE.md row 1)
     print(json.dumps({
         "metric": "resnet50_train_throughput",
         "value": round(throughput, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(throughput / baseline, 3),
+        "ms_per_step": round(dt * 1e3, 2),
+        "achieved_tflops": round(achieved, 1),
+        "matmul_peak_tflops": round(peak, 1),
+        "mfu": round(achieved / peak, 3),
+        "step_gflops": round(step_flops / 1e9, 1),
+        "step_gbytes": round(step_bytes / 1e9, 1),
+        "methodology": "K-sweep slope with loss materialization "
+                       "(block_until_ready does not drain on axon; "
+                       "r02's 66520 img/s was an enqueue-rate artifact)",
     }))
 
 

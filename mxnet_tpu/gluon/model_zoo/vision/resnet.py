@@ -241,7 +241,10 @@ resnet_block_versions = [
 
 
 def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
-               **kwargs):
+               layout=None, **kwargs):
+    """``layout="NHWC"`` builds the net channel-last (TPU-native: convs
+    feed the MXU without layout transposes); inputs must then be NHWC.
+    Default follows the ambient ``nn.default_layout`` scope (NCHW)."""
     if num_layers not in resnet_spec:
         raise MXNetError(
             f"Invalid number of layers: {num_layers}. "
@@ -251,7 +254,8 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
     block_type, layers, channels = resnet_spec[num_layers]
     resnet_class = resnet_net_versions[version - 1]
     block_class = resnet_block_versions[version - 1][block_type]
-    net = resnet_class(block_class, layers, channels, **kwargs)
+    with nn.default_layout(layout):
+        net = resnet_class(block_class, layers, channels, **kwargs)
     if pretrained:
         raise MXNetError(
             "pretrained weights are not downloadable in this environment; "

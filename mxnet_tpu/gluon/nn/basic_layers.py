@@ -178,12 +178,15 @@ class BatchNorm(HybridBlock):
     functional state update instead of the reference's in-place aux-state
     mutation."""
 
-    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+    def __init__(self, axis=None, momentum=0.9, epsilon=1e-5, center=True,
                  scale=True, use_global_stats=False, beta_initializer="zeros",
                  gamma_initializer="ones",
                  running_mean_initializer="zeros",
                  running_variance_initializer="ones", in_channels=0, **kwargs):
         super().__init__(**kwargs)
+        if axis is None:  # default follows the nn.default_layout scope
+            from .layout import channel_axis
+            axis = channel_axis()
         self._kwargs = {
             "axis": axis, "eps": epsilon, "momentum": momentum,
             "fix_gamma": not scale, "use_global_stats": use_global_stats,

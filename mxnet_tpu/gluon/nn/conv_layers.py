@@ -1,8 +1,9 @@
 """Convolution / pooling blocks (reference: python/mxnet/gluon/nn/conv_layers.py).
 
-All layers use channel-first NCHW-family layouts like the reference; the
-Convolution op lowers to lax.conv_general_dilated which XLA tiles onto the
-MXU directly.
+Layer ``layout`` defaults resolve against the ambient
+``nn.default_layout`` scope (channel-first NCHW-family, like the
+reference, unless a scope says otherwise); the Convolution op lowers to
+lax.conv_general_dilated which XLA tiles onto the MXU directly.
 """
 from __future__ import annotations
 
@@ -38,12 +39,16 @@ class _Conv(HybridBlock):
                  bias_initializer="zeros", op_name="Convolution", adj=None,
                  prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
+        from .layout import is_channel_last, resolve_layout
+
         self._channels = channels
         self._in_channels = in_channels
         ndim = len(kernel_size)
         strides = _tup(strides, ndim)
         padding = _tup(padding, ndim)
         dilation = _tup(dilation, ndim)
+        layout = resolve_layout(layout, ndim)
+        self._channel_last = is_channel_last(layout)
         self._op_name = op_name
         self._kwargs = {
             "kernel": kernel_size, "stride": strides, "dilate": dilation,
@@ -56,9 +61,17 @@ class _Conv(HybridBlock):
         self._groups = groups
 
         with self.name_scope():
-            if op_name == "Convolution":
-                wshape = (channels, in_channels // groups
-                          if in_channels else 0) + tuple(kernel_size)
+            cig = in_channels // groups if in_channels else 0
+            if self._channel_last:
+                # channel-last weight conventions (convolution.cc layout
+                # param): conv O*kI, deconv I*kO
+                if op_name == "Convolution":
+                    wshape = (channels,) + tuple(kernel_size) + (cig,)
+                else:
+                    wshape = (in_channels,) + tuple(kernel_size) \
+                        + (channels // groups,)
+            elif op_name == "Convolution":
+                wshape = (channels, cig) + tuple(kernel_size)
             else:  # Deconvolution: (in_channels, channels//groups, *k)
                 wshape = (in_channels, channels // groups) + tuple(kernel_size)
             self.weight = self.params.get(
@@ -78,9 +91,16 @@ class _Conv(HybridBlock):
                 self.act = None
 
     def _infer_param_shapes(self, x, *args):
-        in_channels = x.shape[1]
+        in_channels = x.shape[-1] if self._channel_last else x.shape[1]
         k = tuple(self._kwargs["kernel"])
-        if self._op_name == "Convolution":
+        if self._channel_last:
+            if self._op_name == "Convolution":
+                self.weight.shape = (self._channels,) + k \
+                    + (in_channels // self._groups,)
+            else:
+                self.weight.shape = (in_channels,) + k \
+                    + (self._channels // self._groups,)
+        elif self._op_name == "Convolution":
             self.weight.shape = (
                 self._channels, in_channels // self._groups
             ) + k
@@ -115,17 +135,17 @@ class _Conv(HybridBlock):
             s += ", {}".format(self.act)
         s += ")"
         shape = self.weight.shape
+        cin = shape[-1] if self._channel_last else shape[1]
         return s.format(
             name=self.__class__.__name__,
-            mapping="{0} -> {1}".format(shape[1] if shape[1] else None,
-                                        shape[0]),
+            mapping="{0} -> {1}".format(cin if cin else None, shape[0]),
             **self._kwargs,
         )
 
 
 class Conv1D(_Conv):
     def __init__(self, channels, kernel_size, strides=1, padding=0,
-                 dilation=1, groups=1, layout="NCW", activation=None,
+                 dilation=1, groups=1, layout=None, activation=None,
                  use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0, **kwargs):
         if isinstance(kernel_size, (int, onp.integer)):
@@ -140,7 +160,7 @@ class Conv1D(_Conv):
 
 class Conv2D(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
-                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
+                 dilation=(1, 1), groups=1, layout=None, activation=None,
                  use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0, **kwargs):
         kernel_size = _tup(kernel_size, 2)
@@ -155,7 +175,7 @@ class Conv2D(_Conv):
 class Conv3D(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1, 1),
                  padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
-                 layout="NCDHW", activation=None, use_bias=True,
+                 layout=None, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros",
                  in_channels=0, **kwargs):
         kernel_size = _tup(kernel_size, 3)
@@ -182,7 +202,7 @@ class _ConvTranspose(_Conv):
 
 class Conv1DTranspose(_ConvTranspose):
     def __init__(self, channels, kernel_size, strides=1, padding=0,
-                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 output_padding=0, dilation=1, groups=1, layout=None,
                  activation=None, use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0, **kwargs):
         if isinstance(kernel_size, (int, onp.integer)):
@@ -196,7 +216,7 @@ class Conv1DTranspose(_ConvTranspose):
 class Conv2DTranspose(_ConvTranspose):
     def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
                  output_padding=(0, 0), dilation=(1, 1), groups=1,
-                 layout="NCHW", activation=None, use_bias=True,
+                 layout=None, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros",
                  in_channels=0, **kwargs):
         kernel_size = _tup(kernel_size, 2)
@@ -209,7 +229,7 @@ class Conv2DTranspose(_ConvTranspose):
 class Conv3DTranspose(_ConvTranspose):
     def __init__(self, channels, kernel_size, strides=(1, 1, 1),
                  padding=(0, 0, 0), output_padding=(0, 0, 0),
-                 dilation=(1, 1, 1), groups=1, layout="NCDHW",
+                 dilation=(1, 1, 1), groups=1, layout=None,
                  activation=None, use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0, **kwargs):
         kernel_size = _tup(kernel_size, 3)
@@ -224,6 +244,8 @@ class _Pooling(HybridBlock):
                  global_pool=False, pool_type="max", layout=None,
                  count_include_pad=None, **kwargs):
         super().__init__(**kwargs)
+        from .layout import resolve_layout
+
         if strides is None:
             strides = pool_size
         ndim = len(pool_size)
@@ -232,6 +254,7 @@ class _Pooling(HybridBlock):
             "pad": _tup(padding, ndim), "global_pool": global_pool,
             "pool_type": pool_type,
             "pooling_convention": "full" if ceil_mode else "valid",
+            "layout": resolve_layout(layout, ndim),
         }
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
@@ -254,7 +277,7 @@ class _Pooling(HybridBlock):
 
 
 class MaxPool1D(_Pooling):
-    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+    def __init__(self, pool_size=2, strides=None, padding=0, layout=None,
                  ceil_mode=False, **kwargs):
         super().__init__(
             _tup(pool_size, 1), strides, padding, ceil_mode, False, "max",
@@ -263,7 +286,7 @@ class MaxPool1D(_Pooling):
 
 class MaxPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
-                 layout="NCHW", ceil_mode=False, **kwargs):
+                 layout=None, ceil_mode=False, **kwargs):
         super().__init__(
             _tup(pool_size, 2), strides, _tup(padding, 2), ceil_mode, False,
             "max", layout, **kwargs)
@@ -271,14 +294,14 @@ class MaxPool2D(_Pooling):
 
 class MaxPool3D(_Pooling):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
-                 layout="NCDHW", ceil_mode=False, **kwargs):
+                 layout=None, ceil_mode=False, **kwargs):
         super().__init__(
             _tup(pool_size, 3), strides, _tup(padding, 3), ceil_mode, False,
             "max", layout, **kwargs)
 
 
 class AvgPool1D(_Pooling):
-    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+    def __init__(self, pool_size=2, strides=None, padding=0, layout=None,
                  ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(
             _tup(pool_size, 1), strides, padding, ceil_mode, False, "avg",
@@ -287,7 +310,7 @@ class AvgPool1D(_Pooling):
 
 class AvgPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
-                 layout="NCHW", ceil_mode=False, count_include_pad=True,
+                 layout=None, ceil_mode=False, count_include_pad=True,
                  **kwargs):
         super().__init__(
             _tup(pool_size, 2), strides, _tup(padding, 2), ceil_mode, False,
@@ -296,7 +319,7 @@ class AvgPool2D(_Pooling):
 
 class AvgPool3D(_Pooling):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
-                 layout="NCDHW", ceil_mode=False, count_include_pad=True,
+                 layout=None, ceil_mode=False, count_include_pad=True,
                  **kwargs):
         super().__init__(
             _tup(pool_size, 3), strides, _tup(padding, 3), ceil_mode, False,
@@ -304,33 +327,33 @@ class AvgPool3D(_Pooling):
 
 
 class GlobalMaxPool1D(_Pooling):
-    def __init__(self, layout="NCW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1,), None, 0, True, True, "max", layout, **kwargs)
 
 
 class GlobalMaxPool2D(_Pooling):
-    def __init__(self, layout="NCHW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1, 1), None, 0, True, True, "max", layout, **kwargs)
 
 
 class GlobalMaxPool3D(_Pooling):
-    def __init__(self, layout="NCDHW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1, 1, 1), None, 0, True, True, "max", layout,
                          **kwargs)
 
 
 class GlobalAvgPool1D(_Pooling):
-    def __init__(self, layout="NCW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1,), None, 0, True, True, "avg", layout, **kwargs)
 
 
 class GlobalAvgPool2D(_Pooling):
-    def __init__(self, layout="NCHW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1, 1), None, 0, True, True, "avg", layout, **kwargs)
 
 
 class GlobalAvgPool3D(_Pooling):
-    def __init__(self, layout="NCDHW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1, 1, 1), None, 0, True, True, "avg", layout,
                          **kwargs)
 

@@ -1,0 +1,65 @@
+"""Construction-time default-layout scope for conv/pool/norm layers.
+
+The reference picks layout per-op via each operator's ``layout`` param
+(src/operator/nn/convolution.cc) and its perf guide tells users to
+switch the whole net (docs perf.md).  Here one scope flips every layer
+default so a model builds channel-last end-to-end:
+
+    with nn.default_layout("NHWC"):
+        net = resnet50_v1()
+
+Channel-last is the TPU-native layout — the channel dim sits on the
+128-lane minor axis so XLA tiles convs straight onto the MXU with no
+layout transposes.  Layers resolve their default at construction;
+explicitly passed ``layout=``/``axis=`` always wins.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+_CHANNEL_LAST = {"NWC": 1, "NHWC": 2, "NDHWC": 3}
+_CHANNEL_FIRST = {"NCW": 1, "NCHW": 2, "NCDHW": 3}
+
+_state = threading.local()
+
+
+def _current():
+    return getattr(_state, "layout", "NCHW")
+
+
+@contextmanager
+def default_layout(layout):
+    """Scope under which conv/pool/BatchNorm layer defaults follow
+    ``layout`` ("NCHW"-family or "NHWC"-family; None = no change)."""
+    if layout is None:
+        yield
+        return
+    if layout not in _CHANNEL_LAST and layout not in _CHANNEL_FIRST:
+        raise ValueError(f"unknown layout {layout!r}")
+    prev = _current()
+    _state.layout = layout
+    try:
+        yield
+    finally:
+        _state.layout = prev
+
+
+def is_channel_last(layout=None):
+    return (layout if layout is not None else _current()) in _CHANNEL_LAST
+
+
+def resolve_layout(layout, ndim):
+    """Layer-default layout for ``ndim`` spatial dims, honoring an
+    explicit ``layout`` argument when given."""
+    if layout is not None:
+        return layout
+    if is_channel_last():
+        return ["NWC", "NHWC", "NDHWC"][ndim - 1]
+    return ["NCW", "NCHW", "NCDHW"][ndim - 1]
+
+
+def channel_axis(layout=None):
+    """Channel axis for a 4-d activation under ``layout`` (or the scope
+    default): 1 for channel-first, -1 for channel-last."""
+    return -1 if is_channel_last(layout) else 1

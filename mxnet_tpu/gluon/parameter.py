@@ -205,7 +205,18 @@ class Parameter:
             self._finish_init(init_mod.Constant(0), ctx, default_init)
         if not isinstance(data, nd.NDArray):
             data = nd.array(data, dtype=self.dtype)
-        self._data._adopt(data.astype(self.dtype)._data)
+        new = data.astype(self.dtype)._data
+        # keep the parameter on its current device: loading .params from
+        # disk (host arrays) must not silently migrate a TPU-resident
+        # parameter back to CPU (reference set_data keeps ctx)
+        cur = self._data._data
+        if hasattr(cur, "devices") and hasattr(new, "devices") \
+                and cur.devices() != new.devices():
+            import jax
+            # target the existing sharding (covers multi-device/mesh
+            # placements), not just one device of it
+            new = jax.device_put(new, cur.sharding)
+        self._data._adopt(new)
 
     def zero_grad(self):
         if self._data is not None and self._data._grad is not None:

@@ -4,8 +4,14 @@ Reference parity: src/operator/nn/convolution.cc, deconvolution.cc,
 pooling.cc, upsampling.cc (+ their cuDNN wrappers nn/cudnn/ with the
 autotuned algo registry cudnn_algoreg-inl.h).  TPU-native: one
 ``lax.conv_general_dilated`` call — XLA picks MXU tilings, so the whole
-cuDNN algorithm-selection machinery disappears.  Layouts are the
-reference's NCW/NCHW/NCDHW; weights are OIHW (num_filter, C/group, *k).
+cuDNN algorithm-selection machinery disappears.
+
+Layouts: the reference's channel-first NCW/NCHW/NCDHW family (weights
+OIHW: num_filter, C/group, *k) and the channel-last NWC/NHWC/NDHWC
+family (weights O*kI: num_filter, *k, C/group — the reference's NHWC
+weight convention, convolution.cc layout param).  Channel-last is the
+TPU-native layout: the channel dim lands on the 128-lane minor axis, so
+XLA feeds the MXU without inserting transposes.
 """
 from __future__ import annotations
 
@@ -13,6 +19,9 @@ import jax
 import jax.numpy as jnp
 
 from .registry import register_op
+
+_CHANNEL_LAST = frozenset(("NWC", "NHWC", "NDHWC"))
+_CHANNEL_FIRST = frozenset(("NCW", "NCHW", "NCDHW"))
 
 
 def _tup(v, n, default=1):
@@ -23,15 +32,22 @@ def _tup(v, n, default=1):
     return tuple(int(x) for x in v)
 
 
-def _dimnums(nd):
-    # NCHW-family dimension numbers for any spatial rank
-    sp = "".join(chr(ord("0") + i) for i in range(nd))  # placeholder
+def _channel_last(layout, nd):
+    if layout is None or layout in _CHANNEL_FIRST:
+        return False
+    if layout in _CHANNEL_LAST:
+        return True
+    raise ValueError(f"unsupported layout {layout!r} for {nd}d conv/pool")
+
+
+def _dimnums(nd, channel_last=False):
     spatial = ["W", "HW", "DHW"][nd - 1]
+    if channel_last:
+        specs = (f"N{spatial}C", f"O{spatial}I", f"N{spatial}C")
+    else:
+        specs = (f"NC{spatial}", f"OI{spatial}", f"NC{spatial}")
     return jax.lax.conv_dimension_numbers(
-        (1, 1) + (1,) * nd,
-        (1, 1) + (1,) * nd,
-        (f"NC{spatial}", f"OI{spatial}", f"NC{spatial}"),
-    )
+        (1, 1) + (1,) * nd, (1, 1) + (1,) * nd, specs)
 
 
 @register_op("Convolution", aliases=("Convolution_v1",))
@@ -44,7 +60,8 @@ def convolution(data, weight, bias=None, *, kernel, num_filter, stride=None,
     stride = _tup(stride, nd)
     dilate = _tup(dilate, nd)
     pad = _tup(pad, nd, 0)
-    dn = _dimnums(nd)
+    cl = _channel_last(layout, nd)
+    dn = _dimnums(nd, cl)
     out = jax.lax.conv_general_dilated(
         data, weight,
         window_strides=stride,
@@ -54,7 +71,7 @@ def convolution(data, weight, bias=None, *, kernel, num_filter, stride=None,
         feature_group_count=num_group,
     )
     if not no_bias and bias is not None:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        out = out + (bias if cl else bias.reshape((1, -1) + (1,) * nd))
     return out
 
 
@@ -71,7 +88,7 @@ def deconvolution(data, weight, bias=None, *, kernel, num_filter,
     dilate = _tup(dilate, nd)
     pad = _tup(pad, nd, 0)
     adj = _tup(adj, nd, 0)
-    dn = _dimnums(nd)
+    cl = _channel_last(layout, nd)
     # effective padding for transposed conv: k_eff - 1 - p
     padding = []
     for i in range(nd):
@@ -79,15 +96,30 @@ def deconvolution(data, weight, bias=None, *, kernel, num_filter,
         lo = k_eff - 1 - pad[i]
         hi = k_eff - 1 - pad[i] + adj[i]
         padding.append((lo, hi))
-    # weight layout (C_in, C_out/group, *k) -> flip spatial, swap IO
-    w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
-    if num_group > 1:
-        ci, co_g = w.shape[0], w.shape[1]
-        w = w.reshape(num_group, ci // num_group, co_g, *w.shape[2:])
-        w = jnp.swapaxes(w, 1, 2)
-        w = w.reshape(num_group * co_g, ci // num_group, *w.shape[3:])
+    if cl:
+        # weight (C_in, *k, C_out/group) -> flip spatial; kernel IO roles
+        # are expressed via the I<spatial>O rhs spec, no physical swap
+        spatial = ["W", "HW", "DHW"][nd - 1]
+        dn = jax.lax.conv_dimension_numbers(
+            (1, 1) + (1,) * nd, (1, 1) + (1,) * nd,
+            (f"N{spatial}C", f"I{spatial}O", f"N{spatial}C"))
+        w = jnp.flip(weight, axis=tuple(range(1, 1 + nd)))
+        if num_group > 1:
+            ci, co_g = w.shape[0], w.shape[-1]
+            w = w.reshape(num_group, ci // num_group, *w.shape[1:])
+            w = jnp.moveaxis(w, 0, -2)  # (ci/g, *k, g, co_g)
+            w = w.reshape(ci // num_group, *w.shape[1:-2], num_group * co_g)
     else:
-        w = jnp.swapaxes(w, 0, 1)
+        dn = _dimnums(nd)
+        # weight layout (C_in, C_out/group, *k) -> flip spatial, swap IO
+        w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
+        if num_group > 1:
+            ci, co_g = w.shape[0], w.shape[1]
+            w = w.reshape(num_group, ci // num_group, co_g, *w.shape[2:])
+            w = jnp.swapaxes(w, 1, 2)
+            w = w.reshape(num_group * co_g, ci // num_group, *w.shape[3:])
+        else:
+            w = jnp.swapaxes(w, 0, 1)
     out = jax.lax.conv_general_dilated(
         data, w,
         window_strides=(1,) * nd,
@@ -98,7 +130,7 @@ def deconvolution(data, weight, bias=None, *, kernel, num_filter,
         feature_group_count=num_group,
     )
     if not no_bias and bias is not None:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        out = out + (bias if cl else bias.reshape((1, -1) + (1,) * nd))
     return out
 
 
@@ -109,24 +141,34 @@ def pooling(data, *, kernel=(), pool_type="max", global_pool=False,
             layout=None):
     """Reference: src/operator/nn/pooling.cc via lax.reduce_window."""
     nd = data.ndim - 2
+    cl = _channel_last(layout, nd)
+    sp0 = 1 if cl else 2  # first spatial axis
     if global_pool:
-        kernel = data.shape[2:]
+        kernel = data.shape[sp0:sp0 + nd]
         stride = (1,) * nd
         pad = (0,) * nd
     stride = _tup(stride, nd)
     pad = _tup(pad, nd, 0)
     kernel = _tup(kernel, nd)
-    dims = (1, 1) + kernel
-    strides = (1, 1) + stride
-    base_pad = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+    if cl:
+        dims = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+    else:
+        dims = (1, 1) + kernel
+        strides = (1, 1) + stride
+    sp_pad = [(p, p) for p in pad]
     if pooling_convention == "full":
         # ceil mode: add extra right-pad so last window fits
-        base_pad = [(0, 0), (0, 0)]
+        sp_pad = []
         for i in range(nd):
-            size = data.shape[2 + i] + 2 * pad[i]
+            size = data.shape[sp0 + i] + 2 * pad[i]
             rem = (size - kernel[i]) % stride[i]
             extra = (stride[i] - rem) % stride[i] if rem else 0
-            base_pad.append((pad[i], pad[i] + extra))
+            sp_pad.append((pad[i], pad[i] + extra))
+    if cl:
+        base_pad = [(0, 0)] + sp_pad + [(0, 0)]
+    else:
+        base_pad = [(0, 0), (0, 0)] + sp_pad
 
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \

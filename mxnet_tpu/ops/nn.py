@@ -121,6 +121,78 @@ def _mean_var_nout(p):
     return 3 if p.get("output_mean_var") else 1
 
 
+def _bn_stats(data, axis):
+    """fp32 batch stats; two-pass (subtract mean first) — the one-pass
+    E[x^2]-E[x]^2 form catastrophically cancels in fp32 for channels
+    with |mean| >> std, and BN time is fusion-dominated anyway."""
+    red = tuple(i for i in range(data.ndim) if i != axis % data.ndim)
+    bshape = [1] * data.ndim
+    bshape[axis % data.ndim] = data.shape[axis % data.ndim]
+    x32 = data.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=red)
+    var = jnp.mean(
+        jnp.square(x32 - mean.reshape(bshape)), axis=red)
+    return mean, var
+
+
+def _bn_train_fwd(data, gamma, beta, eps, axis, fix_gamma):
+    ax = axis % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    bshape = [1] * data.ndim
+    bshape[ax] = data.shape[ax]
+    mean, var = _bn_stats(data, axis)
+    inv = jax.lax.rsqrt(var + eps)
+    g32 = jnp.ones_like(inv) if fix_gamma else gamma.astype(jnp.float32)
+    scale = (inv * g32).reshape(bshape)
+    shift = (beta.astype(jnp.float32)
+             - mean * inv * g32).reshape(bshape)
+    out = (data.astype(jnp.float32) * scale + shift).astype(data.dtype)
+    res = (data, gamma, mean, inv, red, bshape)
+    return (out, mean, var), res
+
+
+def _bn_train_bwd(eps, axis, fix_gamma, res, cts):
+    """Fused BN backward (the cuDNN BatchNormalizationBackward analog,
+    reference batch_norm.cu): residuals are the ORIGINAL bf16 x plus
+    per-channel stats — no fp32 activation-sized tensors survive the
+    forward, which halves the train-step HBM traffic."""
+    data, gamma, mean, inv, red, bshape = res
+    dy, dmean_ct, dvar_ct = cts
+    n = 1
+    for i in red:
+        n *= data.shape[i]
+    x32 = data.astype(jnp.float32)
+    dy32 = dy.astype(jnp.float32)
+    g32 = jnp.ones_like(inv) if fix_gamma else gamma.astype(jnp.float32)
+    xhat = (x32 - mean.reshape(bshape)) * inv.reshape(bshape)
+    sum_dy = jnp.sum(dy32, axis=red)
+    sum_dy_xhat = jnp.sum(dy32 * xhat, axis=red)
+    # d/dx of the normalized output (batch stats participate)
+    dx32 = (inv * g32).reshape(bshape) * (
+        dy32 - (sum_dy / n).reshape(bshape)
+        - xhat * (sum_dy_xhat / n).reshape(bshape))
+    # cotangents flowing into the mean/var outputs (moving-average
+    # update runs under autograd.pause -> normally zero, kept for
+    # correctness of output_mean_var users)
+    if dmean_ct is not None:
+        dx32 = dx32 + (dmean_ct / n).reshape(bshape)
+    if dvar_ct is not None:
+        dx32 = dx32 + (dvar_ct * 2.0 / n).reshape(bshape) \
+            * (x32 - mean.reshape(bshape))
+    dgamma = jnp.zeros_like(gamma) if fix_gamma \
+        else sum_dy_xhat.astype(gamma.dtype)
+    dbeta = sum_dy.astype(gamma.dtype)
+    return dx32.astype(data.dtype), dgamma, dbeta
+
+
+def _bn_train_primal(data, gamma, beta, eps, axis, fix_gamma):
+    return _bn_train_fwd(data, gamma, beta, eps, axis, fix_gamma)[0]
+
+
+_bn_train = jax.custom_vjp(_bn_train_primal, nondiff_argnums=(3, 4, 5))
+_bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
+
+
 @register_op("BatchNorm", aliases=("BatchNorm_v1",),
              num_outputs=_mean_var_nout, train_param="train")
 def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
@@ -133,25 +205,26 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
     stats into the moving aux arrays — the reference op mutates its aux
     inputs in-place instead, which has no XLA analog.
     """
-    red = tuple(i for i in range(data.ndim) if i != axis % data.ndim)
+    if train and not use_global_stats:
+        # fused train path: custom VJP keeps residuals to the original
+        # activation + per-channel stats (see _bn_train_bwd)
+        out, mean, var = _bn_train(data, gamma, beta, float(eps), int(axis),
+                                   bool(fix_gamma))
+        if output_mean_var:
+            return out, mean, var
+        return out
+
     bshape = [1] * data.ndim
     bshape[axis % data.ndim] = data.shape[axis % data.ndim]
-
     # stats in fp32 regardless of activation dtype (bf16 AMP-safe);
     # output cast back so downstream matmuls stay on the bf16 MXU path
-    data32 = data.astype(jnp.float32)
-    if train and not use_global_stats:
-        mean = jnp.mean(data32, axis=red)
-        var = jnp.var(data32, axis=red)
-    else:
-        mean, var = (moving_mean.astype(jnp.float32),
-                     moving_var.astype(jnp.float32))
-    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    mean, var = (moving_mean.astype(jnp.float32),
+                 moving_var.astype(jnp.float32))
     inv = jax.lax.rsqrt(var + eps)
-    out = (data32 - mean.reshape(bshape))
-    out = out * (inv * g.astype(jnp.float32)).reshape(bshape) \
-        + beta.astype(jnp.float32).reshape(bshape)
-    out = out.astype(data.dtype)
+    g32 = jnp.ones_like(inv) if fix_gamma else gamma.astype(jnp.float32)
+    scale = (inv * g32).reshape(bshape)
+    shift = (beta.astype(jnp.float32) - mean * inv * g32).reshape(bshape)
+    out = (data.astype(jnp.float32) * scale + shift).astype(data.dtype)
     if output_mean_var:
         return out, mean, var
     return out

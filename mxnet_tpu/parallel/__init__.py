@@ -296,6 +296,11 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
         new_p, new_s = _apply_updates(params_, opt_state_, grads, t, key)
         return loss, new_p, new_s
 
+    if donate and jax.local_devices()[0].platform == "axon":
+        # the axon tunnel backend rejects aliased (donated) buffers at
+        # readback time (TPU backend InvalidArgument) — measured r03;
+        # XLA owns enough HBM headroom here that donation is optional
+        donate = False
     donate_argnums = (0, 1) if donate else ()
     if mesh is not None:
         repl = NamedSharding(mesh, P())

@@ -1,0 +1,243 @@
+"""Channel-last (NHWC-family) layout support.
+
+Reference: the ``layout`` parameter on Convolution/Deconvolution/Pooling
+(src/operator/nn/convolution.cc) and the perf-guide guidance to run nets
+channel-last (docs perf.md).  Every case checks numeric equality against
+the channel-first path with transposed weights.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def _rand(*s):
+    return onp.random.rand(*s).astype("float32")
+
+
+def test_conv2d_nhwc_matches_nchw():
+    onp.random.seed(0)
+    x = _rand(2, 3, 8, 8)
+    w = _rand(5, 3, 3, 3)
+    b = _rand(5)
+    o1 = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                        kernel=(3, 3), num_filter=5, pad=(1, 1),
+                        stride=(2, 2)).asnumpy()
+    o2 = nd.Convolution(nd.array(x.transpose(0, 2, 3, 1)),
+                        nd.array(w.transpose(0, 2, 3, 1)), nd.array(b),
+                        kernel=(3, 3), num_filter=5, pad=(1, 1),
+                        stride=(2, 2), layout="NHWC").asnumpy()
+    onp.testing.assert_allclose(o2.transpose(0, 3, 1, 2), o1, rtol=1e-5,
+                                atol=1e-5)
+
+
+def test_conv2d_nhwc_grouped():
+    onp.random.seed(1)
+    x = _rand(2, 4, 6, 6)
+    w = _rand(8, 2, 3, 3)  # groups=2: (O, C/g, kh, kw)
+    o1 = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                        num_filter=8, pad=(1, 1), num_group=2,
+                        no_bias=True).asnumpy()
+    o2 = nd.Convolution(nd.array(x.transpose(0, 2, 3, 1)),
+                        nd.array(w.transpose(0, 2, 3, 1)), kernel=(3, 3),
+                        num_filter=8, pad=(1, 1), num_group=2,
+                        no_bias=True, layout="NHWC").asnumpy()
+    onp.testing.assert_allclose(o2.transpose(0, 3, 1, 2), o1, rtol=1e-5,
+                                atol=1e-5)
+
+
+def test_deconv2d_nhwc_matches_nchw():
+    onp.random.seed(2)
+    x = _rand(2, 4, 5, 5)
+    w = _rand(4, 6, 3, 3)  # (C_in, C_out, kh, kw)
+    o1 = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                          num_filter=6, stride=(2, 2), pad=(1, 1),
+                          adj=(1, 1)).asnumpy()
+    o2 = nd.Deconvolution(nd.array(x.transpose(0, 2, 3, 1)),
+                          nd.array(w.transpose(0, 2, 3, 1)),
+                          kernel=(3, 3), num_filter=6, stride=(2, 2),
+                          pad=(1, 1), adj=(1, 1), layout="NHWC").asnumpy()
+    onp.testing.assert_allclose(o2.transpose(0, 3, 1, 2), o1, rtol=1e-5,
+                                atol=1e-5)
+
+
+def test_deconv2d_nhwc_grouped():
+    onp.random.seed(3)
+    x = _rand(2, 4, 5, 5)
+    w = _rand(4, 3, 3, 3)  # groups=2: (C_in, C_out/g, kh, kw)
+    o1 = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                          num_filter=6, num_group=2, stride=(2, 2),
+                          pad=(1, 1)).asnumpy()
+    o2 = nd.Deconvolution(nd.array(x.transpose(0, 2, 3, 1)),
+                          nd.array(w.transpose(0, 2, 3, 1)),
+                          kernel=(3, 3), num_filter=6, num_group=2,
+                          stride=(2, 2), pad=(1, 1),
+                          layout="NHWC").asnumpy()
+    onp.testing.assert_allclose(o2.transpose(0, 3, 1, 2), o1, rtol=1e-5,
+                                atol=1e-5)
+
+
+@pytest.mark.parametrize("pool_type", ["max", "avg"])
+@pytest.mark.parametrize("convention", ["valid", "full"])
+def test_pooling_nhwc(pool_type, convention):
+    onp.random.seed(4)
+    x = _rand(2, 3, 7, 7)
+    o1 = nd.Pooling(nd.array(x), kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                    pool_type=pool_type,
+                    pooling_convention=convention).asnumpy()
+    o2 = nd.Pooling(nd.array(x.transpose(0, 2, 3, 1)), kernel=(3, 3),
+                    stride=(2, 2), pad=(1, 1), pool_type=pool_type,
+                    pooling_convention=convention,
+                    layout="NHWC").asnumpy()
+    onp.testing.assert_allclose(o2.transpose(0, 3, 1, 2), o1, rtol=1e-5,
+                                atol=1e-5)
+
+
+def test_global_pool_nhwc():
+    x = _rand(2, 3, 5, 5)
+    o1 = nd.Pooling(nd.array(x), global_pool=True, pool_type="avg").asnumpy()
+    o2 = nd.Pooling(nd.array(x.transpose(0, 2, 3, 1)), global_pool=True,
+                    pool_type="avg", layout="NHWC").asnumpy()
+    onp.testing.assert_allclose(o2.transpose(0, 3, 1, 2), o1, rtol=1e-5)
+
+
+def test_default_layout_scope():
+    with nn.default_layout("NHWC"):
+        conv = nn.Conv2D(4, 3, in_channels=2)
+        bn = nn.BatchNorm()
+        explicit = nn.BatchNorm(axis=1)
+    assert conv._kwargs["layout"] == "NHWC"
+    assert conv.weight.shape == (4, 3, 3, 2)
+    assert bn._axis == -1
+    assert explicit._axis == 1  # explicit argument wins over the scope
+    # scope restored
+    conv2 = nn.Conv2D(4, 3, in_channels=2)
+    assert conv2._kwargs["layout"] == "NCHW"
+    assert conv2.weight.shape == (4, 2, 3, 3)
+
+
+def test_gluon_conv_nhwc_deferred_infer():
+    with nn.default_layout("NHWC"):
+        conv = nn.Conv2D(8, 3, padding=1)
+    conv.initialize()
+    out = conv(nd.array(_rand(2, 6, 6, 5)))
+    assert out.shape == (2, 6, 6, 8)
+    assert conv.weight.shape == (8, 3, 3, 5)
+
+
+def test_resnet_nhwc_matches_nchw_and_trains():
+    onp.random.seed(5)
+    net = gluon.model_zoo.vision.resnet18_v1(classes=10, layout="NHWC")
+    net.initialize(init=mx.init.Xavier())
+    net(nd.array(_rand(1, 16, 16, 3)))
+    net2 = gluon.model_zoo.vision.resnet18_v1(classes=10)
+    net2.initialize(init=mx.init.Xavier())
+    net2(nd.array(_rand(1, 3, 16, 16)))
+
+    import re
+
+    def strip(k):
+        return re.sub(r"^[^_]*", "", k)
+
+    p1 = dict(net.collect_params().items())
+    m2 = {strip(k): v for k, v in net2.collect_params().items()}
+    for k, v in p1.items():
+        a = m2[strip(k)].data().asnumpy()
+        if a.ndim == 4:
+            a = a.transpose(0, 2, 3, 1)
+        v.set_data(nd.array(a))
+
+    xn = _rand(2, 3, 16, 16)
+    o_nchw = net2(nd.array(xn)).asnumpy()
+    o_nhwc = net(nd.array(xn.transpose(0, 2, 3, 1))).asnumpy()
+    onp.testing.assert_allclose(o_nhwc, o_nchw, rtol=1e-4, atol=1e-4)
+
+    # one training step decreases loss on a fixed batch
+    x = nd.array(_rand(4, 16, 16, 3))
+    y = nd.array(onp.array([0, 1, 2, 3], dtype="float32"))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    losses = []
+    for _ in range(3):
+        with autograd.record():
+            loss = loss_fn(net(x), y).mean()
+        loss.backward()
+        trainer.step(4)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_bn_train_grads_match_finite_difference():
+    onp.random.seed(6)
+    from mxnet_tpu.ops.nn import batch_norm
+    import jax
+    import jax.numpy as jnp
+
+    x = _rand(3, 2, 4, 4) * 2 - 1
+    gamma = _rand(2) + 0.5
+    beta = _rand(2)
+    mm_ = onp.zeros(2, "float32")
+    mv_ = onp.ones(2, "float32")
+
+    def f(x, g, b):
+        return jnp.sum(batch_norm(x, g, b, mm_, mv_, fix_gamma=False,
+                                  train=True, eps=1e-5) ** 2)
+
+    gx, gg, gb = jax.grad(f, argnums=(0, 1, 2))(
+        jnp.asarray(x), jnp.asarray(gamma), jnp.asarray(beta))
+    eps = 1e-3
+
+    def num(fn, a):
+        a = onp.asarray(a, "float64").copy()
+        g = onp.zeros_like(a)
+        it = onp.nditer(a, flags=["multi_index"])
+        for _ in it:
+            i = it.multi_index
+            a[i] += eps
+            fp = float(fn(a.astype("float32")))
+            a[i] -= 2 * eps
+            fm = float(fn(a.astype("float32")))
+            a[i] += eps
+            g[i] = (fp - fm) / (2 * eps)
+        return g
+
+    ngg = num(lambda g: f(jnp.asarray(x), jnp.asarray(g),
+                          jnp.asarray(beta)), gamma)
+    onp.testing.assert_allclose(gg, ngg, rtol=2e-2, atol=1e-2)
+    ngb = num(lambda b: f(jnp.asarray(x), jnp.asarray(gamma),
+                          jnp.asarray(b)), beta)
+    onp.testing.assert_allclose(gb, ngb, rtol=2e-2, atol=1e-2)
+    # spot-check dx
+    xs = onp.asarray(x)
+    for i in [(0, 0, 0, 0), (2, 1, 3, 2)]:
+        xp = xs.copy()
+        xp[i] += eps
+        xm = xs.copy()
+        xm[i] -= eps
+        ng = (float(f(jnp.asarray(xp), jnp.asarray(gamma),
+                      jnp.asarray(beta)))
+              - float(f(jnp.asarray(xm), jnp.asarray(gamma),
+                        jnp.asarray(beta)))) / (2 * eps)
+        onp.testing.assert_allclose(gx[i], ng, rtol=5e-2, atol=5e-2)
+
+
+def test_bn_fix_gamma_zero_grad():
+    from mxnet_tpu.ops.nn import batch_norm
+    import jax
+    import jax.numpy as jnp
+
+    x = _rand(2, 3, 4, 4)
+    gamma = _rand(3) + 0.5
+    beta = _rand(3)
+    mm_ = onp.zeros(3, "float32")
+    mv_ = onp.ones(3, "float32")
+
+    def f(g):
+        return jnp.sum(batch_norm(jnp.asarray(x), g, beta, mm_, mv_,
+                                  fix_gamma=True, train=True) ** 2)
+
+    gg = jax.grad(f)(jnp.asarray(gamma))
+    onp.testing.assert_allclose(gg, onp.zeros(3), atol=1e-7)
