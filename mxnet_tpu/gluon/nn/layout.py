@@ -18,8 +18,9 @@ from __future__ import annotations
 import threading
 from contextlib import contextmanager
 
-_CHANNEL_LAST = {"NWC": 1, "NHWC": 2, "NDHWC": 3}
-_CHANNEL_FIRST = {"NCW": 1, "NCHW": 2, "NCDHW": 3}
+# single source of truth for layout-string classification lives in the
+# op layer (ops/conv.py); this module only adds the scoping mechanics
+from ...ops.conv import _CHANNEL_FIRST, _CHANNEL_LAST
 
 _state = threading.local()
 

@@ -24,8 +24,8 @@ class ndarray(NDArray):
 
     def __repr__(self):
         try:
-            return f"array({self.asnumpy()!r}".replace(
-                "array(array(", "array(") + ")"
+            r = repr(self.asnumpy())
+            return r if r.startswith("array(") else f"array({r})"
         except Exception:
             return f"array(<traced {self._data}>)"
 
@@ -864,3 +864,306 @@ uint8 = onp.uint8
 bool_ = onp.bool_
 bfloat16 = jnp.bfloat16
 _np_version = onp.__version__
+
+
+# ---------------------------------------------------------- round 3 fill
+# (reference multiarray.py tail + numpy_dispatch_protocol.py interop)
+def empty_like(a, dtype=None):
+    return zeros_like(a, dtype=dtype)
+
+
+def geomspace(start, stop, num=50, endpoint=True, dtype=None, ctx=None):
+    return _direct(jnp.geomspace, start, stop, num=num, endpoint=endpoint,
+                   dtype=dtype or "float32")
+
+
+def round(a, decimals=0):  # noqa: A001
+    return _f("round", a) if decimals == 0 else \
+        _direct(jnp.round, a, decimals=decimals)
+
+
+def fmax(x1, x2):
+    return _direct(jnp.fmax, x1, x2)
+
+
+def fmin(x1, x2):
+    return _direct(jnp.fmin, x1, x2)
+
+
+def nansum(a, axis=None, dtype=None, keepdims=False):
+    return _direct(jnp.nansum, a, axis=axis, dtype=dtype,
+                   keepdims=keepdims)
+
+
+def nanprod(a, axis=None, dtype=None, keepdims=False):
+    return _direct(jnp.nanprod, a, axis=axis, dtype=dtype,
+                   keepdims=keepdims)
+
+
+def nanargmax(a, axis=None):
+    return _direct(jnp.nanargmax, a, axis=axis)
+
+
+def nanargmin(a, axis=None):
+    return _direct(jnp.nanargmin, a, axis=axis)
+
+
+def flatten(a, order="C"):
+    return _in(a).reshape((-1,))
+
+
+def dsplit(ary, indices_or_sections):
+    return [_np(o) for o in
+            _direct(jnp.dsplit, ary, indices_or_sections)]
+
+
+def argwhere(a):
+    return _direct(jnp.argwhere, a)
+
+
+def extract(condition, arr):
+    a = _in(arr)
+    c = _in(condition)
+    return _direct(lambda aa, cc: aa.ravel()[jnp.nonzero(cc.ravel())[0]],
+                   a, c)
+
+
+def partition(a, kth, axis=-1):
+    return _direct(jnp.partition, a, kth=kth, axis=axis)
+
+
+def argpartition(a, kth, axis=-1):
+    return _direct(jnp.argpartition, a, kth=kth, axis=axis)
+
+
+def take_along_axis(arr, indices, axis):
+    return _f("_npi_take_along_axis", arr, indices, axis=axis)
+
+
+def choose(a, choices):
+    ch = stack([_in(c) for c in choices]) if isinstance(choices, (list, tuple)) \
+        else _in(choices)
+    return take_along_axis(ch, _in(a).astype("int64").reshape(
+        (1,) + tuple(_in(a).shape)), axis=0)[0]
+
+
+def compress(condition, a, axis=None):
+    return _direct(
+        lambda cc, aa: jnp.compress(onp.asarray(cc).astype(bool), aa,
+                                    axis=axis),
+        condition, a)
+
+
+def append(arr, values, axis=None):
+    return _f("_npi_concatenate", arr, values, axis=axis) if axis is not None \
+        else _f("_npi_concatenate", _in(arr).reshape((-1,)),
+                _in(values).reshape((-1,)), axis=0)
+
+
+def array_equiv(a1, a2):
+    try:
+        return bool(_direct(
+            lambda a, b: jnp.all(jnp.broadcast_arrays(a, b)[0]
+                                 == jnp.broadcast_arrays(a, b)[1]),
+            a1, a2).item())
+    except ValueError:
+        return False
+
+
+def bartlett(M, dtype="float32", ctx=None):
+    return _f("_npi_bartlett", M=M, dtype=dtype)
+
+
+def kaiser(M, beta, dtype="float32", ctx=None):
+    return _direct(lambda: jnp.asarray(onp.kaiser(M, beta), dtype=dtype))
+
+
+def diagonal(a, offset=0, axis1=0, axis2=1):
+    return _f("_npi_diagonal", a, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diagflat(v, k=0):
+    return _f("_npi_diagflat", v, k=k)
+
+
+def diag_indices_from(arr):
+    n = _in(arr).shape[0]
+    idx = arange(n, dtype="int64")
+    return tuple(idx for _ in range(_in(arr).ndim))
+
+
+def triu_indices(n, k=0, m=None):
+    r, c = onp.triu_indices(n, k, m)
+    return (array(r, dtype="int64"), array(c, dtype="int64"))
+
+
+def tril_indices(n, k=0, m=None):
+    r, c = onp.tril_indices(n, k, m)
+    return (array(r, dtype="int64"), array(c, dtype="int64"))
+
+
+def triu_indices_from(arr, k=0):
+    s = _in(arr).shape
+    return triu_indices(s[-2], k, s[-1])
+
+
+def tril_indices_from(arr, k=0):
+    s = _in(arr).shape
+    return tril_indices(s[-2], k, s[-1])
+
+
+def ndim(a):
+    return _in(a).ndim
+
+
+def shape(a):
+    return tuple(_in(a).shape)
+
+
+def size(a, axis=None):
+    s = _in(a).shape
+    if axis is None:
+        out = 1
+        for d in s:
+            out *= d
+        return out
+    return s[axis]
+
+
+def asarray(a, dtype=None):
+    if isinstance(a, ndarray) and dtype is None:
+        return a
+    return array(a, dtype=dtype)
+
+
+def ascontiguousarray(a, dtype=None):
+    return asarray(a, dtype=dtype)
+
+
+def float_power(x1, x2):
+    return _direct(jnp.float_power, x1, x2)
+
+
+def bitwise_and(x1, x2):
+    return _f("_npi_bitwise_and", x1, x2)
+
+
+def bitwise_or(x1, x2):
+    return _f("_npi_bitwise_or", x1, x2)
+
+
+def bitwise_xor(x1, x2):
+    return _f("_npi_bitwise_xor", x1, x2)
+
+
+def bitwise_not(x):
+    return _f("_npi_bitwise_not", x)
+
+
+invert = bitwise_not
+
+
+def left_shift(x1, x2):
+    return _f("_npi_left_shift", x1, x2)
+
+
+def right_shift(x1, x2):
+    return _f("_npi_right_shift", x1, x2)
+
+
+def positive(x):
+    return _f("_copy", x)
+
+
+def modf(x):
+    return _direct(jnp.modf, x)
+
+
+def divmod_(x1, x2):
+    return _direct(jnp.divmod, x1, x2)
+
+
+def signbit(x):
+    return _direct(jnp.signbit, x)
+
+
+def spacing(x):
+    # signed, measured away from zero (numpy semantics)
+    return _direct(
+        lambda v: jnp.nextafter(v, jnp.copysign(jnp.inf, v)) - v, x)
+
+
+def ptp(a, axis=None, keepdims=False):
+    return _direct(jnp.ptp, a, axis=axis, keepdims=keepdims)
+
+
+# ---------------------------------------------- numpy dispatch protocol
+# Reference: python/mxnet/numpy_dispatch_protocol.py — make
+# onp.mean(mx_np_array), onp.concatenate([...]) etc. dispatch to this
+# module via NEP-18 (__array_function__) and NEP-13 (__array_ufunc__).
+_UFUNC_MAP = None
+_FUNC_MAP = None
+
+
+def _build_dispatch_maps():
+    global _UFUNC_MAP, _FUNC_MAP
+    import sys
+    mod = sys.modules[__name__]
+    _UFUNC_MAP = {}
+    for name in ("add", "subtract", "multiply", "divide", "true_divide",
+                 "floor_divide", "power", "mod", "remainder", "sqrt",
+                 "square", "absolute", "exp", "log", "log2", "log10",
+                 "log1p", "expm1", "sin", "cos", "tan", "arcsin",
+                 "arccos", "arctan", "arctan2", "sinh", "cosh", "tanh",
+                 "arcsinh", "arccosh", "arctanh", "maximum", "minimum",
+                 "negative", "sign", "floor", "ceil", "trunc", "rint",
+                 "equal", "not_equal", "less", "less_equal", "greater",
+                 "greater_equal", "logical_and", "logical_or",
+                 "logical_xor", "isnan", "isinf", "isfinite",
+                 "copysign", "ldexp", "fmod", "hypot", "bitwise_and",
+                 "bitwise_or", "bitwise_xor"):
+        fn = getattr(mod, name, None)
+        if fn is not None:
+            _UFUNC_MAP[name] = fn
+    _FUNC_MAP = {}
+    for name in ("mean", "sum", "prod", "max", "min", "argmax", "argmin",
+                 "std", "var", "concatenate", "stack", "vstack", "hstack",
+                 "dstack", "split", "reshape", "transpose", "squeeze",
+                 "expand_dims", "clip", "where", "dot", "tensordot",
+                 "einsum", "unique", "nonzero", "sort", "argsort",
+                 "cumsum", "around", "broadcast_to", "tile", "repeat",
+                 "roll", "flip", "trace", "diff", "ravel", "atleast_1d",
+                 "atleast_2d", "atleast_3d", "may_share_memory",
+                 "shares_memory", "zeros_like", "ones_like", "meshgrid"):
+        fn = getattr(mod, name, None)
+        if fn is not None:
+            _FUNC_MAP[name] = fn
+
+
+def _np_array_function(self, func, types, args, kwargs):
+    if _FUNC_MAP is None:
+        _build_dispatch_maps()
+    ours = _FUNC_MAP.get(func.__name__)
+    if ours is None:
+        # fall back: compute via host numpy on materialized values
+        args = [a.asnumpy() if isinstance(a, NDArray) else a for a in args]
+        return func(*args, **kwargs)
+    return ours(*args, **kwargs)
+
+
+def _np_array_ufunc(self, ufunc, method, *args, **kwargs):
+    if _UFUNC_MAP is None:
+        _build_dispatch_maps()
+    ours = _UFUNC_MAP.get(ufunc.__name__)
+    if method == "__call__" and ours is not None \
+            and kwargs.get("out") is None:
+        kwargs.pop("out", None)
+        return ours(*args, **kwargs)
+    # fall back to host numpy on materialized values (covers unmapped
+    # ufuncs and methods like .reduce/.accumulate/.outer)
+    args = [a.asnumpy() if isinstance(a, NDArray) else a for a in args]
+    return getattr(ufunc, method)(*args, **kwargs)
+
+
+ndarray.__array_function__ = _np_array_function
+ndarray.__array_ufunc__ = _np_array_ufunc

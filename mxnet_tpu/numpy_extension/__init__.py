@@ -150,3 +150,139 @@ def save(fname, data):
         _nd.save(fname, {k: v for k, v in data.items()})
     else:
         _nd.save(fname, data)
+
+
+# round 3: remaining npx surface (reference numpy_extension/_op.py)
+def is_np_shape():
+    return is_np_array()
+
+
+def use_np_shape(fn):
+    return use_np(fn)
+
+
+def use_np_array(fn):
+    return use_np(fn)
+
+
+def masked_softmax(data, mask=None, axis=-1, temperature=None):
+    import jax.numpy as jnp
+
+    from ..numpy.multiarray import _direct
+
+    if mask is None:
+        return softmax(data, axis=axis, temperature=temperature)
+    t = 1.0 if temperature is None else float(temperature)
+
+    def f(d, m):
+        neg = jnp.finfo(d.dtype).min
+        return jax_softmax(jnp.where(m.astype(bool), d / t, neg), axis)
+
+    import jax
+
+    def jax_softmax(v, ax):
+        return jax.nn.softmax(v, axis=ax)
+
+    return _direct(f, data, mask)
+
+
+def masked_log_softmax(data, mask=None, axis=-1, temperature=None):
+    import jax
+
+    from ..numpy.multiarray import _direct
+
+    if mask is None:
+        return log_softmax(data, axis=axis)
+    t = 1.0 if temperature is None else float(temperature)
+
+    def f(d, m):
+        import jax.numpy as jnp
+
+        neg = jnp.finfo(d.dtype).min
+        return jax.nn.log_softmax(
+            jnp.where(m.astype(bool), d / t, neg), axis=axis)
+
+    return _direct(f, data, mask)
+
+
+def deconvolution(data, weight, bias=None, kernel=None, stride=None,
+                  dilate=None, pad=None, adj=None, num_filter=1,
+                  num_group=1, no_bias=True, layout=None):
+    args = [data, weight] + ([bias] if bias is not None else [])
+    return _f("Deconvolution", *args, kernel=kernel, stride=stride,
+              dilate=dilate, pad=pad, adj=adj, num_filter=num_filter,
+              num_group=num_group, no_bias=no_bias or bias is None,
+              layout=layout)
+
+
+def rnn(data, parameters, state, state_cell=None, mode="lstm",
+        state_size=1, num_layers=1, bidirectional=False, p=0.0,
+        state_outputs=False, projection_size=None):
+    args = [data, parameters, state] + (
+        [state_cell] if state_cell is not None else [])
+    return _f("RNN", *args, mode=mode, state_size=state_size,
+              num_layers=num_layers, bidirectional=bidirectional, p=p,
+              state_outputs=state_outputs,
+              projection_size=projection_size)
+
+
+def embedding(data, weight, input_dim=1, output_dim=1, dtype="float32",
+              sparse_grad=False):
+    return _f("Embedding", data, weight, input_dim=input_dim,
+              output_dim=output_dim, dtype=dtype)
+
+
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
+    return _f("LayerNorm", data, gamma, beta, axis=axis, eps=eps)
+
+
+def batch_dot(a, b, transpose_a=False, transpose_b=False):
+    return _f("batch_dot", a, b, transpose_a=transpose_a,
+              transpose_b=transpose_b)
+
+
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    return _f("broadcast_like", lhs, rhs, lhs_axes=lhs_axes,
+              rhs_axes=rhs_axes)
+
+
+def shape_array(data):
+    return _f("shape_array", data)
+
+
+def smooth_l1(data, scalar=1.0):
+    return _f("smooth_l1", data, scalar=scalar)
+
+
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    return _f("_contrib_MultiBoxPrior", data, sizes=sizes, ratios=ratios,
+              clip=clip, steps=steps, offsets=offsets)
+
+
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    return _f("_contrib_MultiBoxTarget", anchor, label, cls_pred,
+              overlap_threshold=overlap_threshold,
+              ignore_label=ignore_label,
+              negative_mining_ratio=negative_mining_ratio,
+              negative_mining_thresh=negative_mining_thresh,
+              minimum_negative_samples=minimum_negative_samples,
+              variances=variances)
+
+
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                       threshold=0.01, background_id=0,
+                       nms_threshold=0.5, force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    return _f("_contrib_MultiBoxDetection", cls_prob, loc_pred, anchor,
+              clip=clip, threshold=threshold, background_id=background_id,
+              nms_threshold=nms_threshold, force_suppress=force_suppress,
+              variances=variances, nms_topk=nms_topk)
+
+
+def roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
+    return _f("ROIPooling", data, rois, pooled_size=pooled_size,
+              spatial_scale=spatial_scale)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as onp
 
 from .registry import register_op
 
@@ -471,3 +472,40 @@ _reg("_npi_tensorinv", lambda a, *, ind=2: jnp.linalg.tensorinv(a,
                                                                 ind=ind))
 _reg("_npi_tensorsolve", lambda a, b, *, axes=None:
      jnp.linalg.tensorsolve(a, b, axes=axes))
+
+# round 3: concat/gather/diag/window/bitwise families
+# (reference: src/operator/numpy/np_matrix_op.cc, np_window_op.cc,
+#  np_elemwise_broadcast_logic_op.cc)
+_reg("_npi_concatenate",
+     lambda *arrs, axis=0: jnp.concatenate(arrs, axis=axis))
+_reg("_npi_take_along_axis",
+     lambda arr, idx, *, axis: jnp.take_along_axis(
+         arr, idx.astype(jnp.int32), axis=axis))
+_reg("_npi_bartlett",
+     lambda *, M, dtype="float32": jnp.asarray(onp.bartlett(int(M)),
+                                               dtype=dtype), diff=False)
+_reg("_npi_diagonal",
+     lambda a, *, offset=0, axis1=0, axis2=1: jnp.diagonal(
+         a, offset=offset, axis1=axis1, axis2=axis2))
+_reg("_npi_diagflat", lambda v, *, k=0: jnp.diagflat(v, k=k))
+
+
+def _as_int(x):
+    # cast only float inputs; preserve existing integer dtypes so
+    # int64 shifts don't silently truncate
+    return x.astype(jnp.int32) if jnp.issubdtype(x.dtype, jnp.floating) \
+        else x
+
+
+_reg("_npi_bitwise_and",
+     lambda a, b: jnp.bitwise_and(_as_int(a), _as_int(b)), diff=False)
+_reg("_npi_bitwise_or",
+     lambda a, b: jnp.bitwise_or(_as_int(a), _as_int(b)), diff=False)
+_reg("_npi_bitwise_xor",
+     lambda a, b: jnp.bitwise_xor(_as_int(a), _as_int(b)), diff=False)
+_reg("_npi_bitwise_not",
+     lambda a: jnp.bitwise_not(_as_int(a)), diff=False)
+_reg("_npi_left_shift",
+     lambda a, b: jnp.left_shift(_as_int(a), _as_int(b)), diff=False)
+_reg("_npi_right_shift",
+     lambda a, b: jnp.right_shift(_as_int(a), _as_int(b)), diff=False)

@@ -302,3 +302,135 @@ def test_np_autograd_through_mixed_ops():
     onp.testing.assert_allclose(a.grad.asnumpy(),
                                 2 * onp.tri(3, dtype="float32"),
                                 rtol=1e-6)
+
+
+# ---------------- round 3: breadth additions (reference test_numpy_op.py)
+def test_np_linalg_family():
+    a = onp.array([[4.0, 1.0], [1.0, 3.0]], dtype="float32")
+    x = np.array(a)
+    onp.testing.assert_allclose(np.linalg.det(x).asnumpy(),
+                                onp.linalg.det(a), rtol=1e-5)
+    onp.testing.assert_allclose(np.linalg.inv(x).asnumpy(),
+                                onp.linalg.inv(a), rtol=1e-5)
+    w, v = np.linalg.eigh(x)
+    wr, vr = onp.linalg.eigh(a)
+    onp.testing.assert_allclose(w.asnumpy(), wr, rtol=1e-5)
+    q, r = np.linalg.qr(x)
+    onp.testing.assert_allclose((q.asnumpy() @ r.asnumpy()), a, rtol=1e-5,
+                                atol=1e-6)
+    b = onp.array([1.0, 2.0], dtype="float32")
+    onp.testing.assert_allclose(np.linalg.solve(x, np.array(b)).asnumpy(),
+                                onp.linalg.solve(a, b), rtol=1e-5)
+    sol = np.linalg.lstsq(x, np.array(b), rcond=None)
+    onp.testing.assert_allclose(sol[0].asnumpy(),
+                                onp.linalg.lstsq(a, b, rcond=None)[0],
+                                rtol=1e-4)
+    s, ld = np.linalg.slogdet(x)
+    sr, ldr = onp.linalg.slogdet(a)
+    assert float(s.asnumpy()) == sr
+    onp.testing.assert_allclose(float(ld.asnumpy()), ldr, rtol=1e-5)
+
+
+def test_np_linalg_solve_grad():
+    # solve is differentiable through jax; check via the tape
+    from mxnet_tpu import autograd
+    a = np.array([[3.0, 1.0], [1.0, 2.0]])
+    b = np.array([1.0, 1.0])
+    a.attach_grad()
+    with autograd.record():
+        x = np.linalg.solve(a, b)
+        loss = (x * x).sum()
+    loss.backward()
+    g = a.grad.asnumpy()
+    # numeric
+    eps = 1e-3
+    an = a.asnumpy()
+    for i in range(2):
+        for j in range(2):
+            ap = an.copy(); ap[i, j] += eps
+            am = an.copy(); am[i, j] -= eps
+            fp = (onp.linalg.solve(ap, b.asnumpy()) ** 2).sum()
+            fm = (onp.linalg.solve(am, b.asnumpy()) ** 2).sum()
+            onp.testing.assert_allclose(g[i, j], (fp - fm) / (2 * eps),
+                                        rtol=2e-2, atol=1e-3)
+
+
+def test_np_fill_functions():
+    a = onp.arange(12, dtype="float32").reshape(3, 4)
+    x = np.array(a)
+    onp.testing.assert_allclose(np.diagonal(x).asnumpy(), onp.diagonal(a))
+    onp.testing.assert_allclose(np.diagflat(np.array([1.0, 2.0])).asnumpy(),
+                                onp.diagflat([1.0, 2.0]))
+    onp.testing.assert_allclose(np.ptp(x, axis=0).asnumpy(),
+                                onp.ptp(a, axis=0))
+    onp.testing.assert_allclose(np.bartlett(6).asnumpy(),
+                                onp.bartlett(6).astype("float32"), rtol=1e-6)
+    onp.testing.assert_allclose(np.kaiser(6, 8.6).asnumpy(),
+                                onp.kaiser(6, 8.6).astype("float32"),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(np.geomspace(1, 1000, 4).asnumpy(),
+                                onp.geomspace(1, 1000, 4), rtol=1e-5)
+    idx = np.array([[0, 1], [1, 0]], dtype="int32")
+    onp.testing.assert_allclose(
+        np.take_along_axis(x[:2], idx, 1).asnumpy(),
+        onp.take_along_axis(a[:2], idx.asnumpy().astype(int), 1))
+    onp.testing.assert_allclose(np.append(x, x, axis=0).asnumpy(),
+                                onp.append(a, a, axis=0))
+    onp.testing.assert_allclose(np.partition(np.array([3.0, 1.0, 2.0]),
+                                             1).asnumpy(),
+                                onp.partition(onp.array([3.0, 1.0, 2.0]), 1))
+    r, c = np.triu_indices(3, 1)
+    rr, cr = onp.triu_indices(3, 1)
+    onp.testing.assert_allclose(r.asnumpy(), rr)
+    onp.testing.assert_allclose(c.asnumpy(), cr)
+    assert np.ndim(x) == 2 and np.shape(x) == (3, 4) and np.size(x) == 12
+
+
+def test_np_bitwise():
+    a = np.array([6, 3], dtype="int32")
+    b = np.array([3, 5], dtype="int32")
+    onp.testing.assert_allclose(np.bitwise_and(a, b).asnumpy(), [2, 1])
+    onp.testing.assert_allclose(np.bitwise_or(a, b).asnumpy(), [7, 7])
+    onp.testing.assert_allclose(np.bitwise_xor(a, b).asnumpy(), [5, 6])
+    onp.testing.assert_allclose(np.left_shift(a, b).asnumpy(), [48, 96])
+    onp.testing.assert_allclose(np.right_shift(a, np.array([1, 1],
+                                dtype="int32")).asnumpy(), [3, 1])
+
+
+def test_np_dispatch_protocol():
+    # NEP-18/NEP-13 interop (reference numpy_dispatch_protocol.py)
+    x = np.array([[1.0, 2.0], [3.0, 4.0]])
+    m = onp.mean(x)
+    assert isinstance(m, np.ndarray)
+    onp.testing.assert_allclose(float(m.asnumpy()), 2.5)
+    s = onp.add(x, x)
+    assert isinstance(s, np.ndarray)
+    onp.testing.assert_allclose(s.asnumpy(), [[2, 4], [6, 8]])
+    c = onp.concatenate([x, x], axis=1)
+    assert isinstance(c, np.ndarray) and c.shape == (2, 4)
+    sq = onp.sqrt(x)
+    assert isinstance(sq, np.ndarray)
+    onp.testing.assert_allclose(sq.asnumpy(), onp.sqrt(x.asnumpy()))
+
+
+def test_np_boolean_mask_assign():
+    x = np.array([1.0, -2.0, 3.0, -4.0])
+    x[x < 0] = 0.0
+    onp.testing.assert_allclose(x.asnumpy(), [1, 0, 3, 0])
+    y = np.array([[1.0, -1.0], [-1.0, 1.0]])
+    y[y < 0] = np.array(9.0)
+    onp.testing.assert_allclose(y.asnumpy(), [[1, 9], [9, 1]])
+
+
+def test_npx_extras():
+    d = np.array([[1.0, 2.0, 3.0]])
+    m = np.array([[1, 1, 0]])
+    out = npx.masked_softmax(d, m).asnumpy()
+    assert out[0, 2] == 0.0
+    onp.testing.assert_allclose(out[0, :2].sum(), 1.0, rtol=1e-5)
+    bd = npx.batch_dot(np.ones((2, 3, 4)), np.ones((2, 4, 5)))
+    assert bd.shape == (2, 3, 5)
+    onp.testing.assert_allclose(npx.smooth_l1(np.array([0.5, 2.0])).asnumpy(),
+                                [0.125, 1.5])
+    ln = npx.layer_norm(d, np.ones(3), np.zeros(3))
+    onp.testing.assert_allclose(ln.asnumpy().mean(), 0.0, atol=1e-6)
