@@ -1,47 +1,20 @@
 """ONNX import/export (reference: python/mxnet/contrib/onnx/ —
 mx2onnx export_model + onnx2mx import_model).
 
-Environment triage: the ``onnx`` package is not installed in this
-zero-egress image, and emitting/parsing ONNX protobufs without it would
-mean vendoring the schema.  The API surface is preserved and fails
-fast with an actionable error; the native interchange formats —
-Symbol JSON + bit-compatible ``.params`` (reference formats, round-trip
-tested) — cover save/load/deploy within the framework.
+This environment has no ``onnx`` package, so the IR schema subset is
+vendored (``_proto/onnx_subset.proto``, field-number-faithful to the
+public spec) and compiled with protoc: exported files are readable by
+stock onnx and stock-onnx files (for the supported op set) import here.
+Covered ops: Conv, BatchNormalization, Gemm, MaxPool/AveragePool +
+global variants, Relu/Sigmoid/Tanh/Softplus/LeakyRelu, Softmax,
+Flatten, Concat, Add/Sub/Mul/Div, Clip, Reshape, Dropout(->Identity),
+Exp/Log/Sqrt — the reference _op_translations.py model-zoo subset.
 """
 from __future__ import annotations
 
-from ...base import MXNetError
+from .checker import check_model  # noqa: F401
+from .mx2onnx import export_model  # noqa: F401
+from .onnx2mx import get_model_metadata, import_model  # noqa: F401
 
-__all__ = ["export_model", "import_model", "get_model_metadata"]
-
-_MSG = ("the 'onnx' python package is not available in this "
-        "environment; install onnx to use contrib.onnx, or use the "
-        "native interchange (Symbol.tojson + nd.save .params, loadable "
-        "via SymbolBlock.imports / Module.load)")
-
-
-def export_model(sym, params, input_shape, input_type=None,
-                 onnx_file_path="model.onnx", verbose=False):
-    """Reference: contrib/onnx/mx2onnx/export_model.py."""
-    try:
-        import onnx  # noqa: F401
-    except ImportError as e:
-        raise MXNetError(_MSG) from e
-    raise MXNetError("onnx export backend not implemented")
-
-
-def import_model(model_file):
-    """Reference: contrib/onnx/onnx2mx/import_model.py."""
-    try:
-        import onnx  # noqa: F401
-    except ImportError as e:
-        raise MXNetError(_MSG) from e
-    raise MXNetError("onnx import backend not implemented")
-
-
-def get_model_metadata(model_file):
-    try:
-        import onnx  # noqa: F401
-    except ImportError as e:
-        raise MXNetError(_MSG) from e
-    raise MXNetError("onnx import backend not implemented")
+__all__ = ["export_model", "import_model", "get_model_metadata",
+           "check_model"]

@@ -1,0 +1,8 @@
+"""Vendored ONNX IR protobuf bindings.
+
+``onnx_subset.proto`` is a field-number-faithful subset of the public
+ONNX schema (Apache-2.0); ``onnx_subset_pb2.py`` is protoc output from
+it.  Files serialized here parse with stock ``onnx`` and vice versa
+(for the message subset we use).
+"""
+from . import onnx_subset_pb2 as pb  # noqa: F401

@@ -1,0 +1,86 @@
+"""Structural validator for exported models (vendored analog of
+onnx.checker.check_model for the schema subset we emit)."""
+from __future__ import annotations
+
+import numpy as onp
+
+from ...base import MXNetError
+from ._proto import pb
+
+_ITEMSIZE = {pb.TensorProto.FLOAT: 4, pb.TensorProto.DOUBLE: 8,
+             pb.TensorProto.FLOAT16: 2, pb.TensorProto.BFLOAT16: 2,
+             pb.TensorProto.INT32: 4, pb.TensorProto.INT64: 8,
+             pb.TensorProto.INT8: 1, pb.TensorProto.UINT8: 1,
+             pb.TensorProto.BOOL: 1}
+
+
+def check_model(model_or_path):
+    """Raise MXNetError on structural problems; returns the parsed
+    ModelProto on success."""
+    if isinstance(model_or_path, (str, bytes)) and not isinstance(
+            model_or_path, pb.ModelProto):
+        model = pb.ModelProto()
+        if isinstance(model_or_path, str):
+            with open(model_or_path, "rb") as f:
+                model.ParseFromString(f.read())
+        else:
+            model.ParseFromString(model_or_path)
+    else:
+        model = model_or_path
+
+    if model.ir_version < 3:
+        raise MXNetError(f"bad ir_version {model.ir_version}")
+    if not model.opset_import:
+        raise MXNetError("missing opset_import")
+    g = model.graph
+    if not g.node:
+        raise MXNetError("empty graph")
+
+    defined = set()
+    for t in g.initializer:
+        if not t.name:
+            raise MXNetError("unnamed initializer")
+        n = 1
+        for d in t.dims:
+            if d < 0:
+                raise MXNetError(f"negative dim in {t.name}")
+            n *= d
+        itemsize = _ITEMSIZE.get(t.data_type)
+        if itemsize is None:
+            raise MXNetError(f"{t.name}: unknown data_type {t.data_type}")
+        if t.raw_data and len(t.raw_data) != n * itemsize:
+            raise MXNetError(
+                f"{t.name}: raw_data {len(t.raw_data)}B != "
+                f"dims product {n} x itemsize {itemsize}")
+        defined.add(t.name)
+    for vi in g.input:
+        defined.add(vi.name)
+
+    for node in g.node:
+        if not node.op_type:
+            raise MXNetError("node without op_type")
+        for i in node.input:
+            if i and i not in defined:
+                raise MXNetError(
+                    f"node {node.name or node.op_type}: input {i!r} "
+                    "not produced by a prior node/initializer/input "
+                    "(graph must be topologically sorted)")
+        for o in node.output:
+            defined.add(o)
+
+    for vi in g.output:
+        if vi.name not in defined:
+            raise MXNetError(f"graph output {vi.name!r} never produced")
+    return model
+
+
+def check_numpy_roundtrip(arr):
+    """Tensor encode/decode self-test used by the test-suite."""
+    from .mx2onnx import _tensor
+    from .onnx2mx import _to_numpy
+
+    t = _tensor("t", arr)
+    back = _to_numpy(t)
+    if not onp.array_equal(onp.asarray(arr, back.dtype), back):
+        raise MXNetError("tensor roundtrip mismatch")
+    return True

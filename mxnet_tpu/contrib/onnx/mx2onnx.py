@@ -1,0 +1,341 @@
+"""Symbol → ONNX export.
+
+Reference: python/mxnet/contrib/onnx/mx2onnx/_op_translations.py +
+export_onnx.py — a per-op translation table walked over the Symbol's
+nnvm JSON graph.  Emits through the vendored IR bindings
+(``_proto/onnx_subset.proto``); files are readable by stock onnx.
+"""
+from __future__ import annotations
+
+import ast
+import json
+
+import numpy as onp
+
+from ...base import MXNetError
+from ._proto import pb
+
+ONNX_OPSET = 13
+_DT = {"float32": pb.TensorProto.FLOAT, "float64": pb.TensorProto.DOUBLE,
+       "float16": pb.TensorProto.FLOAT16, "int32": pb.TensorProto.INT32,
+       "int64": pb.TensorProto.INT64, "int8": pb.TensorProto.INT8,
+       "uint8": pb.TensorProto.UINT8, "bool": pb.TensorProto.BOOL,
+       "bfloat16": pb.TensorProto.BFLOAT16}
+
+
+def _lit(v, default=None):
+    """Parse an attrs string back to a python literal."""
+    if v is None:
+        return default
+    if isinstance(v, str):
+        try:
+            return ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            return v
+    return v
+
+
+def _tensor(name, arr):
+    t = pb.TensorProto()
+    t.name = name
+    a = onp.asarray(arr)
+    if a.dtype == onp.float64:
+        a = a.astype(onp.float32)
+    t.dims.extend(a.shape)
+    t.data_type = _DT[str(a.dtype)]
+    t.raw_data = a.tobytes()
+    return t
+
+
+def _vinfo(name, shape, dtype="float32"):
+    vi = pb.ValueInfoProto()
+    vi.name = name
+    vi.type.tensor_type.elem_type = _DT[dtype]
+    for d in shape:
+        dim = vi.type.tensor_type.shape.dim.add()
+        if d is None or d == 0:
+            dim.dim_param = "N"
+        else:
+            dim.dim_value = int(d)
+    return vi
+
+
+def _node(op_type, inputs, outputs, name="", **attrs):
+    n = pb.NodeProto()
+    n.op_type = op_type
+    n.input.extend(inputs)
+    n.output.extend(outputs)
+    n.name = name or outputs[0]
+    for k, v in attrs.items():
+        if v is None:
+            continue
+        a = n.attribute.add()
+        a.name = k
+        if isinstance(v, float):
+            a.type = pb.AttributeProto.FLOAT
+            a.f = v
+        elif isinstance(v, bool):
+            a.type = pb.AttributeProto.INT
+            a.i = int(v)
+        elif isinstance(v, int):
+            a.type = pb.AttributeProto.INT
+            a.i = v
+        elif isinstance(v, str):
+            a.type = pb.AttributeProto.STRING
+            a.s = v.encode()
+        elif isinstance(v, (list, tuple)):
+            if v and isinstance(v[0], float):
+                a.type = pb.AttributeProto.FLOATS
+                a.floats.extend(v)
+            else:
+                a.type = pb.AttributeProto.INTS
+                a.ints.extend(int(x) for x in v)
+        else:
+            raise MXNetError(f"unsupported attribute value {v!r}")
+    return n
+
+
+# ---------------------------------------------------------- translators
+# each: (ctx, name, inputs, attrs) -> list[NodeProto]; ctx carries the
+# graph builder state (initializers, fresh-name counter)
+class _Ctx:
+    def __init__(self, params):
+        self.params = params
+        self.initializers = []
+        self.init_names = set()
+        self._n = 0
+
+    def fresh(self, base):
+        self._n += 1
+        return f"{base}_{self._n}"
+
+    def add_init(self, name, arr):
+        if name not in self.init_names:
+            self.initializers.append(_tensor(name, arr))
+            self.init_names.add(name)
+        return name
+
+
+def _conv(ctx, name, ins, attrs):
+    if _lit(attrs.get("layout"), "NCHW") not in (None, "NCHW", "NCW"):
+        raise MXNetError("ONNX export requires channel-first layout "
+                         "(ONNX Conv is NCHW); rebuild the net without "
+                         "layout='NHWC'")
+    kernel = _lit(attrs.get("kernel"))
+    stride = _lit(attrs.get("stride"), (1,) * len(kernel))
+    pad = _lit(attrs.get("pad"), (0,) * len(kernel))
+    dilate = _lit(attrs.get("dilate"), (1,) * len(kernel))
+    return [_node("Conv", ins, [name], name,
+                  kernel_shape=list(kernel), strides=list(stride),
+                  pads=list(pad) * 2, dilations=list(dilate),
+                  group=int(_lit(attrs.get("num_group"), 1)))]
+
+
+def _bn(ctx, name, ins, attrs):
+    # ins: data, gamma, beta, moving_mean, moving_var
+    if _lit(attrs.get("fix_gamma"), False):
+        g = ctx.params.get(ins[1])
+        shape = g.shape if g is not None else None
+        if shape is None:
+            raise MXNetError("fix_gamma BatchNorm export needs params")
+        ones_name = ctx.fresh(ins[1] + "_fixed")
+        ctx.add_init(ones_name, onp.ones(shape, "float32"))
+        ins = [ins[0], ones_name] + list(ins[2:])
+    return [_node("BatchNormalization", list(ins), [name], name,
+                  epsilon=float(_lit(attrs.get("eps"), 1e-3)),
+                  momentum=float(_lit(attrs.get("momentum"), 0.9)))]
+
+
+def _act(ctx, name, ins, attrs):
+    table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+             "softrelu": "Softplus", "softsign": "Softsign"}
+    act = _lit(attrs.get("act_type"), "relu")
+    if act not in table:
+        raise MXNetError(f"Activation {act} has no ONNX mapping")
+    return [_node(table[act], ins, [name], name)]
+
+
+def _fc(ctx, name, ins, attrs):
+    no_bias = _lit(attrs.get("no_bias"), False)
+    flatten = _lit(attrs.get("flatten"), True)
+    nodes = []
+    data = ins[0]
+    if flatten:
+        flat = ctx.fresh(name + "_flat")
+        nodes.append(_node("Flatten", [data], [flat], flat, axis=1))
+        data = flat
+    gemm_in = [data, ins[1]] + ([] if no_bias else [ins[2]])
+    nodes.append(_node("Gemm", gemm_in, [name], name, alpha=1.0, beta=1.0,
+                       transA=0, transB=1))
+    return nodes
+
+
+def _pool(ctx, name, ins, attrs):
+    ptype = _lit(attrs.get("pool_type"), "max")
+    if _lit(attrs.get("global_pool"), False):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}.get(ptype)
+        if op is None:
+            raise MXNetError(f"global {ptype} pool has no ONNX mapping")
+        return [_node(op, ins, [name], name)]
+    kernel = _lit(attrs.get("kernel"))
+    stride = _lit(attrs.get("stride"), (1,) * len(kernel))
+    pad = _lit(attrs.get("pad"), (0,) * len(kernel))
+    ceil_mode = _lit(attrs.get("pooling_convention"), "valid") == "full"
+    op = {"max": "MaxPool", "avg": "AveragePool"}.get(ptype)
+    if op is None:
+        raise MXNetError(f"pool_type {ptype} has no ONNX mapping")
+    kw = dict(kernel_shape=list(kernel), strides=list(stride),
+              pads=list(pad) * 2, ceil_mode=int(ceil_mode))
+    if op == "AveragePool":
+        kw["count_include_pad"] = int(
+            _lit(attrs.get("count_include_pad"), True))
+    return [_node(op, ins, [name], name, **kw)]
+
+
+def _softmax(ctx, name, ins, attrs):
+    return [_node("Softmax", ins[:1], [name], name,
+                  axis=int(_lit(attrs.get("axis"), -1)))]
+
+
+def _flatten_op(ctx, name, ins, attrs):
+    return [_node("Flatten", ins, [name], name, axis=1)]
+
+
+def _concat(ctx, name, ins, attrs):
+    ax = attrs.get("dim", attrs.get("axis"))
+    return [_node("Concat", list(ins), [name], name,
+                  axis=int(_lit(ax, 1)))]
+
+
+def _dropout(ctx, name, ins, attrs):
+    return [_node("Identity", ins[:1], [name], name)]
+
+
+def _binary(onnx_op):
+    def f(ctx, name, ins, attrs):
+        return [_node(onnx_op, list(ins), [name], name)]
+    return f
+
+
+def _clip(ctx, name, ins, attrs):
+    lo = ctx.add_init(ctx.fresh(name + "_min"),
+                      onp.float32(_lit(attrs.get("a_min"), 0.0)))
+    hi = ctx.add_init(ctx.fresh(name + "_max"),
+                      onp.float32(_lit(attrs.get("a_max"), 0.0)))
+    return [_node("Clip", [ins[0], lo, hi], [name], name)]
+
+
+def _reshape(ctx, name, ins, attrs):
+    shape = _lit(attrs.get("shape"))
+    sh = ctx.add_init(ctx.fresh(name + "_shape"),
+                      onp.asarray(shape, "int64"))
+    return [_node("Reshape", [ins[0], sh], [name], name)]
+
+
+def _leaky(ctx, name, ins, attrs):
+    act = _lit(attrs.get("act_type"), "leaky")
+    if act != "leaky":
+        raise MXNetError(f"LeakyReLU act_type {act} has no ONNX mapping")
+    return [_node("LeakyRelu", ins[:1], [name], name,
+                  alpha=float(_lit(attrs.get("slope"), 0.25)))]
+
+
+_TRANSLATORS = {
+    "Convolution": _conv,
+    "BatchNorm": _bn,
+    "Activation": _act,
+    "FullyConnected": _fc,
+    "Pooling": _pool,
+    "softmax": _softmax,
+    "Softmax": _softmax,
+    "Flatten": _flatten_op,
+    "Concat": _concat,
+    "concat": _concat,
+    "Dropout": _dropout,
+    "elemwise_add": _binary("Add"),
+    "broadcast_add": _binary("Add"),
+    "elemwise_sub": _binary("Sub"),
+    "broadcast_sub": _binary("Sub"),
+    "elemwise_mul": _binary("Mul"),
+    "broadcast_mul": _binary("Mul"),
+    "elemwise_div": _binary("Div"),
+    "broadcast_div": _binary("Div"),
+    "relu": lambda c, n, i, a: [_node("Relu", i, [n], n)],
+    "sigmoid": lambda c, n, i, a: [_node("Sigmoid", i, [n], n)],
+    "tanh": lambda c, n, i, a: [_node("Tanh", i, [n], n)],
+    "exp": lambda c, n, i, a: [_node("Exp", i, [n], n)],
+    "log": lambda c, n, i, a: [_node("Log", i, [n], n)],
+    "sqrt": lambda c, n, i, a: [_node("Sqrt", i, [n], n)],
+    "clip": _clip,
+    "Reshape": _reshape,
+    "LeakyReLU": _leaky,
+}
+
+
+def export_model(sym, params, input_shape, input_type="float32",
+                 onnx_file_path="model.onnx", verbose=False):
+    """Export (Symbol, params) to an ONNX file.
+
+    ``params`` maps parameter name → NDArray (merged arg+aux, the
+    reference convention); ``input_shape`` is one shape tuple (or a
+    list with one entry) for the single graph input.
+    """
+    from ...ndarray import NDArray
+
+    if isinstance(input_shape, list):
+        if len(input_shape) != 1:
+            raise MXNetError("one graph input supported")
+        input_shape = input_shape[0]
+    params = {k.split(":", 1)[-1]:
+              (v.asnumpy() if isinstance(v, NDArray) else onp.asarray(v))
+              for k, v in params.items()}
+
+    graph = json.loads(sym.tojson())
+    nodes = graph["nodes"]
+    heads = graph["heads"]
+
+    ctx = _Ctx(params)
+    out_name = {}
+    onnx_nodes = []
+    graph_inputs = []
+    for nid, n in enumerate(nodes):
+        op, name = n["op"], n["name"]
+        if op == "null":
+            out_name[(nid, 0)] = name
+            if name in params:
+                ctx.add_init(name, params[name])
+            else:
+                graph_inputs.append(_vinfo(name, input_shape, input_type))
+            continue
+        ins = [out_name[(i[0], i[1])] for i in n["inputs"]]
+        attrs = n.get("attrs", {})
+        tr = _TRANSLATORS.get(op)
+        if tr is None:
+            raise MXNetError(f"op {op!r} has no ONNX translation "
+                             "(reference _op_translations.py parity "
+                             "covers the model-zoo subset)")
+        new_nodes = tr(ctx, name, ins, attrs)
+        onnx_nodes.extend(new_nodes)
+        nouts = len(new_nodes[-1].output)
+        for i in range(nouts):
+            out_name[(nid, i)] = new_nodes[-1].output[i]
+        if verbose:
+            print(f"{op} {name} -> "
+                  f"{[nn.op_type for nn in new_nodes]}")
+
+    model = pb.ModelProto()
+    model.ir_version = 8
+    model.producer_name = "mxnet_tpu"
+    model.producer_version = "0.1"
+    opset = model.opset_import.add()
+    opset.version = ONNX_OPSET
+    g = model.graph
+    g.name = "mxnet_tpu_graph"
+    g.node.extend(onnx_nodes)
+    g.initializer.extend(ctx.initializers)
+    g.input.extend(graph_inputs)
+    for (nid, i) in [(h[0], h[1]) for h in heads]:
+        g.output.extend([_vinfo(out_name[(nid, i)], ())])
+    with open(onnx_file_path, "wb") as f:
+        f.write(model.SerializeToString())
+    return onnx_file_path

@@ -1,0 +1,250 @@
+"""ONNX → Symbol import.
+
+Reference: python/mxnet/contrib/onnx/onnx2mx/import_model.py +
+_import_helper.py op map.  Parses through the vendored IR bindings, so
+stock-onnx files (for the supported op subset) load without the onnx
+package installed.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ...base import MXNetError
+from ._proto import pb
+
+_NP_DT = {pb.TensorProto.FLOAT: onp.float32,
+          pb.TensorProto.DOUBLE: onp.float64,
+          pb.TensorProto.FLOAT16: onp.float16,
+          pb.TensorProto.INT32: onp.int32,
+          pb.TensorProto.INT64: onp.int64,
+          pb.TensorProto.INT8: onp.int8,
+          pb.TensorProto.UINT8: onp.uint8,
+          pb.TensorProto.BOOL: onp.bool_}
+
+
+def _to_numpy(t):
+    dt = _NP_DT.get(t.data_type)
+    if dt is None:
+        raise MXNetError(f"unsupported tensor data_type {t.data_type}")
+    if t.raw_data:
+        a = onp.frombuffer(t.raw_data, dtype=dt)
+    elif t.float_data:
+        a = onp.asarray(t.float_data, dtype=dt)
+    elif t.int64_data:
+        a = onp.asarray(t.int64_data, dtype=dt)
+    elif t.int32_data:
+        a = onp.asarray(t.int32_data, dtype=dt)
+    else:
+        a = onp.zeros(0, dtype=dt)
+    return a.reshape(tuple(t.dims))
+
+
+def _attrs(node):
+    out = {}
+    for a in node.attribute:
+        if a.type == pb.AttributeProto.FLOAT:
+            out[a.name] = a.f
+        elif a.type == pb.AttributeProto.INT:
+            out[a.name] = a.i
+        elif a.type == pb.AttributeProto.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == pb.AttributeProto.INTS:
+            out[a.name] = tuple(a.ints)
+        elif a.type == pb.AttributeProto.FLOATS:
+            out[a.name] = tuple(a.floats)
+        elif a.type == pb.AttributeProto.TENSOR:
+            out[a.name] = _to_numpy(a.t)
+    return out
+
+
+def _pads2(att, nd_):
+    p = att.get("pads")
+    if p is None:
+        return (0,) * nd_
+    begin, end = p[:nd_], p[nd_:]
+    if tuple(begin) != tuple(end):
+        raise MXNetError(f"asymmetric pads {p} unsupported on import")
+    return tuple(begin)
+
+
+def import_model(model_file):
+    """Returns (sym, arg_params, aux_params) — reference
+    import_model.py signature."""
+    from ... import symbol as sym_mod
+    from ...ndarray import array as nd_array
+
+    model = pb.ModelProto()
+    with open(model_file, "rb") as f:
+        model.ParseFromString(f.read())
+    g = model.graph
+
+    inits = {t.name: _to_numpy(t) for t in g.initializer}
+    env = {}
+    aux_names = set()
+
+    for vi in g.input:
+        if vi.name not in inits:
+            env[vi.name] = sym_mod.var(vi.name)
+    for name in inits:
+        env[name] = sym_mod.var(name)
+
+    def n_in(node, i):
+        return env[node.input[i]]
+
+    def _init_of(node, i, what):
+        """Initializer tensor for input slot i, with clean errors for
+        the legal-but-unsupported cases (empty-string optional inputs,
+        weights arriving as graph inputs instead of initializers)."""
+        if i >= len(node.input) or not node.input[i]:
+            return None
+        name = node.input[i]
+        if name not in inits:
+            raise MXNetError(
+                f"{node.op_type} {node.name or ''}: {what} "
+                f"({name!r}) is not a graph initializer; dynamic "
+                "weights/bounds are not supported on import")
+        return inits[name]
+
+    for node in g.node:
+        op = node.op_type
+        att = _attrs(node)
+        outs = list(node.output)
+        if op == "Conv":
+            k = att["kernel_shape"]
+            nd_ = len(k)
+            ins = [n_in(node, i) for i in range(len(node.input))]
+            out = sym_mod.Convolution(
+                *ins, kernel=tuple(k),
+                stride=tuple(att.get("strides", (1,) * nd_)),
+                dilate=tuple(att.get("dilations", (1,) * nd_)),
+                pad=_pads2(att, nd_),
+                num_filter=int(_init_of(node, 1, "weight").shape[0]),
+                num_group=int(att.get("group", 1)),
+                no_bias=len(node.input) < 3, name=node.name)
+        elif op == "BatchNormalization":
+            ins = [n_in(node, i) for i in range(5)]
+            aux_names.update(node.input[3:5])
+            out = sym_mod.BatchNorm(
+                *ins, eps=float(att.get("epsilon", 1e-5)),
+                momentum=float(att.get("momentum", 0.9)),
+                fix_gamma=False, name=node.name)
+        elif op == "Gemm":
+            if att.get("transA", 0) or not att.get("transB", 0):
+                raise MXNetError("only Gemm(transA=0, transB=1) imports")
+            ins = [n_in(node, i) for i in range(len(node.input))]
+            out = sym_mod.FullyConnected(
+                *ins, num_hidden=int(_init_of(node, 1,
+                                              "weight").shape[0]),
+                no_bias=len(node.input) < 3, flatten=False,
+                name=node.name)
+        elif op in ("MaxPool", "AveragePool"):
+            k = att["kernel_shape"]
+            nd_ = len(k)
+            out = sym_mod.Pooling(
+                n_in(node, 0), kernel=tuple(k),
+                stride=tuple(att.get("strides", (1,) * nd_)),
+                pad=_pads2(att, nd_),
+                pool_type="max" if op == "MaxPool" else "avg",
+                pooling_convention="full" if att.get("ceil_mode")
+                else "valid",
+                count_include_pad=bool(att.get("count_include_pad", 1)),
+                name=node.name)
+        elif op in ("GlobalMaxPool", "GlobalAveragePool"):
+            out = sym_mod.Pooling(
+                n_in(node, 0), global_pool=True,
+                pool_type="max" if op == "GlobalMaxPool" else "avg",
+                kernel=(1, 1), name=node.name)
+        elif op == "Flatten":
+            out = sym_mod.Flatten(n_in(node, 0), name=node.name)
+        elif op == "Relu":
+            out = sym_mod.Activation(n_in(node, 0), act_type="relu",
+                                     name=node.name)
+        elif op == "Sigmoid":
+            out = sym_mod.Activation(n_in(node, 0), act_type="sigmoid",
+                                     name=node.name)
+        elif op == "Tanh":
+            out = sym_mod.Activation(n_in(node, 0), act_type="tanh",
+                                     name=node.name)
+        elif op == "Softplus":
+            out = sym_mod.Activation(n_in(node, 0), act_type="softrelu",
+                                     name=node.name)
+        elif op == "LeakyRelu":
+            out = sym_mod.LeakyReLU(n_in(node, 0), act_type="leaky",
+                                    slope=float(att.get("alpha", 0.01)),
+                                    name=node.name)
+        elif op == "Softmax":
+            out = sym_mod.softmax(n_in(node, 0),
+                                  axis=int(att.get("axis", -1)),
+                                  name=node.name)
+        elif op == "Concat":
+            ins = [n_in(node, i) for i in range(len(node.input))]
+            out = sym_mod.Concat(*ins, num_args=len(ins),
+                                 dim=int(att.get("axis", 1)),
+                                 name=node.name)
+        elif op in ("Add", "Sub", "Mul", "Div"):
+            mxop = {"Add": "broadcast_add", "Sub": "broadcast_sub",
+                    "Mul": "broadcast_mul", "Div": "broadcast_div"}[op]
+            out = getattr(sym_mod, mxop)(n_in(node, 0), n_in(node, 1),
+                                         name=node.name)
+        elif op == "Identity":
+            out = n_in(node, 0)
+        elif op == "Clip":
+            lo_t = _init_of(node, 1, "min bound")
+            hi_t = _init_of(node, 2, "max bound")
+            lo = float(lo_t) if lo_t is not None else -onp.inf
+            hi = float(hi_t) if hi_t is not None else onp.inf
+            out = sym_mod.clip(n_in(node, 0), a_min=lo, a_max=hi,
+                               name=node.name)
+        elif op == "Reshape":
+            shape = tuple(int(x) for x in inits[node.input[1]])
+            out = sym_mod.Reshape(n_in(node, 0), shape=shape,
+                                  name=node.name)
+        elif op in ("Exp", "Log", "Sqrt"):
+            out = getattr(sym_mod, op.lower())(n_in(node, 0),
+                                               name=node.name)
+        else:
+            raise MXNetError(f"ONNX op {op!r} has no import mapping")
+        if len(outs) == 1:
+            env[outs[0]] = out
+        else:
+            for i, o in enumerate(outs):
+                env[o] = out[i]
+
+    out_syms = [env[o.name] for o in g.output]
+    sym = out_syms[0] if len(out_syms) == 1 \
+        else sym_mod.Group(out_syms)
+    arg_params, aux_params = {}, {}
+    for name, a in inits.items():
+        # Clip/Reshape constants etc. are folded into attrs, but keep
+        # them out of params only if some symbol references them
+        target = aux_params if name in aux_names else arg_params
+        target[name] = nd_array(a)
+    used = set(sym.list_arguments()) | set(sym.list_auxiliary_states()) \
+        if hasattr(sym, "list_auxiliary_states") \
+        else set(sym.list_arguments())
+    arg_params = {k: v for k, v in arg_params.items() if k in used}
+    aux_params = {k: v for k, v in aux_params.items() if k in used}
+    return sym, arg_params, aux_params
+
+
+def get_model_metadata(model_file):
+    """Reference: onnx2mx/import_model.py get_model_metadata."""
+    model = pb.ModelProto()
+    with open(model_file, "rb") as f:
+        model.ParseFromString(f.read())
+    g = model.graph
+    inits = {t.name for t in g.initializer}
+
+    def shapes(vis):
+        out = []
+        for vi in vis:
+            if vi.name in inits:
+                continue
+            dims = tuple(
+                d.dim_value if d.HasField("dim_value") else d.dim_param
+                for d in vi.type.tensor_type.shape.dim)
+            out.append((vi.name, dims))
+        return out
+
+    return {"input_tensor_data": shapes(g.input),
+            "output_tensor_data": shapes(g.output)}

@@ -254,7 +254,9 @@ class Block:
     def load_parameters(self, filename, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False,
                         dtype_source="current"):
-        loaded = nd.load(filename)
+        from .parameter import _strip_arg_aux
+
+        loaded = _strip_arg_aux(nd.load(filename))
         params = self._collect_params_with_prefix()
         if not loaded and not params:
             return
@@ -406,6 +408,14 @@ class HybridBlock(Block):
 
     # ------------------------------------------------------------- forward
     def forward(self, x, *args):
+        from .. import symbol as _sym_mod
+
+        if isinstance(x, _sym_mod.Symbol):
+            # symbolic trace (reference: hybrid_forward with F=mx.sym):
+            # parameters appear as named variables so the exported graph
+            # aligns with collect_params()/save_parameters names
+            params = {k: v.var() for k, v in self._reg_params.items()}
+            return self.hybrid_forward(_sym_mod, x, *args, **params)
         if isinstance(x, nd.NDArray) and not isinstance(
             x._data, jax.core.Tracer
         ) and self._active:
@@ -581,9 +591,29 @@ class HybridBlock(Block):
         raise NotImplementedError
 
     def export(self, path, epoch=0):
-        """Reference exports symbol-JSON + params; here: params only plus a
-        jax-native export hook (symbol export lands with mx.sym)."""
-        self.save_parameters(f"{path}-{epoch:04d}.params")
+        """Write ``path-symbol.json`` + ``path-{epoch:04d}.params``
+        (reference block.py export): the graph comes from a symbolic
+        trace of hybrid_forward, parameters are saved under the
+        reference's ``arg:``/``aux:`` key convention so
+        ``SymbolBlock.imports``/``mx.mod.Module`` can load them."""
+        from .. import ndarray as _ndm
+        from .. import symbol as _sym_mod
+
+        data = _sym_mod.var("data")
+        out = self(data)
+        if isinstance(out, (list, tuple)):
+            out = _sym_mod.Group(list(out))
+        out.save(f"{path}-symbol.json")
+        # arg/aux split follows the GRAPH's classification (__aux__
+        # marking == nnvm mutable inputs), not grad_req: a frozen
+        # trainable weight is still an arg
+        aux_names = set(out.list_auxiliary_states())
+        arg_aux = {}
+        for name, p in self.collect_params().items():
+            kind = "aux" if name in aux_names else "arg"
+            arg_aux[f"{kind}:{name}"] = p.data()
+        _ndm.save(f"{path}-{epoch:04d}.params", arg_aux)
+        return out
 
 
 def _collect_all_params(block):
@@ -616,6 +646,18 @@ class SymbolBlock(HybridBlock):
         super().__init__(prefix="", params=params)
         self._outputs = outputs
         self._inputs = inputs
+        # every non-input graph variable becomes a Parameter (aux vars
+        # with grad_req='null'), so load_parameters/collect_params see
+        # the full weight set (reference block.py:1236)
+        input_names = {s.name for s in inputs}
+        aux = set(outputs.list_auxiliary_states()) \
+            if hasattr(outputs, "list_auxiliary_states") else set()
+        for name in outputs.list_inputs():
+            if name in input_names:
+                continue
+            self.params.get(
+                name, grad_req="null" if name in aux else "write",
+                allow_deferred_init=True, differentiable=name not in aux)
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
@@ -631,8 +673,14 @@ class SymbolBlock(HybridBlock):
         return ret
 
     def forward(self, *args):
-        from .. import symbol as sym_mod
-
-        return sym_mod._executor_forward(
-            self._outputs, self._inputs, args, self.collect_params()
-        )
+        arg_dict = {s.name: a for s, a in zip(self._inputs, args)}
+        aux_names = set(self._outputs.list_auxiliary_states()) \
+            if hasattr(self._outputs, "list_auxiliary_states") else set()
+        arg_params, aux_params = {}, {}
+        for name, p in self.collect_params().items():
+            (aux_params if name in aux_names else arg_params)[name] = \
+                p.data()
+        ex = self._outputs.bind(args={**arg_dict, **arg_params},
+                                aux_states=aux_params)
+        outs = ex.forward()
+        return outs[0] if len(outs) == 1 else outs

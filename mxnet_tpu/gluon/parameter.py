@@ -27,6 +27,15 @@ class DeferredInitializationError(MXNetError):
     """Parameter used before its shape is known (reference parameter.py)."""
 
 
+def _strip_arg_aux(loaded):
+    """Exported checkpoints key params as 'arg:<name>'/'aux:<name>'
+    (reference export convention) — strip for matching."""
+    if any(k.startswith(("arg:", "aux:")) for k in loaded):
+        return {k.split(":", 1)[1] if k.startswith(("arg:", "aux:"))
+                else k: v for k, v in loaded.items()}
+    return loaded
+
+
 class Parameter:
     """A weight tensor with autograd + initialization state.
 
@@ -397,7 +406,7 @@ class ParameterDict:
 
     def load(self, filename, ctx=None, allow_missing=False,
              ignore_extra=False, restore_prefix=""):
-        arg_dict = nd.load(filename)
+        arg_dict = _strip_arg_aux(nd.load(filename))
         if restore_prefix:
             arg_dict = {restore_prefix + k: v for k, v in arg_dict.items()}
         if not allow_missing:

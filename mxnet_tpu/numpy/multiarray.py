@@ -1140,14 +1140,25 @@ def _build_dispatch_maps():
             _FUNC_MAP[name] = fn
 
 
+def _materialize(x):
+    """Deep-convert NDArrays (incl. inside lists/tuples/dicts) to host
+    numpy so a fallback call cannot re-dispatch back to us."""
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    if isinstance(x, (list, tuple)):
+        return type(x)(_materialize(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _materialize(v) for k, v in x.items()}
+    return x
+
+
 def _np_array_function(self, func, types, args, kwargs):
     if _FUNC_MAP is None:
         _build_dispatch_maps()
     ours = _FUNC_MAP.get(func.__name__)
     if ours is None:
         # fall back: compute via host numpy on materialized values
-        args = [a.asnumpy() if isinstance(a, NDArray) else a for a in args]
-        return func(*args, **kwargs)
+        return func(*_materialize(list(args)), **_materialize(kwargs))
     return ours(*args, **kwargs)
 
 
@@ -1160,9 +1171,26 @@ def _np_array_ufunc(self, ufunc, method, *args, **kwargs):
         kwargs.pop("out", None)
         return ours(*args, **kwargs)
     # fall back to host numpy on materialized values (covers unmapped
-    # ufuncs and methods like .reduce/.accumulate/.outer)
-    args = [a.asnumpy() if isinstance(a, NDArray) else a for a in args]
-    return getattr(ufunc, method)(*args, **kwargs)
+    # ufuncs and methods like .reduce/.accumulate/.outer); out= mx
+    # arrays receive the result via in-place adoption
+    out = kwargs.pop("out", None)
+    res = getattr(ufunc, method)(*_materialize(list(args)),
+                                 **_materialize(kwargs))
+    if out is not None:
+        outs = out if isinstance(out, tuple) else (out,)
+        ress = res if isinstance(res, tuple) else (res,)
+        wrapped = []
+        for o, r in zip(outs, ress):
+            if isinstance(o, NDArray):
+                o._adopt(jnp.asarray(r, o._data.dtype))
+                wrapped.append(o)
+            else:
+                onp.copyto(o, r)
+                wrapped.append(o)
+        # numpy normalizes out= to a 1-tuple before dispatch; a single
+        # out returns the bare array (numpy call semantics)
+        return wrapped[0] if len(wrapped) == 1 else tuple(wrapped)
+    return res
 
 
 ndarray.__array_function__ = _np_array_function

@@ -105,6 +105,27 @@ class Executor:
             if n not in self.aux_dict:
                 raise MXNetError(f"missing auxiliary state {n}")
 
+        # co-locate: params loaded from disk are host arrays while data
+        # may already live on the chip — a mixed-device bind would fail
+        # inside jit.  Unify onto the first argument's device (normally
+        # the data input), or onto an explicitly-given bind ctx.
+        movable = [v for v in list(self.arg_dict.values())
+                   + list(self.aux_dict.values())
+                   if hasattr(v._data, "devices")]  # skips tracers
+        devs = {next(iter(v._data.devices())) for v in movable}
+        if len(devs) > 1 or (ctx is not None and movable):
+            if ctx is not None:
+                target = ctx.jax_device()
+            else:
+                first = self.arg_dict.get(arg_names[0])
+                target = next(iter(first._data.devices())) \
+                    if first is not None and hasattr(first._data,
+                                                     "devices") \
+                    else next(iter(devs))
+            for v in movable:
+                if next(iter(v._data.devices())) != target:
+                    v._data = jax.device_put(v._data, target)
+
         if isinstance(grad_req, str):
             self._grad_req = {n: grad_req for n in arg_names}
         elif isinstance(grad_req, (list, tuple)):

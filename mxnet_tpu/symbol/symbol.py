@@ -419,6 +419,11 @@ def _make_op_symbol(opname, input_syms, attrs, name, num_outputs=None):
     inputs = []
     for s in input_syms:
         inputs.append((s._node, s._out if s._out is not None else 0))
+    # explicitly-passed variables feeding aux slots (BatchNorm moving
+    # stats) are aux states too, same as the auto-created ones above
+    for slot in _AUX_INPUTS.get(opname, ()):
+        if slot < len(inputs) and inputs[slot][0].op is None:
+            inputs[slot][0].attr_dict["__aux__"] = True
     node = _Node(opname, name, attrs, inputs, num_outputs=num_outputs)
     return Symbol(node)
 
