@@ -305,6 +305,11 @@ def export_model(sym, params, input_shape, input_type="float32",
             if name in params:
                 ctx.add_init(name, params[name])
             else:
+                if graph_inputs:
+                    raise MXNetError(
+                        f"variable {name!r} has no entry in params and "
+                        f"{graph_inputs[0].name!r} is already the data "
+                        "input — missing/typo'd parameter key?")
                 graph_inputs.append(_vinfo(name, input_shape, input_type))
             continue
         ins = [out_name[(i[0], i[1])] for i in n["inputs"]]

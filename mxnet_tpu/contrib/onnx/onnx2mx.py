@@ -30,10 +30,19 @@ def _to_numpy(t):
         a = onp.frombuffer(t.raw_data, dtype=dt)
     elif t.float_data:
         a = onp.asarray(t.float_data, dtype=dt)
+    elif t.double_data:
+        a = onp.asarray(t.double_data, dtype=dt)
     elif t.int64_data:
         a = onp.asarray(t.int64_data, dtype=dt)
+    elif t.uint64_data:
+        a = onp.asarray(t.uint64_data, dtype=dt)
     elif t.int32_data:
-        a = onp.asarray(t.int32_data, dtype=dt)
+        if t.data_type == pb.TensorProto.FLOAT16:
+            # spec: fp16 values are uint16 BIT PATTERNS in int32_data
+            a = onp.asarray(t.int32_data, dtype=onp.uint16).view(
+                onp.float16)
+        else:
+            a = onp.asarray(t.int32_data, dtype=dt)
     else:
         a = onp.zeros(0, dtype=dt)
     return a.reshape(tuple(t.dims))
@@ -77,6 +86,8 @@ def import_model(model_file):
     with open(model_file, "rb") as f:
         model.ParseFromString(f.read())
     g = model.graph
+    opset = max((o.version for o in model.opset_import
+                 if o.domain in ("", "ai.onnx")), default=13)
 
     inits = {t.name: _to_numpy(t) for t in g.initializer}
     env = {}
@@ -173,8 +184,10 @@ def import_model(model_file):
                                     slope=float(att.get("alpha", 0.01)),
                                     name=node.name)
         elif op == "Softmax":
+            # opset <13 default axis is 1 (coerce-to-2D semantics)
+            default_axis = -1 if opset >= 13 else 1
             out = sym_mod.softmax(n_in(node, 0),
-                                  axis=int(att.get("axis", -1)),
+                                  axis=int(att.get("axis", default_axis)),
                                   name=node.name)
         elif op == "Concat":
             ins = [n_in(node, i) for i in range(len(node.input))]
@@ -189,10 +202,13 @@ def import_model(model_file):
         elif op == "Identity":
             out = n_in(node, 0)
         elif op == "Clip":
+            # opset <11 carries bounds as min/max attributes
             lo_t = _init_of(node, 1, "min bound")
             hi_t = _init_of(node, 2, "max bound")
-            lo = float(lo_t) if lo_t is not None else -onp.inf
-            hi = float(hi_t) if hi_t is not None else onp.inf
+            lo = float(lo_t) if lo_t is not None \
+                else float(att.get("min", -onp.inf))
+            hi = float(hi_t) if hi_t is not None \
+                else float(att.get("max", onp.inf))
             out = sym_mod.clip(n_in(node, 0), a_min=lo, a_max=hi,
                                name=node.name)
         elif op == "Reshape":

@@ -77,13 +77,20 @@ def _key_list(key):
 
 class GradientCompression:
     """2-bit gradient compression with error-feedback residual
-    (reference src/kvstore/gradient_compression.h:38-121)."""
+    (reference src/kvstore/gradient_compression.h:38-121).
+
+    Wire format: each value quantizes to a 2-bit code (0 -> 0, 1 -> +t,
+    2 -> -t), four codes per byte — a 16x payload reduction vs fp32,
+    matching the reference's packed representation.  The residual
+    (what quantization dropped) stays on this worker as device state
+    and is added into the next round's gradient.
+    """
 
     def __init__(self, threshold=0.5):
         self.threshold = float(threshold)
         self._residual = {}
 
-    def compress(self, key, grad_v):
+    def _quantize(self, key, grad_v):
         r = self._residual.get(key)
         if r is None:
             r = jnp.zeros_like(grad_v)
@@ -92,6 +99,40 @@ class GradientCompression:
         q = jnp.where(acc >= t, t, jnp.where(acc <= -t, -t, 0.0))
         self._residual[key] = acc - q
         return q
+
+    def compress(self, key, grad_v):
+        """Local quantize-dequantize (single-process stores: no wire)."""
+        return self._quantize(key, grad_v)
+
+    def compress_packed(self, key, grad_v):
+        """Quantize and pack to the 2-bit wire payload (uint8)."""
+        q = self._quantize(key, grad_v)
+        codes = jnp.where(q > 0, jnp.uint8(1),
+                          jnp.where(q < 0, jnp.uint8(2), jnp.uint8(0)))
+        flat = codes.reshape(-1)
+        pad = (-flat.size) % 4
+        if pad:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((pad,), jnp.uint8)])
+        flat = flat.reshape(-1, 4)
+        payload = (flat[:, 0] | (flat[:, 1] << 2) | (flat[:, 2] << 4)
+                   | (flat[:, 3] << 6)).astype(jnp.uint8)
+        return payload
+
+    def decompress(self, payload, shape, dtype=jnp.float32):
+        """Unpack a 2-bit payload back to {-t, 0, +t} floats."""
+        t = self.threshold
+        p = payload.astype(jnp.uint8)
+        codes = jnp.stack(
+            [p & 3, (p >> 2) & 3, (p >> 4) & 3, (p >> 6) & 3],
+            axis=-1).reshape(-1)
+        n = 1
+        for d in shape:
+            n *= d
+        codes = codes[:n].reshape(shape)
+        return jnp.where(codes == 1, jnp.asarray(t, dtype),
+                         jnp.where(codes == 2, jnp.asarray(-t, dtype),
+                                   jnp.asarray(0.0, dtype)))
 
 
 class KVStore:
@@ -139,8 +180,6 @@ class KVStore:
             agg = vlist[0]._data
             for v in vlist[1:]:
                 agg = agg + v._data
-            if self._compression is not None:
-                agg = self._compression.compress(k, agg)
             agg = self._reduce(k, agg)
             agg_nd = nd.NDArray(agg)
             if self._updater is not None:
@@ -151,8 +190,10 @@ class KVStore:
                 self._store[k]._adopt(agg.astype(self._store[k]._data.dtype))
 
     def _reduce(self, key, agg):
-        """Cross-worker reduction hook; identity for single-process
-        stores, a global allreduce in DistKVStore."""
+        """Cross-worker reduction hook; for single-process stores this
+        is just the local compression round-trip (no wire exists)."""
+        if self._compression is not None:
+            agg = self._compression.compress(key, agg)
         return agg
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
@@ -174,8 +215,31 @@ class KVStore:
             self.pull(key, out, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        # dense emulation (TPU-hostile sparse path; SURVEY.md §7 hard parts)
-        self.pull(key, out, priority)
+        """Pull only the requested rows (reference kvstore_dist.h:344):
+        the result has the selected rows of the stored value and zeros
+        elsewhere.  Storage stays dense-backed (TPU-hostile sparse
+        compute; SURVEY.md §7 hard parts) but the row_ids semantics are
+        honored, so embedding-style sparse training gets the right
+        values."""
+        if row_ids is None:
+            return self.pull(key, out, priority)
+        keys, single = _key_list(key)
+        if single:
+            outs = [out if isinstance(out, list) else [out]]
+            rows = [row_ids if isinstance(row_ids, list) else [row_ids]]
+        else:
+            outs = [o if isinstance(o, list) else [o] for o in out]
+            rows = [r if isinstance(r, list) else [r] for r in row_ids]
+        for k, olist, rlist in zip(keys, outs, rows):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            src = self._store[k]._data
+            for o, rids in zip(olist, rlist):
+                idx = jnp.asarray(rids._data
+                                  if isinstance(rids, nd.NDArray)
+                                  else rids).astype(jnp.int32).reshape(-1)
+                sel = jnp.zeros_like(src).at[idx].set(src[idx])
+                o._adopt(sel.astype(o._data.dtype))
 
     def set_gradient_compression(self, compression_params):
         ctype = compression_params.get("type", "2bit")
@@ -248,6 +312,12 @@ class DistKVStore(KVStore):
         super().__init__(kv_type)
         self._rank = jax.process_index()
         self._size = jax.process_count()
+        self._mesh = None
+        self._sum_fn = None
+        # wire accounting for the last push (tools/bandwidth.py and the
+        # compression tests read these)
+        self.last_wire_bytes = 0
+        self.last_uncompressed_bytes = 0
 
     @staticmethod
     def _widen(arr):
@@ -257,14 +327,49 @@ class DistKVStore(KVStore):
             return arr.astype(jnp.float32), arr.dtype
         return arr, None
 
+    def _worker_mesh(self):
+        """One-device-per-process mesh: collectives ride the process
+        group links (the TPU-native replacement for ps-lite ZPush —
+        XLA emits a real reduce, O(N) bytes per link, not the
+        O(N*size) allgather+host-sum this had before round 3)."""
+        if self._mesh is None:
+            import numpy as onp
+            from jax.sharding import Mesh
+
+            per_proc = {}
+            for d in jax.devices():
+                per_proc.setdefault(d.process_index, d)
+            devs = [per_proc[i] for i in sorted(per_proc)]
+            self._mesh = Mesh(onp.array(devs), ("w",))
+        return self._mesh
+
     def _allreduce(self, arr):
         if self._size == 1:
             return arr
-        from jax.experimental import multihost_utils
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
         a, narrow = self._widen(arr)
-        out = multihost_utils.process_allgather(a).sum(axis=0)
+        mesh = self._worker_mesh()
+        sharding = NamedSharding(mesh, P("w"))
+        local_dev = [d for d in mesh.devices.flat
+                     if d.process_index == self._rank][0]
+        local = jax.device_put(a[None], local_dev)
+        garr = jax.make_array_from_single_device_arrays(
+            (self._size,) + tuple(a.shape), sharding, [local])
+        if self._sum_fn is None:
+            self._sum_fn = jax.jit(
+                lambda x: x.sum(axis=0),
+                out_shardings=NamedSharding(mesh, P()))
+        out = self._sum_fn(garr).addressable_data(0)
         return out.astype(narrow) if narrow is not None else out
+
+    def _gather_payloads(self, payload):
+        """Allgather of the packed wire payload: the bytes crossing the
+        process boundary ARE the compressed representation (reference
+        kvstore_dist.h:431 compresses the transmitted buffer)."""
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(payload)
 
     def _broadcast0(self, arr):
         """Rank-0's value everywhere (init consistency, like the server
@@ -284,7 +389,30 @@ class DistKVStore(KVStore):
             self._store[k]._adopt(self._broadcast0(self._store[k]._data))
 
     def _reduce(self, key, agg):
-        return self._allreduce(agg)  # NETWORK boundary (was ZPush/ZPull)
+        # NETWORK boundary (was ZPush/ZPull)
+        if self._compression is not None:
+            # per-worker compress BEFORE the collective: only the
+            # packed 2-bit payload crosses the wire; every worker
+            # decompresses all peers' payloads and sums
+            narrow = agg.dtype if agg.dtype in (jnp.float16,
+                                                jnp.bfloat16) else None
+            a32 = agg.astype(jnp.float32) if narrow is not None else agg
+            payload = self._compression.compress_packed(key, a32)
+            self.last_wire_bytes = int(payload.nbytes)
+            self.last_uncompressed_bytes = int(agg.nbytes)
+            if self._size == 1:
+                out = self._compression.decompress(payload, a32.shape,
+                                                   a32.dtype)
+            else:
+                gathered = self._gather_payloads(payload)
+                out = sum(
+                    self._compression.decompress(gathered[i], a32.shape,
+                                                 a32.dtype)
+                    for i in range(self._size))
+            return out.astype(narrow) if narrow is not None else out
+        self.last_wire_bytes = int(agg.nbytes)
+        self.last_uncompressed_bytes = int(agg.nbytes)
+        return self._allreduce(agg)
 
 
 def create(name="local"):

@@ -1156,9 +1156,21 @@ def _np_array_function(self, func, types, args, kwargs):
     if _FUNC_MAP is None:
         _build_dispatch_maps()
     ours = _FUNC_MAP.get(func.__name__)
+    if kwargs.get("out") is not None:
+        ours = None  # mapped impls take no out=; use the fallback
     if ours is None:
-        # fall back: compute via host numpy on materialized values
-        return func(*_materialize(list(args)), **_materialize(kwargs))
+        # fall back: compute via host numpy on materialized values;
+        # an out= mx array receives the result via in-place adoption
+        out = kwargs.pop("out", None)
+        res = func(*_materialize(list(args)),
+                   **_materialize(kwargs))
+        if isinstance(out, NDArray):
+            out._adopt(jnp.asarray(res, out._data.dtype))
+            return out
+        if out is not None:
+            onp.copyto(out, res)
+            return out
+        return res
     return ours(*args, **kwargs)
 
 

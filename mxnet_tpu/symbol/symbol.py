@@ -575,15 +575,3 @@ def load(fname):
         return load_json(f.read())
 
 
-def _executor_forward(outputs, inputs, args, params):
-    """SymbolBlock forward support (gluon/block.py SymbolBlock)."""
-    from .executor import Executor
-
-    arg_dict = {}
-    for s, a in zip(inputs, args):
-        arg_dict[s.name] = a
-    for name, p in params.items():
-        arg_dict[name] = p.data()
-    ex = Executor(outputs, None, arg_dict, None, "null", None)
-    outs = ex.forward()
-    return outs[0] if len(outs) == 1 else outs

@@ -90,15 +90,48 @@ def main():
     onp.testing.assert_allclose(out.asnumpy(),
                                 onp.full((2, 2), 0.5 * n))
 
-    # --- gradient compression: quantized to {-t, 0, t} before reduce
+    # --- gradient compression: the WIRE carries the packed 2-bit
+    # payload (16x smaller than fp32); arithmetic = sum over workers of
+    # each worker's quantized {-t, 0, t} gradient
     kvc = kvs.create("dist_sync")
     kvc.set_gradient_compression({"type": "2bit", "threshold": 0.5})
-    kvc.init("c", mx.nd.zeros((4,)))
-    kvc.push("c", mx.nd.full((4,), 10.0))
+    nelem = 1024
+    kvc.init("c", mx.nd.zeros((nelem,)))
+    kvc.push("c", mx.nd.full((nelem,), 10.0))
     kvc.barrier()
-    out = mx.nd.zeros((4,))
+    out = mx.nd.zeros((nelem,))
     kvc.pull("c", out=out)
-    onp.testing.assert_allclose(out.asnumpy(), onp.full((4,), 0.5 * n))
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((nelem,), 0.5 * n))
+    # transmitted-size assertion: 2 bits/value = nelem/4 bytes vs 4*nelem
+    assert kvc.last_wire_bytes == nelem // 4, kvc.last_wire_bytes
+    assert kvc.last_uncompressed_bytes == 4 * nelem
+    assert kvc.last_uncompressed_bytes // kvc.last_wire_bytes == 16
+
+    # --- error-feedback residual: a sub-threshold push accumulates and
+    # crosses the threshold on the next round (gradient_compression.h
+    # residual semantics)
+    kvc._set_updater(lambda k, g, s: s._adopt(g._data))
+    kvc.init("cr", mx.nd.zeros((nelem,)))
+    kvc.push("cr", mx.nd.full((nelem,), 0.3))
+    kvc.barrier()
+    out = mx.nd.zeros((nelem,))
+    kvc.pull("cr", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.zeros((nelem,)))
+    kvc.push("cr", mx.nd.full((nelem,), 0.3))  # residual 0.3 + 0.3 >= t
+    kvc.barrier()
+    kvc.pull("cr", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((nelem,), 0.5 * n))
+
+    # --- row_sparse pull honors row_ids
+    kv.init("rs", mx.nd.array(onp.arange(12, dtype="float32")
+                              .reshape(4, 3)))
+    kv.barrier()
+    out = mx.nd.zeros((4, 3))
+    kv.row_sparse_pull("rs", out=out, row_ids=mx.nd.array([1, 3]))
+    expect = onp.zeros((4, 3), "float32")
+    base = onp.arange(12, dtype="float32").reshape(4, 3)
+    expect[[1, 3]] = base[[1, 3]]
+    onp.testing.assert_allclose(out.asnumpy(), expect)
 
     print(f"[worker {r}] dist_sync_kvstore OK ({n} workers)", flush=True)
 

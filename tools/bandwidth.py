@@ -73,6 +73,19 @@ def main():
     print(json.dumps({"metric": "kvstore_pushpull",
                       "GBps": round(2 * nbytes / dt / 1e9, 3)}))
 
+    # wire-size accounting with 2-bit gradient compression: the packed
+    # payload is what a dist push transmits (kvstore.py _reduce)
+    kvc = mx.kv.create("dist_sync")
+    kvc.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kvc.init("c", val)
+    kvc.push("c", val)
+    print(json.dumps({
+        "metric": "push_wire_bytes",
+        "uncompressed": kvc.last_uncompressed_bytes,
+        "compressed_2bit": kvc.last_wire_bytes,
+        "reduction_x": round(kvc.last_uncompressed_bytes
+                             / max(kvc.last_wire_bytes, 1), 1)}))
+
     devs = jax.local_devices()
     if len(devs) > 1:
         from mxnet_tpu.parallel import get_mesh
