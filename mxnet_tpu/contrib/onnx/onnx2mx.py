@@ -19,6 +19,8 @@ _NP_DT = {pb.TensorProto.FLOAT: onp.float32,
           pb.TensorProto.INT64: onp.int64,
           pb.TensorProto.INT8: onp.int8,
           pb.TensorProto.UINT8: onp.uint8,
+          pb.TensorProto.UINT32: onp.uint32,
+          pb.TensorProto.UINT64: onp.uint64,
           pb.TensorProto.BOOL: onp.bool_}
 
 
@@ -184,11 +186,19 @@ def import_model(model_file):
                                     slope=float(att.get("alpha", 0.01)),
                                     name=node.name)
         elif op == "Softmax":
-            # opset <13 default axis is 1 (coerce-to-2D semantics)
-            default_axis = -1 if opset >= 13 else 1
-            out = sym_mod.softmax(n_in(node, 0),
-                                  axis=int(att.get("axis", default_axis)),
-                                  name=node.name)
+            if opset >= 13:
+                out = sym_mod.softmax(n_in(node, 0),
+                                      axis=int(att.get("axis", -1)),
+                                      name=node.name)
+            else:
+                # opset <13: coerce-to-2D — flatten dims from `axis`
+                # and normalize over them jointly, then restore shape
+                axis = int(att.get("axis", 1))
+                d = n_in(node, 0)
+                flat = sym_mod.Reshape(
+                    d, shape=(0,) * axis + (-1,))
+                sm = sym_mod.softmax(flat, axis=-1)
+                out = sym_mod.reshape_like(sm, d, name=node.name)
         elif op == "Concat":
             ins = [n_in(node, i) for i in range(len(node.input))]
             out = sym_mod.Concat(*ins, num_args=len(ins),

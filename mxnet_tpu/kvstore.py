@@ -119,20 +119,39 @@ class GradientCompression:
                    | (flat[:, 3] << 6)).astype(jnp.uint8)
         return payload
 
-    def decompress(self, payload, shape, dtype=jnp.float32):
-        """Unpack a 2-bit payload back to {-t, 0, +t} floats."""
+    def _codes_to_values(self, codes, dtype):
         t = self.threshold
-        p = payload.astype(jnp.uint8)
-        codes = jnp.stack(
-            [p & 3, (p >> 2) & 3, (p >> 4) & 3, (p >> 6) & 3],
-            axis=-1).reshape(-1)
-        n = 1
-        for d in shape:
-            n *= d
-        codes = codes[:n].reshape(shape)
         return jnp.where(codes == 1, jnp.asarray(t, dtype),
                          jnp.where(codes == 2, jnp.asarray(-t, dtype),
                                    jnp.asarray(0.0, dtype)))
+
+    @staticmethod
+    def _unpack(p):
+        p = p.astype(jnp.uint8)
+        return jnp.stack(
+            [p & 3, (p >> 2) & 3, (p >> 4) & 3, (p >> 6) & 3],
+            axis=-1)
+
+    def decompress(self, payload, shape, dtype=jnp.float32):
+        """Unpack a 2-bit payload back to {-t, 0, +t} floats."""
+        codes = self._unpack(payload).reshape(-1)
+        n = 1
+        for d in shape:
+            n *= d
+        return self._codes_to_values(codes[:n].reshape(shape), dtype)
+
+    def decompress_sum(self, gathered, shape, dtype=jnp.float32):
+        """Decompress a (workers, payload_len) gather and sum over
+        workers in ONE fused XLA computation (per-worker padding makes
+        a flat reshape wrong, so unpack per row)."""
+        w = gathered.shape[0]
+        codes = self._unpack(gathered).reshape(w, -1)
+        n = 1
+        for d in shape:
+            n *= d
+        vals = self._codes_to_values(
+            codes[:, :n].reshape((w,) + tuple(shape)), dtype)
+        return vals.sum(axis=0)
 
 
 class KVStore:
@@ -405,10 +424,8 @@ class DistKVStore(KVStore):
                                                    a32.dtype)
             else:
                 gathered = self._gather_payloads(payload)
-                out = sum(
-                    self._compression.decompress(gathered[i], a32.shape,
-                                                 a32.dtype)
-                    for i in range(self._size))
+                out = self._compression.decompress_sum(
+                    gathered, a32.shape, a32.dtype)
             return out.astype(narrow) if narrow is not None else out
         self.last_wire_bytes = int(agg.nbytes)
         self.last_uncompressed_bytes = int(agg.nbytes)

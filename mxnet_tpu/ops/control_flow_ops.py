@@ -161,3 +161,172 @@ def cond(pred, then_func, else_func):
                     wrap(else_func), operand=None)
     outs = [NDArray(o) for o in outs]
     return outs[0] if len(outs) == 1 else outs
+
+
+# ------------------------------------------------------------------
+# Graph-level control flow: registered ops executing subgraph Symbols
+# (reference src/operator/control_flow.cc:1089-1255 — _foreach,
+# _while_loop, _cond as nnvm ops whose subgraphs serialize with the
+# graph).  Subgraphs travel as JSON-text attrs so Symbol.tojson()/load
+# round-trips them; evaluation lowers onto lax.scan / masked scan /
+# lax.cond inside the executor's single XLA program.
+# ------------------------------------------------------------------
+import json as _json
+
+from .registry import register_op as _register_op
+
+_SUBGRAPH_CACHE = {}
+
+
+def _load_subgraph(sg):
+    """Attr value -> Symbol; accepts the JSON text (or the dict a JSON
+    round-trip may literal-eval it into)."""
+    if not isinstance(sg, str):
+        sg = _json.dumps(sg)
+    sym = _SUBGRAPH_CACHE.get(sg)
+    if sym is None:
+        from ..symbol import load_json
+
+        sym = load_json(sg)
+        _SUBGRAPH_CACHE[sg] = sym
+    return sym
+
+
+def _names(v):
+    if isinstance(v, str):
+        return _json.loads(v)
+    return list(v)
+
+
+def _check_no_aux_mutation(sub, train, opname):
+    """BatchNorm moving-stat updates inside a subgraph cannot be
+    threaded out through a fixed-arity graph op; fail loudly instead of
+    training with silently stale statistics (reference shares aux
+    arrays imperatively, control_flow.cc)."""
+    if not train:
+        return
+    for node in sub._topo():
+        if node.op in ("BatchNorm", "BatchNorm_v1", "SyncBatchNorm") \
+                and not node.attrs.get("use_global_stats", False):
+            raise MXNetError(
+                f"{opname}: training a BatchNorm inside a control-flow "
+                "subgraph would not update its moving statistics; set "
+                "use_global_stats=True or move the BatchNorm outside "
+                "the loop")
+
+
+@_register_op("_foreach",
+              num_outputs=lambda p: int(p["num_out_data"])
+              + int(p["num_states"]),
+              key_param="key", train_param="train")
+def _foreach_graph_op(*inputs, subgraph, input_names, num_data,
+                      num_states, num_out_data, key=None, train=False):
+    """Scan the subgraph over axis 0 of the data inputs.
+
+    Input slots: [data x num_data, states x num_states, remain...];
+    subgraph outputs: [out_data x num_out_data, new_states].
+    Reference: control_flow.cc ForeachComputeExCPU."""
+    from ..symbol.executor import _eval_graph
+
+    sub = _load_subgraph(subgraph)
+    names = _names(input_names)
+    nd_, ns = int(num_data), int(num_states)
+    nod = int(num_out_data)
+    data = inputs[:nd_]
+    states = inputs[nd_:nd_ + ns]
+    remain = inputs[nd_ + ns:]
+    data_names = names[:nd_]
+    state_names = names[nd_:nd_ + ns]
+    remain_names = names[nd_ + ns:]
+
+    _check_no_aux_mutation(sub, train, "_foreach")
+    n_steps = data[0].shape[0] if data else 0
+
+    def body(carry, xs):
+        i, xs = xs[0], xs[1:]
+        k = jax.random.fold_in(key, i) if key is not None else None
+        env = dict(zip(remain_names, remain))
+        env.update(zip(data_names, xs))
+        env.update(zip(state_names, carry))
+        outs, _ = _eval_graph(sub, env, k, train)
+        return tuple(outs[nod:]), tuple(outs[:nod])
+
+    carry, ys = lax.scan(body, tuple(states),
+                         (jnp.arange(n_steps),) + tuple(data))
+    result = list(ys) + list(carry)
+    return tuple(result) if len(result) > 1 else result[0]
+
+
+@_register_op("_while_loop",
+              num_outputs=lambda p: int(p["num_out_data"])
+              + int(p["num_states"]),
+              key_param="key", train_param="train")
+def _while_loop_graph_op(*inputs, cond_graph, body_graph, input_names,
+                         num_states, num_out_data, max_iterations,
+                         key=None, train=False):
+    """Masked fixed-length scan: runs ``max_iterations`` steps, freezes
+    state and zero-pads outputs once the cond subgraph goes false —
+    fixed shapes, so XLA compiles one loop and the whole op stays
+    reverse-mode differentiable.  Reference: control_flow.cc
+    WhileLoopComputeExCPU (padded outputs, same contract)."""
+    from ..symbol.executor import _eval_graph
+
+    csub = _load_subgraph(cond_graph)
+    bsub = _load_subgraph(body_graph)
+    names = _names(input_names)
+    ns, nod = int(num_states), int(num_out_data)
+    states = inputs[:ns]
+    remain = inputs[ns:]
+    state_names = names[:ns]
+    remain_names = names[ns:]
+
+    _check_no_aux_mutation(csub, train, "_while_loop")
+    _check_no_aux_mutation(bsub, train, "_while_loop")
+
+    def step(carry, i):
+        active, st = carry[0], carry[1:]
+        k = jax.random.fold_in(key, i) if key is not None else None
+        env = dict(zip(remain_names, remain))
+        env.update(zip(state_names, st))
+        c_out, _ = _eval_graph(csub, env, k, train)
+        pred = jnp.logical_and(active,
+                               c_out[0].reshape(()).astype(bool))
+        b_outs, _ = _eval_graph(bsub, env, k, train)
+        out_d = b_outs[:nod]
+        new_st = b_outs[nod:]
+        st2 = tuple(jnp.where(pred, n, o) for n, o in zip(new_st, st))
+        od = tuple(jnp.where(pred, o, jnp.zeros_like(o)) for o in out_d)
+        return (pred,) + st2, od
+
+    carry, ys = lax.scan(step, (jnp.bool_(True),) + tuple(states),
+                         jnp.arange(int(max_iterations)))
+    result = list(ys) + list(carry[1:])
+    return tuple(result) if len(result) > 1 else result[0]
+
+
+@_register_op("_cond", num_outputs=lambda p: int(p["num_outputs"]),
+              key_param="key", train_param="train")
+def _cond_graph_op(*inputs, cond_graph, then_graph, else_graph,
+                   input_names, num_outputs, key=None, train=False):
+    """lax.cond over then/else subgraphs; the pred subgraph sees the
+    same inputs.  Reference: control_flow.cc CondComputeExCPU."""
+    from ..symbol.executor import _eval_graph
+
+    psub = _load_subgraph(cond_graph)
+    tsub = _load_subgraph(then_graph)
+    esub = _load_subgraph(else_graph)
+    for sub_ in (psub, tsub, esub):
+        _check_no_aux_mutation(sub_, train, "_cond")
+    names = _names(input_names)
+    env = dict(zip(names, inputs))
+    p_out, _ = _eval_graph(psub, env, key, train)
+    pred = p_out[0].reshape(()).astype(bool)
+
+    def _branch(sub):
+        def f(ins):
+            outs, _ = _eval_graph(sub, dict(zip(names, ins)), key, train)
+            return tuple(outs)
+        return f
+
+    outs = lax.cond(pred, _branch(tsub), _branch(esub), tuple(inputs))
+    return tuple(outs) if len(outs) > 1 else outs[0]

@@ -1,0 +1,164 @@
+"""``mx.sym.contrib`` — symbolic higher-order control flow.
+
+Reference: python/mxnet/symbol/contrib.py (foreach/while_loop/cond
+building _foreach/_while_loop/_cond graph nodes whose subgraphs
+serialize with the Symbol, src/operator/control_flow.cc).  The builders
+trace the user's python callable with fresh subgraph input variables;
+outer Symbols the body closes over must be variables (weights), which
+become extra op inputs shared by node identity with the outer graph.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+from ..base import MXNetError
+from ..ops.registry import _OPS, get_op
+from ._op_namespace import _make_sym_func
+from .symbol import Symbol, _auto_name, _make_op_symbol, var
+
+_this = sys.modules[__name__]
+
+
+def _expose_contrib():
+    for name in list(_OPS):
+        if name.startswith("_contrib_"):
+            short = name[len("_contrib_"):]
+            if short.isidentifier() and not hasattr(_this, short):
+                setattr(_this, short, _make_sym_func(name))
+
+
+def _listify(x):
+    single = not isinstance(x, (list, tuple))
+    return ([x] if single else list(x)), single
+
+
+def _subgraph_extras(sub, local_names):
+    """Variables the body closed over (weights etc.): the ORIGINAL
+    outer var nodes, so the op's inputs unify with the outer graph by
+    node identity."""
+    extras, seen = [], set()
+    for node in sub._topo():
+        if node.op is None and node.name not in local_names \
+                and node.name not in seen:
+            seen.add(node.name)
+            extras.append(Symbol(node))
+    return extras
+
+
+def foreach(body, data, init_states, name=None):
+    """Symbolic scan (reference symbol/contrib.py:foreach).
+
+    ``body(data_slice, states) -> (outputs, new_states)`` is traced
+    once with subgraph variables; returns (outputs, final_states)
+    Symbols whose node is a ``_foreach`` op."""
+    from .symbol import Group
+
+    name = name or _auto_name("foreach")
+    datas, data_single = _listify(data)
+    states, states_single = _listify(init_states)
+    data_vars = [var(f"{name}_data{i}") for i in range(len(datas))]
+    state_vars = [var(f"{name}_state{i}") for i in range(len(states))]
+    out, new_states = body(data_vars[0] if data_single else data_vars,
+                           state_vars[0] if states_single else state_vars)
+    outs, out_single = _listify(out)
+    new_states, _ = _listify(new_states)
+    if len(new_states) != len(states):
+        raise MXNetError("foreach body must return as many states as "
+                         "init_states")
+    sub = Group(outs + new_states)
+    local = {v.name for v in data_vars + state_vars}
+    extras = _subgraph_extras(sub, local)
+    slot_names = ([v.name for v in data_vars]
+                  + [v.name for v in state_vars]
+                  + [s.name for s in extras])
+    attrs = {
+        "subgraph": sub.tojson(),
+        "input_names": json.dumps(slot_names),
+        "num_data": len(datas),
+        "num_states": len(states),
+        "num_out_data": len(outs),
+    }
+    node = _make_op_symbol("_foreach", list(datas) + list(states) + extras,
+                           attrs, name)
+    out_syms = [node[i] for i in range(len(outs))]
+    state_syms = [node[len(outs) + i] for i in range(len(states))]
+    return (out_syms[0] if out_single else out_syms,
+            state_syms[0] if states_single else state_syms)
+
+
+def while_loop(cond, func, loop_vars, max_iterations, name=None):
+    """Symbolic while (reference symbol/contrib.py:while_loop): outputs
+    are stacked over ``max_iterations`` steps (zero-padded after the
+    predicate fails), states are the final loop vars."""
+    from .symbol import Group
+
+    name = name or _auto_name("while")
+    states, states_single = _listify(loop_vars)
+    state_vars = [var(f"{name}_state{i}") for i in range(len(states))]
+    sv = state_vars[0] if states_single else state_vars
+    pred = cond(sv)
+    out, new_states = func(sv)
+    outs, out_single = _listify(out)
+    new_states, _ = _listify(new_states)
+    if len(new_states) != len(states):
+        raise MXNetError("while_loop func must return as many states "
+                         "as loop_vars")
+    bsub = Group(outs + new_states)
+    csub = Group([pred])
+    local = {v.name for v in state_vars}
+    extras = _subgraph_extras(Group([pred] + outs + new_states), local)
+    slot_names = [v.name for v in state_vars] + [s.name for s in extras]
+    attrs = {
+        "cond_graph": csub.tojson(),
+        "body_graph": bsub.tojson(),
+        "input_names": json.dumps(slot_names),
+        "num_states": len(states),
+        "num_out_data": len(outs),
+        "max_iterations": int(max_iterations),
+    }
+    node = _make_op_symbol("_while_loop", list(states) + extras, attrs,
+                           name)
+    out_syms = [node[i] for i in range(len(outs))]
+    state_syms = [node[len(outs) + i] for i in range(len(states))]
+    return (out_syms[0] if out_single else out_syms,
+            state_syms[0] if states_single else state_syms)
+
+
+def cond(pred, then_func, else_func, inputs=None, name=None):
+    """Symbolic branch (reference symbol/contrib.py:cond).
+
+    ``pred``/``then_func``/``else_func`` are callables taking the
+    ``inputs`` Symbols (a list; [] allowed for closures over outer
+    variables)."""
+    from .symbol import Group
+
+    name = name or _auto_name("cond")
+    ins, single = _listify(inputs if inputs is not None else [])
+    in_vars = [var(f"{name}_in{i}") for i in range(len(ins))]
+    iv = in_vars[0] if single and ins else in_vars
+    p = pred(iv) if ins else pred()
+    t = then_func(iv) if ins else then_func()
+    e = else_func(iv) if ins else else_func()
+    t_list, t_single = _listify(t)
+    e_list, _ = _listify(e)
+    if len(t_list) != len(e_list):
+        raise MXNetError("then and else branches must return the same "
+                         "number of outputs")
+    local = {v.name for v in in_vars}
+    union = Group([p] + t_list + e_list)
+    extras = _subgraph_extras(union, local)
+    slot_names = [v.name for v in in_vars] + [s.name for s in extras]
+    attrs = {
+        "cond_graph": Group([p]).tojson(),
+        "then_graph": Group(t_list).tojson(),
+        "else_graph": Group(e_list).tojson(),
+        "input_names": json.dumps(slot_names),
+        "num_outputs": len(t_list),
+    }
+    node = _make_op_symbol("_cond", list(ins) + extras, attrs, name)
+    out_syms = [node[i] for i in range(len(t_list))]
+    return out_syms[0] if t_single else out_syms
+
+
+_expose_contrib()

@@ -210,6 +210,35 @@ class Symbol:
     def __neg__(self):
         return self.__mul__(-1.0)
 
+    # comparisons (reference symbol.py __gt__/...: 1.0/0.0 outputs)
+    def __gt__(self, other):
+        return self._binary(other, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, other):
+        return self._binary(other, "broadcast_greater_equal",
+                            "_greater_equal_scalar")
+
+    def __lt__(self, other):
+        return self._binary(other, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, other):
+        return self._binary(other, "broadcast_lesser_equal",
+                            "_lesser_equal_scalar")
+
+    def __eq__(self, other):
+        if not isinstance(other, (Symbol, int, float)):
+            return NotImplemented
+        return self._binary(other, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, other):
+        if not isinstance(other, (Symbol, int, float)):
+            return NotImplemented
+        return self._binary(other, "broadcast_not_equal",
+                            "_not_equal_scalar")
+
+    def __hash__(self):
+        return id(self._node) ^ hash(self._out)
+
     # ------------------------------------------------------- evaluation
     def _eval(self, value_of):
         """Evaluate outputs given a dict node->list[jax value] resolver."""
@@ -269,7 +298,8 @@ class Symbol:
             if attrs:
                 entry["attrs"] = attrs
             user_attrs = {k: str(v) for k, v in n.attr_dict.items()
-                          if not k.startswith("__")}
+                          if not k.startswith("__")
+                          or k in ("__shape__", "__dtype__", "__init__")}
             if user_attrs:
                 entry["attr"] = user_attrs
             if n.op is None:
@@ -492,8 +522,13 @@ def load_json(json_str):
         user_attr = nj.get("attr", {}) or {}
         inputs = [(built[i], oi) for i, oi, *_ in nj.get("inputs", [])]
         if op == "null":
-            node = _Node(None, nj["name"], {}, [],
-                         attr_dict=dict(user_attr))
+            ad = dict(user_attr)
+            if isinstance(ad.get("__shape__"), str):
+                import ast
+
+                ad["__shape__"] = tuple(
+                    ast.literal_eval(ad["__shape__"]))
+            node = _Node(None, nj["name"], {}, [], attr_dict=ad)
         else:
             opdef = get_op(op)  # raises for unknown op
             attrs = _parse_attrs(op, attrs_raw)

@@ -1,0 +1,169 @@
+"""Graph-level control flow: _foreach/_while_loop/_cond as Symbol ops.
+
+Reference: src/operator/control_flow.cc:1089-1255 + symbol/contrib.py;
+tests modeled on tests/python/unittest/test_contrib_control_flow.py.
+The key contract: subgraphs serialize with the Symbol (tojson/load
+round-trip) and the ops execute + differentiate inside the graph
+executor's single XLA program.
+"""
+import json
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import symbol as sym
+
+
+def _exec(graph, **args):
+    ex = graph.bind(args=args)
+    return [o.asnumpy() for o in ex.forward()]
+
+
+def test_foreach_roundtrip_and_exec():
+    data = sym.var("data")
+    w = sym.var("w")
+
+    def body(x, s):
+        h = sym.broadcast_add(sym.elemwise_mul(x, w), s)
+        return h, h
+
+    outs, final = sym.contrib.foreach(body, data, sym.var("s0"))
+    assert sorted(outs.list_arguments()) == ["data", "s0", "w"]
+
+    back = sym.load_json(outs.tojson())
+    ops = {n["op"] for n in json.loads(back.tojson())["nodes"]}
+    assert "_foreach" in ops
+
+    x = onp.arange(6, dtype="float32").reshape(3, 2)
+    wv = onp.array([2.0, 3.0], dtype="float32")
+    expect = onp.cumsum(x * wv, axis=0)
+    for g in (outs, back):
+        (o,) = _exec(g, data=nd.array(x), w=nd.array(wv),
+                     s0=nd.zeros((2,)))
+        onp.testing.assert_allclose(o, expect, rtol=1e-6)
+
+
+def test_foreach_gradient_through_executor():
+    data = sym.var("data")
+    w = sym.var("w")
+
+    def body(x, s):
+        h = sym.broadcast_add(sym.elemwise_mul(x, w), s)
+        return h, h
+
+    outs, _ = sym.contrib.foreach(body, data, sym.var("s0"))
+    loss = sym.sum(outs)
+    x = onp.arange(6, dtype="float32").reshape(3, 2)
+    wv = onp.array([2.0, 3.0], dtype="float32")
+    args = {"data": nd.array(x), "w": nd.array(wv), "s0": nd.zeros((2,))}
+    grads = {k: nd.zeros(v.shape) for k, v in args.items()}
+    ex = loss.bind(args=args, args_grad=grads)
+    ex.forward(is_train=True)
+    ex.backward()
+    # d loss / d w = sum_t (T - t) * x_t  (each x_t*w flows into T-t sums)
+    T = x.shape[0]
+    expect_gw = ((T - onp.arange(T))[:, None] * x).sum(axis=0)
+    onp.testing.assert_allclose(grads["w"].asnumpy(), expect_gw,
+                                rtol=1e-5)
+
+
+def test_while_loop_roundtrip_and_exec():
+    s0 = sym.var("s0")
+
+    def cond_fn(s):
+        return sym.sum(s) < 40.0
+
+    def body_fn(s):
+        nxt = s * 2.0
+        return nxt, nxt
+
+    outs, final = sym.contrib.while_loop(cond_fn, body_fn, s0,
+                                         max_iterations=6)
+    back = sym.load_json(final.tojson())
+    s = onp.array([1.0, 1.0], dtype="float32")
+    # iterations: sums 2,4,8,16,32,64 -> cond(sum<40) fails at sum=32's
+    # next check? step runs while sum(s)<40 at entry: s=2->4->8->16->32
+    # ->64 (entered at sum=32), then stops: final = 64s? Walk: entry
+    # sums 2,4,8,16,32 pass; 64 fails. 5 doublings applied after entry
+    # checks starting from s=[1,1]: final [32,32].
+    for g in (final, back):
+        (f,) = _exec(g, s0=nd.array(s))
+        onp.testing.assert_allclose(f, [32.0, 32.0])
+    (o,) = _exec(outs, s0=nd.array(s))
+    # stacked outputs padded to max_iterations with zeros after stop
+    onp.testing.assert_allclose(
+        o, [[2, 2], [4, 4], [8, 8], [16, 16], [32, 32], [0, 0]])
+
+
+def test_cond_roundtrip_and_exec():
+    a = sym.var("a")
+    b = sym.var("b")
+
+    out = sym.contrib.cond(
+        lambda ins: sym.sum(ins[0]) > sym.sum(ins[1]),
+        lambda ins: ins[0] * 2.0,
+        lambda ins: ins[1] + 10.0,
+        inputs=[a, b])
+    back = sym.load_json(out.tojson())
+    av = onp.array([5.0, 5.0], dtype="float32")
+    bv = onp.array([1.0, 1.0], dtype="float32")
+    for g in (out, back):
+        (o,) = _exec(g, a=nd.array(av), b=nd.array(bv))
+        onp.testing.assert_allclose(o, av * 2)
+        (o,) = _exec(g, a=nd.array(bv), b=nd.array(av))
+        onp.testing.assert_allclose(o, av + 10)
+
+
+def test_bucketed_rnn_foreach_trains_under_module():
+    """The VERDICT 'done' case: an RNN built as a _foreach Symbol
+    round-trips JSON and trains under mx.mod.Module."""
+    T, B, D, H, C = 5, 8, 6, 10, 3
+    data = sym.var("data")
+    # loop-carried params declare shapes (forward-only inference cannot
+    # back-deduce through the subgraph; reference users hit the same
+    # with variable-shape-free foreach params)
+    wx = sym.var("wx", shape=(D, H))
+    wh = sym.var("wh", shape=(H, H))
+
+    def step(x, h):
+        nxt = sym.Activation(
+            sym.elemwise_add(sym.dot(x, wx), sym.dot(h, wh)),
+            act_type="tanh")
+        return nxt, nxt
+
+    outs, last_h = sym.contrib.foreach(
+        step, sym.SwapAxis(data, dim1=0, dim2=1), sym.var("h0"))
+    logits = sym.FullyConnected(last_h, num_hidden=C, name="out_fc")
+    net = sym.SoftmaxOutput(logits, name="softmax")
+
+    # JSON round-trip BEFORE training (serializability requirement)
+    net = sym.load_json(net.tojson())
+
+    onp.random.seed(0)
+    x = onp.random.rand(B, T, D).astype("float32")
+    y = onp.random.randint(0, C, size=(B,)).astype("float32")
+    # h0 rides as data; wx/wh stay args so the optimizer learns them
+    mod = mx.mod.Module(net, data_names=("data", "h0"),
+                        label_names=("softmax_label",))
+    from mxnet_tpu.io import NDArrayIter
+
+    h0 = onp.zeros((B, H), dtype="float32")
+    it = NDArrayIter(data={"data": x, "h0": h0}, label={"softmax_label": y},
+                     batch_size=B)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    metric = mx.metric.create("ce")
+    losses = []
+    for epoch in range(12):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.update_metric(metric, batch.label)
+            mod.backward()
+            mod.update()
+        losses.append(metric.get()[1])
+    assert losses[-1] < losses[0] * 0.7, losses
