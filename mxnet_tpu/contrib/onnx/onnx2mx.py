@@ -195,10 +195,20 @@ def import_model(model_file):
                 # and normalize over them jointly, then restore shape
                 axis = int(att.get("axis", 1))
                 d = n_in(node, 0)
-                flat = sym_mod.Reshape(
-                    d, shape=(0,) * axis + (-1,))
-                sm = sym_mod.softmax(flat, axis=-1)
-                out = sym_mod.reshape_like(sm, d, name=node.name)
+                if axis == -1:
+                    # flattening from the last axis is the identity:
+                    # plain last-axis softmax
+                    out = sym_mod.softmax(d, axis=-1, name=node.name)
+                elif axis < 0:
+                    raise MXNetError(
+                        f"opset<13 Softmax with negative axis {axis} "
+                        "needs the input rank, which import does not "
+                        "know; re-export at opset>=13")
+                else:
+                    flat = sym_mod.Reshape(
+                        d, shape=(0,) * axis + (-1,))
+                    sm = sym_mod.softmax(flat, axis=-1)
+                    out = sym_mod.reshape_like(sm, d, name=node.name)
         elif op == "Concat":
             ins = [n_in(node, i) for i in range(len(node.input))]
             out = sym_mod.Concat(*ins, num_args=len(ins),

@@ -1,0 +1,60 @@
+"""gluon.contrib.nn (reference: python/mxnet/gluon/contrib/nn/
+basic_layers.py)."""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..nn.basic_layers import BatchNorm
+
+__all__ = ["SyncBatchNorm"]
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm (reference gluon/contrib/nn SyncBatchNorm
+    over src/operator/contrib/sync_batch_norm.cc).
+
+    Statistics reduce over ``axis_name`` when the forward runs inside a
+    ``shard_map``/``pmap`` over that mesh axis (lax.pmean — the
+    TPU-native AllReduce); outside a mapped context it behaves as
+    BatchNorm on the full local batch, which matches the reference's
+    single-device degenerate case.  ``num_devices`` is accepted for API
+    compatibility.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", axis_name=None,
+                 **kwargs):
+        super().__init__(
+            axis=1, momentum=momentum, epsilon=epsilon, center=center,
+            scale=scale, use_global_stats=use_global_stats,
+            beta_initializer=beta_initializer,
+            gamma_initializer=gamma_initializer,
+            running_mean_initializer=running_mean_initializer,
+            running_variance_initializer=running_variance_initializer,
+            in_channels=in_channels, **kwargs)
+        if num_devices is not None and num_devices < 1:
+            raise MXNetError("num_devices must be >= 1")
+        self._kwargs["axis_name"] = axis_name
+        del self._kwargs["axis"]  # SyncBatchNorm op is channel-1 only
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        from ... import autograd
+
+        training = (autograd.is_training()
+                    and not self._kwargs["use_global_stats"])
+        if training:
+            out, batch_mean, batch_var = F.SyncBatchNorm(
+                x, gamma, beta, running_mean, running_var,
+                output_mean_var=True, **self._kwargs)
+            m = self._kwargs["momentum"]
+            with autograd.pause():
+                new_mean = m * running_mean + (1.0 - m) * batch_mean
+                new_var = m * running_var + (1.0 - m) * batch_var
+                running_mean._adopt(new_mean._data)
+                running_var._adopt(new_var._data)
+            return out
+        return F.SyncBatchNorm(x, gamma, beta, running_mean, running_var,
+                               **self._kwargs)

@@ -38,11 +38,13 @@ class _RNNLayer(HybridBlock):
         self._h2h_weight_initializer = h2h_weight_initializer
         self._i2h_bias_initializer = i2h_bias_initializer
         self._h2h_bias_initializer = h2h_bias_initializer
-        if projection_size is not None:
-            raise MXNetError("projection_size not supported yet")
+        if projection_size is not None and mode != "lstm":
+            raise MXNetError("projection_size is LSTM-only "
+                             "(reference rnn-inl.h:444)")
 
         self._gates = _GATES[mode]
         ng, ni, nh = self._gates, input_size, hidden_size
+        nr = projection_size if projection_size else nh
         with self.name_scope():
             for i in range(num_layers):
                 for j in ["l", "r"][: self._dir]:
@@ -50,15 +52,19 @@ class _RNNLayer(HybridBlock):
                         f"{j}{i}_i2h_weight", (ng * nh, ni),
                         i2h_weight_initializer)
                     self._register_param(
-                        f"{j}{i}_h2h_weight", (ng * nh, nh),
+                        f"{j}{i}_h2h_weight", (ng * nh, nr),
                         h2h_weight_initializer)
+                    if projection_size:
+                        self._register_param(
+                            f"{j}{i}_h2r_weight", (nr, nh),
+                            h2h_weight_initializer)
                     self._register_param(
                         f"{j}{i}_i2h_bias", (ng * nh,),
                         i2h_bias_initializer)
                     self._register_param(
                         f"{j}{i}_h2h_bias", (ng * nh,),
                         h2h_bias_initializer)
-                ni = nh * self._dir
+                ni = nr * self._dir
 
     def _register_param(self, name, shape, init):
         p = self.params.get(
@@ -69,11 +75,12 @@ class _RNNLayer(HybridBlock):
     def _infer_param_shapes(self, x, *args):
         ins = x.shape[2]  # C is axis 2 in both TNC and NTC
         ng, nh = self._gates, self._hidden_size
+        nr = self._projection_size if self._projection_size else nh
         ni = ins
         for i in range(self._num_layers):
             for j in ["l", "r"][: self._dir]:
                 getattr(self, f"{j}{i}_i2h_weight").shape = (ng * nh, ni)
-            ni = nh * self._dir
+            ni = nr * self._dir
         self._input_size = ins
 
     def state_info(self, batch_size=0):
@@ -113,6 +120,9 @@ class _RNNLayer(HybridBlock):
             for j in ["l", "r"][: self._dir]:
                 flat.append(params[f"{j}{i}_i2h_weight"].reshape((-1,)))
                 flat.append(params[f"{j}{i}_h2h_weight"].reshape((-1,)))
+                if self._projection_size:
+                    flat.append(
+                        params[f"{j}{i}_h2r_weight"].reshape((-1,)))
         for i in range(self._num_layers):
             for j in ["l", "r"][: self._dir]:
                 flat.append(params[f"{j}{i}_i2h_bias"])
@@ -128,6 +138,7 @@ class _RNNLayer(HybridBlock):
             p=self._dropout,
             state_outputs=True,
             mode=self._mode,
+            projection_size=self._projection_size,
         )
         if self._mode == "lstm":
             outputs, states = out[0], [out[1], out[2]]
@@ -190,9 +201,11 @@ class LSTM(_RNNLayer):
             projection_size, **kwargs)
 
     def state_info(self, batch_size=0):
+        # h state uses the projected size under LSTMP; c keeps H
+        r = self._projection_size or self._hidden_size
         return [
-            {"shape": (self._num_layers * self._dir, batch_size,
-                       self._hidden_size), "__layout__": "LNC"},
+            {"shape": (self._num_layers * self._dir, batch_size, r),
+             "__layout__": "LNC"},
             {"shape": (self._num_layers * self._dir, batch_size,
                        self._hidden_size), "__layout__": "LNC"},
         ]

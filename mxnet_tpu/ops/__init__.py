@@ -15,6 +15,7 @@ from . import (  # noqa: F401  (import-for-registration)
     sequence_ops,
     linalg_ops,
     contrib_ops,
+    contrib_tail,
     numpy_ops,
     detection_ops,
     flash_attention,

@@ -1,0 +1,312 @@
+"""Contrib operator tail (round 3).
+
+Reference parity: src/operator/contrib/sync_batch_norm.cc,
+deformable_convolution.cc, bilinear_resize.cc, adaptive_avg_pooling.cc,
+correlation.cc, count_sketch.cc and the interleaved multi-head
+attention ops (transformer-inl.h).  TPU-native: everything is dense
+jnp/lax — gathers ride the vector unit, contractions the MXU; SyncBN's
+cross-device reduction is one ``lax.pmean`` over the mesh axis instead
+of the reference's NCCL AllReduce key-value protocol.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+# ------------------------------------------------------ SyncBatchNorm
+def _syncbn_nout(p):
+    return 3 if p.get("output_mean_var") else 1
+
+
+@register_op("_contrib_SyncBatchNorm", aliases=("SyncBatchNorm",),
+             num_outputs=_syncbn_nout, train_param="train")
+def sync_batch_norm(data, gamma, beta, moving_mean, moving_var, *,
+                    eps=1e-3, momentum=0.9, fix_gamma=True,
+                    use_global_stats=False, output_mean_var=False,
+                    ndev=1, key=None, axis_name=None, train=False):
+    """Reference: src/operator/contrib/sync_batch_norm.cc — BatchNorm
+    whose batch statistics reduce across devices.
+
+    Inside a ``shard_map``/``pmap`` over ``axis_name``, per-device
+    sums ``lax.pmean`` into global statistics (the reference's
+    cross-device AllReduce of sum/sumsq); without a mapped axis it
+    degenerates to plain BatchNorm on the full batch.
+    """
+    ax = 1 % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    bshape = [1] * data.ndim
+    bshape[ax] = data.shape[ax]
+    x32 = data.astype(jnp.float32)
+    if train and not use_global_stats:
+        mean = jnp.mean(x32, axis=red)
+        meansq = jnp.mean(x32 * x32, axis=red)
+        if axis_name is not None:
+            mean = lax.pmean(mean, axis_name)
+            meansq = lax.pmean(meansq, axis_name)
+        var = jnp.maximum(meansq - mean * mean, 0.0)
+    else:
+        mean = moving_mean.astype(jnp.float32)
+        var = moving_var.astype(jnp.float32)
+    g32 = jnp.ones_like(mean) if fix_gamma else gamma.astype(jnp.float32)
+    inv = lax.rsqrt(var + eps)
+    out = ((x32 - mean.reshape(bshape)) * (inv * g32).reshape(bshape)
+           + beta.astype(jnp.float32).reshape(bshape)).astype(data.dtype)
+    if output_mean_var:
+        return out, mean, var
+    return out
+
+
+# --------------------------------------------- DeformableConvolution
+def _bilinear_gather(data, y, x):
+    """data (C, H, W); y/x arbitrary same-shaped float coords; bilinear
+    sample with zero padding outside."""
+    c, h, w = data.shape
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy = y - y0
+    wx = x - x0
+
+    def tap(yy, xx):
+        yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        valid = ((yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1))
+        g = data[:, yc, xc]  # (C, *coord_shape)
+        return g * valid.astype(data.dtype)
+
+    return (tap(y0, x0) * ((1 - wy) * (1 - wx))
+            + tap(y0, x0 + 1) * ((1 - wy) * wx)
+            + tap(y0 + 1, x0) * (wy * (1 - wx))
+            + tap(y0 + 1, x0 + 1) * (wy * wx))
+
+
+@register_op("_contrib_DeformableConvolution",
+             aliases=("DeformableConvolution",))
+def deformable_convolution(data, offset, weight, bias=None, *, kernel,
+                           num_filter, stride=(1, 1), dilate=(1, 1),
+                           pad=(0, 0), num_group=1,
+                           num_deformable_group=1, no_bias=False,
+                           workspace=1024, layout=None):
+    """Reference: src/operator/contrib/deformable_convolution.cc
+    (Dai et al., Deformable ConvNets).  Sampled patches gather with
+    learned offsets, then one einsum onto the MXU."""
+    n, c, h, w = data.shape
+    kh, kw = kernel
+    sh, sw = stride if isinstance(stride, (tuple, list)) else (stride,) * 2
+    dh, dw = dilate if isinstance(dilate, (tuple, list)) else (dilate,) * 2
+    ph, pw = pad if isinstance(pad, (tuple, list)) else (pad,) * 2
+    ho = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    wo = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    ndg = num_deformable_group
+
+    # base sampling grid per kernel tap: (kh*kw, ho, wo)
+    ys = jnp.arange(ho) * sh - ph
+    xs = jnp.arange(wo) * sw - pw
+    ky = jnp.arange(kh) * dh
+    kx = jnp.arange(kw) * dw
+    base_y = ys[None, :, None] + ky.repeat(kw)[:, None, None]
+    base_x = xs[None, None, :] + jnp.tile(kx, kh)[:, None, None]
+    base_y = jnp.broadcast_to(base_y, (kh * kw, ho, wo))
+    base_x = jnp.broadcast_to(base_x, (kh * kw, ho, wo))
+
+    # offset: (N, ndg*2*kh*kw, ho, wo) -> (N, ndg, kh*kw, 2, ho, wo)
+    off = offset.reshape(n, ndg, kh * kw, 2, ho, wo)
+
+    def sample_one(dat, off_b):
+        # dat (C,H,W), off_b (ndg, kh*kw, 2, ho, wo)
+        cg = c // ndg
+
+        def per_group(dg, og):
+            y = base_y + og[:, 0]
+            x = base_x + og[:, 1]
+            return _bilinear_gather(dg, y, x)  # (cg, kh*kw, ho, wo)
+
+        groups = [per_group(dat[g * cg:(g + 1) * cg], off_b[g])
+                  for g in range(ndg)]
+        return jnp.concatenate(groups, axis=0)  # (C, kh*kw, ho, wo)
+
+    cols = jax.vmap(sample_one)(data, off)  # (N, C, kh*kw, ho, wo)
+    cg2 = c // num_group
+    og2 = num_filter // num_group
+    cols = cols.reshape(n, num_group, cg2, kh * kw, ho, wo)
+    wr = weight.reshape(num_group, og2, cg2, kh * kw)
+    out = jnp.einsum("ngckhw,gock->ngohw", cols, wr)
+    out = out.reshape(n, num_filter, ho, wo)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+# ------------------------------------------------- BilinearResize2D
+@register_op("_contrib_BilinearResize2D", aliases=("BilinearResize2D",))
+def bilinear_resize_2d(data, *, height=0, width=0, scale_height=None,
+                       scale_width=None, mode="size"):
+    """Reference: src/operator/contrib/bilinear_resize.cc —
+    align-corners bilinear (x_src = x_dst*(W_in-1)/(W_out-1))."""
+    from ..base import MXNetError
+
+    n, c, h, w = data.shape
+    if scale_height is not None and (not height and not width):
+        if scale_width is None:
+            scale_width = scale_height
+        height = int(round(h * float(scale_height)))
+        width = int(round(w * float(scale_width)))
+    ho, wo = int(height), int(width)
+    if ho <= 0 or wo <= 0:
+        raise MXNetError(
+            f"BilinearResize2D mode={mode!r}: resolved output size "
+            f"({ho}, {wo}) is empty — pass height/width or "
+            "scale_height/scale_width")
+    ys = jnp.arange(ho) * ((h - 1) / max(ho - 1, 1))
+    xs = jnp.arange(wo) * ((w - 1) / max(wo - 1, 1))
+    y, x = jnp.meshgrid(ys, xs, indexing="ij")
+
+    def one(dat):
+        return _bilinear_gather(dat, y, x)
+
+    return jax.vmap(one)(data).astype(data.dtype)
+
+
+# --------------------------------------------- AdaptiveAvgPooling2D
+@register_op("_contrib_AdaptiveAvgPooling2D",
+             aliases=("AdaptiveAvgPooling2D",))
+def adaptive_avg_pooling_2d(data, *, output_size=(1, 1)):
+    """Reference: src/operator/contrib/adaptive_avg_pooling.cc — via an
+    integral image so uneven bins stay one fused gather (no
+    data-dependent loop for XLA)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ho, wo = output_size
+    n, c, h, w = data.shape
+    x32 = data.astype(jnp.float32)
+    integ = jnp.pad(x32.cumsum(2).cumsum(3), ((0, 0), (0, 0), (1, 0),
+                                              (1, 0)))
+    import numpy as onp
+
+    ys = onp.floor(onp.arange(ho) * h / ho).astype("int32")
+    ye = onp.ceil((onp.arange(ho) + 1) * h / ho).astype("int32")
+    xs = onp.floor(onp.arange(wo) * w / wo).astype("int32")
+    xe = onp.ceil((onp.arange(wo) + 1) * w / wo).astype("int32")
+    area = ((ye - ys)[:, None] * (xe - xs)[None, :]).astype("float32")
+    s = (integ[:, :, ye[:, None], xe[None, :]]
+         - integ[:, :, ys[:, None], xe[None, :]]
+         - integ[:, :, ye[:, None], xs[None, :]]
+         + integ[:, :, ys[:, None], xs[None, :]])
+    return (s / area).astype(data.dtype)
+
+
+# ---------------------------------------------------------- Correlation
+@register_op("_contrib_Correlation", aliases=("Correlation",))
+def correlation(data1, data2, *, kernel_size=1, max_displacement=1,
+                stride1=1, stride2=1, pad_size=0, is_multiply=True):
+    """Reference: src/operator/contrib/correlation.cc (FlowNet): for
+    each displacement in the search window, the channel-mean of the
+    patchwise product (or abs-difference) of the two feature maps."""
+    n, c, h, w = data1.shape
+    d = max_displacement
+    p = pad_size
+    a = jnp.pad(data1, ((0, 0), (0, 0), (p, p), (p, p)))
+    # b gets an extra max_displacement of zero padding so edge
+    # displacements read zeros instead of dynamic_slice silently
+    # clamping back in bounds
+    b = jnp.pad(data2, ((0, 0), (0, 0), (p + d, p + d), (p + d, p + d)))
+    hp, wp = h + 2 * p, w + 2 * p
+    k2 = kernel_size // 2
+    ho = (hp - 2 * d - 2 * k2 + (stride1 - 1)) // stride1
+    wo = (wp - 2 * d - 2 * k2 + (stride1 - 1)) // stride1
+    disp = range(-d, d + 1, stride2)
+    outs = []
+    y0 = d + k2
+    for dy in disp:
+        for dx in disp:
+            aa = lax.dynamic_slice(
+                a, (0, 0, y0, y0),
+                (n, c, ho * stride1, wo * stride1))
+            bb = lax.dynamic_slice(
+                b, (0, 0, y0 + dy + d, y0 + dx + d),
+                (n, c, ho * stride1, wo * stride1))
+            if kernel_size > 1:
+                win = kernel_size
+                prod = aa * bb if is_multiply else jnp.abs(aa - bb)
+                prod = lax.reduce_window(
+                    prod, 0.0, lax.add, (1, 1, win, win), (1, 1, 1, 1),
+                    [(0, 0), (0, 0), (k2, k2), (k2, k2)])
+                prod = prod / (win * win)
+            else:
+                prod = aa * bb if is_multiply else jnp.abs(aa - bb)
+            outs.append(prod[:, :, ::stride1, ::stride1].mean(axis=1))
+    return jnp.stack(outs, axis=1)
+
+
+# --------------------------------------------------------- count_sketch
+@register_op("_contrib_count_sketch", differentiable=False)
+def count_sketch(data, h, s, *, out_dim, processing_batch_size=32):
+    """Reference: src/operator/contrib/count_sketch.cc (compact
+    bilinear pooling): out[:, h[i]] += s[i] * data[:, i]."""
+    idx = h.reshape(-1).astype(jnp.int32)
+    sign = s.reshape(-1).astype(data.dtype)
+    contrib = data * sign[None, :]
+    out = jnp.zeros((data.shape[0], int(out_dim)), data.dtype)
+    return out.at[:, idx].add(contrib)
+
+
+# ------------------------------------- interleaved multi-head attention
+@register_op("_contrib_interleaved_matmul_selfatt_qk")
+def interleaved_matmul_selfatt_qk(queries_keys_values, *, heads):
+    """Reference: transformer-inl.h InterleavedMatMulSelfAttQK — input
+    (L, B, heads*3*dim) with per-head interleaved [q; k; v]; output
+    (B*heads, L, L) scaled q.k^T."""
+    ln, b, e = queries_keys_values.shape
+    d = e // heads // 3
+    qkv = queries_keys_values.reshape(ln, b, heads, 3, d)
+    q = qkv[:, :, :, 0]
+    k = qkv[:, :, :, 1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32)).astype(
+        queries_keys_values.dtype)
+    scores = jnp.einsum("lbhd,mbhd->bhlm", q * scale, k)
+    return scores.reshape(b * heads, ln, ln)
+
+
+@register_op("_contrib_interleaved_matmul_selfatt_valatt")
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, *,
+                                      heads):
+    """Reference: InterleavedMatMulSelfAttValAtt — attention
+    (B*heads, L, L) applied to the interleaved values; output
+    (L, B, heads*dim)."""
+    ln, b, e = queries_keys_values.shape
+    d = e // heads // 3
+    v = queries_keys_values.reshape(ln, b, heads, 3, d)[:, :, :, 2]
+    att = attention.reshape(b, heads, ln, ln)
+    out = jnp.einsum("bhlm,mbhd->lbhd", att, v)
+    return out.reshape(ln, b, heads * d)
+
+
+@register_op("_contrib_interleaved_matmul_encdec_qk")
+def interleaved_matmul_encdec_qk(queries, keys_values, *, heads):
+    """Reference: InterleavedMatMulEncDecQK — queries (Lq, B, heads*dim),
+    keys_values (Lk, B, heads*2*dim) interleaved [k; v]; output
+    (B*heads, Lq, Lk)."""
+    lq, b, eq = queries.shape
+    d = eq // heads
+    lk = keys_values.shape[0]
+    q = queries.reshape(lq, b, heads, d)
+    k = keys_values.reshape(lk, b, heads, 2, d)[:, :, :, 0]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32)).astype(
+        queries.dtype)
+    scores = jnp.einsum("lbhd,mbhd->bhlm", q * scale, k)
+    return scores.reshape(b * heads, lq, lk)
+
+
+@register_op("_contrib_interleaved_matmul_encdec_valatt")
+def interleaved_matmul_encdec_valatt(keys_values, attention, *, heads):
+    """Reference: InterleavedMatMulEncDecValAtt."""
+    lk, b, e = keys_values.shape
+    d = e // heads // 2
+    v = keys_values.reshape(lk, b, heads, 2, d)[:, :, :, 1]
+    lq = attention.shape[1]
+    att = attention.reshape(b, heads, lq, lk)
+    out = jnp.einsum("bhlm,mbhd->lbhd", att, v)
+    return out.reshape(lq, b, heads * d)

@@ -23,21 +23,32 @@ _GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
 
 
 def unpack_rnn_params(params, mode, num_layers, input_size, state_size,
-                      bidirectional=False):
-    """Split the flat parameter vector into per-layer weight/bias arrays."""
+                      bidirectional=False, projection_size=None):
+    """Split the flat parameter vector into per-layer weight/bias arrays.
+
+    With ``projection_size=r`` (LSTMP, reference rnn-inl.h:444-476) the
+    recurrent input is the projected hidden of size r: per layer/dir
+    W_i2h (G*H, in), W_h2h (G*H, r), W_proj (r, H); biases unchanged.
+    """
     g = _GATES[mode]
     d = 2 if bidirectional else 1
     h = state_size
+    r = projection_size if projection_size else h
     ws, bs = [], []
     off = 0
     for layer in range(num_layers):
-        ins = input_size if layer == 0 else h * d
+        ins = input_size if layer == 0 else r * d
         for _ in range(d):
             w_i2h = params[off:off + g * h * ins].reshape(g * h, ins)
             off += g * h * ins
-            w_h2h = params[off:off + g * h * h].reshape(g * h, h)
-            off += g * h * h
-            ws.append((w_i2h, w_h2h))
+            w_h2h = params[off:off + g * h * r].reshape(g * h, r)
+            off += g * h * r
+            if projection_size:
+                w_proj = params[off:off + r * h].reshape(r, h)
+                off += r * h
+            else:
+                w_proj = None
+            ws.append((w_i2h, w_h2h, w_proj))
     for layer in range(num_layers):
         for _ in range(d):
             b_i2h = params[off:off + g * h]
@@ -49,28 +60,34 @@ def unpack_rnn_params(params, mode, num_layers, input_size, state_size,
 
 
 def rnn_param_size(mode, num_layers, input_size, state_size,
-                   bidirectional=False):
+                   bidirectional=False, projection_size=None):
     g = _GATES[mode]
     d = 2 if bidirectional else 1
     h = state_size
+    r = projection_size if projection_size else h
     size = 0
     for layer in range(num_layers):
-        ins = input_size if layer == 0 else h * d
-        size += d * (g * h * ins + g * h * h + 2 * g * h)
+        ins = input_size if layer == 0 else r * d
+        size += d * (g * h * ins + g * h * r + 2 * g * h)
+        if projection_size:
+            size += d * r * h
     return size
 
 
-def _cell_step(mode, w_i2h, w_h2h, b_i2h, b_h2h, x, h_prev, c_prev):
+def _cell_step(mode, w_i2h, w_h2h, b_i2h, b_h2h, x, h_prev, c_prev,
+               w_proj=None):
     gi = jnp.dot(x, w_i2h.T) + b_i2h
     gh = jnp.dot(h_prev, w_h2h.T) + b_h2h
-    hsz = w_h2h.shape[1]
     if mode == "lstm":
         z = gi + gh
         i, f, g, o = jnp.split(z, 4, axis=-1)
         i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
         g = jnp.tanh(g)
         c = f * c_prev + i * g
-        return o * jnp.tanh(c), c
+        h = o * jnp.tanh(c)
+        if w_proj is not None:  # LSTMP recurrent projection
+            h = jnp.dot(h, w_proj.T)
+        return h, c
     if mode == "gru":
         ri, zi, ni = jnp.split(gi, 3, axis=-1)
         rh, zh, nh = jnp.split(gh, 3, axis=-1)
@@ -83,12 +100,12 @@ def _cell_step(mode, w_i2h, w_h2h, b_i2h, b_h2h, x, h_prev, c_prev):
 
 
 def _run_layer(mode, wb, x, h0, c0, reverse=False):
-    (w_i2h, w_h2h), (b_i2h, b_h2h) = wb
+    (w_i2h, w_h2h, w_proj), (b_i2h, b_h2h) = wb
 
     def step(carry, xt):
         h_prev, c_prev = carry
         h, c = _cell_step(mode, w_i2h, w_h2h, b_i2h, b_h2h, xt, h_prev,
-                          c_prev)
+                          c_prev, w_proj)
         return (h, c), h
 
     (hT, cT), ys = jax.lax.scan(step, (h0, c0), x, reverse=reverse)
@@ -113,8 +130,11 @@ def rnn(data, parameters, state, state_cell=None, *, state_size, num_layers,
     [+ final h [+ final c for lstm] when state_outputs]."""
     t, n, input_size = data.shape
     d = 2 if bidirectional else 1
+    if projection_size is not None and mode != "lstm":
+        raise ValueError("projection_size is LSTM-only (rnn-inl.h:444)")
     ws, bs = unpack_rnn_params(parameters, mode, num_layers, input_size,
-                               state_size, bidirectional)
+                               state_size, bidirectional,
+                               projection_size)
     x = data
     h_fin, c_fin = [], []
     for layer in range(num_layers):
@@ -122,8 +142,12 @@ def rnn(data, parameters, state, state_cell=None, *, state_size, num_layers,
         for direction in range(d):
             idx = layer * d + direction
             h0 = state[idx]
-            c0 = state_cell[idx] if (mode == "lstm" and state_cell is not None) \
-                else jnp.zeros_like(h0)
+            if mode == "lstm" and state_cell is not None:
+                c0 = state_cell[idx]
+            elif projection_size is not None:
+                c0 = jnp.zeros((n, state_size), h0.dtype)
+            else:
+                c0 = jnp.zeros_like(h0)
             ys, hT, cT = _run_layer(mode, (ws[idx], bs[idx]), x, h0, c0,
                                     reverse=(direction == 1))
             outs.append(ys)

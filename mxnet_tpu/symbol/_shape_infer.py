@@ -83,10 +83,12 @@ def _deduce_param_shapes(op, attrs, input_shapes, slot_names):
         nl = attrs.get("num_layers", 1)
         h = attrs["state_size"]
         bi = attrs.get("bidirectional", False)
+        proj = attrs.get("projection_size")
+        r = proj if proj else h
         d = 2 if bi else 1
         t, n, input_size = data
-        out[1] = (rnn_param_size(mode, nl, input_size, h, bi),)
-        out[2] = (nl * d, n, h)
+        out[1] = (rnn_param_size(mode, nl, input_size, h, bi, proj),)
+        out[2] = (nl * d, n, r)
         out[3] = (nl * d, n, h)
     return out
 

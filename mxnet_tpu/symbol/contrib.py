@@ -28,9 +28,7 @@ def _expose_contrib():
                 setattr(_this, short, _make_sym_func(name))
 
 
-def _listify(x):
-    single = not isinstance(x, (list, tuple))
-    return ([x] if single else list(x)), single
+from ..ops.control_flow_ops import _states_list as _listify  # noqa: E402
 
 
 def _subgraph_extras(sub, local_names):

@@ -239,6 +239,13 @@ class Symbol:
     def __hash__(self):
         return id(self._node) ^ hash(self._out)
 
+    def __bool__(self):
+        # __eq__ builds a graph node, so truthiness of a comparison is
+        # meaningless — fail loudly (reference NotImplementedForSymbol)
+        raise MXNetError(
+            "a Symbol has no boolean value; use `is`/`is not` for "
+            "identity, or execute the graph for elementwise comparison")
+
     # ------------------------------------------------------- evaluation
     def _eval(self, value_of):
         """Evaluate outputs given a dict node->list[jax value] resolver."""
