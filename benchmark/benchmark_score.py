@@ -42,7 +42,17 @@ def score_model(model_name, batches, dtypes, image_shape=(3, 224, 224),
     net.initialize(init=mx.init.Xavier(), ctx=ctx)
     net(mx.nd.zeros((1,) + image_shape, ctx=ctx))
     params0, apply_fn = functionalize(net, train=False)
-    fwd = jax.jit(lambda p, xx: apply_fn(p, xx))
+
+    # honest timing (see bench.py): block_until_ready does not drain on
+    # the axon tunnel, so each forward is CHAINED into the next input
+    # and the final loss-like scalar is materialized; the marginal
+    # cost per step comes from a two-K sweep, cancelling readback
+    # latency.
+    def chained(p, x, eps):
+        out = apply_fn(p, x + eps.astype(x.dtype))
+        return out.astype(jnp.float32).sum() * 1e-12
+
+    cfwd = jax.jit(chained)
 
     for dtype in dtypes:
         cdtype = jnp.dtype(dtype)
@@ -51,15 +61,24 @@ def score_model(model_name, batches, dtypes, image_shape=(3, 224, 224),
         for batch in batches:
             x = jnp.asarray(onp.random.rand(batch, *image_shape),
                             dtype=cdtype)
-            out = fwd(params, x)
-            jax.block_until_ready(out)
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                out = fwd(params, x)
-            jax.block_until_ready(out)
-            dt = time.perf_counter() - t0
+
+            def run(k):
+                eps = jnp.float32(0)
+                t0 = time.perf_counter()
+                for _ in range(k):
+                    eps = cfwd(params, x, eps)
+                _ = float(eps)  # drain the device pipeline
+                return time.perf_counter() - t0
+
+            run(1)
+            trials = []
+            for _ in range(3):
+                t1, t2 = run(3), run(3 + steps)
+                trials.append((t2 - t1) / steps)
+            dt = sorted(trials)[1]
             yield {"model": model_name, "batch": batch, "dtype": dtype,
-                   "throughput": round(batch * steps / dt, 2),
+                   "throughput": round(batch / dt, 2),
+                   "ms_per_batch": round(dt * 1e3, 3),
                    "unit": "img/s"}
 
 

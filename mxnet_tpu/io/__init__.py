@@ -1,3 +1,5 @@
 """Data iterators (reference: python/mxnet/io/)."""
 from .io import *  # noqa: F401,F403
-from .image_record_iter import ImageRecordIter  # noqa: F401
+from .image_record_iter import (  # noqa: F401
+    ImageDetRecordIter, ImageRecordIter)
+from .iterators import CSVIter, LibSVMIter, MNISTIter  # noqa: F401

@@ -26,7 +26,7 @@ from .. import recordio
 from ..base import MXNetError
 from .io import DataBatch, DataDesc, DataIter
 
-__all__ = ["ImageRecordIter"]
+__all__ = ["ImageDetRecordIter", "ImageRecordIter"]
 
 
 class ImageRecordIter(DataIter):
@@ -87,7 +87,10 @@ class ImageRecordIter(DataIter):
         self._queue = None
         self._worker = None
         self._stop = threading.Event()
-        self.reset()
+        if not getattr(self, "_defer_start", False):
+            # subclasses with extra config (ImageDetRecordIter) start
+            # the producer themselves once fully constructed
+            self.reset()
 
     def _parse_python(self):
         # pure-python fallback: ONE source of framing truth —
@@ -114,7 +117,6 @@ class ImageRecordIter(DataIter):
 
     def _producer_impl(self):
         bs = self.batch_size
-        c, h, w = self.data_shape
         order = self._order
         n = len(order)
         i = 0
@@ -128,23 +130,30 @@ class ImageRecordIter(DataIter):
                 # when the dataset/shard is smaller than a batch
                 idx = onp.concatenate([idx, onp.resize(order, pad)])
             # round_batch=False: final batch is genuinely smaller, pad=0
-            out_rows = len(idx)
-            jpegs, labels = [], []
-            for j in idx:
-                header, img = recordio.unpack(bytes(self._records[j]))
-                jpegs.append(img)
-                lab = onp.atleast_1d(onp.asarray(header.label, "float32"))
-                labels.append(lab[:self.label_width])
-            batch = self._decode_batch(jpegs, h, w)
-            lab_arr = onp.zeros((out_rows, self.label_width), "float32")
-            for k, lab in enumerate(labels):
-                lab_arr[k, :len(lab)] = lab
+            batch, lab_arr = self._make_batch(idx)
             if self._stop.is_set():
                 break
             self._queue.put((batch, lab_arr,
                              pad if self._round_batch else 0))
         if not self._stop.is_set():
             self._queue.put(None)
+
+    def _make_batch(self, idx):
+        """Decode+augment one index batch; subclasses override for
+        different label/augment semantics (ImageDetRecordIter)."""
+        c, h, w = self.data_shape
+        out_rows = len(idx)
+        jpegs, labels = [], []
+        for j in idx:
+            header, img = recordio.unpack(bytes(self._records[j]))
+            jpegs.append(img)
+            lab = onp.atleast_1d(onp.asarray(header.label, "float32"))
+            labels.append(lab[:self.label_width])
+        batch = self._decode_batch(jpegs, h, w)
+        lab_arr = onp.zeros((out_rows, self.label_width), "float32")
+        for k, lab in enumerate(labels):
+            lab_arr[k, :len(lab)] = lab
+        return batch, lab_arr
 
     def _decode_batch(self, jpegs, h, w):
         from .. import _native
@@ -234,7 +243,7 @@ class ImageRecordIter(DataIter):
         data = nd.array(batch.astype(self._dtype)
                         if self._dtype != "float32" else batch,
                         dtype=self._dtype)
-        lab = nd.array(labels[:, 0] if self.label_width == 1 else labels)
+        lab = nd.array(labels[:, 0] if (self.label_width == 1 and labels.ndim == 2) else labels)
         return DataBatch(data=[data], label=[lab], pad=pad)
 
     def close(self):
@@ -249,3 +258,114 @@ class ImageRecordIter(DataIter):
         self._records = None  # release memoryviews into the mmap
         self._mm.close()
         self._file.close()
+
+
+class ImageDetRecordIter(ImageRecordIter):
+    """Detection RecordIO iterator (reference
+    src/io/iter_image_det_recordio.cc:597).
+
+    Record label layout (the im2rec detection convention): a flat float
+    vector ``[header_width, object_width, <extra header...>,
+    obj0(object_width values: id, xmin, ymin, xmax, ymax, ...), ...]``
+    with normalized corner coordinates.  Batches emit labels shaped
+    (batch, max_objects, object_width) padded with -1 — what
+    MultiBoxTarget consumes.
+
+    Augmentation is bbox-aware: images are plain-resized to data_shape
+    (no crop — the reference's det-crop sampler with min_object_covered
+    is out of scope this round) and ``rand_mirror`` flips the image AND
+    remaps [xmin, xmax] -> [1-xmax, 1-xmin].
+    """
+
+    _defer_start = True  # producer starts after det config is set
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 label_pad_width=0, object_width=5, shuffle=False,
+                 rand_mirror=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0, label_width=-1,
+                 round_batch=True, part_index=0, num_parts=1, seed=0,
+                 dtype="float32", **kwargs):
+        if kwargs.get("rand_crop"):
+            raise MXNetError(
+                "ImageDetRecordIter: rand_crop is not bbox-aware yet; "
+                "use rand_mirror")
+        super().__init__(
+            path_imgrec, data_shape, batch_size, shuffle=shuffle,
+            rand_crop=False, rand_mirror=False, resize=-1,
+            mean_r=mean_r, mean_g=mean_g, mean_b=mean_b, std_r=std_r,
+            std_g=std_g, std_b=std_b, label_width=1,
+            round_batch=round_batch, part_index=part_index,
+            num_parts=num_parts, seed=seed, dtype=dtype)
+        self._det_mirror = rand_mirror
+        self._object_width = int(object_width)
+        if label_pad_width:
+            self._max_objs = (int(label_pad_width) - 2) \
+                // self._object_width
+        else:
+            self._max_objs = self._scan_max_objs()
+        self.reset()  # start the producer (deferred in the base init)
+
+    def _scan_max_objs(self):
+        m = 1
+        for rec in self._records:
+            # header-only read: unpack slices, so passing the
+            # memoryview avoids copying the JPEG payload
+            header, _ = recordio.unpack(rec)
+            lab = onp.atleast_1d(onp.asarray(header.label, "float32"))
+            if lab.size >= 2:
+                ow = int(lab[1])
+                hw = int(lab[0])
+                m = max(m, (lab.size - hw) // max(ow, 1))
+        return m
+
+    def _parse_det_label(self, lab):
+        lab = onp.atleast_1d(onp.asarray(lab, "float32"))
+        ow = self._object_width
+        out = onp.full((self._max_objs, ow), -1.0, "float32")
+        if lab.size < 2:
+            return out
+        hw = int(lab[0])
+        rec_ow = max(int(lab[1]), 1)  # zero guard: malformed record
+        objs = lab[hw:]
+        nobj = min(objs.size // rec_ow, self._max_objs)
+        for k in range(nobj):
+            out[k, :min(ow, rec_ow)] = objs[k * rec_ow:
+                                            k * rec_ow + min(ow, rec_ow)]
+        return out
+
+    def _make_batch(self, idx):
+        from .. import image as img_mod
+
+        c, h, w = self.data_shape
+        out_rows = len(idx)
+        batch = onp.zeros((out_rows, 3, h, w), "float32")
+        labels = onp.full(
+            (out_rows, self._max_objs, self._object_width), -1.0,
+            "float32")
+        mirror = ((self._rng.rand(out_rows) < 0.5)
+                  if self._det_mirror
+                  else onp.zeros(out_rows, bool))
+        for k, j in enumerate(idx):
+            header, img = recordio.unpack(bytes(self._records[j]))
+            im = img_mod.imdecode(img)
+            im = img_mod.imresize(im, w, h)
+            arr = im.asnumpy().astype("float32")
+            lab = self._parse_det_label(header.label)
+            if mirror[k]:
+                arr = arr[:, ::-1]
+                valid = lab[:, 0] >= 0
+                xmin = lab[valid, 1].copy()
+                xmax = lab[valid, 3].copy()
+                lab[valid, 1] = 1.0 - xmax
+                lab[valid, 3] = 1.0 - xmin
+            arr = (arr - self._mean) / self._std
+            batch[k] = arr.transpose(2, 0, 1)
+            labels[k] = lab
+        return batch, labels
+
+    @property
+    def provide_label(self):
+        return [DataDesc(
+            "label",
+            (self.batch_size, self._max_objs, self._object_width),
+            "float32")]

@@ -1,0 +1,213 @@
+"""CSVIter / LibSVMIter / MNISTIter / ImageDetRecordIter.
+
+Reference: src/io/iter_csv.cc, iter_libsvm.cc, iter_mnist.cc,
+iter_image_det_recordio.cc + tests/python/unittest/test_io.py.
+"""
+import gzip
+import os
+import struct
+import tempfile
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.io import CSVIter, ImageDetRecordIter, LibSVMIter, MNISTIter
+
+
+def test_csv_iter_batches_and_pad():
+    d = tempfile.mkdtemp()
+    data = onp.arange(70, dtype="float32").reshape(10, 7)
+    lab = onp.arange(10, dtype="float32")
+    onp.savetxt(os.path.join(d, "d.csv"), data, delimiter=",")
+    onp.savetxt(os.path.join(d, "l.csv"), lab, delimiter=",")
+    it = CSVIter(data_csv=os.path.join(d, "d.csv"), data_shape=(7,),
+                 label_csv=os.path.join(d, "l.csv"), batch_size=4)
+    batches = list(it)
+    assert len(batches) == 3
+    onp.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:4])
+    onp.testing.assert_allclose(batches[0].label[0].asnumpy(), lab[:4])
+    assert batches[2].pad == 2  # 10 rows, bs 4 -> last wraps 2
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_csv_iter_feeds_module_fit():
+    d = tempfile.mkdtemp()
+    onp.random.seed(0)
+    x = onp.random.rand(32, 6).astype("float32")
+    w_true = onp.random.rand(6, 3).astype("float32")
+    y = onp.argmax(x @ w_true, axis=1).astype("float32")
+    onp.savetxt(os.path.join(d, "d.csv"), x, delimiter=",")
+    onp.savetxt(os.path.join(d, "l.csv"), y, delimiter=",")
+    it = CSVIter(data_csv=os.path.join(d, "d.csv"), data_shape=(6,),
+                 label_csv=os.path.join(d, "l.csv"), batch_size=8)
+
+    from mxnet_tpu import symbol as sym
+
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(sym.var("data"), num_hidden=3), name="softmax")
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(it, num_epoch=6,
+            optimizer_params={"learning_rate": 0.5})
+    score = mod.score(it, mx.metric.create("acc"))
+    acc = dict(score)["accuracy"] if isinstance(score, list) else \
+        score[0][1]
+    assert acc > 0.5
+
+
+def test_libsvm_iter():
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "d.svm")
+    with open(path, "w") as f:
+        f.write("1 0:1.5 3:2.0\n")
+        f.write("0 1:1.0\n")
+        f.write("1 2:3.0 4:4.0\n")
+    it = LibSVMIter(data_libsvm=path, data_shape=(5,), batch_size=3)
+    b = next(it)
+    onp.testing.assert_allclose(
+        b.data[0].asnumpy(),
+        [[1.5, 0, 0, 2.0, 0], [0, 1.0, 0, 0, 0], [0, 0, 3.0, 0, 4.0]])
+    onp.testing.assert_allclose(b.label[0].asnumpy(), [1, 0, 1])
+
+
+def _write_idx(path, arr, gz=False):
+    ndim = arr.ndim
+    magic = 0x0800 | ndim
+    hdr = struct.pack(">i", magic) + b"".join(
+        struct.pack(">i", d) for d in arr.shape)
+    payload = hdr + arr.astype("uint8").tobytes()
+    if gz:
+        with gzip.open(path, "wb") as f:
+            f.write(payload)
+    else:
+        with open(path, "wb") as f:
+            f.write(payload)
+
+
+@pytest.mark.parametrize("gz", [False, True])
+def test_mnist_iter(gz):
+    d = tempfile.mkdtemp()
+    imgs = onp.random.randint(0, 256, (20, 28, 28)).astype("uint8")
+    labs = onp.random.randint(0, 10, (20,)).astype("uint8")
+    suffix = ".gz" if gz else ""
+    ip = os.path.join(d, "img-idx" + suffix)
+    lp = os.path.join(d, "lab-idx" + suffix)
+    _write_idx(ip, imgs, gz)
+    _write_idx(lp, labs, gz)
+    it = MNISTIter(image=ip, label=lp, batch_size=5)
+    b = next(it)
+    assert b.data[0].shape == (5, 1, 28, 28)
+    onp.testing.assert_allclose(b.data[0].asnumpy(),
+                                imgs[:5, None] / 255.0, rtol=1e-6)
+    onp.testing.assert_allclose(b.label[0].asnumpy(), labs[:5])
+    flat = MNISTIter(image=ip, label=lp, batch_size=4, flat=True)
+    assert next(flat).data[0].shape == (4, 784)
+
+
+def _make_det_rec(path, n=8, size=32):
+    """Pack a tiny detection .rec: colored squares with their bboxes."""
+    from mxnet_tpu import recordio
+
+    rec = recordio.MXRecordIO(path, "w")
+    rng = onp.random.RandomState(0)
+    boxes = []
+    for i in range(n):
+        img = onp.zeros((size, size, 3), "uint8")
+        x0, y0 = rng.randint(2, size // 2, 2)
+        x1, y1 = x0 + size // 4, y0 + size // 4
+        img[y0:y1, x0:x1] = (0, 0, 255)  # pack_img is cv2-BGR: red
+        bb = (x0 / size, y0 / size, x1 / size, y1 / size)
+        boxes.append(bb)
+        label = onp.array([2, 5, 0, bb[0], bb[1], bb[2], bb[3]],
+                          "float32")
+        header = recordio.IRHeader(0, label, i, 0)
+        rec.write(recordio.pack_img(header, img, quality=95))
+    rec.close()
+    return boxes
+
+
+def test_image_det_record_iter():
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "det.rec")
+    boxes = _make_det_rec(path, n=8)
+    it = ImageDetRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                            batch_size=4)
+    b = next(it)
+    assert b.data[0].shape == (4, 3, 32, 32)
+    lab = b.label[0].asnumpy()
+    assert lab.shape == (4, 1, 5)
+    for k in range(4):
+        assert lab[k, 0, 0] == 0  # class id
+        onp.testing.assert_allclose(lab[k, 0, 1:], boxes[k], atol=0.02)
+    it.close()
+
+
+def test_image_det_record_iter_mirror_flips_boxes():
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "det.rec")
+    boxes = _make_det_rec(path, n=8)
+    it = ImageDetRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                            batch_size=8, rand_mirror=True, seed=3)
+    b = next(it)
+    lab = b.label[0].asnumpy()
+    img = b.data[0].asnumpy()
+    flipped = 0
+    for k in range(8):
+        x0, y0, x1, y1 = lab[k, 0, 1:]
+        assert x1 > x0 and y1 > y0  # mirrored boxes stay well-formed
+        # red square must sit where the bbox claims
+        cx = int((x0 + x1) / 2 * 32)
+        cy = int((y0 + y1) / 2 * 32)
+        assert img[k, 0, cy, cx] > 100  # red channel present
+        if not onp.allclose([x0, y0, x1, y1], boxes[k], atol=0.04):
+            flipped += 1
+    assert flipped > 0  # some images actually mirrored
+    it.close()
+
+
+def test_ssd_trains_from_det_rec():
+    """The VERDICT 'done' case: the SSD recipe consumes .rec batches
+    with bbox-aware labels."""
+    from mxnet_tpu import autograd, gluon
+
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "det.rec")
+    _make_det_rec(path, n=8, size=96)
+    it = ImageDetRecordIter(path_imgrec=path, data_shape=(3, 96, 96),
+                            batch_size=4, rand_mirror=True,
+                            std_r=255.0, std_g=255.0, std_b=255.0)
+    net = gluon.model_zoo.vision.get_model("ssd_300_resnet18",
+                                           num_classes=1)
+    net.initialize(init=mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01})
+    cls_loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for epoch in range(3):
+        it.reset()
+        epoch_loss, nb = 0.0, 0
+        for batch in it:
+            x = batch.data[0]
+            y = batch.label[0]
+            with autograd.record():
+                cls_preds, loc_preds, anchors = net(x)
+                loc_t, loc_m, cls_t = net.training_targets(
+                    anchors, cls_preds, y)
+                lc = cls_loss(cls_preds.reshape((-1, 2)),
+                              cls_t.reshape((-1,)))
+                keep = (cls_t.reshape((-1,)) >= 0)
+                npos = (cls_t > 0).sum() + 1e-6
+                lc = (lc * keep).sum() / npos
+                ll = (mx.nd.smooth_l1((loc_preds - loc_t) * loc_m,
+                                      scalar=1.0)).sum() / npos
+                loss = lc + ll
+            loss.backward()
+            trainer.step(x.shape[0])
+            epoch_loss += float(loss.asnumpy())
+            nb += 1
+        losses.append(epoch_loss / nb)
+    assert losses[-1] < losses[0], losses
+    it.close()

@@ -277,8 +277,11 @@ def test_rtc_cuda_module_raises():
         rtc.CudaModule("__global__ void k() {}")
 
 
-def test_onnx_gated():
+def test_onnx_is_real_now():
+    # round 3 replaced the import-gate with a vendored-schema
+    # implementation (tests/test_onnx.py covers roundtrips)
     from mxnet_tpu.contrib import onnx as onnx_mod
 
-    with pytest.raises(MXNetError, match="onnx"):
-        onnx_mod.export_model(None, None, None)
+    assert callable(onnx_mod.export_model)
+    assert callable(onnx_mod.import_model)
+    assert callable(onnx_mod.check_model)
