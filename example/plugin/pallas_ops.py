@@ -1,0 +1,36 @@
+"""Example operator plugin for mx.library.load — the lib_api.h analog.
+
+Registers a Pallas TPU kernel (scaled residual-add) plus a plain jnp op;
+loaded ops appear in mx.nd / mx.sym immediately.
+
+    import mxnet_tpu as mx
+    mx.library.load("example/plugin/pallas_ops.py")
+    mx.nd.plugin_scaled_add(a, b, scale=2.0)
+"""
+import jax
+import jax.numpy as jnp
+
+
+def _scaled_add_pallas(x, y, scale):
+    """Pallas kernel when the backend supports Mosaic; jnp fallback."""
+    try:
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, y_ref, o_ref):
+            o_ref[...] = x_ref[...] + y_ref[...] * scale
+
+        return pl.pallas_call(
+            kernel, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype)
+        )(x, y)
+    except Exception:
+        return x + y * scale
+
+
+def register_ops(registry):
+    @registry.register_op("plugin_scaled_add")
+    def plugin_scaled_add(x, y, *, scale=1.0):
+        return _scaled_add_pallas(x, y, jnp.asarray(scale, x.dtype))
+
+    @registry.register_op("plugin_swish")
+    def plugin_swish(x, *, beta=1.0):
+        return x * jax.nn.sigmoid(beta * x)

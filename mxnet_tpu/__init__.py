@@ -80,6 +80,8 @@ _LAZY = {
     "amp": ".contrib.amp",
     "operator": ".operator",
     "rtc": ".rtc",
+    "library": ".library",
+    "deploy": ".deploy",
 }
 
 

@@ -1,0 +1,96 @@
+"""Deployment: serialized compiled inference artifacts.
+
+Reference: include/mxnet/c_predict_api.h:348 + amalgamation/ — the
+reference ships a C ABI predictor that loads symbol-JSON + params with
+no Python.  TPU-native translation: ``jax.export`` serializes the
+traced+lowered StableHLO of a model's forward into a self-contained
+artifact; the loader needs jax (any language with a StableHLO runtime
+can also consume ``stablehlo_text``), not the model's Python code —
+the same deploy-without-model-source contract the predict API serves.
+
+    path = mx.deploy.export_model(net, example_x, "model.mxje")
+    f = mx.deploy.load_model(path)     # -> callable on nd/np arrays
+    y = f(x)
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .base import MXNetError
+
+__all__ = ["export_model", "load_model", "stablehlo_text"]
+
+
+def _functional_forward(net):
+    from .parallel import functionalize
+
+    params, apply_fn = functionalize(net, train=False)
+    return params, apply_fn
+
+
+def export_model(net, example_input, path, platforms=("cpu", "tpu")):
+    """Serialize ``net``'s inference forward (weights baked in) to
+    ``path`` via jax.export.  ``example_input`` fixes shapes/dtypes
+    (ndarray / numpy).  The default multi-platform lowering makes one
+    artifact loadable on CPU hosts and TPU workers alike.  Returns
+    ``path``."""
+    import jax
+    from jax import export as jexport
+
+    from .ndarray import NDArray
+
+    x = example_input._data if isinstance(example_input, NDArray) \
+        else jax.numpy.asarray(onp.asarray(example_input))
+    params, apply_fn = _functional_forward(net)
+
+    def infer(xv):
+        return apply_fn(params, xv)
+
+    exp = jexport.export(
+        jax.jit(infer),
+        platforms=platforms)(jax.ShapeDtypeStruct(x.shape, x.dtype))
+    blob = exp.serialize()
+    with open(path, "wb") as f:
+        f.write(blob)
+    return path
+
+
+def load_model(path):
+    """Load a serialized artifact; returns ``f(x) -> NDArray`` (no
+    model Python code needed — the artifact carries the program and
+    the weights as constants)."""
+    from jax import export as jexport
+
+    from .ndarray import NDArray
+
+    with open(path, "rb") as f:
+        blob = f.read()
+    exp = jexport.deserialize(blob)
+
+    def run(x):
+        import jax.numpy as jnp
+
+        xv = x._data if isinstance(x, NDArray) else jnp.asarray(
+            onp.asarray(x))
+        out = exp.call(xv)
+        if isinstance(out, (tuple, list)):
+            return [NDArray(o) for o in out]
+        return NDArray(out)
+
+    return run
+
+
+def stablehlo_text(net, example_input):
+    """The StableHLO MLIR of the inference forward — the
+    language-neutral exchange format (any StableHLO runtime can
+    compile it; the analog of shipping the amalgamated predictor)."""
+    import jax
+
+    from .ndarray import NDArray
+
+    x = example_input._data if isinstance(example_input, NDArray) \
+        else jax.numpy.asarray(onp.asarray(example_input))
+    params, apply_fn = _functional_forward(net)
+    lowered = jax.jit(lambda xv: apply_fn(params, xv)).lower(
+        jax.ShapeDtypeStruct(x.shape, x.dtype))
+    return lowered.as_text()

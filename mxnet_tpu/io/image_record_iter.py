@@ -337,8 +337,12 @@ class ImageDetRecordIter(ImageRecordIter):
         from .. import image as img_mod
 
         c, h, w = self.data_shape
+        if c != 3:
+            raise MXNetError(
+                "ImageDetRecordIter decodes 3-channel images; "
+                f"data_shape[0]={c}")
         out_rows = len(idx)
-        batch = onp.zeros((out_rows, 3, h, w), "float32")
+        batch = onp.zeros((out_rows, c, h, w), "float32")
         labels = onp.full(
             (out_rows, self._max_objs, self._object_width), -1.0,
             "float32")

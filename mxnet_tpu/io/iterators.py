@@ -65,14 +65,13 @@ class _ArrayFeedIter(DataIter):
         idx = self._order[self._cursor:end]
         pad = 0
         if end > n:
-            pad = end - n
             if self._round_batch:
-                # onp.resize cycles when the dataset is smaller than
-                # the remaining pad (same as ImageRecordIter)
+                # wrap and report pad; onp.resize cycles when the
+                # dataset is smaller than the remaining pad (same
+                # semantics as ImageRecordIter)
+                pad = end - n
                 idx = onp.concatenate([idx, onp.resize(self._order, pad)])
-            else:
-                idx = onp.concatenate(
-                    [idx, onp.resize(idx, pad)])
+            # round_batch=False: final batch genuinely smaller, pad=0
         self._cursor = end
         return DataBatch(
             data=[nd.array(self._data[idx])],
@@ -90,9 +89,8 @@ class CSVIter(_ArrayFeedIter):
     def __init__(self, data_csv, data_shape, batch_size, label_csv=None,
                  label_shape=(1,), shuffle=False, round_batch=True,
                  seed=0, dtype="float32", **kwargs):
-        raw = onp.genfromtxt(data_csv, delimiter=",", dtype=dtype)
-        if raw.ndim == 1:
-            raw = raw[:, None]
+        raw = onp.loadtxt(data_csv, delimiter=",", dtype=dtype,
+                          ndmin=2)
         want = 1
         for d in data_shape:
             want *= int(d)
@@ -102,9 +100,8 @@ class CSVIter(_ArrayFeedIter):
                 f"data_shape {tuple(data_shape)}")
         data = raw.reshape((-1,) + tuple(int(d) for d in data_shape))
         if label_csv is not None:
-            lab = onp.genfromtxt(label_csv, delimiter=",", dtype=dtype)
-            if lab.ndim == 1:
-                lab = lab[:, None]
+            lab = onp.loadtxt(label_csv, delimiter=",", dtype=dtype,
+                              ndmin=2)
             lab = lab.reshape((-1,) + tuple(int(d) for d in label_shape))
             if len(lab) != len(data):
                 raise MXNetError("CSVIter: label/data row mismatch")

@@ -1,0 +1,105 @@
+"""Custom-operator library loading — the MXLoadLib analog.
+
+Reference: include/mxnet/lib_api.h + python/mxnet/library.py — the
+reference dlopens a C++ .so whose ``RegisterOp`` entry points add
+operators at runtime.  TPU-native translation: a plugin is a Python
+module (file path or import name) whose ops are jnp/lax/Pallas
+functions registered with ``mxnet_tpu.register_op`` — Pallas kernels
+ARE the TPU's native "custom kernel .so", and the registry is the same
+one every built-in op uses, so loaded ops appear in mx.nd / mx.sym /
+mx.np namespaces immediately.
+
+A plugin module may either:
+  * call ``mxnet_tpu.ops.registry.register_op`` at import time, or
+  * define ``register_ops(registry)`` which is called with the
+    registry module after import (the lib_api.h ``initialize`` hook).
+
+    # my_ops.py
+    import jax.numpy as jnp
+    def register_ops(registry):
+        @registry.register_op("my_scaled_gelu")
+        def my_scaled_gelu(x, *, scale=1.0):
+            import jax
+            return jax.nn.gelu(x) * scale
+
+    mx.library.load("my_ops.py")
+    mx.nd.my_scaled_gelu(mx.nd.ones((2, 2)), scale=0.5)
+"""
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import os
+import sys
+
+from .base import MXNetError
+
+__all__ = ["load", "compiled_with_cxx11_abi", "loaded_libraries"]
+
+_LOADED: dict[str, object] = {}
+
+
+def load(path, verbose=True):
+    """Load an operator plugin (reference MXLoadLib, library.py:29).
+
+    ``path``: a ``.py`` file path or an importable module name.
+    Returns the loaded module; ops it registers become visible in the
+    nd/sym/np namespaces right away.
+    """
+    from .ops import registry as _registry
+
+    key = os.path.abspath(path) if os.path.isfile(path) else path
+    if key in _LOADED:
+        return _LOADED[key]
+    before = set(_registry.list_ops())
+    if os.path.isfile(path):
+        name = "_mx_plugin_" + os.path.splitext(
+            os.path.basename(path))[0]
+        spec = importlib.util.spec_from_file_location(name, path)
+        if spec is None or spec.loader is None:
+            raise MXNetError(f"cannot load library {path!r}")
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        try:
+            spec.loader.exec_module(mod)
+        except Exception as e:
+            sys.modules.pop(name, None)
+            raise MXNetError(
+                f"library {path!r} failed to initialize: {e}") from e
+    else:
+        try:
+            mod = importlib.import_module(path)
+        except ImportError as e:
+            raise MXNetError(
+                f"{path!r} is neither a file nor an importable "
+                f"module: {e}") from e
+    hook = getattr(mod, "register_ops", None)
+    if callable(hook):
+        hook(_registry)
+    new_ops = sorted(set(_registry.list_ops()) - before)
+    if not new_ops:
+        raise MXNetError(
+            f"library {path!r} registered no operators (define "
+            "register_ops(registry) or call register_op at import)")
+    # expose in the generated namespaces (same path the built-in
+    # registry uses at import time)
+    from . import ndarray as _nd
+
+    _nd._expose_new_ops()
+    from .symbol import _op_namespace as _symns
+
+    _symns._expose_new_ops()
+    if verbose:
+        print(f"[mx.library] loaded {path!r}: {', '.join(new_ops)}")
+    _LOADED[key] = mod
+    return mod
+
+
+def loaded_libraries():
+    return dict(_LOADED)
+
+
+def compiled_with_cxx11_abi():
+    """Reference library.py surface; the TPU build has no C++ ABI
+    boundary for op plugins (they are jnp/Pallas python modules)."""
+    return False

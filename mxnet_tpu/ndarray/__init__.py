@@ -114,6 +114,22 @@ def _expose_all():
 
 _expose_all()
 
+
+def _expose_new_ops():
+    """Expose ops added after import (mx.library.load): only missing
+    names are generated — existing wrapper objects stay stable."""
+    for name in list_ops():
+        if not name.isidentifier() or hasattr(op, name):
+            continue
+        opdef = get_op(name)
+        fn = _make_op_func(opdef, name)
+        setattr(op, name, fn)
+        if name.startswith("_"):
+            setattr(_internal, name, fn)
+        if not hasattr(_this, name):
+            setattr(_this, name, fn)
+
+
 # ---------------------------------------------------------------- methods
 _METHOD_OPS = [
     "sum", "nansum", "mean", "max", "min", "prod", "nanprod", "argmax",

@@ -70,6 +70,22 @@ def _make_sym_func(opname):
 
 # NOTE: an op is literally named "_mod" — assign via globals() so no
 # module-alias variable can be shadowed by a generated function
+def _expose_new_ops():
+    """(Re)generate sym.<Op> functions — idempotent; called again by
+    mx.library.load for plugin ops.  Also patches the parent package
+    (mxnet_tpu.symbol), whose star-import copy of this namespace was
+    frozen at import time."""
+    import sys
+
+    pkg = sys.modules.get("mxnet_tpu.symbol")
+    for _name in list_ops():
+        if _name not in globals():
+            fn = _make_sym_func(_name)
+            globals()[_name] = fn
+            if pkg is not None and not hasattr(pkg, _name):
+                setattr(pkg, _name, fn)
+
+
 for _name in list_ops():
     _f = _make_sym_func(_name)
     globals()[_name] = _f

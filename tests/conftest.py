@@ -46,7 +46,7 @@ _TRAIN_FILES = {
     "test_moe", "test_amp_fused", "test_onnx", "test_iterators",
     "test_gluon", "test_image", "test_attention", "test_contrib_tail",
     "test_symbol_module", "test_contrib_misc", "test_round2_extras",
-    "test_test_utils", "test_layout",
+    "test_test_utils", "test_layout", "test_library_deploy",
 }
 _DIST_FILES = {"test_dist"}
 

@@ -1,0 +1,77 @@
+"""mx.library plugin loading + mx.deploy serialized inference.
+
+Reference: include/mxnet/lib_api.h + python/mxnet/library.py
+(MXLoadLib), include/mxnet/c_predict_api.h (deploy ABI) — see the
+module docstrings for the TPU-native translations.
+"""
+import os
+import tempfile
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.base import MXNetError
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_library_load_example_plugin():
+    mx.library.load(os.path.join(_REPO, "example/plugin/pallas_ops.py"),
+                    verbose=False)
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    out = nd.plugin_scaled_add(a, b, scale=2.0)
+    onp.testing.assert_allclose(out.asnumpy(), [7.0, 10.0])
+    # loaded ops participate in autograd
+    from mxnet_tpu import autograd
+
+    a.attach_grad()
+    with autograd.record():
+        y = (nd.plugin_swish(a) ** 2).sum()
+    y.backward()
+    assert float(nd.abs(a.grad).sum().asnumpy()) > 0
+    # and in the symbol namespace
+    from mxnet_tpu import symbol as sym
+
+    g = sym.plugin_scaled_add(sym.var("x"), sym.var("y"), scale=3.0)
+    ex = g.bind(args={"x": a, "y": b})
+    onp.testing.assert_allclose(ex.forward()[0].asnumpy(), [10.0, 14.0])
+    assert os.path.join(_REPO, "example/plugin/pallas_ops.py") in \
+        mx.library.loaded_libraries()
+
+
+def test_library_load_rejects_empty_plugin():
+    d = tempfile.mkdtemp()
+    p = os.path.join(d, "empty_plugin.py")
+    with open(p, "w") as f:
+        f.write("x = 1\n")
+    with pytest.raises(MXNetError, match="registered no operators"):
+        mx.library.load(p, verbose=False)
+
+
+def test_library_load_missing():
+    with pytest.raises(MXNetError, match="neither a file"):
+        mx.library.load("no_such_module_xyz", verbose=False)
+
+
+def test_deploy_roundtrip_matches_forward():
+    net = gluon.model_zoo.vision.resnet18_v1(classes=7)
+    net.initialize(init=mx.init.Xavier())
+    x = nd.array(onp.random.rand(2, 3, 32, 32).astype("float32"))
+    ref = net(x).asnumpy()
+    path = mx.deploy.export_model(net, x, tempfile.mktemp(suffix=".mxje"))
+    f = mx.deploy.load_model(path)
+    onp.testing.assert_allclose(f(x).asnumpy(), ref, rtol=1e-5,
+                                atol=1e-5)
+    # artifact is self-contained: numpy input works too
+    onp.testing.assert_allclose(f(x.asnumpy()).asnumpy(), ref,
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_deploy_stablehlo_text():
+    net = gluon.nn.Dense(4, in_units=3)
+    net.initialize()
+    txt = mx.deploy.stablehlo_text(net, nd.zeros((1, 3)))
+    assert "module" in txt and ("stablehlo" in txt or "mhlo" in txt)
