@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# CI entrypoints (reference: ci/docker/runtime_functions.sh) — each
+# function is one matrix cell; the tiers mirror pytest.ini markers.
+set -euo pipefail
+
+unittest_cpu_unit() {
+    # fast correctness gate (<60 s)
+    python -m pytest -m unit -q
+}
+
+unittest_cpu_train() {
+    # training loops / model zoo / ONNX (~12 min)
+    python -m pytest -m train -q
+}
+
+unittest_cpu_dist() {
+    # multi-process jax.distributed workers (reference:
+    # launch.py -n 3 --launcher local dist_sync_kvstore.py)
+    python -m pytest -m dist -q
+}
+
+multichip_dryrun() {
+    # the five-axis parallelism compile check on a virtual 8-dev mesh
+    JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python __graft_entry__.py
+}
+
+sanity_bench() {
+    # smoke the headline bench (prints one JSON line)
+    python bench.py
+}
+
+"$@"
