@@ -31,15 +31,22 @@ class BaseSparseNDArray(NDArray):
     __slots__ = ("_meta_cache",)
 
     def _cached_meta(self, name, compute):
+        # keyed on the buffer OBJECT (held alive in the cache tuple so
+        # an address-reused new buffer can never collide), and returning
+        # a fresh wrapper each call so caller-side mutation cannot
+        # poison the cached values
         cache = getattr(self, "_meta_cache", None)
-        key = id(self._data)
-        if cache is None or cache[0] != key:
-            cache = (key, {})
+        if cache is None or cache[0] is not self._data:
+            cache = (self._data, {})
             self._meta_cache = cache
         store = cache[1]
         if name not in store:
             store[name] = compute()
-        return store[name]
+        # fresh wrapper over the (immutable) cached jax buffer: zero
+        # recompute/copy cost, and caller-side __setitem__ adopts a new
+        # buffer in the wrapper without touching the cache
+        cached = store[name]
+        return type(cached)(cached._data)
 
 
 class CSRNDArray(BaseSparseNDArray):

@@ -170,12 +170,16 @@ def test_sparse_metadata_cached_and_invalidated():
     # now memoized against the backing buffer identity
     a = nd.sparse.csr_matrix(
         onp.array([[0, 1.0, 0], [2.0, 0, 3.0]], "float32"))
-    assert a.indices is a.indices
-    assert a.indptr is a.indptr
+    # cached: same backing buffer, fresh wrappers (mutation-safe)
+    assert a.indices._data is a.indices._data
+    assert a.indptr._data is a.indptr._data
+    onp.testing.assert_allclose(a.indices.asnumpy(), [1, 0, 2])
+    idx = a.indices
+    idx[0] = 99  # caller mutation must not poison the cache
     onp.testing.assert_allclose(a.indices.asnumpy(), [1, 0, 2])
     a[0, 0] = 5.0  # in-place write swaps the buffer -> recompute
     onp.testing.assert_allclose(a.indices.asnumpy(), [0, 1, 0, 2])
     rs = nd.sparse.row_sparse_array(
         onp.array([[0, 0], [1.0, 2], [0, 0]], "float32"))
-    assert rs.indices is rs.indices
+    assert rs.indices._data is rs.indices._data
     onp.testing.assert_allclose(rs.indices.asnumpy(), [1])
