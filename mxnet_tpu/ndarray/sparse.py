@@ -30,16 +30,18 @@ class BaseSparseNDArray(NDArray):
 
     __slots__ = ("_meta_cache",)
 
+    def _adopt(self, data):
+        # every in-place mutation path funnels through _adopt: drop the
+        # metadata cache (no stale reads, and no pinning of the
+        # pre-mutation dense buffer in memory)
+        self._meta_cache = None
+        super()._adopt(data)
+
     def _cached_meta(self, name, compute):
-        # keyed on the buffer OBJECT (held alive in the cache tuple so
-        # an address-reused new buffer can never collide), and returning
-        # a fresh wrapper each call so caller-side mutation cannot
-        # poison the cached values
-        cache = getattr(self, "_meta_cache", None)
-        if cache is None or cache[0] is not self._data:
-            cache = (self._data, {})
-            self._meta_cache = cache
-        store = cache[1]
+        store = getattr(self, "_meta_cache", None)
+        if store is None:
+            store = {}
+            self._meta_cache = store
         if name not in store:
             store[name] = compute()
         # fresh wrapper over the (immutable) cached jax buffer: zero
