@@ -49,6 +49,101 @@ def _standard_inputs(large=False):
         "transpose": ([a], {}),
         "sort": ([a], {}),
         "_npi_einsum": ([a, a], dict(subscripts="ij,jk->ik")),
+        **_family_inputs(),
+    }
+
+
+def _family_inputs():
+    """Specs for ops whose required hyper-params defeat the auto-probe
+    (the reference opperf's per-op rule tables)."""
+    img = onp.random.rand(8, 16, 32, 32).astype("float32")
+    vec16 = onp.ones(16, "float32")
+    z16 = onp.zeros(16, "float32")
+    seq = onp.random.rand(16, 8, 32).astype("float32")
+    rois = onp.array([[0, 2, 2, 20, 20], [4, 1, 1, 16, 16]], "float32")
+    anchors = onp.random.rand(1, 64, 4).astype("float32")
+    cls_prob = onp.random.rand(2, 3, 64).astype("float32")
+    loc_pred = onp.random.rand(2, 256).astype("float32")
+    det_label = onp.array([[[0, .1, .1, .4, .4]], [[1, .5, .5, .9, .9]]],
+                          "float32")
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    psz = rnn_param_size("lstm", 1, 32, 64)
+    qkv = onp.random.rand(16, 4, 96).astype("float32")
+    att = onp.random.rand(8, 16, 16).astype("float32")
+    return {
+        "Activation": ([img], dict(act_type="relu")),
+        "LeakyReLU": ([img], dict(act_type="leaky")),
+        "Cast": ([img], dict(dtype="float16")),
+        "Pad": ([img], dict(mode="constant",
+                            pad_width=(0, 0, 0, 0, 1, 1, 1, 1))),
+        "UpSampling": ([img], dict(scale=2, sample_type="nearest")),
+        "SliceChannel": ([img], dict(num_outputs=2)),
+        "LayerNorm": ([img, vec16, z16], dict(axis=1)),
+        "GroupNorm": ([img, onp.ones(4, "float32"),
+                       onp.zeros(4, "float32")], dict(num_groups=4)),
+        "InstanceNorm": ([img, vec16, z16], {}),
+        "SyncBatchNorm": ([img, vec16, z16, z16.copy(), vec16.copy()],
+                          {}),
+        "Deconvolution": ([img, onp.random.rand(16, 8, 3, 3)
+                           .astype("float32")],
+                          dict(kernel=(3, 3), num_filter=8,
+                               stride=(2, 2), pad=(1, 1))),
+        "DeformableConvolution": (
+            [img, onp.zeros((8, 18, 32, 32), "float32"),
+             onp.random.rand(16, 16, 3, 3).astype("float32")],
+            dict(kernel=(3, 3), num_filter=16, pad=(1, 1),
+                 no_bias=True)),
+        "BilinearResize2D": ([img], dict(height=64, width=64)),
+        "AdaptiveAvgPooling2D": ([img], dict(output_size=(4, 4))),
+        "Correlation": ([img, img.copy()],
+                        dict(kernel_size=1, max_displacement=2,
+                             pad_size=2)),
+        "GridGenerator": ([onp.random.rand(8, 6).astype("float32")],
+                          dict(transform_type="affine",
+                               target_shape=(16, 16))),
+        "ROIPooling": ([img, rois],
+                       dict(pooled_size=(4, 4), spatial_scale=1.0)),
+        "_contrib_ROIAlign": ([img, rois],
+                              dict(pooled_size=(4, 4),
+                                   spatial_scale=1.0)),
+        "RNN": ([seq, onp.random.uniform(-0.1, 0.1, psz)
+                 .astype("float32"),
+                 onp.zeros((1, 8, 64), "float32"),
+                 onp.zeros((1, 8, 64), "float32")],
+                dict(state_size=64, num_layers=1, mode="lstm")),
+        "_contrib_MultiBoxPrior": ([img], dict(sizes=(0.5,),
+                                               ratios=(1.0,))),
+        "_contrib_MultiBoxDetection": ([cls_prob, loc_pred, anchors],
+                                       {}),
+        "_contrib_MultiBoxTarget": ([anchors, det_label,
+                                     cls_prob], {}),
+        "_contrib_box_iou": ([onp.random.rand(8, 4).astype("float32"),
+                              onp.random.rand(8, 4).astype("float32")],
+                             {}),
+        "_contrib_interleaved_matmul_selfatt_qk": ([qkv],
+                                                   dict(heads=8)),
+        "_contrib_interleaved_matmul_selfatt_valatt": ([qkv, att],
+                                                       dict(heads=8)),
+        "_contrib_quantize_v2": ([img], {}),
+        "_contrib_dequantize": (
+            [onp.random.randint(-127, 127, (16, 16)).astype("int8"),
+             onp.array([-1.0], "float32"), onp.array([1.0], "float32")],
+            {}),
+        "batch_take": ([a16 := onp.random.rand(16, 16)
+                        .astype("float32"),
+                        onp.arange(16, dtype="float32")], {}),
+        "one_hot": ([onp.arange(16, dtype="float32")], dict(depth=32)),
+        "take": ([onp.random.rand(32, 8).astype("float32"),
+                  onp.arange(16, dtype="float32")], {}),
+        "Embedding": ([onp.arange(16, dtype="float32"),
+                       onp.random.rand(100, 32).astype("float32")],
+                      dict(input_dim=100, output_dim=32)),
+        "SequenceMask": ([seq], {}),
+        "topk": ([onp.random.rand(16, 64).astype("float32")],
+                 dict(k=4)),
+        "pick": ([onp.random.rand(16, 8).astype("float32"),
+                  onp.zeros(16, "float32")], {}),
     }
 
 

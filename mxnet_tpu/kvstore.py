@@ -63,7 +63,12 @@ def init_distributed(coordinator=None, num_workers=None, rank=None):
         port = os.environ.get("DMLC_PS_ROOT_PORT", "9091")
         coordinator = f"{uri}:{port}"
     if rank is None:
-        rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+        # DMLC_WORKER_ID wins; under `launch.py --launcher mpi` the
+        # rank comes from the MPI runtime's own env instead
+        rank = int(os.environ.get(
+            "DMLC_WORKER_ID",
+            os.environ.get("OMPI_COMM_WORLD_RANK",
+                           os.environ.get("PMI_RANK", "0"))))
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_workers,
                                process_id=rank)

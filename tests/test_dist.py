@@ -10,6 +10,16 @@ import pytest
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
 def _launch(n, script, timeout=600):
     env = dict(os.environ)
     # children must pick their own backend; drop the pytest CPU-mesh
@@ -55,3 +65,38 @@ def test_dist_sync_single_process_degrades_to_one_worker_group():
     out = mx.nd.zeros((2,))
     kv.pull("w", out=out)
     onp.testing.assert_allclose(out.asnumpy(), [3.0, 3.0])
+
+
+def test_ssh_launcher_with_shim():
+    """--launcher ssh drives workers through an ssh command; a local
+    shim (runs the remote command via bash) makes it CI-testable
+    (reference dmlc_tracker/ssh.py contract)."""
+    import stat
+    import tempfile
+
+    d = tempfile.mkdtemp()
+    shim = os.path.join(d, "fake_ssh")
+    with open(shim, "w") as f:
+        f.write("#!/usr/bin/env bash\n"
+                "# args: -o StrictHostKeyChecking=no <host> <command>\n"
+                'shift 2; shift\n'
+                'exec bash -c "$1"\n')
+    os.chmod(shim, stat.S_IRWXU)
+    hosts = os.path.join(d, "hosts.txt")
+    with open(hosts, "w") as f:
+        f.write("127.0.0.1\n127.0.0.1\n127.0.0.1\n")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    cmd = [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+           "-n", "3", "--launcher", "ssh", "-H", hosts,
+           "--ssh-cmd", shim, "--workdir", _REPO, "--cpu",
+           "--port", str(_free_port()),
+           sys.executable, os.path.join(_REPO, "tests",
+                                        "dist_sync_kvstore.py")]
+    res = subprocess.run(cmd, env=env, cwd=_REPO, timeout=600,
+                         capture_output=True, text=True)
+    sys.stdout.write(res.stdout[-1500:])
+    sys.stderr.write(res.stderr[-2000:])
+    assert res.returncode == 0
+    for r in range(3):
+        assert f"[worker {r}] dist_sync_kvstore OK" in res.stdout

@@ -8,20 +8,33 @@ launcher only has to start N processes with the right DMLC_* env vars —
 the same contract the reference bootstraps from
 (docs distributed_training.md:262-276).
 
-Local mode (the reference's `--launcher local`, used by CI to test
-dist_sync without a cluster, ci/docker/runtime_functions.sh:1367-1374):
+Modes:
 
-    python tools/launch.py -n 4 python train.py ...
+  local  (reference `--launcher local`, used by CI to test dist_sync
+          without a cluster, ci/docker/runtime_functions.sh:1367-1374)
+
+      python tools/launch.py -n 4 python train.py ...
+
+  ssh    (reference `--launcher ssh -H hostfile`): one worker per
+          hostfile line, launched over ssh with the DMLC_* env inlined;
+          worker 0's host is the jax.distributed coordinator.
+
+      python tools/launch.py -n 4 --launcher ssh -H hosts.txt \\
+          python train.py ...
+
+  mpi    (reference `--launcher mpi`): delegates process placement to
+          mpirun; ranks read OMPI_COMM_WORLD_RANK/PMI_RANK for their
+          DMLC_WORKER_ID.
 
 --cpu forces the workers onto the CPU backend with a virtual device
 each — the way to exercise multi-worker semantics on one host (the
-driver's 8-device CPU mesh pattern).  ssh/mpi launchers for real pods
-are intentionally thin wrappers users drive through their own schedulers.
+driver's 8-device CPU mesh pattern).
 """
 from __future__ import annotations
 
 import argparse
 import os
+import shlex
 import socket
 import subprocess
 import sys
@@ -35,11 +48,105 @@ def _free_port():
     return port
 
 
+def _worker_env(args, rank, root_uri, port):
+    env = {
+        "DMLC_ROLE": "worker",
+        "DMLC_PS_ROOT_URI": root_uri,
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_NUM_SERVER": "0",
+    }
+    if rank is not None:
+        env["DMLC_WORKER_ID"] = str(rank)
+    if args.cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+    for kv in args.env:
+        k, _, v = kv.partition("=")
+        env[k] = v
+    return env
+
+
+def _launch_local(args):
+    port = _free_port()
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env.update(_worker_env(args, rank, "127.0.0.1", port))
+        if args.cpu:
+            # the accelerator plugin registers at interpreter start and
+            # would pre-initialize the backend, breaking
+            # jax.distributed.initialize in the workers
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+        procs.append(subprocess.Popen(args.command, env=env))
+    return procs
+
+
+def _launch_ssh(args):
+    """Reference ssh_submit (dmlc_tracker/ssh.py): one worker per
+    hostfile line; env is inlined into the remote command."""
+    if not args.hostfile:
+        raise SystemExit("--launcher ssh requires -H/--hostfile")
+    with open(args.hostfile) as f:
+        hosts = [h for h in (ln.strip() for ln in f)
+                 if h and not h.startswith("#")]
+    if len(hosts) < args.num_workers:
+        raise SystemExit(
+            f"hostfile has {len(hosts)} hosts < -n {args.num_workers}")
+    root_uri = hosts[0].split(":")[0]
+    port = args.port or 9099
+    procs = []
+    for rank in range(args.num_workers):
+        host, _, ssh_port = hosts[rank].partition(":")
+        env = _worker_env(args, rank, root_uri, port)
+        env_str = " ".join(f"{k}={shlex.quote(v)}"
+                           for k, v in env.items())
+        unset = "-u PALLAS_AXON_POOL_IPS " if args.cpu else ""
+        remote = (f"cd {shlex.quote(args.workdir or '.')} && "
+                  f"env {unset}{env_str} "
+                  + " ".join(shlex.quote(c) for c in args.command))
+        ssh_cmd = [args.ssh_cmd, "-o", "StrictHostKeyChecking=no"]
+        if ssh_port:
+            ssh_cmd += ["-p", ssh_port]
+        procs.append(subprocess.Popen(ssh_cmd + [host, remote]))
+    return procs
+
+
+def _launch_mpi(args):
+    """Reference mpi_submit: mpirun owns placement; each rank derives
+    DMLC_WORKER_ID from its MPI rank env — kvstore.init_distributed
+    falls back to OMPI_COMM_WORLD_RANK/PMI_RANK when DMLC_WORKER_ID is
+    absent, so no per-rank env is needed here."""
+    root_uri = args.root_uri or "127.0.0.1"
+    port = args.port or 9099
+    env = _worker_env(args, None, root_uri, port)
+    flags = []
+    for k, v in env.items():
+        flags += ["-x", f"{k}={v}"]
+    inner = list(args.command)
+    if args.cpu:
+        # same accelerator-plugin guard as the local/ssh paths
+        inner = ["env", "-u", "PALLAS_AXON_POOL_IPS"] + inner
+    cmd = ([args.mpirun_cmd, "-n", str(args.num_workers)] + flags
+           + ["--allow-run-as-root"] + inner)
+    return [subprocess.Popen(cmd)]
+
+
 def main():
     ap = argparse.ArgumentParser(
-        description="launch a local multi-worker mxnet_tpu job")
+        description="launch a multi-worker mxnet_tpu job")
     ap.add_argument("-n", "--num-workers", type=int, required=True)
-    ap.add_argument("--launcher", default="local", choices=["local"])
+    ap.add_argument("--launcher", default="local",
+                    choices=["local", "ssh", "mpi"])
+    ap.add_argument("-H", "--hostfile", default=None,
+                    help="ssh mode: one host[:port] per line")
+    ap.add_argument("--ssh-cmd", default="ssh",
+                    help="ssh binary (tests substitute a shim)")
+    ap.add_argument("--mpirun-cmd", default="mpirun")
+    ap.add_argument("--root-uri", default=None,
+                    help="coordinator address override")
+    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--workdir", default=None,
+                    help="ssh mode: remote working directory")
     ap.add_argument("--cpu", action="store_true",
                     help="force workers onto the CPU backend (local "
                          "multi-process testing)")
@@ -50,29 +157,9 @@ def main():
     if not args.command:
         ap.error("no command given")
 
-    port = _free_port()
-    procs = []
-    for rank in range(args.num_workers):
-        env = dict(os.environ)
-        env.update({
-            "DMLC_ROLE": "worker",
-            "DMLC_PS_ROOT_URI": "127.0.0.1",
-            "DMLC_PS_ROOT_PORT": str(port),
-            "DMLC_NUM_WORKER": str(args.num_workers),
-            "DMLC_NUM_SERVER": "0",
-            "DMLC_WORKER_ID": str(rank),
-        })
-        if args.cpu:
-            env["JAX_PLATFORMS"] = "cpu"
-            # the accelerator plugin registers at interpreter start and
-            # would pre-initialize the backend, breaking
-            # jax.distributed.initialize in the workers
-            env.pop("PALLAS_AXON_POOL_IPS", None)
-        for kv in args.env:
-            k, _, v = kv.partition("=")
-            env[k] = v
-        procs.append(subprocess.Popen(args.command, env=env))
-
+    launcher = {"local": _launch_local, "ssh": _launch_ssh,
+                "mpi": _launch_mpi}[args.launcher]
+    procs = launcher(args)
     rc = 0
     for p in procs:
         p.wait()
