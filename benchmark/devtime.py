@@ -1,0 +1,132 @@
+"""Device-side chained timing for per-op benchmarks.
+
+Host-loop timing (launch op K times, read back, divide) is unusable on
+the axon TPU tunnel: readback latency jitter of tens of ms swamps
+sub-ms ops, which produced the round-3 opperf artifact where 153/370
+rows had negative avg_time_ms.  This module times a K-iteration
+``lax.fori_loop`` whose iterations are serialized by a genuine data
+dependence (each iteration perturbs an input with a zero derived from
+the previous output), executed as ONE device program with ONE scalar
+readback.  The marginal per-iteration time comes from two K values, so
+the constant dispatch+readback cost cancels exactly once rather than
+once per iteration.
+
+Reference analog: benchmark/opperf/utils/benchmark_utils.py times ops
+under the engine profiler, which also records device time, not host
+enqueue time.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as onp
+
+
+def _zero_like_scalar(out, jnp):
+    """A traced scalar that is always 0 but data-depends on ``out``.
+
+    NOT ``z * 0`` — XLA's algebraic simplifier folds that to a constant,
+    which severs the chain, lets the loop body dead-code-eliminate, and
+    "times" an empty loop (observed: 4096^3 matmul at 4,143 TF/s, 20x
+    over the chip's peak).  min(|finite(z)|, 0) is runtime-zero but not
+    provably zero to the compiler."""
+    outs = out if isinstance(out, (list, tuple)) else (out,)
+    # the scalar must consume EVERY element of EVERY output: with a
+    # partial dependence XLA slices or DCEs the producer itself
+    # (observed: slice(dot) rewritten to a [1,512]x[512,1] dot, emptying
+    # the loop; a tuple op's unused outputs would be eliminated the same
+    # way).  The full reduces cost one extra read of the outputs per
+    # iteration — documented overhead of the method.
+    z = jnp.float32(0.0)
+    for o in outs:
+        if jnp.iscomplexobj(o):
+            o = jnp.real(o)
+        z = z + jnp.sum(o.astype(jnp.float32))
+    z = jnp.where(jnp.isfinite(z), z, 0.0)  # NaN would poison the args
+    return jnp.minimum(jnp.abs(z), 0.0)
+
+
+def _perturb(args, s, jnp):
+    """Inject the zero scalar into the first mutable numeric arg so the
+    next iteration cannot be reordered before the previous output."""
+    new = list(args)
+    for i, a in enumerate(new):
+        if not hasattr(a, "dtype") or a.dtype == jnp.bool_:
+            continue
+        if jnp.issubdtype(a.dtype, jnp.integer):
+            delta = s.astype(a.dtype)
+        elif a.dtype in (jnp.float32, jnp.float64, jnp.float16,
+                         jnp.bfloat16) or jnp.issubdtype(
+                             a.dtype, jnp.floating):
+            delta = s.astype(a.dtype)
+        elif jnp.issubdtype(a.dtype, jnp.complexfloating):
+            delta = s.astype(a.dtype)
+        else:
+            continue
+        if a.ndim:
+            idx = (0,) * a.ndim
+            new[i] = a.at[idx].add(delta)
+        else:
+            new[i] = a + delta
+        return new
+    return new  # no numeric arg: rely on jit not hoisting effectful fn
+
+
+def device_chain_time(fn, args, k_small=2, trials=3, target_spread=0.8,
+                      max_seconds=20.0, max_runs=4096):
+    """Median marginal seconds per call of ``fn(*args)`` on device.
+
+    fn must be jax-traceable with fixed shapes.  Returns (dt_seconds,
+    runs_used).  The K spread is sized adaptively so the marginal time
+    (runs x dt) is ~``target_spread`` seconds — the tunnel's dispatch+
+    readback constant jitters by tens of ms, so the spread must dwarf
+    it — clamped so one timing stays under ``max_seconds``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    args = [jnp.asarray(a) if not hasattr(a, "dtype") else a for a in args]
+
+    @partial(jax.jit, static_argnums=(0,))
+    def loop(k, loop_args):
+        def body(_, carry):
+            cargs, s = carry
+            cargs = tuple(_perturb(cargs, s, jnp))
+            # barrier: keeps the perturbed args (and thus fn) from being
+            # hoisted or simplified out of the loop
+            cargs = jax.lax.optimization_barrier(cargs)
+            out = fn(*cargs)
+            return cargs, _zero_like_scalar(out, jnp)
+
+        _, s = jax.lax.fori_loop(
+            0, k, body, (tuple(loop_args), jnp.float32(0.0)))
+        return s
+
+    def run(k):
+        t0 = time.perf_counter()
+        s = loop(k, args)
+        _ = float(s)  # scalar readback drains the chain
+        return time.perf_counter() - t0
+
+    # probe with a mid-size loop to estimate per-iter cost (the small-K
+    # run alone is all constant overhead for fast ops); each distinct K
+    # compiles its own program, so warm both before the clock
+    probe_k = 32
+    run(k_small)
+    run(probe_k)
+    t_small = run(k_small)
+    t_probe = run(probe_k)
+    per_iter = max((t_probe - t_small) / (probe_k - k_small), 1e-7)
+    runs = max(8, min(int(target_spread / per_iter), max_runs,
+                      max(int(max_seconds / per_iter), 8)))
+    if runs == probe_k - k_small:
+        runs += 1  # reuse-distinct program size (separate jit cache key)
+    run(k_small + runs)  # compile the big-K program before the clock
+    ts = []
+    for _ in range(trials):
+        t1 = run(k_small)
+        t2 = run(k_small + runs)
+        ts.append((t2 - t1) / runs)
+    ts.sort()
+    return ts[len(ts) // 2], runs

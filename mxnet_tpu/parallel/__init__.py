@@ -238,7 +238,10 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
     def _apply_updates(params_, opt_state_, grads, t, key):
         new_p, new_s = {}, {}
         for i, n in enumerate(names):
-            sub = jax.random.fold_in(key, i)
+            # stochastic rules (SGLD) get a distinct per-param key;
+            # deterministic ones skip the fold-in (it compiles to ~2
+            # dead scalar ops per parameter otherwise)
+            sub = jax.random.fold_in(key, i) if opt.needs_key else None
             new_p[n], new_s[n] = opt.fused_update(
                 params_[n], grads[n], opt_state_[n], t, key=sub)
         return new_p, new_s
@@ -296,12 +299,13 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
         new_p, new_s = _apply_updates(params_, opt_state_, grads, t, key)
         return loss, new_p, new_s
 
-    if donate and jax.local_devices()[0].platform == "axon":
-        # the axon tunnel backend rejects aliased (donated) buffers at
-        # readback time (TPU backend InvalidArgument) — measured r03;
-        # XLA owns enough HBM headroom here that donation is optional
-        donate = False
     donate_argnums = (0, 1) if donate else ()
+    if donate:
+        # device_put of an already-committed array aliases it, so the
+        # first donated step would delete the gluon block's own weight
+        # buffers out from under it.  A jitted identity materializes
+        # fresh buffers the step is then free to consume.
+        params = jax.jit(lambda p: p)(params)
     if mesh is not None:
         repl = NamedSharding(mesh, P())
         batch_sharding = NamedSharding(mesh, P(data_axis))
