@@ -72,8 +72,26 @@ def _perturb(args, s, jnp):
     return new  # no numeric arg: rely on jit not hoisting effectful fn
 
 
+_OVERHEAD_CACHE = []
+
+
+def chain_overhead():
+    """Per-iteration cost of the timing skeleton itself (perturb +
+    barrier + scalar reduce on a tiny array, plus the while-loop
+    bookkeeping) — measured once and cached.  Sub-us ops are dominated
+    by this floor, so opperf subtracts it."""
+    if not _OVERHEAD_CACHE:
+        import jax.numpy as jnp
+
+        dt, _ = device_chain_time(lambda a: a, [jnp.zeros((8,))],
+                                  subtract_overhead=False)
+        _OVERHEAD_CACHE.append(max(dt, 0.0))
+    return _OVERHEAD_CACHE[0]
+
+
 def device_chain_time(fn, args, k_small=2, trials=3, target_spread=0.8,
-                      max_seconds=20.0, max_runs=4096):
+                      max_seconds=20.0, max_runs=2_000_000,
+                      subtract_overhead=False):
     """Median marginal seconds per call of ``fn(*args)`` on device.
 
     fn must be jax-traceable with fixed shapes.  Returns (dt_seconds,
@@ -84,12 +102,14 @@ def device_chain_time(fn, args, k_small=2, trials=3, target_spread=0.8,
     """
     import jax
     import jax.numpy as jnp
-    from functools import partial
 
     args = [jnp.asarray(a) if not hasattr(a, "dtype") else a for a in args]
 
-    @partial(jax.jit, static_argnums=(0,))
+    @jax.jit
     def loop(k, loop_args):
+        # k is a TRACED bound (lowers to a while loop) so every K shares
+        # ONE compiled program — per-op compile cost on the tunnel is
+        # seconds, and three static-K programs per op tripled it
         def body(_, carry):
             cargs, s = carry
             cargs = tuple(_perturb(cargs, s, jnp))
@@ -105,28 +125,41 @@ def device_chain_time(fn, args, k_small=2, trials=3, target_spread=0.8,
 
     def run(k):
         t0 = time.perf_counter()
-        s = loop(k, args)
+        s = loop(jnp.int32(k), args)
         _ = float(s)  # scalar readback drains the chain
         return time.perf_counter() - t0
 
-    # probe with a mid-size loop to estimate per-iter cost (the small-K
-    # run alone is all constant overhead for fast ops); each distinct K
-    # compiles its own program, so warm both before the clock
-    probe_k = 32
-    run(k_small)
-    run(probe_k)
+    # Geometric probe ladder: grow K until the marginal time is clearly
+    # above the dispatch jitter, then stop.  A single mid-size probe is
+    # NOT safe: jitter can make per-iter read as ~0, and extrapolating
+    # from that launched multi-minute device loops that tripped the
+    # tunnel's watchdog and crashed the TPU worker (observed r04).
+    run(k_small)  # compiles the program
     t_small = run(k_small)
-    t_probe = run(probe_k)
-    per_iter = max((t_probe - t_small) / (probe_k - k_small), 1e-7)
-    runs = max(8, min(int(target_spread / per_iter), max_runs,
-                      max(int(max_seconds / per_iter), 8)))
-    if runs == probe_k - k_small:
-        runs += 1  # reuse-distinct program size (separate jit cache key)
-    run(k_small + runs)  # compile the big-K program before the clock
+    k = 4
+    while True:
+        t_k = run(k_small + k)
+        delta = t_k - t_small
+        if delta > target_spread / 2 or k >= max_runs \
+                or t_k > max_seconds / 2:
+            break
+        k = min(k * 8, max_runs)
+    # the ladder stops as soon as the spread is MEASURABLE (> spread/2);
+    # scale up to the full target so the trials' spread dwarfs the
+    # ~40 ms jitter rather than merely exceeding it, bounded by
+    # max_seconds per timing
+    if 0 < delta < target_spread and t_k < max_seconds / 2:
+        per_iter = delta / k
+        k = min(max(k, int(target_spread / per_iter)), max_runs,
+                max(int((max_seconds / 2) / per_iter), k))
+    runs = k
     ts = []
     for _ in range(trials):
         t1 = run(k_small)
         t2 = run(k_small + runs)
         ts.append((t2 - t1) / runs)
     ts.sort()
-    return ts[len(ts) // 2], runs
+    dt = ts[len(ts) // 2]
+    if subtract_overhead:
+        dt = max(dt - chain_overhead(), 0.0)
+    return dt, runs

@@ -5,8 +5,8 @@ opperf.py runs every registered op with timing via the profiler).
 Times eager dispatch+execution of registered ops on representative
 shapes, emitting one JSON line per op:
 
-    python benchmark/opperf.py [--ops dot,Convolution] [--warmup 5]
-        [--runs 25] [--large]
+    python benchmark/opperf.py [--ops dot,Convolution] [--runs 25]
+        [--large]
 """
 from __future__ import annotations
 
@@ -14,11 +14,11 @@ import argparse
 import json
 import os
 import sys
-import time
 
 import numpy as onp
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import mxnet_tpu as mx  # noqa: E402
 from mxnet_tpu.ops.registry import get_op, list_ops  # noqa: E402
@@ -147,27 +147,30 @@ def _family_inputs():
     }
 
 
-def _materialize(out):
-    o = out[0] if isinstance(out, (list, tuple)) else out
-    onp.asarray(o._data).ravel()  # host readback drains the pipeline
+def bench_op(opname, inputs, params, ctx, runs):
+    """Marginal per-call device time via the chained fori_loop timer
+    (benchmark/devtime.py).  Round 3's host-loop two-K sweep produced
+    153 negative timings out of 370 rows — tunnel readback jitter
+    swamped sub-ms ops; the device-side chain makes that impossible by
+    construction (one program, one scalar readback, data-dependent
+    iterations)."""
+    import jax
 
+    from devtime import device_chain_time
 
-def bench_op(opname, inputs, params, ctx, warmup, runs):
-    """Marginal per-call time from a two-K sweep with host readback at
-    the end of each run (block_until_ready does not drain on the axon
-    tunnel — see bench.py)."""
-    nd_inputs = [mx.nd.array(x, ctx=ctx) for x in inputs]
+    op = get_op(opname)
+    vals = [mx.nd.array(x, ctx=ctx)._data for x in inputs]
+    kwargs = dict(params)
+    if op.key_param and op.key_param not in kwargs:
+        kwargs[op.key_param] = jax.random.key(0)
 
-    def run(k):
-        t0 = time.perf_counter()
-        for _ in range(k):
-            out = mx.nd.invoke(opname, nd_inputs, **params)
-        _materialize(out)
-        return time.perf_counter() - t0
+    def fn(*args):
+        return op.fn(*args, **kwargs)
 
-    run(max(1, warmup))  # compile before the clock
-    t1, t2 = run(3), run(3 + runs)
-    return (t2 - t1) / runs
+    dt, _ = device_chain_time(fn, vals, target_spread=0.4,
+                              trials=max(3, min(runs // 8, 5)),
+                              subtract_overhead=True)
+    return dt
 
 
 # ops whose signatures genuinely need bespoke shapes/params beyond the
@@ -212,10 +215,23 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--ops", default=None,
                     help="comma list; default = curated + all probe-able")
-    ap.add_argument("--warmup", type=int, default=5)
     ap.add_argument("--runs", type=int, default=25)
     ap.add_argument("--large", action="store_true")
+    ap.add_argument("--baseline", default=None,
+                    help="prior OPPERF jsonl; adds per-op regression "
+                         "columns (prev_ms, speedup)")
     args = ap.parse_args()
+
+    prev = {}
+    if args.baseline:
+        with open(args.baseline) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if "op" in row and "avg_time_ms" in row:
+                    prev[row["op"]] = row["avg_time_ms"]
 
     ctx = mx.gpu(0)
     curated = _standard_inputs(args.large)
@@ -241,8 +257,7 @@ def main():
                 skipped.append(name)
                 continue
         try:
-            dt = bench_op(name, spec[0], spec[1], ctx, args.warmup,
-                          args.runs)
+            dt = bench_op(name, spec[0], spec[1], ctx, args.runs)
         except Exception as e:
             # auto-probed inputs legitimately miss some signatures, but
             # an explicitly requested op failing must be visible
@@ -252,8 +267,13 @@ def main():
             else:
                 skipped.append(name)
             continue
-        print(json.dumps({"op": name, "avg_time_ms": round(dt * 1e3, 4),
-                          "runs": args.runs}), flush=True)
+        row = {"op": name, "avg_time_ms": round(dt * 1e3, 4),
+               "method": "device-chain"}
+        if name in prev:
+            row["prev_ms"] = prev[name]
+            if prev[name] > 0 and dt > 0:
+                row["speedup_vs_prev"] = round(prev[name] / (dt * 1e3), 2)
+        print(json.dumps(row), flush=True)
     if skipped:
         print(json.dumps({"skipped_unprobeable": len(skipped),
                           "ops": skipped}), flush=True)

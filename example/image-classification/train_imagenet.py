@@ -42,6 +42,10 @@ def main():
                     help="device | dist_sync (under tools/launch.py)")
     ap.add_argument("--data-parallel-mesh", action="store_true",
                     help="shard the batch over all local chips")
+    ap.add_argument("--gpus", default=None,
+                    help="comma list of device ids (reference --gpus "
+                         "0,1,2): builds the data mesh over exactly "
+                         "those chips")
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -63,7 +67,11 @@ def main():
                                            classes=args.num_classes)
     net.initialize(init=mx.init.Xavier(), ctx=ctx)
     net(mx.nd.zeros((1,) + shape, ctx=ctx))
-    mesh = get_mesh() if args.data_parallel_mesh else None
+    if args.gpus:
+        ids = [int(i) for i in args.gpus.split(",")]
+        mesh = get_mesh(devices=[mx.gpu(i).jax_device() for i in ids])
+    else:
+        mesh = get_mesh() if args.data_parallel_mesh else None
     step_fn, params, opt_state = make_train_step(
         net, gluon.loss.SoftmaxCrossEntropyLoss(),
         optimizer=args.optimizer, learning_rate=args.lr, momentum=0.9,

@@ -28,8 +28,11 @@ def _conv3x3(channels, stride, in_channels):
 
 
 class BasicBlockV1(HybridBlock):
+    # no_bias is accepted for API uniformity with BottleneckV1: every
+    # conv in this block is already bias-free, so True is a no-op that
+    # still yields the bias-free model the caller asked for.
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
+                 no_bias=False, **kwargs):
         super().__init__(**kwargs)
         self.body = nn.HybridSequential(prefix="")
         self.body.add(_conv3x3(channels, stride, in_channels))
@@ -96,8 +99,11 @@ class BottleneckV1(HybridBlock):
 
 
 class BasicBlockV2(HybridBlock):
+    # no_bias is accepted for API uniformity with BottleneckV1: every
+    # conv in this block is already bias-free, so True is a no-op that
+    # still yields the bias-free model the caller asked for.
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
+                 no_bias=False, **kwargs):
         super().__init__(**kwargs)
         self.bn1 = nn.BatchNorm()
         self.conv1 = _conv3x3(channels, stride, in_channels)
@@ -124,8 +130,11 @@ class BasicBlockV2(HybridBlock):
 
 
 class BottleneckV2(HybridBlock):
+    # no_bias is accepted for API uniformity with BottleneckV1: every
+    # conv in this block is already bias-free, so True is a no-op that
+    # still yields the bias-free model the caller asked for.
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
+                 no_bias=False, **kwargs):
         super().__init__(**kwargs)
         self.bn1 = nn.BatchNorm()
         self.conv1 = nn.Conv2D(channels // 4, kernel_size=1, strides=1,
@@ -184,8 +193,7 @@ class ResNetV1(HybridBlock):
 
     def _make_layer(self, block, layers, channels, stride, stage_index,
                     in_channels=0):
-        extra = {"no_bias": True} if (
-            self._no_bias and block is BottleneckV1) else {}
+        extra = {"no_bias": True} if self._no_bias else {}
         layer = nn.HybridSequential(prefix=f"stage{stage_index}_")
         with layer.name_scope():
             layer.add(block(channels, stride, channels != in_channels,

@@ -1,9 +1,15 @@
 """Module: executor-backed trainable module.
 
 Reference parity: python/mxnet/module/module.py (``Module`` :40 over
-``DataParallelExecutorGroup``).  TPU-native: ONE executor on one logical
-device view — batch sharding over chips is the parallel layer's job
-(mxnet_tpu.parallel), not N executors.
+``DataParallelExecutorGroup``).  TPU-native: ONE executor, ONE compiled
+SPMD program.  ``context=[gpu(0)..gpu(N-1)]`` builds a 1-D 'data' mesh
+over those chips: batch args shard over it, params/aux replicate, and
+XLA inserts the gradient all-reduce — the reference's
+DataParallelExecutorGroup (executor_group.py:144 batch slicing, :304
+grad reduce) collapses into sharding annotations.  BatchNorm under the
+mesh computes GLOBAL batch stats (collectives inside the jitted graph),
+i.e. SyncBatchNorm semantics — stricter than the reference's per-device
+stats.
 """
 from __future__ import annotations
 
@@ -32,8 +38,20 @@ class Module(BaseModule):
         self._data_names = list(data_names) if data_names else []
         self._label_names = list(label_names) if label_names else []
         self._context = context or cpu()
+        self._mesh = None
         if isinstance(self._context, (list, tuple)):
-            self._context = self._context[0]  # one logical device view
+            ctxs = list(self._context)
+            self._context = ctxs[0]
+            if len(ctxs) > 1:
+                import jax
+                from jax.sharding import Mesh
+
+                devs = [c.jax_device() for c in ctxs]
+                if len(set(devs)) != len(devs):
+                    raise MXNetError(
+                        f"context list {ctxs} resolves to duplicate "
+                        "devices — data parallelism needs distinct chips")
+                self._mesh = Mesh(onp.array(devs), ("data",))
         self._fixed_param_names = set(fixed_param_names or [])
         arg_names = symbol.list_arguments()
         self._param_names = [
@@ -112,6 +130,8 @@ class Module(BaseModule):
         self._grad_req = req
         self._exec = self._symbol.simple_bind(
             self._context, grad_req=req, **shape_kwargs)
+        if self._mesh is not None:
+            self._place_on_mesh()
         self.binded = True
         if shared_module is not None and shared_module._exec is not None:
             # share the actual parameter NDArray objects (reference:
@@ -138,6 +158,39 @@ class Module(BaseModule):
             self.init_params(arg_params=self._arg_params,
                              aux_params=self._aux_params,
                              force_init=True, allow_missing=True)
+
+    # ------------------------------------------------------ mesh support
+    def _data_sharding(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self._mesh, P("data"))
+
+    def _place_on_mesh(self):
+        """Replicate params/aux/grads over the data mesh; batch args
+        shard at feed time (reference: executor_group.py:144 slices the
+        batch across contexts — here the sharding annotation does it)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(self._mesh, P())
+        batch_names = set(self._data_names) | set(self._label_names)
+        for store in (self._exec.arg_dict, self._exec.aux_dict,
+                      self._exec.grad_dict):
+            for n, v in store.items():
+                if n in batch_names:
+                    continue
+                v._data = jax.device_put(v._data, repl)
+
+    def _shard_batch(self, name, arr):
+        import jax
+
+        n_dev = self._mesh.devices.size
+        if arr.shape[0] % n_dev:
+            raise MXNetError(
+                f"batch axis of '{name}' ({arr.shape[0]}) must divide "
+                f"the {n_dev}-device data mesh")
+        return jax.device_put(arr, self._data_sharding())
 
     # ----------------------------------------------------------- params
     def init_params(self, initializer=None, arg_params=None,
@@ -167,6 +220,9 @@ class Module(BaseModule):
                 val = initializer(init_mod.InitDesc(name), arr.shape,
                                   str(arr.dtype))
                 arr._adopt(nd.array(onp.asarray(val))._data)
+        if self._mesh is not None:
+            # _adopt swapped in host-placed arrays; restore replication
+            self._place_on_mesh()
         self.params_initialized = True
 
     @staticmethod
@@ -216,6 +272,11 @@ class Module(BaseModule):
         if data_batch.label is not None and self._label_names:
             for name, arr in zip(self._label_names, data_batch.label):
                 feeds[name] = arr
+        if self._mesh is not None:
+            for name, arr in feeds.items():
+                v = arr._data if isinstance(arr, nd.NDArray) else \
+                    nd.array(onp.asarray(arr))._data
+                feeds[name] = nd.NDArray(self._shard_batch(name, v))
         # rebind on shape change (reference module reshapes executors)
         for k, v in feeds.items():
             if tuple(self._exec.arg_dict[k].shape) != tuple(v.shape):

@@ -955,11 +955,33 @@ class Updater:
 
     def __call__(self, index, grad, weight):
         if index not in self.states:
-            self.states[index] = (
-                self.optimizer.create_state_multi_precision(index, weight))
+            state = self.optimizer.create_state_multi_precision(
+                index, weight)
+            self.states[index] = self._match_sharding(state, weight)
             self.states_synced[index] = True
         self.optimizer.update_multi_precision(
             index, weight, grad, self.states[index])
+
+    @staticmethod
+    def _match_sharding(state, weight):
+        """Place freshly-created state like its weight: under a Module
+        data mesh the weight is replicated over N devices, and a state
+        array committed to a single device would make the fused update
+        a cross-committed-device error."""
+        w = weight._data
+        sharding = getattr(w, "sharding", None)
+        if sharding is None or not hasattr(w, "devices") \
+                or len(w.devices()) <= 1:
+            return state
+
+        def place(s):
+            if isinstance(s, (tuple, list)):
+                return type(s)(place(x) for x in s)
+            if isinstance(s, nd.NDArray) and s.shape == weight.shape:
+                s._data = jax.device_put(s._data, sharding)
+            return s
+
+        return place(state)
 
     def get_states(self, dump_optimizer=False):
         import copy
