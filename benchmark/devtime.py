@@ -103,7 +103,10 @@ def device_chain_time(fn, args, k_small=2, trials=3, target_spread=0.8,
     import jax
     import jax.numpy as jnp
 
-    args = [jnp.asarray(a) if not hasattr(a, "dtype") else a for a in args]
+    # leave pytree args (dicts/lists of arrays) alone — jit flattens
+    # them; only promote bare scalars/numpy arrays
+    args = [a if hasattr(a, "dtype") or isinstance(a, (dict, list, tuple))
+            else jnp.asarray(a) for a in args]
 
     @jax.jit
     def loop(k, loop_args):

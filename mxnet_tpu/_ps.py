@@ -1,0 +1,363 @@
+"""Sharded TCP parameter-server backend for DistKVStore.
+
+Reference parity: the ps-lite server group + KVStoreDistServer
+(src/kvstore/kvstore_dist_server.h).  Every worker PROCESS also runs a
+server thread owning a hash shard of the keys — the reference's
+EncodeDefaultKey key-to-server sharding (src/kvstore/kvstore_dist.h:606)
+— so per-worker wire traffic is O(N) per push/pull, never O(W*N).
+
+Two application modes, matching the reference server:
+  * sync  — "wait for all W workers, merge, then update"
+            (kvstore_dist_server.h:346-359 DataHandleDefault).  Pulls
+            block until the in-flight merge round completes.
+  * async — each worker's push applies IMMEDIATELY on arrival; no
+            worker ever waits for a peer (the dist_async contract).
+
+Dead-node detection: every worker heartbeats server 0; the
+``num_dead_node(timeout)`` probe is the reference's
+``get_num_dead_node`` floor (include/mxnet/kvstore.h:380).
+
+Transport: length-prefixed pickled tuples over TCP between trusted
+cluster peers (the reference trusts its ps-lite peers the same way).
+Server addresses are exchanged through the jax.distributed coordinator
+KV service; single-host jobs fall back to loopback derived ports.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+
+import numpy as onp
+
+from .base import MXNetError
+
+_LEN = struct.Struct("!Q")
+
+
+def _send_msg(sock, obj):
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def _recv_msg(sock):
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _decompress_2bit(payload, shape, threshold):
+    """Unpack the 2-bit wire payload (see GradientCompression) on the
+    server side, numpy-only: code 1 -> +t, 2 -> -t, 0 -> 0."""
+    p = onp.frombuffer(payload, dtype=onp.uint8)
+    codes = onp.stack(
+        [p & 3, (p >> 2) & 3, (p >> 4) & 3, (p >> 6) & 3], axis=-1
+    ).reshape(-1)
+    n = 1
+    for d in shape:
+        n *= d
+    codes = codes[:n].reshape(shape)
+    out = onp.zeros(shape, onp.float32)
+    out[codes == 1] = threshold
+    out[codes == 2] = -threshold
+    return out
+
+
+class _ServerShard(threading.Thread):
+    """One process's server: owns keys with hash(key) % size == rank."""
+
+    def __init__(self, rank, size):
+        super().__init__(daemon=True, name=f"ps-server-{rank}")
+        self.rank = rank
+        self.size = size
+        self.values = {}           # key -> onp.ndarray (fp32 master)
+        self.pending = {}          # key -> merge accumulator (sync mode)
+        self.pending_count = {}
+        # round bookkeeping for sync pulls: a pull by worker s must wait
+        # until every round s has PUSHED is merged — waiting on "no
+        # in-flight merge" deadlocks when a fast worker opens round N+1
+        # before a slow one pulls round N
+        self.completed_rounds = {}   # key -> merged round count
+        self.pushed_rounds = {}      # (key, sender) -> pushes by sender
+        # keys are namespaced per KVStore instance ("s0/weight"); each
+        # namespace can carry its own optimizer rule
+        self.updaters = {}         # namespace -> updater callable
+        self.last_hb = {}          # worker rank -> monotonic time
+        self._cv = threading.Condition()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", 0))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+
+    def run(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                try:
+                    resp = self._handle(msg)
+                except Exception as exc:  # surface to the CLIENT —
+                    # dying silently leaves the peer blocked in recv
+                    # with a misleading 'peer closed'
+                    resp = ("err", repr(exc))
+                _send_msg(conn, resp)
+        except (ConnectionError, EOFError, OSError):
+            conn.close()
+
+    # ----------------------------------------------------------- logic
+    def _updater_for(self, key):
+        ns = key.split("/", 1)[0] if "/" in key else ""
+        return self.updaters.get(ns)
+
+    def _apply(self, key, grad):
+        """Immediate update (async) / post-merge update (sync)."""
+        updater = self._updater_for(key)
+        if updater is None:
+            # no optimizer on the server: sync replaces the value with
+            # the merged sum (the bare push/pull-sum contract); async
+            # accumulates (each arrival folds in, there is no "round")
+            return grad
+        from . import ndarray as nd
+
+        bare = key.split("/", 1)[1] if "/" in key else key
+        stored = nd.array(self.values[key])
+        updater(bare, nd.array(grad), stored)
+        return onp.asarray(stored.asnumpy(), onp.float32)
+
+    def _handle(self, msg):
+        op = msg[0]
+        if op == "init":
+            _, key, value, sender = msg
+            with self._cv:
+                # rank-0's init wins (reference: the server keeps the
+                # first controller-blessed value)
+                if sender == 0 or key not in self.values:
+                    self.values[key] = onp.asarray(value, onp.float32)
+                self._cv.notify_all()
+            return ("ok",)
+        if op == "push":
+            _, key, payload, mode, meta = msg
+            sender = meta.get("sender", -1)
+            if meta.get("compressed"):
+                grad = _decompress_2bit(payload, meta["shape"],
+                                        meta["threshold"])
+            else:
+                grad = onp.asarray(payload, onp.float32)
+            with self._cv:
+                if key not in self.values:
+                    raise MXNetError(f"push to uninitialized key {key}")
+                if mode == "async":
+                    if self._updater_for(key) is None:
+                        self.values[key] = self.values[key] + grad
+                    else:
+                        self.values[key] = self._apply(key, grad)
+                else:  # sync: merge all W, then update once
+                    self.pushed_rounds[(key, sender)] = \
+                        self.pushed_rounds.get((key, sender), 0) + 1
+                    acc = self.pending.get(key)
+                    self.pending[key] = grad if acc is None else acc + grad
+                    cnt = self.pending_count.get(key, 0) + 1
+                    if cnt == self.size:
+                        merged = self.pending.pop(key)
+                        self.pending_count[key] = 0
+                        self.completed_rounds[key] = \
+                            self.completed_rounds.get(key, 0) + 1
+                        if self._updater_for(key) is None:
+                            self.values[key] = merged
+                        else:
+                            self.values[key] = self._apply(key, merged)
+                    else:
+                        self.pending_count[key] = cnt
+                self._cv.notify_all()
+            return ("ok",)
+        if op == "pull":
+            _, key, sender = msg
+            deadline = time.monotonic() + 600.0
+            with self._cv:
+                # wait for init, and for every round THIS worker pushed
+                # to be merged (round-aware: other workers may already
+                # be pushing the next round)
+                def ready():
+                    if key not in self.values:
+                        return False
+                    need = self.pushed_rounds.get((key, sender), 0)
+                    return self.completed_rounds.get(key, 0) >= need
+                while not ready():
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        raise MXNetError(f"pull timeout on key {key}")
+                    self._cv.wait(timeout=min(left, 1.0))
+                return ("val", self.values[key])
+        if op == "hb":
+            _, sender = msg
+            with self._cv:
+                self.last_hb[sender] = time.monotonic()
+            return ("ok",)
+        if op == "dead":
+            _, timeout_s = msg
+            now = time.monotonic()
+            with self._cv:
+                dead = [r for r in range(self.size)
+                        if now - self.last_hb.get(r, -1e18) > timeout_s]
+            return ("dead", dead)
+        raise MXNetError(f"unknown ps op {op!r}")
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class PSBackend:
+    """Worker-side client + in-process server shard (one per process)."""
+
+    _singleton = None
+
+    @classmethod
+    def get(cls, rank, size):
+        if cls._singleton is None:
+            cls._singleton = cls(rank, size)
+        return cls._singleton
+
+    def __init__(self, rank, size):
+        self.rank = rank
+        self.size = size
+        self.server = _ServerShard(rank, size)
+        self.server.start()
+        self._addrs = self._exchange_addrs()
+        self._conns = {}
+        self._conn_locks = {}
+        self._conn_create = threading.Lock()
+        self._hb_stop = threading.Event()
+        self._hb = threading.Thread(target=self._heartbeat_loop,
+                                    daemon=True, name="ps-heartbeat")
+        self._hb.start()
+
+    # ----------------------------------------------------- bootstrap
+    def _exchange_addrs(self):
+        host = socket.gethostname()
+        try:
+            my_ip = socket.gethostbyname(host)
+        except OSError:
+            my_ip = "127.0.0.1"
+        mine = f"{my_ip}:{self.server.port}"
+        if self.size == 1:
+            return {0: mine}
+        from jax._src import distributed as _jd
+
+        client = _jd.global_state.client
+        if client is None:
+            raise MXNetError(
+                "parameter-server backend needs jax.distributed (launch "
+                "with tools/launch.py) for address exchange")
+        client.key_value_set(f"mxps/addr/{self.rank}", mine)
+        addrs = {}
+        for r in range(self.size):
+            addrs[r] = client.blocking_key_value_get(
+                f"mxps/addr/{r}", 60_000)
+        return addrs
+
+    def _conn(self, r):
+        # guarded: the heartbeat thread and the worker thread race to
+        # open the first connection; an unguarded check-then-create left
+        # two sockets sharing one dict slot and corrupted the framing
+        with self._conn_create:
+            if r not in self._conns:
+                host, port = self._addrs[r].rsplit(":", 1)
+                s = socket.create_connection((host, int(port)),
+                                             timeout=600)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._conns[r] = s
+                self._conn_locks[r] = threading.Lock()
+        return self._conns[r], self._conn_locks[r]
+
+    def _request(self, r, msg):
+        sock, lock = self._conn(r)
+        with lock:
+            _send_msg(sock, msg)
+            resp = _recv_msg(sock)
+        if resp[0] == "val":
+            return resp[1]
+        if resp[0] == "dead":
+            return resp[1]
+        if resp[0] == "err":
+            raise MXNetError(f"ps server error: {resp[1]}")
+        return None
+
+    def owner(self, key):
+        # stable across processes (NOT python hash(): PYTHONHASHSEED)
+        import zlib
+
+        return zlib.crc32(str(key).encode()) % self.size
+
+    # ----------------------------------------------------- operations
+    def init(self, key, value):
+        self._request(self.owner(key),
+                      ("init", key, onp.asarray(value, onp.float32),
+                       self.rank))
+
+    def push(self, key, grad, mode, compressed_payload=None, meta=None):
+        if compressed_payload is not None:
+            payload = compressed_payload
+            meta = dict(meta or {})
+            meta["compressed"] = True
+        else:
+            payload = onp.asarray(grad, onp.float32)
+            meta = {"compressed": False}
+        meta["sender"] = self.rank
+        self._request(self.owner(key), ("push", key, payload, mode, meta))
+
+    def pull(self, key):
+        return self._request(self.owner(key), ("pull", key, self.rank))
+
+    def set_updater(self, namespace, updater):
+        # in-process: this rank's shard applies with this updater; all
+        # ranks run the same program so every shard gets the same rule
+        self.server.updaters[namespace] = updater
+
+    def num_dead_node(self, timeout_s=60.0):
+        """Count workers whose heartbeat is older than ``timeout_s``
+        (reference get_num_dead_node, include/mxnet/kvstore.h:380)."""
+        dead = self._request(0, ("dead", float(timeout_s)))
+        return len(dead)
+
+    def dead_nodes(self, timeout_s=60.0):
+        return self._request(0, ("dead", float(timeout_s)))
+
+    def _heartbeat_loop(self):
+        interval = float(os.environ.get("MXNET_PS_HEARTBEAT_SEC", "0.3"))
+        while not self._hb_stop.is_set():
+            try:
+                self._request(0, ("hb", self.rank))
+            except Exception:
+                pass
+            self._hb_stop.wait(interval)
+
+    def stop_heartbeat(self):
+        """Test hook: a worker that stops heartbeating is 'dead' to the
+        liveness probe after the timeout."""
+        self._hb_stop.set()

@@ -1,0 +1,6 @@
+"""SVRG optimization (reference
+python/mxnet/contrib/svrg_optimization/): variance-reduced SGD via a
+periodically-refreshed full-batch gradient snapshot."""
+from .svrg_module import SVRGModule
+
+__all__ = ["SVRGModule"]

@@ -1,0 +1,173 @@
+"""SVRGModule (reference contrib/svrg_optimization/svrg_module.py).
+
+Stochastic Variance Reduced Gradient (Johnson & Zhang 2013): every
+``update_freq`` epochs the current weights are snapshotted and the FULL
+dataset gradient ``mu`` at the snapshot is computed; each step then
+descends along
+
+    g_i(w) - g_i(w_snapshot) + mu
+
+which is an unbiased, variance-reduced gradient estimate.  The
+reference implements this as two Modules (main + frozen snapshot) plus
+a wrapper optimizer; the same structure is used here over the
+TPU-native Module.
+"""
+from __future__ import annotations
+
+import logging
+
+from ... import ndarray as nd
+from ...module.module import Module
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(Module):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None,
+                 group2ctxs=None, compression_params=None,
+                 update_freq=2):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, logger=logger,
+                         context=context, work_load_list=work_load_list,
+                         fixed_param_names=fixed_param_names,
+                         state_names=state_names, group2ctxs=group2ctxs,
+                         compression_params=compression_params)
+        if not isinstance(update_freq, int) or update_freq <= 0:
+            raise ValueError(
+                f"update_freq must be a positive int, got {update_freq}")
+        self.update_freq = update_freq
+        # frozen snapshot executor (the reference's _mod_aux)
+        self._mod_aux = Module(symbol, data_names=data_names,
+                               label_names=label_names, logger=logger,
+                               context=context)
+        self._param_dict = None  # mu: full-dataset grads at the snapshot
+        self._aux_grads = None   # g_i(w_snapshot) for the current batch
+
+    # ------------------------------------------------------------ bind
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        super().bind(data_shapes, label_shapes, for_training,
+                     inputs_need_grad, force_rebind, shared_module,
+                     grad_req)
+        self._mod_aux.bind(data_shapes, label_shapes, for_training,
+                           inputs_need_grad, force_rebind, None,
+                           grad_req)
+
+    def init_params(self, *args, **kwargs):
+        super().init_params(*args, **kwargs)
+        arg, aux = self.get_params()
+        self._mod_aux.init_params(arg_params=arg, aux_params=aux,
+                                  force_init=True, allow_missing=True)
+
+    # --------------------------------------------------------- running
+    def forward(self, data_batch, is_train=None):
+        super().forward(data_batch, is_train)
+        if is_train or (is_train is None and self.for_training):
+            self._mod_aux.forward(data_batch, is_train=True)
+
+    def backward(self, out_grads=None):
+        super().backward(out_grads)
+        self._mod_aux.backward(out_grads)
+        self._aux_grads = {
+            n: self._mod_aux._exec.grad_dict[n].copy()
+            for n in self._param_names
+            if n in self._mod_aux._exec.grad_dict}
+
+    def update(self):
+        """Apply the SVRG-adjusted gradient through the optimizer
+        (reference _update_svrg_gradients + Module.update)."""
+        if self._param_dict is not None and self._aux_grads is not None:
+            for name in self._param_names:
+                g = self._exec.grad_dict.get(name)
+                if g is None:
+                    continue
+                g_spec = self._aux_grads.get(name)
+                mu = self._param_dict.get(name)
+                if g_spec is not None and mu is not None:
+                    g._adopt(g._data - g_spec._data + mu._data)
+        super().update()
+
+    # -------------------------------------------------------- full grad
+    def update_full_grads(self, train_data):
+        """Snapshot the current weights into the aux module and compute
+        mu = the average gradient over the whole ``train_data``
+        (reference update_full_grads)."""
+        arg, aux = self.get_params()
+        self._mod_aux.init_params(arg_params=arg, aux_params=aux,
+                                  force_init=True, allow_missing=True)
+        train_data.reset()
+        accum = {}
+        nbatch = 0
+        for batch in train_data:
+            self._mod_aux.forward(batch, is_train=True)
+            self._mod_aux.backward()
+            for name in self._param_names:
+                g = self._mod_aux._exec.grad_dict.get(name)
+                if g is None:
+                    continue
+                if name in accum:
+                    accum[name]._adopt(accum[name]._data + g._data)
+                else:
+                    accum[name] = g.copy()
+            nbatch += 1
+        self._param_dict = {
+            n: nd.NDArray(v._data / max(nbatch, 1))
+            for n, v in accum.items()}
+        train_data.reset()
+
+    # -------------------------------------------------------------- fit
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),),
+            eval_end_callback=None, eval_batch_end_callback=None,
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None):
+        from ... import initializer as init_mod
+        from ... import metric as metric_mod
+
+        assert num_epoch is not None, "please specify number of epochs"
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        self.init_params(initializer=initializer
+                         or init_mod.Uniform(0.01),
+                         arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing,
+                         force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+
+        for epoch in range(begin_epoch, num_epoch):
+            if epoch % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, data_batch in enumerate(train_data):
+                self.forward(data_batch, is_train=True)
+                self.backward()
+                self.update()
+                self.update_metric(eval_metric, data_batch.label)
+                if batch_end_callback is not None:
+                    from ...callback import BatchEndParam
+
+                    batch_end_callback(BatchEndParam(
+                        epoch=epoch, nbatch=nbatch,
+                        eval_metric=eval_metric, locals=locals()))
+            if epoch_end_callback is not None:
+                arg, auxp = self.get_params()
+                epoch_end_callback(epoch, self.symbol, arg, auxp)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric
+                                 or eval_metric)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)

@@ -1,0 +1,138 @@
+"""TensorBoard logging callback (reference
+python/mxnet/contrib/tensorboard.py LogMetricsCallback).
+
+The reference delegates to the external ``tensorboard`` python package;
+this environment has none, so the event-file writer is implemented
+here: standard TFRecord framing (length + masked crc32c) around
+hand-encoded Event/Summary protobuf messages — only the scalar-summary
+subset TensorBoard needs.  Files written here load in stock
+TensorBoard.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import time
+
+__all__ = ["SummaryWriter", "LogMetricsCallback"]
+
+
+# ------------------------------------------------------------- crc32c
+_CRC_TABLE = []
+
+
+def _crc_table():
+    if not _CRC_TABLE:
+        poly = 0x82F63B78
+        for n in range(256):
+            c = n
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            _CRC_TABLE.append(c)
+    return _CRC_TABLE
+
+
+def _crc32c(data):
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data):
+    crc = _crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ------------------------------------------------- minimal proto encode
+def _varint(n):
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field(num, wire, payload):
+    return _varint((num << 3) | wire) + payload
+
+
+def _f_double(num, v):
+    return _field(num, 1, struct.pack("<d", v))
+
+
+def _f_float(num, v):
+    return _field(num, 5, struct.pack("<f", v))
+
+
+def _f_varint(num, v):
+    return _field(num, 0, _varint(v))
+
+
+def _f_bytes(num, data):
+    return _field(num, 2, _varint(len(data)) + data)
+
+
+def _scalar_event(tag, value, step, wall_time):
+    # Summary.Value { tag = 1; simple_value = 2 }
+    sval = _f_bytes(1, tag.encode()) + _f_float(2, float(value))
+    summary = _f_bytes(1, sval)                  # Summary.value = 1
+    # Event { wall_time = 1; step = 2; summary = 5 }
+    return (_f_double(1, wall_time) + _f_varint(2, int(step))
+            + _f_bytes(5, summary))
+
+
+class SummaryWriter:
+    """Minimal events-file writer: ``add_scalar(tag, value, step)``."""
+
+    def __init__(self, logdir):
+        os.makedirs(logdir, exist_ok=True)
+        fname = f"events.out.tfevents.{int(time.time())}.mxnet_tpu"
+        self._f = open(os.path.join(logdir, fname), "wb")
+        # first record: file-version event
+        self._write(_f_double(1, time.time())
+                    + _f_bytes(3, b"brain.Event:2"))
+
+    def _write(self, event_bytes):
+        header = struct.pack("<Q", len(event_bytes))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(event_bytes)
+        self._f.write(struct.pack("<I", _masked_crc(event_bytes)))
+
+    def add_scalar(self, tag, value, step=0, wall_time=None):
+        self._write(_scalar_event(
+            tag, value, step, time.time() if wall_time is None
+            else wall_time))
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+class LogMetricsCallback:
+    """Epoch/batch-end callback that logs every metric to TensorBoard
+    (reference contrib/tensorboard.py surface: ``prefix`` namespaces
+    the tags)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        self.summary_writer = SummaryWriter(logging_dir)
+
+    def __call__(self, param):
+        self.step += 1
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            self.summary_writer.add_scalar(name, value, self.step)
+        self.summary_writer.flush()

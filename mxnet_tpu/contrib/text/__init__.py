@@ -1,0 +1,6 @@
+"""Text utilities (reference python/mxnet/contrib/text/): vocabulary,
+token embeddings, composite embeddings."""
+from . import embedding, utils, vocab  # noqa: F401
+from .vocab import Vocabulary  # noqa: F401
+
+__all__ = ["embedding", "utils", "vocab", "Vocabulary"]

@@ -1,0 +1,227 @@
+"""Token embeddings (reference contrib/text/embedding.py).
+
+``register``/``create`` mirror the reference's registry.  The reference
+downloads GloVe/FastText archives on demand; this environment has no
+egress, so the pretrained classes load from a LOCAL file path passed as
+``pretrained_file_name`` (the same text format: one token per line,
+token then vector values, whitespace-separated).  ``CustomEmbedding``
+is byte-for-byte the reference behavior.
+"""
+from __future__ import annotations
+
+import io
+import logging
+
+import numpy as onp
+
+from ... import ndarray as nd
+from ...base import MXNetError
+from .vocab import Vocabulary
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "TokenEmbedding", "CustomEmbedding", "CompositeEmbedding",
+           "GloVe", "FastText"]
+
+_REGISTRY = {}
+
+
+def register(cls):
+    """Register a TokenEmbedding subclass under its lowercase name."""
+    _REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(embedding_name, **kwargs):
+    """Instantiate a registered embedding (reference embedding.create)."""
+    key = embedding_name.lower()
+    if key not in _REGISTRY:
+        raise MXNetError(
+            f"unknown embedding {embedding_name!r}; registered: "
+            f"{sorted(_REGISTRY)}")
+    return _REGISTRY[key](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Names of the pretrained files each embedding understands.  With
+    no egress these are documentation — pass a local file instead."""
+    table = {n: list(c.pretrained_file_names)
+             for n, c in _REGISTRY.items()}
+    if embedding_name is None:
+        return table
+    return table.get(embedding_name.lower(), [])
+
+
+class TokenEmbedding:
+    """Base: token -> vector store with vocabulary indexing
+    (reference TokenEmbedding)."""
+
+    pretrained_file_names = ()
+
+    def __init__(self, unknown_token="<unk>"):
+        self._unknown_token = unknown_token
+        self._idx_to_token = [unknown_token]
+        self._token_to_idx = {unknown_token: 0}
+        self._idx_to_vec = None  # nd.NDArray (n, dim)
+
+    # ------------------------------------------------------------- load
+    def _load_embedding_txt(self, file_path, elem_delim=" ",
+                            encoding="utf8"):
+        vecs = []
+        dim = None
+        with io.open(file_path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) <= 2:
+                    continue  # header or malformed line
+                token, elems = parts[0], parts[1:]
+                if dim is None:
+                    dim = len(elems)
+                    vecs.append(onp.zeros(dim, "float32"))  # <unk> row
+                if len(elems) != dim:
+                    logging.warning(
+                        "line %d of %s has %d values, expected %d — "
+                        "skipped", line_num, file_path, len(elems), dim)
+                    continue
+                if token in self._token_to_idx:
+                    continue
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                vecs.append(onp.asarray(elems, "float32"))
+        if dim is None:
+            raise MXNetError(f"no embedding vectors found in {file_path}")
+        self._idx_to_vec = nd.array(onp.stack(vecs))
+
+    # ------------------------------------------------------------ query
+    @property
+    def vec_len(self):
+        return int(self._idx_to_vec.shape[1])
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = []
+        for t in toks:
+            i = self._token_to_idx.get(t)
+            if i is None and lower_case_backup:
+                i = self._token_to_idx.get(t.lower())
+            idx.append(0 if i is None else i)
+        rows = self._idx_to_vec._data[onp.asarray(idx)]
+        out = nd.NDArray(rows)
+        return nd.NDArray(out._data[0]) if single else out
+
+    def update_token_vectors(self, tokens, new_vectors):
+        toks = [tokens] if isinstance(tokens, str) else tokens
+        mat = new_vectors._data if isinstance(new_vectors, nd.NDArray) \
+            else onp.asarray(new_vectors)
+        if mat.ndim == 1:
+            mat = mat[None, :]
+        idx = []
+        for t in toks:
+            if t not in self._token_to_idx:
+                raise MXNetError(
+                    f"token {t!r} is unknown; only known-token vectors "
+                    "can be updated")
+            idx.append(self._token_to_idx[t])
+        data = self._idx_to_vec._data
+        self._idx_to_vec._adopt(
+            data.at[onp.asarray(idx)].set(mat.astype(data.dtype)))
+
+
+@register
+class GloVe(TokenEmbedding):
+    """GloVe vectors from a LOCAL glove.*.txt file (the reference
+    downloads from the stanford archive — no egress here)."""
+
+    pretrained_file_names = (
+        "glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+        "glove.6B.200d.txt", "glove.6B.300d.txt", "glove.840B.300d.txt",
+        "glove.twitter.27B.25d.txt", "glove.twitter.27B.50d.txt",
+        "glove.twitter.27B.100d.txt", "glove.twitter.27B.200d.txt")
+
+    def __init__(self, pretrained_file_name="glove.6B.50d.txt",
+                 embedding_root=None, unknown_token="<unk>", **kwargs):
+        super().__init__(unknown_token=unknown_token)
+        import os
+
+        path = pretrained_file_name if embedding_root is None else \
+            os.path.join(embedding_root, pretrained_file_name)
+        if not os.path.exists(path):
+            raise MXNetError(
+                f"{path} not found; downloads are unavailable in this "
+                "environment — place the GloVe txt file locally and "
+                "pass its path")
+        self._load_embedding_txt(path)
+
+
+@register
+class FastText(TokenEmbedding):
+    """FastText .vec vectors from a LOCAL file (same txt format, with a
+    count/dim header line that the loader skips)."""
+
+    pretrained_file_names = (
+        "wiki.simple.vec", "wiki.en.vec", "crawl-300d-2M.vec")
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 embedding_root=None, unknown_token="<unk>", **kwargs):
+        super().__init__(unknown_token=unknown_token)
+        import os
+
+        path = pretrained_file_name if embedding_root is None else \
+            os.path.join(embedding_root, pretrained_file_name)
+        if not os.path.exists(path):
+            raise MXNetError(
+                f"{path} not found; downloads are unavailable in this "
+                "environment — place the .vec file locally and pass "
+                "its path")
+        self._load_embedding_txt(path)
+
+
+class CustomEmbedding(TokenEmbedding):
+    """User-provided embedding file (reference CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", unknown_token="<unk>", **kwargs):
+        super().__init__(unknown_token=unknown_token)
+        self._load_embedding_txt(pretrained_file_path, elem_delim,
+                                 encoding)
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenation of several embeddings over one vocabulary
+    (reference CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(vocabulary, Vocabulary):
+            raise MXNetError("vocabulary must be a text.Vocabulary")
+        if isinstance(token_embeddings, TokenEmbedding):
+            token_embeddings = [token_embeddings]
+        super().__init__(unknown_token=vocabulary.unknown_token)
+        self._vocabulary = vocabulary
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        blocks = []
+        for emb in token_embeddings:
+            vecs = emb.get_vecs_by_tokens(self._idx_to_token)
+            blocks.append(vecs._data)
+        import jax.numpy as jnp
+
+        self._idx_to_vec = nd.NDArray(jnp.concatenate(blocks, axis=1))
+
+    @property
+    def vocabulary(self):
+        return self._vocabulary

@@ -1,0 +1,21 @@
+"""Text token-counting helpers (reference contrib/text/utils.py)."""
+from __future__ import annotations
+
+import re
+from collections import Counter
+
+__all__ = ["count_tokens_from_str"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Tokenize ``source_str`` on the delimiters and count tokens
+    (reference utils.py count_tokens_from_str)."""
+    source_str = re.split(token_delim + "|" + seq_delim, source_str)
+    tokens = [t for t in source_str if t]
+    if to_lower:
+        tokens = [t.lower() for t in tokens]
+    if counter_to_update is None:
+        return Counter(tokens)
+    counter_to_update.update(tokens)
+    return counter_to_update

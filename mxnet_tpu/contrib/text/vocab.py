@@ -1,0 +1,81 @@
+"""Vocabulary (reference contrib/text/vocab.py Vocabulary)."""
+from __future__ import annotations
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary:
+    """Indexes tokens by frequency (reference Vocabulary contract:
+    index 0 is the unknown token; reserved tokens follow; then tokens
+    by descending frequency, ties broken lexically)."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise ValueError("min_freq must be >= 1")
+        if reserved_tokens is not None:
+            if unknown_token in reserved_tokens:
+                raise ValueError(
+                    "unknown_token must not appear in reserved_tokens")
+            if len(set(reserved_tokens)) != len(reserved_tokens):
+                raise ValueError("reserved_tokens must be unique")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = (list(reserved_tokens)
+                                 if reserved_tokens else None)
+        self._idx_to_token = [unknown_token] + (self._reserved_tokens
+                                                or [])
+        self._token_to_idx = {t: i
+                              for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            self._index_counter_keys(counter, most_freq_count, min_freq)
+
+    def _index_counter_keys(self, counter, most_freq_count, min_freq):
+        pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+        room = (most_freq_count if most_freq_count is not None
+                else len(pairs))
+        for token, freq in pairs:
+            if freq < min_freq or room <= 0:
+                break
+            if token in self._token_to_idx:
+                continue
+            self._token_to_idx[token] = len(self._idx_to_token)
+            self._idx_to_token.append(token)
+            room -= 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    def __contains__(self, token):
+        return token in self._token_to_idx
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) -> index/indices; unknown tokens map to index 0."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise ValueError(f"token index {i} out of range")
+        toks = [self._idx_to_token[i] for i in idxs]
+        return toks[0] if single else toks

@@ -39,12 +39,21 @@ class ImageRecordIter(DataIter):
     part_index/num_parts (sharding), seed.
     """
 
+    #: ImageNet PCA lighting basis (reference src/io/image_aug_default.cc
+    #: — the AlexNet eigen decomposition, 0..255 pixel scale)
+    _PCA_EIGVAL = onp.array([55.46, 4.794, 1.148], "float32")
+    _PCA_EIGVEC = onp.array([[-0.5675, 0.7192, 0.4009],
+                             [-0.5808, -0.0045, -0.8140],
+                             [-0.5836, -0.6948, 0.4203]], "float32")
+
     def __init__(self, path_imgrec, data_shape, batch_size, shuffle=False,
                  rand_crop=False, rand_mirror=False, resize=-1,
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0,
                  std_g=1.0, std_b=1.0, preprocess_threads=None,
                  prefetch_buffer=None, label_width=1, round_batch=True,
                  part_index=0, num_parts=1, seed=0, dtype="float32",
+                 random_h=0, random_s=0, random_l=0, pca_noise=0.0,
+                 max_random_contrast=0.0, max_random_illumination=0.0,
                  **kwargs):
         super().__init__(batch_size)
         if len(data_shape) != 3:
@@ -57,6 +66,19 @@ class ImageRecordIter(DataIter):
         self._resize = resize
         self._mean = onp.array([mean_r, mean_g, mean_b], "float32")
         self._std = onp.array([std_r, std_g, std_b], "float32")
+        # color-space augmenters (reference image_aug_default.cc:565
+        # RandomHueSaturationLight): HSL jitter ranges follow the
+        # reference's OpenCV-HLS units (H 0..180, S/L 0..255)
+        self._random_h = float(random_h)
+        self._random_s = float(random_s)
+        self._random_l = float(random_l)
+        self._pca_noise = float(pca_noise)
+        self._max_contrast = float(max_random_contrast)
+        self._max_illumination = float(max_random_illumination)
+        self._color_jitter = any((self._random_h, self._random_s,
+                                  self._random_l, self._pca_noise,
+                                  self._max_contrast,
+                                  self._max_illumination))
         from .. import config as _config
 
         self._threads = (preprocess_threads if preprocess_threads
@@ -167,6 +189,21 @@ class ImageRecordIter(DataIter):
                   if self._rand_mirror
                   else onp.zeros(nimg, "uint8"))
         if _native.get_lib() is not None:
+            if self._color_jitter:
+                # decode raw 0..255 (native normalization off), jitter
+                # in color space, then normalize here — the reference
+                # default-aug chain orders it the same way
+                # (image_aug_default.cc: hsl/pca before mean subtract)
+                raw, _ = _native.decode_augment_batch(
+                    jpegs, h, w,
+                    mean=onp.zeros(3, "float32"),
+                    std=onp.ones(3, "float32"),
+                    crop_x=crop_x, crop_y=crop_y, mirror=mirror,
+                    resize_short=self._resize,
+                    num_threads=self._threads)
+                raw = self._apply_color_jitter(raw)
+                return ((raw - self._mean[None, :, None, None])
+                        / self._std[None, :, None, None])
             batch, _ = _native.decode_augment_batch(
                 jpegs, h, w, mean=self._mean, std=self._std,
                 crop_x=crop_x, crop_y=crop_y, mirror=mirror,
@@ -191,9 +228,83 @@ class ImageRecordIter(DataIter):
             arr = im.asnumpy().astype("float32")
             if mirror[k]:
                 arr = arr[:, ::-1]
-            arr = (arr - self._mean) / self._std
+            if not self._color_jitter:
+                arr = (arr - self._mean) / self._std
             out[k] = arr.transpose(2, 0, 1)
+        if self._color_jitter:
+            out = self._apply_color_jitter(out)
+            out = ((out - self._mean[None, :, None, None])
+                   / self._std[None, :, None, None])
         return out
+
+    # ------------------------------------------- color-space augmenters
+    @staticmethod
+    def _rgb_to_hsl(rgb):
+        """Vectorized RGB(0..1) -> (H deg 0..360, S 0..1, L 0..1)."""
+        r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+        maxc = onp.max(rgb, axis=-1)
+        minc = onp.min(rgb, axis=-1)
+        delta = maxc - minc
+        lum = (maxc + minc) / 2.0
+        denom = 1.0 - onp.abs(2.0 * lum - 1.0)
+        sat = onp.where(delta > 0, delta / onp.maximum(denom, 1e-12), 0.0)
+        safe = onp.maximum(delta, 1e-12)
+        hr = onp.where(maxc == r, ((g - b) / safe) % 6.0, 0.0)
+        hg = onp.where(maxc == g, (b - r) / safe + 2.0, 0.0)
+        hb = onp.where(maxc == b, (r - g) / safe + 4.0, 0.0)
+        # priority r > g > b on ties, like colorsys
+        hue = onp.where(maxc == r, hr, onp.where(maxc == g, hg, hb))
+        hue = onp.where(delta > 0, hue * 60.0, 0.0)
+        return hue, sat, lum
+
+    @staticmethod
+    def _hsl_to_rgb(hue, sat, lum):
+        c = (1.0 - onp.abs(2.0 * lum - 1.0)) * sat
+        hp = (hue % 360.0) / 60.0
+        x = c * (1.0 - onp.abs(hp % 2.0 - 1.0))
+        z = onp.zeros_like(c)
+        conds = [(hp < 1), (hp < 2), (hp < 3), (hp < 4), (hp < 5)]
+        r = onp.select(conds, [c, x, z, z, x], c)
+        g = onp.select(conds, [x, c, c, x, z], z)
+        b = onp.select(conds, [z, z, x, c, c], x)
+        m = lum - c / 2.0
+        return onp.stack([r + m, g + m, b + m], axis=-1)
+
+    def _apply_color_jitter(self, batch):
+        """contrast -> illumination -> HSL jitter -> PCA noise on a raw
+        (N, 3, H, W) 0..255 batch (reference image_aug_default.cc
+        DefaultImageAugmenter order; HSL ranges in OpenCV-HLS units:
+        H 0..180 half-degrees, S/L 0..255)."""
+        n = batch.shape[0]
+        rng = self._rng
+        if self._max_contrast > 0:
+            alpha = 1.0 + rng.uniform(-self._max_contrast,
+                                      self._max_contrast, n)
+            batch = batch * alpha[:, None, None, None].astype("float32")
+        if self._max_illumination > 0:
+            beta = rng.uniform(-self._max_illumination,
+                               self._max_illumination, n)
+            batch = batch + beta[:, None, None, None].astype("float32")
+        if self._random_h or self._random_s or self._random_l:
+            img = onp.clip(batch, 0, 255).transpose(0, 2, 3, 1) / 255.0
+            hue, sat, lum = self._rgb_to_hsl(img)
+            if self._random_h:
+                dh = rng.uniform(-self._random_h, self._random_h, n)
+                hue = hue + 2.0 * dh[:, None, None]  # half-deg -> deg
+            if self._random_s:
+                ds = rng.uniform(-self._random_s, self._random_s, n)
+                sat = onp.clip(sat + ds[:, None, None] / 255.0, 0.0, 1.0)
+            if self._random_l:
+                dl = rng.uniform(-self._random_l, self._random_l, n)
+                lum = onp.clip(lum + dl[:, None, None] / 255.0, 0.0, 1.0)
+            batch = (self._hsl_to_rgb(hue, sat, lum) * 255.0) \
+                .transpose(0, 3, 1, 2).astype("float32")
+        if self._pca_noise > 0:
+            alpha = rng.normal(0.0, self._pca_noise, (n, 3)) \
+                .astype("float32")
+            shift = (alpha * self._PCA_EIGVAL) @ self._PCA_EIGVEC.T
+            batch = batch + shift[:, :, None, None]
+        return onp.clip(batch, 0.0, 255.0)
 
     # ---------------------------------------------------------- iterator
     @property

@@ -125,6 +125,61 @@ def sample_normal(mu, sigma, *, shape=(), dtype=None, key=None):
     return mu_b + z * sig_b
 
 
+def _bcast(p, s):
+    """Broadcast a (tensor-valued) distribution parameter of shape
+    ``p.shape`` against the output shape ``s = p.shape + extra``."""
+    return p.reshape(tuple(p.shape) + (1,) * (len(s) - p.ndim))
+
+
+@register_op("sample_gamma", key_param="key", differentiable=False)
+def sample_gamma(alpha, beta, *, shape=(), dtype=None, key=None):
+    """Per-element gamma: one draw per (alpha, beta) pair (reference
+    src/operator/random/sample_op.cc SampleGamma)."""
+    s = tuple(alpha.shape) + (tuple(shape) if shape else ())
+    g = jax.random.gamma(key, _bcast(alpha, s), s, _dt(dtype))
+    return g * _bcast(beta, s)
+
+
+@register_op("sample_exponential", key_param="key", differentiable=False)
+def sample_exponential(lam, *, shape=(), dtype=None, key=None):
+    """Reference sample_op.cc SampleExponential (rate lambda)."""
+    s = tuple(lam.shape) + (tuple(shape) if shape else ())
+    e = jax.random.exponential(key, s, _dt(dtype))
+    return e / _bcast(lam, s)
+
+
+@register_op("sample_poisson", key_param="key", differentiable=False)
+def sample_poisson(lam, *, shape=(), dtype=None, key=None):
+    """Reference sample_op.cc SamplePoisson."""
+    s = tuple(lam.shape) + (tuple(shape) if shape else ())
+    return jax.random.poisson(key, _bcast(lam, s), s).astype(
+        _dt(dtype))
+
+
+@register_op("sample_negative_binomial", key_param="key",
+             differentiable=False)
+def sample_negative_binomial(k, p, *, shape=(), dtype=None, key=None):
+    """Reference sample_op.cc SampleNegativeBinomial — gamma-Poisson
+    mixture with per-element (k, p)."""
+    s = tuple(k.shape) + (tuple(shape) if shape else ())
+    k1, k2 = jax.random.split(key)
+    kb, pb = _bcast(k, s), _bcast(p, s)
+    lam = jax.random.gamma(k1, kb, s) * (1 - pb) / pb
+    return jax.random.poisson(k2, lam, s).astype(_dt(dtype))
+
+
+@register_op("sample_generalized_negative_binomial", key_param="key",
+             differentiable=False)
+def sample_gen_negative_binomial(mu, alpha, *, shape=(), dtype=None,
+                                 key=None):
+    """Reference sample_op.cc SampleGeneralizedNegativeBinomial."""
+    s = tuple(mu.shape) + (tuple(shape) if shape else ())
+    k1, k2 = jax.random.split(key)
+    mub, ab = _bcast(mu, s), _bcast(alpha, s)
+    lam = jax.random.gamma(k1, 1.0 / ab, s) * (mub * ab)
+    return jax.random.poisson(k2, lam, s).astype(_dt(dtype))
+
+
 @register_op("_random_uniform_like", aliases=("uniform_like",),
              key_param="key", differentiable=False)
 def uniform_like(data, *, low=0.0, high=1.0, key=None):

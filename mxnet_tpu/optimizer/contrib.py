@@ -1,0 +1,9 @@
+"""Contrib optimizers namespace (reference
+python/mxnet/optimizer/contrib.py).
+
+GroupAdaGrad itself lives in the main registry (optimizer.py) so
+``mx.optimizer.create('groupadagrad')`` resolves it like the reference;
+this module mirrors the reference import surface."""
+from .optimizer import GroupAdaGrad
+
+__all__ = ["GroupAdaGrad"]

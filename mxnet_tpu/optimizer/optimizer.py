@@ -490,6 +490,56 @@ class AdaGrad(Optimizer):
 
 
 @_jit
+def _group_adagrad_step(w, hist, g, lr, eps):
+    hist = hist + jnp.mean(jnp.square(g), axis=tuple(range(1, g.ndim)),
+                           keepdims=True)
+    return w - lr * g / jnp.sqrt(hist + eps), hist
+
+
+@register
+class GroupAdaGrad(Optimizer):
+    """Per-row (group) AdaGrad (reference
+    python/mxnet/optimizer/contrib.py GroupAdaGrad + fused op
+    src/operator/contrib/optimizer_op.cc group_adagrad_update):
+
+        history += mean(square(grad), axis=1, keepdims=True)
+        weight  -= lr * grad / sqrt(history + eps)
+
+    One adaptive rate per output row — the embedding-table optimizer.
+    Weight decay is not supported (reference contract)."""
+
+    def __init__(self, eps=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        assert len(weight.shape) >= 2, \
+            "GroupAdaGrad needs >=2-dim weights (one group per row)"
+        return (nd.zeros((weight.shape[0],) + (1,) *
+                         (len(weight.shape) - 1),
+                         ctx=weight.context, dtype=weight.dtype),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        assert self._get_wd(index) == 0.0, \
+            "GroupAdaGrad does not support weight decay"
+        lr = self._get_lr(index)
+        (hist,) = state
+        new_w, new_h = _group_adagrad_step(
+            weight._data, hist._data, self._prep(grad._data), lr,
+            self.float_stable_eps)
+        weight._adopt(new_w)
+        hist._adopt(new_h)
+
+    def fused_update(self, w, g, state, t, key=None):
+        (hist,) = state
+        new_w, new_h = _group_adagrad_step(
+            w, hist, self._prep(g), self.learning_rate,
+            self.float_stable_eps)
+        return new_w, (new_h,)
+
+
+@_jit
 def _rmsprop_step(w, n, g, lr, wd, rho, eps):
     g = g + wd * w
     n = rho * n + (1 - rho) * g * g
@@ -974,11 +1024,22 @@ class Updater:
                 or len(w.devices()) <= 1:
             return state
 
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        repl = NamedSharding(sharding.mesh, PartitionSpec()) \
+            if isinstance(sharding, NamedSharding) else None
+
         def place(s):
             if isinstance(s, (tuple, list)):
                 return type(s)(place(x) for x in s)
-            if isinstance(s, nd.NDArray) and s.shape == weight.shape:
-                s._data = jax.device_put(s._data, sharding)
+            if isinstance(s, nd.NDArray):
+                if s.shape == weight.shape:
+                    s._data = jax.device_put(s._data, sharding)
+                elif repl is not None:
+                    # state with its own shape (GroupAdaGrad's per-row
+                    # history): replicate over the same mesh so the
+                    # fused update sees one consistent device set
+                    s._data = jax.device_put(s._data, repl)
             return s
 
         return place(state)

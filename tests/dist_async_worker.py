@@ -1,0 +1,82 @@
+"""dist_async semantics — run under tools/launch.py with 3 workers.
+
+Reference contract (src/kvstore/kvstore_dist_server.h:346-359): async
+pushes apply immediately per worker; no worker waits for a peer.  The
+test makes worker 2 deliberately slow and asserts workers 0/1 complete
+their rounds in a fraction of the slow worker's delay — the exact
+property bulk-sync cannot provide — then checks the final accumulated
+value and the dead-node liveness probe
+(include/mxnet/kvstore.h:380 get_num_dead_node).
+
+    python tools/launch.py -n 3 --cpu python tests/dist_async_worker.py
+"""
+import os
+import sys
+import time
+
+import numpy as onp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx  # noqa: E402
+
+SLOW_RANK = 2
+SLOW_SLEEP = 6.0
+ROUNDS = 5
+SHAPE = (32, 16)
+
+
+def main():
+    kv = mx.kv.create("dist_async")
+    n, r = kv.num_workers, kv.rank
+    assert n == 3, n
+    assert kv.type == "dist_async"
+
+    kv.init("w", mx.nd.zeros(SHAPE))
+
+    if r == SLOW_RANK:
+        # stop heartbeating FIRST so the liveness probe sees a stale
+        # timestamp once the sleep exceeds the probe window
+        kv._ps_backend().stop_heartbeat()
+        time.sleep(SLOW_SLEEP)
+
+    t0 = time.time()
+    for _ in range(ROUNDS):
+        kv.push("w", mx.nd.ones(SHAPE))
+        out = mx.nd.zeros(SHAPE)
+        kv.pull("w", out=out)
+        # async progress: this worker's own contributions are always
+        # visible (its pushes applied immediately)
+    elapsed = time.time() - t0
+    # my own pushes are in whatever we pulled last
+    assert float(out.asnumpy()[0, 0]) >= ROUNDS - 1e-6
+
+    if r != SLOW_RANK:
+        assert elapsed < SLOW_SLEEP / 2, (
+            f"fast worker {r} took {elapsed:.1f}s — async must not "
+            f"block on the {SLOW_SLEEP}s-slow worker")
+        # the slow worker stopped heartbeating at t0; wait until its
+        # last heartbeat is stale relative to the probe window
+        time.sleep(3.0)
+        dead = kv.num_dead_node(timeout_sec=2.0)
+        assert dead >= 1, dead
+
+    kv.barrier()
+    out = mx.nd.zeros(SHAPE)
+    kv.pull("w", out=out)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                onp.full(SHAPE, float(n * ROUNDS)),
+                                err_msg="async accumulate total")
+
+    # all workers are heartbeating again?  No: SLOW_RANK stopped for
+    # good — a generous-window probe still reports it dead, and the
+    # others alive.
+    dead_final = kv.num_dead_node(timeout_sec=30.0)
+    assert dead_final <= 1, dead_final
+
+    print(f"[worker {r}] dist_async OK ({elapsed:.1f}s, {n} workers)",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -284,3 +284,137 @@ def test_quantize_net_hybridized():
     out = net(x).asnumpy()
     err = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-6)
     assert err < 0.1, err
+
+
+# ---------------------------------------------------- round-4 contrib
+def test_group_adagrad():
+    """GroupAdaGrad (reference optimizer/contrib.py): one adaptive rate
+    per row; matches the reference update rule numerically."""
+    import mxnet_tpu as mx
+
+    opt = mx.optimizer.create("groupadagrad", learning_rate=0.1)
+    w = mx.nd.ones((4, 3))
+    g = mx.nd.array(onp.arange(12, dtype="float32").reshape(4, 3) / 10)
+    state = opt.create_state_multi_precision(0, w)
+    opt.update_multi_precision(0, w, g, state)
+    gnp = onp.arange(12, dtype="float32").reshape(4, 3) / 10
+    hist = (gnp ** 2).mean(axis=1, keepdims=True)
+    expect = 1.0 - 0.1 * gnp / onp.sqrt(hist + 1e-5)
+    onp.testing.assert_allclose(w.asnumpy(), expect, rtol=1e-5)
+    # fused rule agrees with the eager rule
+    w2, (h2,) = opt.fused_update(mx.nd.ones((4, 3))._data, g._data,
+                                 (mx.nd.zeros((4, 1))._data,), 1)
+    onp.testing.assert_allclose(onp.asarray(w2), expect, rtol=1e-5)
+
+
+def test_svrg_module_converges():
+    """SVRGModule (reference contrib/svrg_optimization): trains, and the
+    full-grad snapshot machinery engages every update_freq epochs."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+    from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+
+    rng = onp.random.RandomState(0)
+    X = rng.randn(96, 6).astype("float32")
+    w_true = rng.randn(6, 3).astype("float32")
+    y = (X @ w_true).argmax(axis=1).astype("float32")
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=3, name="fc")
+    out = sym.SoftmaxOutput(fc, sym.Variable("softmax_label"),
+                            name="softmax")
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = SVRGModule(out, context=mx.cpu(), update_freq=2)
+    mod.fit(it, num_epoch=6, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.5),),
+            initializer=mx.init.Xavier())
+    assert mod._param_dict is not None  # snapshot grads were computed
+    score = mod.score(it, mx.metric.Accuracy())
+    assert score[0][1] > 0.8, score
+
+
+def test_tensorboard_writer(tmp_path):
+    """The event file is valid TFRecord framing with masked crc32c and
+    parseable scalar events."""
+    import struct
+
+    from mxnet_tpu.contrib.tensorboard import (LogMetricsCallback,
+                                               SummaryWriter,
+                                               _masked_crc)
+
+    d = str(tmp_path / "tb")
+    wtr = SummaryWriter(d)
+    wtr.add_scalar("loss", 0.5, step=1)
+    wtr.add_scalar("loss", 0.25, step=2)
+    wtr.close()
+    import os
+
+    files = os.listdir(d)
+    assert len(files) == 1 and files[0].startswith("events.out.tfevents")
+    raw = open(os.path.join(d, files[0]), "rb").read()
+    # walk the TFRecord stream, verifying both checksums per record
+    off, n_rec = 0, 0
+    while off < len(raw):
+        (ln,) = struct.unpack_from("<Q", raw, off)
+        hdr = raw[off:off + 8]
+        (hcrc,) = struct.unpack_from("<I", raw, off + 8)
+        assert hcrc == _masked_crc(hdr)
+        data = raw[off + 12:off + 12 + ln]
+        (dcrc,) = struct.unpack_from("<I", raw, off + 12 + ln)
+        assert dcrc == _masked_crc(data)
+        off += 12 + ln + 4
+        n_rec += 1
+    assert n_rec == 3  # version event + 2 scalars
+    assert b"loss" in raw and b"brain.Event:2" in raw
+
+    # callback surface (reference LogMetricsCallback)
+    cb = LogMetricsCallback(str(tmp_path / "tb2"), prefix="train")
+    m = __import__("mxnet_tpu").metric.Accuracy()
+
+    class P:  # BatchEndParam-alike
+        eval_metric = m
+    cb(P())
+
+
+def test_contrib_text_vocab_and_embedding(tmp_path):
+    """contrib.text: Vocabulary indexing + CustomEmbedding loading +
+    CompositeEmbedding concatenation (reference contrib/text)."""
+    from collections import Counter
+
+    from mxnet_tpu.contrib import text
+
+    counter = text.utils.count_tokens_from_str(
+        "the quick the fox the quick end")
+    assert counter["the"] == 3 and counter["quick"] == 2
+    v = text.Vocabulary(counter, most_freq_count=4, min_freq=1,
+                        reserved_tokens=["<pad>"])
+    # unk + pad + 4 kept tokens
+    assert len(v) == 6
+    assert v.to_indices("the") != 0
+    assert v.to_indices("missing") == 0
+    assert v.to_tokens(v.to_indices(["quick", "fox"])) == ["quick",
+                                                           "fox"]
+
+    emb_file = tmp_path / "emb.txt"
+    emb_file.write_text("the 1.0 2.0\nquick 3.0 4.0\nfox 5.0 6.0\n")
+    emb = text.embedding.CustomEmbedding(str(emb_file))
+    assert emb.vec_len == 2
+    vec = emb.get_vecs_by_tokens("quick")
+    onp.testing.assert_allclose(vec.asnumpy(), [3.0, 4.0])
+    unk = emb.get_vecs_by_tokens("nope")
+    onp.testing.assert_allclose(unk.asnumpy(), [0.0, 0.0])
+    emb.update_token_vectors("fox", mx_nd_arr := __import__(
+        "mxnet_tpu").nd.array([9.0, 9.0]))
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("fox").asnumpy(), [9.0, 9.0])
+
+    comp = text.embedding.CompositeEmbedding(v, [emb, emb])
+    assert comp.idx_to_vec.shape == (6, 4)
+    got = comp.get_vecs_by_tokens("quick")
+    onp.testing.assert_allclose(got.asnumpy(), [3.0, 4.0, 3.0, 4.0])
+
+    # registry machinery
+    assert "glove" in text.embedding.get_pretrained_file_names()
+    import pytest as _pytest
+    with _pytest.raises(Exception, match="not found|unknown"):
+        text.embedding.create("glove",
+                              pretrained_file_name="missing.txt")

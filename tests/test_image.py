@@ -298,3 +298,85 @@ def test_image_iter_discard(tmp_path):
     it = img_mod.ImageIter(batch_size=4, data_shape=(3, 32, 32),
                            path_imgrec=rec, last_batch_handle="discard")
     assert sum(1 for _ in it) == 2  # partial final batch dropped
+
+
+def test_hsl_roundtrip_matches_colorsys():
+    """The iterator's vectorized RGB<->HSL agrees with colorsys."""
+    import colorsys
+
+    from mxnet_tpu.io.image_record_iter import ImageRecordIter
+
+    rng = onp.random.RandomState(0)
+    px = rng.rand(64, 3).astype("float32")
+    h, s, l = ImageRecordIter._rgb_to_hsl(px)  # noqa: E741
+    back = ImageRecordIter._hsl_to_rgb(h, s, l)
+    onp.testing.assert_allclose(back, px, atol=1e-5)
+    for i in range(0, 64, 7):
+        ch, cl, cs = colorsys.rgb_to_hls(*px[i])
+        onp.testing.assert_allclose(h[i] / 360.0, ch, atol=1e-5)
+        onp.testing.assert_allclose(l[i], cl, atol=1e-5)
+        onp.testing.assert_allclose(s[i], cs, atol=1e-5)
+
+
+def test_image_record_iter_color_jitter(tmp_path):
+    """random_h/s/l + pca_noise + contrast/illumination (reference
+    image_aug_default.cc:565) produce valid, *different* batches while
+    zero-jitter settings reproduce the plain pipeline exactly."""
+    rec = str(tmp_path / "cj.rec")
+    _make_rec(rec, n=8, h=40, w=40)
+
+    def batch(**kw):
+        it = mx.io.ImageRecordIter(
+            path_imgrec=rec, data_shape=(3, 32, 32), batch_size=8,
+            seed=5, preprocess_threads=1, **kw)
+        b = it.next().data[0].asnumpy()
+        it.close()
+        return b
+
+    plain = batch()
+    zeroj = batch(random_h=0, random_s=0, random_l=0, pca_noise=0.0)
+    onp.testing.assert_allclose(zeroj, plain, atol=1e-4)
+
+    jit = batch(random_h=36, random_s=40, random_l=30, pca_noise=0.05,
+                max_random_contrast=0.2, max_random_illumination=20)
+    assert jit.shape == plain.shape
+    assert onp.isfinite(jit).all()
+    assert onp.abs(jit - plain).max() > 1.0  # actually jittered
+    # only-lightness jitter shifts channel means but keeps structure
+    lum = batch(random_l=50)
+    assert onp.abs(lum - plain).mean() > 0.01
+
+
+def test_sample_tensor_param_ops():
+    """Per-element sample_* family (reference sample_op.cc): one draw
+    per parameter element, statistically near the requested moments."""
+    import mxnet_tpu as mx2
+
+    mx2.random.seed(7)
+    lam = mx.nd.array([1.0, 10.0, 100.0])
+    s = mx.nd.invoke("sample_poisson", [lam], shape=(4000,))
+    assert s.shape == (3, 4000)
+    m = s.asnumpy().mean(axis=1)
+    onp.testing.assert_allclose(m, [1.0, 10.0, 100.0], rtol=0.1)
+
+    alpha = mx.nd.array([2.0, 8.0])
+    beta = mx.nd.array([3.0, 0.5])
+    g = mx.nd.invoke("sample_gamma", [alpha, beta], shape=(4000,))
+    onp.testing.assert_allclose(g.asnumpy().mean(axis=1), [6.0, 4.0],
+                                rtol=0.1)
+
+    lam_e = mx.nd.array([0.5, 4.0])
+    e = mx.nd.invoke("sample_exponential", [lam_e], shape=(4000,))
+    onp.testing.assert_allclose(e.asnumpy().mean(axis=1), [2.0, 0.25],
+                                rtol=0.1)
+
+    k = mx.nd.array([5.0]); p = mx.nd.array([0.5])
+    nb = mx.nd.invoke("sample_negative_binomial", [k, p], shape=(4000,))
+    onp.testing.assert_allclose(nb.asnumpy().mean(axis=1), [5.0],
+                                rtol=0.15)
+
+    mu = mx.nd.array([8.0]); a = mx.nd.array([0.2])
+    gnb = mx.nd.invoke("sample_generalized_negative_binomial", [mu, a],
+                       shape=(4000,))
+    onp.testing.assert_allclose(gnb.asnumpy().mean(axis=1), [8.0],
+                                rtol=0.15)

@@ -211,3 +211,33 @@ def test_ssd_trains_from_det_rec():
         losses.append(epoch_loss / nb)
     assert losses[-1] < losses[0], losses
     it.close()
+
+
+def test_rec2idx_tool(tmp_path):
+    """tools/rec2idx.py regenerates a .idx for an existing .rec
+    (reference tools/rec2idx.py), and MXIndexedRecordIO can seek with
+    it."""
+    import subprocess
+    import sys as _sys
+
+    from mxnet_tpu import recordio
+
+    rec = str(tmp_path / "d.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    payloads = [bytes([i]) * (10 + i) for i in range(7)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = subprocess.run(
+        [_sys.executable, os.path.join(repo, "tools", "rec2idx.py"),
+         rec], capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    idx_path = str(tmp_path / "d.idx")
+    assert os.path.exists(idx_path)
+    lines = open(idx_path).read().strip().splitlines()
+    assert len(lines) == 7
+    r = recordio.MXIndexedRecordIO(idx_path, rec, "r")
+    for i in (3, 0, 6):
+        assert r.read_idx(i) == payloads[i]
+    r.close()

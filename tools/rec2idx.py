@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Regenerate the .idx file for an existing RecordIO .rec file.
+
+Reference parity: tools/rec2idx.py (IndexCreator) — walks the record
+stream, recording each record's byte offset keyed by its sequential
+index, so ImageRecordIter/MXIndexedRecordIO can seek randomly into a
+.rec produced without an index (or whose index was lost).
+
+    python tools/rec2idx.py data.rec [data.idx]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+
+def make_index(rec_path, idx_path):
+    reader = recordio.MXRecordIO(rec_path, "r")
+    counter = 0
+    try:
+        with open(idx_path, "w") as idx:
+            while True:
+                pos = reader.tell()
+                if reader.read() is None:
+                    break
+                idx.write(f"{counter}\t{pos}\n")
+                counter += 1
+    finally:
+        reader.close()
+    return counter
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="create an index file for a RecordIO file")
+    ap.add_argument("record", help="path of the .rec file")
+    ap.add_argument("index", nargs="?", default=None,
+                    help="output .idx path (default: alongside .rec)")
+    args = ap.parse_args()
+    idx = args.index or os.path.splitext(args.record)[0] + ".idx"
+    n = make_index(args.record, idx)
+    print(f"wrote {n} entries to {idx}")
+
+
+if __name__ == "__main__":
+    main()
