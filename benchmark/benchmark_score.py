@@ -19,7 +19,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 import numpy as onp
 
@@ -28,8 +27,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _ALLOWED_DTYPES = ("float32", "bfloat16", "float16")
 
 
-def score_model(model_name, batches, dtypes, image_shape=(3, 224, 224),
-                steps=30):
+def score_model(model_name, batches, dtypes,
+                image_shape=(3, 224, 224)):
     import jax
     import jax.numpy as jnp
 

@@ -146,11 +146,9 @@ def _family_inputs():
         "_rminus_scalar": ([img], dict(scalar=2.0)),
         "_mul_scalar": ([img], dict(scalar=2.0)),
         "_div_scalar": ([img], dict(scalar=2.0)),
-        "_rdiv_scalar": ([img], dict(scalar=2.0)),
-        "_mod_scalar": ([img], dict(scalar=2.0)),
-        "_rmod_scalar": ([img], dict(scalar=2.0)),
         "_power_scalar": ([img], dict(scalar=2.0)),
-        "_rpower_scalar": ([img], dict(scalar=2.0)),
+        # (_mod/_rmod/_rdiv/_rpower scalar variants live in the
+        # FD-conditioned block below)
         "_maximum_scalar": ([img], dict(scalar=0.5)),
         "_minimum_scalar": ([img], dict(scalar=0.5)),
         "clip": ([img], dict(a_min=0.2, a_max=0.8)),

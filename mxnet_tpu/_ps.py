@@ -349,12 +349,30 @@ class PSBackend:
         return self._request(0, ("dead", float(timeout_s)))
 
     def _heartbeat_loop(self):
+        # DEDICATED connection: the shared per-server socket is held
+        # for the full duration of a blocking sync pull, and a worker
+        # silently not heartbeating while it WAITS would make the
+        # liveness probe report healthy-but-blocked workers dead —
+        # the exact confusion the probe exists to resolve
         interval = float(os.environ.get("MXNET_PS_HEARTBEAT_SEC", "0.3"))
+        conn = None
         while not self._hb_stop.is_set():
             try:
-                self._request(0, ("hb", self.rank))
+                if conn is None:
+                    host, port = self._addrs[0].rsplit(":", 1)
+                    conn = socket.create_connection(
+                        (host, int(port)), timeout=30)
+                    conn.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                _send_msg(conn, ("hb", self.rank))
+                _recv_msg(conn)
             except Exception:
-                pass
+                try:
+                    if conn is not None:
+                        conn.close()
+                except OSError:
+                    pass
+                conn = None
             self._hb_stop.wait(interval)
 
     def stop_heartbeat(self):

@@ -39,7 +39,9 @@ def _tensor(name, arr):
     t = pb.TensorProto()
     t.name = name
     a = onp.asarray(arr)
-    if a.dtype == onp.float64:
+    if a.dtype == onp.float64 or str(a.dtype) == "bfloat16":
+        # f64: ONNX consumers mostly expect f32; bf16: the importer's
+        # numpy decode path has no BFLOAT16 codec, so widen on export
         a = a.astype(onp.float32)
     t.dims.extend(a.shape)
     t.data_type = _DT[str(a.dtype)]
@@ -47,10 +49,14 @@ def _tensor(name, arr):
     return t
 
 
-def _vinfo(name, shape, dtype="float32"):
+def _vinfo(name, shape, dtype="float32", unknown_rank=False):
     vi = pb.ValueInfoProto()
     vi.name = name
     vi.type.tensor_type.elem_type = _DT[dtype]
+    if unknown_rank:
+        # leave the shape message unset entirely: claiming () would
+        # declare a scalar and break strict shape inference downstream
+        return vi
     for d in shape:
         dim = vi.type.tensor_type.shape.dim.add()
         if d is None or d == 0:
@@ -340,7 +346,8 @@ def export_model(sym, params, input_shape, input_type="float32",
     g.initializer.extend(ctx.initializers)
     g.input.extend(graph_inputs)
     for (nid, i) in [(h[0], h[1]) for h in heads]:
-        g.output.extend([_vinfo(out_name[(nid, i)], ())])
+        g.output.extend([_vinfo(out_name[(nid, i)], (),
+                                unknown_rank=True)])
     with open(onnx_file_path, "wb") as f:
         f.write(model.SerializeToString())
     return onnx_file_path

@@ -160,7 +160,10 @@ def import_model(model_file):
                 pool_type="max" if op == "MaxPool" else "avg",
                 pooling_convention="full" if att.get("ceil_mode")
                 else "valid",
-                count_include_pad=bool(att.get("count_include_pad", 1)),
+                # ONNX operator default EXCLUDES padding (spec: 0);
+                # the exporter always writes the attribute, so only
+                # foreign models hit this default
+                count_include_pad=bool(att.get("count_include_pad", 0)),
                 name=node.name)
         elif op in ("GlobalMaxPool", "GlobalAveragePool"):
             out = sym_mod.Pooling(

@@ -47,6 +47,10 @@ def _masked_crc(data):
 
 # ------------------------------------------------- minimal proto encode
 def _varint(n):
+    if n < 0:
+        # protobuf encodes negative ints as 64-bit two's complement
+        # (10 bytes); python's arithmetic shift would loop forever
+        n &= (1 << 64) - 1
     out = bytearray()
     while True:
         b = n & 0x7F

@@ -85,11 +85,18 @@ class FeedForward:
             self.symbol, context=self.ctx,
             label_names=[n for n in self.symbol.list_arguments()
                          if n.endswith("label")] or None)
+        # hyper-params given to the ctor (learning_rate, momentum, wd,
+        # ...) flow to the optimizer, reference FeedForward contract
+        hyper = tuple(
+            (k, v) for k, v in self._kwargs.items()
+            if k in ("learning_rate", "momentum", "wd", "rescale_grad",
+                     "clip_gradient", "beta1", "beta2", "epsilon"))
         module.fit(
             X, eval_data=eval_data, eval_metric=eval_metric,
             epoch_end_callback=epoch_end_callback,
             batch_end_callback=batch_end_callback, kvstore=kvstore,
             optimizer=self.optimizer,
+            optimizer_params=hyper or (("learning_rate", 0.01),),
             initializer=self.initializer,
             arg_params=self.arg_params, aux_params=self.aux_params,
             num_epoch=self.num_epoch)
@@ -102,6 +109,18 @@ class FeedForward:
             raise MXNetError("call fit before predict")
         out = self._module.predict(X, num_batch=num_batch, reset=reset)
         return out.asnumpy() if hasattr(out, "asnumpy") else out
+
+    def score(self, X, eval_metric="acc", num_batch=None, **kwargs):
+        """Evaluate on a data iterator (reference model.py
+        FeedForward.score)."""
+        if self._module is None:
+            raise MXNetError("call fit before score")
+        from . import metric as metric_mod
+
+        if not hasattr(eval_metric, "update"):
+            eval_metric = metric_mod.create(eval_metric)
+        res = self._module.score(X, eval_metric, num_batch=num_batch)
+        return res[0][1] if res else None
 
     @staticmethod
     def load(prefix, epoch, ctx=None, **kwargs):

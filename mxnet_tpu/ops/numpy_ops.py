@@ -491,10 +491,15 @@ _reg("_npi_diagflat", lambda v, *, k=0: jnp.diagflat(v, k=k))
 
 
 def _as_int(x):
-    # cast only float inputs; preserve existing integer dtypes so
-    # int64 shifts don't silently truncate
-    return x.astype(jnp.int32) if jnp.issubdtype(x.dtype, jnp.floating) \
-        else x
+    # numpy raises for bitwise ops on floats — silently truncating to
+    # int32 would be a semantic divergence from the contract these ops
+    # mirror; integer dtypes pass through untouched (int64 shifts must
+    # not narrow)
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        raise TypeError(
+            "bitwise operations are not supported for floating dtypes "
+            f"(got {x.dtype}); cast to an integer dtype first")
+    return x
 
 
 _reg("_npi_bitwise_and",

@@ -114,3 +114,30 @@ def test_dist_async_kvstore_multiprocess(n):
     assert res.returncode == 0
     for r in range(n):
         assert f"[worker {r}] dist_async OK" in res.stdout
+
+
+def test_local_launcher_restarts_failed_worker(tmp_path):
+    """--max-restarts relaunches a nonzero-exit worker (elasticity
+    floor; see tools/launch.py docstring for the dist_sync caveat)."""
+    marker = str(tmp_path / "attempt")
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import os, sys\n"
+        f"m = {marker!r} + os.environ['DMLC_WORKER_ID']\n"
+        "n = int(open(m).read()) if os.path.exists(m) else 0\n"
+        "open(m, 'w').write(str(n + 1))\n"
+        "# rank 1 fails on its first attempt only\n"
+        "if os.environ['DMLC_WORKER_ID'] == '1' and n == 0:\n"
+        "    sys.exit(3)\n"
+        "print('worker', os.environ['DMLC_WORKER_ID'], 'ok', flush=True)\n")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+         "-n", "2", "--max-restarts", "2", "--cpu",
+         sys.executable, str(script)],
+        env=env, cwd=_REPO, timeout=120, capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    assert "worker 0 ok" in res.stdout and "worker 1 ok" in res.stdout
+    assert "restarting" in res.stderr
+    assert open(marker + "1").read() == "2"  # rank 1 ran twice

@@ -1,0 +1,111 @@
+"""f64/f32/bf16 consistency ladder for the nn op family.
+
+VERDICT r03 weak #8: ``check_consistency`` (reference test_utils.py
+:1259 — there the axis is cpu-vs-gpu, here it is the dtype ladder:
+one XLA program serves every backend) was exercised only sporadically.
+This sweeps the core nn family: each op runs in float64, float32 and
+bfloat16 on identical inputs, and every narrower result must match the
+float64 reference within that dtype's tolerance.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+TOLS = {"float32": (1e-4, 1e-5), "bfloat16": (5e-2, 5e-2)}
+
+
+def _ladder(build, arg_shapes, scale=1.0, aux_ones=()):
+    """Run the symbol across the dtype ladder and compare to f64."""
+    import jax
+
+    rng = onp.random.RandomState(0)
+    s = build()
+    args64 = {}
+    for name, shape in arg_shapes.items():
+        args64[name] = rng.normal(scale=scale, size=shape)
+    outs = {}
+    # x64 must be live or the float64 rung silently truncates to f32
+    # and the ladder compares f32 against itself
+    with jax.enable_x64(True):
+        for dtype in ("float64", "float32", "bfloat16"):
+            args = {k: mx.nd.array(v.astype("float32")).astype(dtype)
+                    for k, v in args64.items()}
+            aux = {n: mx.nd.ones(shape).astype(dtype)
+                   for n, shape in aux_ones}
+            ex = s.bind(mx.cpu(), args=args, aux_states=aux or None)
+            out = ex.forward()[0]
+            assert str(out.dtype) == dtype, (
+                f"{build.__name__}: output dtype {out.dtype} != input "
+                f"rung {dtype}")
+            outs[dtype] = out.asnumpy().astype("float64")
+    for dtype, (rtol, atol) in TOLS.items():
+        onp.testing.assert_allclose(
+            outs[dtype], outs["float64"], rtol=rtol, atol=atol,
+            err_msg=f"{build.__name__} diverges at {dtype}")
+
+
+def test_convolution_ladder():
+    def conv():
+        return sym.Convolution(sym.Variable("data"),
+                               sym.Variable("w"), sym.Variable("b"),
+                               kernel=(3, 3), num_filter=8, pad=(1, 1),
+                               name="c")
+    _ladder(conv, {"data": (2, 4, 12, 12), "w": (8, 4, 3, 3),
+                   "b": (8,)}, scale=0.5)
+
+
+def test_fully_connected_ladder():
+    def fc():
+        return sym.FullyConnected(sym.Variable("data"),
+                                  sym.Variable("w"), sym.Variable("b"),
+                                  num_hidden=16, name="f")
+    _ladder(fc, {"data": (8, 24), "w": (16, 24), "b": (16,)}, scale=0.5)
+
+
+def test_batchnorm_ladder():
+    def bn():
+        return sym.BatchNorm(sym.Variable("data"), name="bn0",
+                             fix_gamma=False)
+    _ladder(bn, {"data": (4, 6, 8, 8), "bn0_gamma": (6,),
+                 "bn0_beta": (6,)},
+            aux_ones=(("bn0_moving_mean", (6,)),
+                      ("bn0_moving_var", (6,))))
+
+
+def test_layernorm_ladder():
+    def ln():
+        return sym.LayerNorm(sym.Variable("data"), sym.Variable("g"),
+                             sym.Variable("b"), name="ln")
+    _ladder(ln, {"data": (6, 32), "g": (32,), "b": (32,)})
+
+
+def test_pooling_ladder():
+    def pool():
+        return sym.Pooling(sym.Variable("data"), kernel=(2, 2),
+                           stride=(2, 2), pool_type="avg", name="p")
+    _ladder(pool, {"data": (2, 4, 8, 8)})
+
+
+def test_softmax_ladder():
+    def sm():
+        return sym.softmax(sym.Variable("data"), name="s")
+    _ladder(sm, {"data": (8, 32)})
+
+
+def test_activation_ladder():
+    def act():
+        return sym.Activation(sym.Variable("data"), act_type="tanh",
+                              name="a")
+    _ladder(act, {"data": (8, 32)})
+
+
+def test_deconvolution_ladder():
+    def deconv():
+        return sym.Deconvolution(sym.Variable("data"),
+                                 sym.Variable("w"), kernel=(3, 3),
+                                 num_filter=4, stride=(2, 2),
+                                 pad=(1, 1), no_bias=True, name="d")
+    _ladder(deconv, {"data": (2, 6, 8, 8), "w": (6, 4, 3, 3)},
+            scale=0.5)

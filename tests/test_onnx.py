@@ -131,3 +131,73 @@ def test_hybrid_export_writes_symbol_json():
     x = nd.array(onp.random.rand(1, 3, 32, 32).astype("float32"))
     onp.testing.assert_allclose(blk(x).asnumpy(), net(x).asnumpy(),
                                 rtol=1e-5, atol=1e-5)
+
+
+def test_bf16_params_export_as_f32():
+    """ADVICE r03: a bf16-param model must export (widened to f32) and
+    re-import rather than emitting an undecodable BFLOAT16 tensor."""
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, in_units=4))
+    net.initialize(init=mx.init.Xavier())
+    net.cast("bfloat16")
+    x = nd.array(onp.random.rand(2, 4).astype("float32")).astype(
+        "bfloat16")
+    ref = net(x).asnumpy().astype("float32")
+    pre = tempfile.mktemp()
+    sym = net.export(pre)
+    params = nd.load(pre + "-0000.params")
+    path = tempfile.mktemp(suffix=".onnx")
+    onnx_mxnet.export_model(sym, params, [(2, 4)], onnx_file_path=path)
+    onnx_mxnet.check_model(path)
+    sym2, arg, aux = onnx_mxnet.import_model(path)
+    assert all(str(v._data.dtype) == "float32" for v in arg.values())
+    ex = sym2.bind(args={**{"data": x.astype("float32")}, **arg},
+                   aux_states=aux)
+    out = ex.forward()[0].asnumpy()
+    onp.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
+
+
+def test_avgpool_import_default_excludes_padding():
+    """ONNX spec: count_include_pad defaults to 0 (exclude). A model
+    WITHOUT the attribute must import with exclude-padding averages."""
+    from mxnet_tpu.contrib.onnx._proto import pb
+
+    m = pb.ModelProto()
+    m.ir_version = 8
+    op = m.opset_import.add(); op.domain = ""; op.version = 13
+    g = m.graph; g.name = "t"
+    n = g.node.add()
+    n.op_type = "AveragePool"; n.input.append("data")
+    n.output.append("out"); n.name = "pool0"
+    k = n.attribute.add(); k.name = "kernel_shape"
+    k.type = pb.AttributeProto.INTS; k.ints.extend([2, 2])
+    p = n.attribute.add(); p.name = "pads"
+    p.type = pb.AttributeProto.INTS; p.ints.extend([1, 1, 1, 1])
+    inp = g.input.add(); inp.name = "data"
+    inp.type.tensor_type.elem_type = pb.TensorProto.FLOAT
+    for d in (1, 1, 4, 4):
+        inp.type.tensor_type.shape.dim.add().dim_value = d
+    g.output.add().name = "out"
+    path = tempfile.mktemp(suffix=".onnx")
+    with open(path, "wb") as f:
+        f.write(m.SerializeToString())
+    sym2, arg, aux = onnx_mxnet.import_model(path)
+    x = nd.array(onp.ones((1, 1, 4, 4), "float32"))
+    ex = sym2.bind(args={**{"data": x}, **arg}, aux_states=aux)
+    out = ex.forward()[0].asnumpy()
+    # corner of an all-ones input: exclude-padding average == 1.0
+    # (include-padding would give 0.25)
+    onp.testing.assert_allclose(out[0, 0, 0, 0], 1.0, rtol=1e-6)
+
+
+def test_bitwise_rejects_floats():
+    """ADVICE r03: numpy raises TypeError for bitwise ops on floats —
+    so does mx.np (no silent int truncation)."""
+    a = mx.np.array([1.0, 2.0])
+    b = mx.np.array([3.0, 1.0])
+    with pytest.raises(TypeError, match="bitwise"):
+        mx.np.bitwise_and(a, b)
+    ia = mx.np.array([1, 2], dtype="int32")
+    ib = mx.np.array([3, 1], dtype="int32")
+    onp.testing.assert_array_equal(
+        mx.np.bitwise_and(ia, ib).asnumpy(), [1, 0])

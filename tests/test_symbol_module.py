@@ -250,3 +250,28 @@ def test_module_multi_context_batch_divisibility():
         mod.forward(DataBatch(data=[mx.nd.zeros((12, 10))],
                               label=[mx.nd.zeros((12,))]),
                     is_train=False)
+
+
+def test_feedforward_legacy_api(tmp_path):
+    """FeedForward (reference model.py legacy trainer): fit, predict,
+    score, save/load."""
+    rng = onp.random.RandomState(2)
+    X = rng.randn(128, 8).astype("float32")
+    w = rng.randn(8, 3).astype("float32")
+    y = (X @ w).argmax(axis=1).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    ff = mx.model.FeedForward(_mlp_symbol(num_hidden=12, classes=3),
+                              ctx=mx.cpu(), num_epoch=8,
+                              optimizer="sgd", learning_rate=0.3,
+                              momentum=0.9,
+                              initializer=mx.init.Xavier())
+    ff.fit(it)
+    preds = ff.predict(it)
+    assert preds.shape == (128, 3)
+    acc = ff.score(it)
+    assert acc > 0.8, acc
+    prefix = str(tmp_path / "ff")
+    ff.save(prefix, 8)
+    ff2 = mx.model.FeedForward.load(prefix, 8, ctx=mx.cpu())
+    assert ff2.arg_params is not None
+    assert "fc1_weight" in ff2.arg_params

@@ -29,6 +29,25 @@ Modes:
 --cpu forces the workers onto the CPU backend with a virtual device
 each — the way to exercise multi-worker semantics on one host (the
 driver's 8-device CPU mesh pattern).
+
+Failure handling (reference floor: kvstore get_num_dead_node,
+include/mxnet/kvstore.h:380):
+
+  * ``DistKVStore.num_dead_node(timeout_sec=...)`` reports workers
+    whose parameter-server heartbeat went stale — poll it from rank 0
+    to detect hung/dead peers.
+  * ``--max-restarts K`` (local mode) relaunches a worker that exits
+    nonzero, up to K times per rank.  This suits IDEMPOTENT worker
+    scripts that re-initialize their own state (resume from a
+    checkpoint, re-run a data shard).  It does NOT transparently
+    resume an in-flight kvstore job: a crashed worker takes its
+    parameter-server key shard's memory with it, and bulk-sync
+    collectives cannot survive a lost member (jax.distributed tears
+    the group down) — for training, recovery is a whole-job restart
+    from the last checkpoint (Module.save_checkpoint / Trainer state
+    files), the reference's recovery story too.  Use
+    ``num_dead_node`` to DETECT the failure promptly; use
+    checkpoints to recover.
 """
 from __future__ import annotations
 
@@ -68,8 +87,8 @@ def _worker_env(args, rank, root_uri, port):
 
 def _launch_local(args):
     port = _free_port()
-    procs = []
-    for rank in range(args.num_workers):
+
+    def spawn(rank):
         env = dict(os.environ)
         env.update(_worker_env(args, rank, "127.0.0.1", port))
         if args.cpu:
@@ -77,8 +96,32 @@ def _launch_local(args):
             # would pre-initialize the backend, breaking
             # jax.distributed.initialize in the workers
             env.pop("PALLAS_AXON_POOL_IPS", None)
-        procs.append(subprocess.Popen(args.command, env=env))
-    return procs
+        return subprocess.Popen(args.command, env=env)
+
+    procs = [spawn(r) for r in range(args.num_workers)]
+    if not args.max_restarts:
+        return procs
+    # supervise: relaunch nonzero-exit workers up to --max-restarts
+    # times per rank (see module docstring for the dist_sync caveat)
+    budget = [args.max_restarts] * args.num_workers
+    while True:
+        live = [p for p in procs if p.poll() is None]
+        done = [(r, p) for r, p in enumerate(procs)
+                if p.poll() is not None]
+        restarted = False
+        for r, p in done:
+            if p.returncode and budget[r] > 0:
+                budget[r] -= 1
+                sys.stderr.write(
+                    f"[launch] worker {r} exited rc={p.returncode}; "
+                    f"restarting ({budget[r]} retries left)\n")
+                procs[r] = spawn(r)
+                restarted = True
+        if not live and not restarted:
+            return procs
+        import time as _time
+
+        _time.sleep(0.5)
 
 
 def _launch_ssh(args):
@@ -152,6 +195,10 @@ def main():
                          "multi-process testing)")
     ap.add_argument("--env", action="append", default=[],
                     help="extra KEY=VALUE for workers")
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="local mode: relaunch a nonzero-exit worker "
+                         "up to K times (for idempotent/checkpoint-"
+                         "resuming scripts; see docstring)")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     if not args.command:
