@@ -28,16 +28,34 @@ _OUT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "_build")
 
 
-def _build():
+def build_native(src, out_name, ldflags=(), opt="-O3"):
+    """Build ``src`` into ``_build/<out_name>`` and return the path.
+
+    ATOMIC against concurrent builders (launch.py starts N worker
+    processes that may all hit a cold cache simultaneously): compile to
+    a per-pid temp file, then os.replace onto the final name — a
+    concurrent reader either sees the old complete file or the new
+    complete file, never a half-written ELF."""
     os.makedirs(_OUT_DIR, exist_ok=True)
-    out = os.path.join(_OUT_DIR, "librecordio_native.so")
+    out = os.path.join(_OUT_DIR, out_name)
     if os.path.exists(out) and \
-            os.path.getmtime(out) >= os.path.getmtime(_SRC):
+            os.path.getmtime(out) >= os.path.getmtime(src):
         return out
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
-           "-o", out, "-ljpeg", "-lpthread"]
-    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    tmp = f"{out}.{os.getpid()}.tmp"
+    cmd = ["g++", opt, "-shared", "-fPIC", "-std=c++17", src,
+           "-o", tmp] + list(ldflags)
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, out)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
     return out
+
+
+def _build():
+    return build_native(_SRC, "librecordio_native.so",
+                        ldflags=("-ljpeg", "-lpthread"))
 
 
 def get_lib():

@@ -17,13 +17,20 @@ Dead-node detection: every worker heartbeats server 0; the
 ``num_dead_node(timeout)`` probe is the reference's
 ``get_num_dead_node`` floor (include/mxnet/kvstore.h:380).
 
-Transport: length-prefixed pickled tuples over TCP between trusted
-cluster peers (the reference trusts its ps-lite peers the same way).
-Server addresses are exchanged through the jax.distributed coordinator
-KV service; single-host jobs fall back to loopback derived ports.
+Transport: the server shard is NATIVE C++ (src/ps_server_native.cc,
+built on first use like the recordio decoder — the runtime analog of
+ps-lite's C++ server) speaking a little-endian binary protocol; when
+the toolchain is unavailable (or MXNET_PS_NATIVE=0) a pure-Python
+shard speaking length-prefixed pickle serves instead.  Each shard
+advertises its protocol in the exchanged address ("n:host:port" /
+"p:host:port"), so clients pick the right codec per server and mixed
+clusters still work.  Both transports trust their cluster peers, as
+the reference trusts its ps-lite peers.  Addresses are exchanged
+through the jax.distributed coordinator KV service.
 """
 from __future__ import annotations
 
+import ctypes
 import os
 import pickle
 import socket
@@ -232,8 +239,109 @@ class _ServerShard(threading.Thread):
             pass
 
 
+# ------------------------------------------------- native shard loader
+_native_lock = threading.Lock()
+_native_lib = None
+_native_tried = False
+
+_PS_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "src", "ps_server_native.cc")
+
+#: ctypes signature of the optimizer callback the native server calls
+_UPDATER_CB = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(ctypes.c_float),
+    ctypes.POINTER(ctypes.c_float), ctypes.c_uint64)
+
+
+def _get_native_lib():
+    """Build + load the C++ shard (same pattern as _native.py's
+    recordio decoder); None when the toolchain is absent or
+    MXNET_PS_NATIVE=0."""
+    global _native_lib, _native_tried
+    if os.environ.get("MXNET_PS_NATIVE", "1") == "0":
+        return None
+    with _native_lock:
+        if _native_tried:
+            return _native_lib
+        _native_tried = True
+        try:
+            from ._native import build_native
+
+            out = build_native(_PS_SRC, "libps_server_native.so",
+                               ldflags=("-lpthread",), opt="-O2")
+            lib = ctypes.CDLL(out)
+            lib.ps_native_start.restype = ctypes.c_int
+            lib.ps_native_start.argtypes = [ctypes.c_int, ctypes.c_int]
+            lib.ps_native_set_updater.restype = None
+            lib.ps_native_set_updater.argtypes = [_UPDATER_CB]
+            _native_lib = lib
+        except Exception:
+            _native_lib = None
+        return _native_lib
+
+
+# --------------------------------------------- native binary encoding
+def _n_encode(msg):
+    op_map = {"init": 0, "push": 1, "pull": 2, "hb": 3, "dead": 4}
+    op = msg[0]
+    key = msg[1] if op in ("init", "push", "pull") else ""
+    kb = key.encode()
+    head = struct.pack("<BI", op_map[op], len(kb)) + kb
+    if op == "init":
+        _, _, value, sender = msg
+        v = onp.ascontiguousarray(value, onp.float32)
+        body = struct.pack("<iQ", sender, v.size) + v.tobytes()
+    elif op == "push":
+        _, _, payload, mode, meta = msg
+        if meta.get("compressed"):
+            n = 1
+            for d in meta["shape"]:
+                n *= d
+            body = struct.pack(
+                "<iBBfQ", meta["sender"], 0 if mode == "sync" else 1,
+                1, float(meta["threshold"]), n) + bytes(payload)
+        else:
+            v = onp.ascontiguousarray(payload, onp.float32)
+            body = struct.pack(
+                "<iBBfQ", meta["sender"], 0 if mode == "sync" else 1,
+                0, 0.0, v.size) + v.tobytes()
+    elif op == "pull":
+        body = struct.pack("<i", msg[2])
+    elif op == "hb":
+        body = struct.pack("<i", msg[1])
+    else:  # dead
+        body = struct.pack("<d", float(msg[1]))
+    frame = head + body
+    return struct.pack("<Q", len(frame)) + frame
+
+
+def _n_roundtrip(sock, msg):
+    sock.sendall(_n_encode(msg))
+    (ln,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    data = _recv_exact(sock, ln)
+    status = data[0]
+    if status == 0:
+        return None
+    if status == 1:
+        raise MXNetError(f"ps server error: {data[1:].decode()}")
+    if status == 2:
+        (n,) = struct.unpack_from("<Q", data, 1)
+        return onp.frombuffer(data, onp.float32, count=n,
+                              offset=9).copy()
+    if status == 3:
+        (m,) = struct.unpack_from("<I", data, 1)
+        return list(struct.unpack_from(f"<{m}i", data, 5))
+    raise MXNetError(f"ps: bad response status {status}")
+
+
 class PSBackend:
-    """Worker-side client + in-process server shard (one per process)."""
+    """Worker-side client + in-process server shard (one per process).
+
+    The shard is the native C++ server when buildable (protocol tag
+    "n:" in the exchanged address), else the Python pickle server
+    ("p:"); clients pick the codec per server address, so mixed
+    clusters interoperate.
+    """
 
     _singleton = None
 
@@ -246,8 +354,23 @@ class PSBackend:
     def __init__(self, rank, size):
         self.rank = rank
         self.size = size
-        self.server = _ServerShard(rank, size)
-        self.server.start()
+        self._updaters = {}
+        self._native_cb = None  # keep the ctypes callback alive
+        lib = _get_native_lib()
+        port = lib.ps_native_start(rank, size) if lib is not None \
+            else -1
+        if port > 0:
+            self._lib = lib
+            self.server = None
+            self._proto = "n"
+            self._port = port
+        else:
+            self._lib = None
+            self.server = _ServerShard(rank, size)
+            self.server.start()
+            self.server.updaters = self._updaters
+            self._proto = "p"
+            self._port = self.server.port
         self._addrs = self._exchange_addrs()
         self._conns = {}
         self._conn_locks = {}
@@ -264,7 +387,7 @@ class PSBackend:
             my_ip = socket.gethostbyname(host)
         except OSError:
             my_ip = "127.0.0.1"
-        mine = f"{my_ip}:{self.server.port}"
+        mine = f"{self._proto}:{my_ip}:{self._port}"
         if self.size == 1:
             return {0: mine}
         from jax._src import distributed as _jd
@@ -281,23 +404,29 @@ class PSBackend:
                 f"mxps/addr/{r}", 60_000)
         return addrs
 
+    def _addr_of(self, r):
+        proto, host, port = self._addrs[r].split(":", 2)
+        return proto, host, int(port)
+
     def _conn(self, r):
         # guarded: the heartbeat thread and the worker thread race to
         # open the first connection; an unguarded check-then-create left
         # two sockets sharing one dict slot and corrupted the framing
         with self._conn_create:
             if r not in self._conns:
-                host, port = self._addrs[r].rsplit(":", 1)
-                s = socket.create_connection((host, int(port)),
-                                             timeout=600)
+                _, host, port = self._addr_of(r)
+                s = socket.create_connection((host, port), timeout=600)
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 self._conns[r] = s
                 self._conn_locks[r] = threading.Lock()
         return self._conns[r], self._conn_locks[r]
 
     def _request(self, r, msg):
+        proto = self._addr_of(r)[0]
         sock, lock = self._conn(r)
         with lock:
+            if proto == "n":
+                return _n_roundtrip(sock, msg)
             _send_msg(sock, msg)
             resp = _recv_msg(sock)
         if resp[0] == "val":
@@ -337,7 +466,37 @@ class PSBackend:
     def set_updater(self, namespace, updater):
         # in-process: this rank's shard applies with this updater; all
         # ranks run the same program so every shard gets the same rule
-        self.server.updaters[namespace] = updater
+        self._updaters[namespace] = updater
+        if self._lib is not None and self._native_cb is None:
+            self._native_cb = _UPDATER_CB(self._native_updater)
+            self._lib.ps_native_set_updater(self._native_cb)
+
+    def _native_updater(self, key_c, grad_p, value_p, n):
+        """C callback from the native shard: apply the Python-side
+        optimizer rule in place.  Returns 0 if applied, 1 if no rule is
+        registered for the key's namespace (server falls back to its
+        default merge semantics), -1 if the rule RAISED — the server
+        surfaces that to the pushing client instead of silently
+        merging."""
+        try:
+            key = key_c.decode()
+            ns, _, bare = key.partition("/")
+            updater = self._updaters.get(ns)
+            if updater is None:
+                return 1
+            from . import ndarray as nd
+
+            grad = onp.ctypeslib.as_array(grad_p, shape=(n,)).copy()
+            value = onp.ctypeslib.as_array(value_p, shape=(n,))
+            stored = nd.array(value.copy())
+            updater(bare or key, nd.array(grad), stored)
+            value[:] = onp.asarray(stored.asnumpy(), onp.float32)
+            return 0
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            return -1
 
     def num_dead_node(self, timeout_s=60.0):
         """Count workers whose heartbeat is older than ``timeout_s``
@@ -356,16 +515,20 @@ class PSBackend:
         # the exact confusion the probe exists to resolve
         interval = float(os.environ.get("MXNET_PS_HEARTBEAT_SEC", "0.3"))
         conn = None
+        proto = self._addr_of(0)[0]
         while not self._hb_stop.is_set():
             try:
                 if conn is None:
-                    host, port = self._addrs[0].rsplit(":", 1)
+                    _, host, port = self._addr_of(0)
                     conn = socket.create_connection(
-                        (host, int(port)), timeout=30)
+                        (host, port), timeout=30)
                     conn.setsockopt(socket.IPPROTO_TCP,
                                     socket.TCP_NODELAY, 1)
-                _send_msg(conn, ("hb", self.rank))
-                _recv_msg(conn)
+                if proto == "n":
+                    _n_roundtrip(conn, ("hb", self.rank))
+                else:
+                    _send_msg(conn, ("hb", self.rank))
+                    _recv_msg(conn)
             except Exception:
                 try:
                     if conn is not None:

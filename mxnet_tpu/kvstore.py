@@ -466,6 +466,8 @@ class DistKVStore(KVStore):
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized")
             val = jnp.asarray(ps.pull(self._ps_key(k)))
+            # the native shard returns flat f32; restore the key's shape
+            val = val.reshape(self._store[k].shape)
             self._store[k]._adopt(
                 val.astype(self._store[k]._data.dtype))
             for o in olist:

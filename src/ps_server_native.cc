@@ -1,0 +1,401 @@
+// Native parameter-server shard (the C++ runtime analog of ps-lite's
+// server, src/kvstore/kvstore_dist_server.h).  One shard per worker
+// process; the Python client (mxnet_tpu/_ps.py) speaks a little-endian
+// binary protocol to it.  Semantics mirror the Python _ServerShard
+// exactly: sync pushes merge all W workers per round (round-aware
+// pulls), async pushes apply immediately, heartbeats feed the
+// get_num_dead_node probe.  Optimizer rules registered from Python run
+// through a C callback (the reference ships optimizers to its servers
+// the same way, just compiled in).
+//
+// Wire format (all little-endian):
+//   request  = [u64 len][u8 op][u32 klen][key bytes][op payload]
+//     op 0 INIT: [i32 sender][u64 n][f32 x n]
+//     op 1 PUSH: [i32 sender][u8 mode 0=sync 1=async][u8 compressed]
+//                [f32 threshold][u64 n][payload: f32 x n, or
+//                 u8 x ceil(n/4) packed 2-bit codes]
+//     op 2 PULL: [i32 sender]
+//     op 3 HB:   [i32 sender]
+//     op 4 DEAD: [f64 timeout_sec]
+//   response = [u64 len][u8 status][payload]
+//     status 0 OK: empty      status 1 ERR: utf-8 message
+//     status 2 VAL: [u64 n][f32 x n]
+//     status 3 DEAD: [u32 m][i32 x m ranks]
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// updater callback provided by Python: applies the optimizer rule for
+// `key` to `value` (length n) given `grad`.  Returns 0 if it applied,
+// 1 if no rule is registered (server uses default merge semantics),
+// and < 0 on a Python-side error — the server must surface that to
+// the client, NOT fall back silently.  Runs under the server
+// connection thread; the Python side re-acquires the GIL (ctypes does
+// this automatically).
+typedef int (*updater_fn)(const char* key, const float* grad,
+                          float* value, uint64_t n);
+
+struct Shard {
+  int rank = 0;
+  int size = 1;
+  int listen_fd = -1;
+  int port = 0;
+  updater_fn updater = nullptr;
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::unordered_map<std::string, std::vector<float>> values;
+  std::unordered_map<std::string, std::vector<float>> pending;
+  std::unordered_map<std::string, int> pending_count;
+  std::unordered_map<std::string, long> completed_rounds;
+  std::map<std::pair<std::string, int>, long> pushed_rounds;
+  std::unordered_map<int, double> last_hb;
+  std::vector<std::thread> threads;
+  bool stopping = false;
+};
+
+Shard* g_shard = nullptr;
+
+double now_sec() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+bool read_exact(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+void put_u64(std::vector<char>* out, uint64_t v) {
+  out->insert(out->end(), reinterpret_cast<char*>(&v),
+              reinterpret_cast<char*>(&v) + 8);
+}
+
+bool send_resp(int fd, uint8_t status, const std::vector<char>& body) {
+  uint64_t len = 1 + body.size();
+  std::vector<char> frame;
+  frame.reserve(8 + len);
+  put_u64(&frame, len);
+  frame.push_back(static_cast<char>(status));
+  frame.insert(frame.end(), body.begin(), body.end());
+  return write_all(fd, frame.data(), frame.size());
+}
+
+bool send_err(int fd, const std::string& msg) {
+  std::vector<char> body(msg.begin(), msg.end());
+  return send_resp(fd, 1, body);
+}
+
+// decode the 2-bit packed payload (see GradientCompression): code 1 ->
+// +t, 2 -> -t, 0/3 -> 0
+void decompress_2bit(const uint8_t* p, uint64_t n, float t,
+                     std::vector<float>* out) {
+  out->assign(n, 0.0f);
+  for (uint64_t i = 0; i < n; ++i) {
+    uint8_t code = (p[i >> 2] >> ((i & 3) * 2)) & 3;
+    if (code == 1)
+      (*out)[i] = t;
+    else if (code == 2)
+      (*out)[i] = -t;
+  }
+}
+
+// returns 0 on success, -1 if the python updater errored (the caller
+// must send an error response and leave the value untouched)
+int apply_update(Shard* s, const std::string& key,
+                 const std::vector<float>& grad, bool is_async) {
+  // caller holds s->mu
+  auto& val = s->values[key];
+  if (s->updater != nullptr) {
+    int rc = s->updater(key.c_str(), grad.data(), val.data(),
+                        static_cast<uint64_t>(val.size()));
+    if (rc == 0) return 0;  // python rule applied in place
+    if (rc < 0) return -1;  // python rule RAISED: surface, don't merge
+  }
+  if (is_async) {
+    for (size_t i = 0; i < val.size(); ++i) val[i] += grad[i];
+  } else {
+    val = grad;  // sync, no updater: value becomes the merged sum
+  }
+  return 0;
+}
+
+void serve_conn_inner(Shard* s, int fd) {
+  std::vector<char> buf;
+  for (;;) {
+    uint64_t len = 0;
+    if (!read_exact(fd, &len, 8)) break;
+    // 1 GiB frame cap: anything larger is a corrupt/foreign peer (a
+    // pickle client's big-endian length, version skew), not data
+    if (len < 5 || len > (1ull << 30)) break;
+    buf.resize(len);
+    if (!read_exact(fd, buf.data(), len)) break;
+    const char* p = buf.data();
+    uint8_t op = static_cast<uint8_t>(*p++);
+    uint32_t klen;
+    std::memcpy(&klen, p, 4);
+    p += 4;
+    if (static_cast<uint64_t>(klen) > len - 5) {
+      send_err(fd, "malformed frame");
+      continue;
+    }
+    std::string key(p, p + klen);
+    p += klen;
+    const char* end = buf.data() + len;
+
+    if (op == 0) {  // INIT
+      int32_t sender;
+      uint64_t n;
+      std::memcpy(&sender, p, 4);
+      p += 4;
+      std::memcpy(&n, p, 8);
+      p += 8;
+      if (n > static_cast<uint64_t>(end - p) / 4) {
+        send_err(fd, "short init payload");
+        continue;
+      }
+      std::unique_lock<std::mutex> lk(s->mu);
+      if (sender == 0 || s->values.find(key) == s->values.end()) {
+        auto& v = s->values[key];
+        v.resize(n);
+        std::memcpy(v.data(), p, n * 4);
+      }
+      s->cv.notify_all();
+      lk.unlock();
+      send_resp(fd, 0, {});
+    } else if (op == 1) {  // PUSH
+      int32_t sender;
+      uint8_t mode, compressed;
+      float threshold;
+      uint64_t n;
+      std::memcpy(&sender, p, 4);
+      p += 4;
+      mode = static_cast<uint8_t>(*p++);
+      compressed = static_cast<uint8_t>(*p++);
+      std::memcpy(&threshold, p, 4);
+      p += 4;
+      std::memcpy(&n, p, 8);
+      p += 8;
+      std::vector<float> grad;
+      if (compressed) {
+        if (n > (1ull << 33) ||
+            (n + 3) / 4 > static_cast<uint64_t>(end - p)) {
+          send_err(fd, "short packed payload");
+          continue;
+        }
+        decompress_2bit(reinterpret_cast<const uint8_t*>(p), n,
+                        threshold, &grad);
+      } else {
+        if (n > static_cast<uint64_t>(end - p) / 4) {
+          send_err(fd, "short push payload");
+          continue;
+        }
+        grad.resize(n);
+        std::memcpy(grad.data(), p, n * 4);
+      }
+      std::unique_lock<std::mutex> lk(s->mu);
+      auto it = s->values.find(key);
+      if (it == s->values.end() || it->second.size() != n) {
+        lk.unlock();
+        send_err(fd, "push to uninitialized key " + key);
+        continue;
+      }
+      int urc = 0;
+      if (mode == 1) {  // async: apply immediately
+        urc = apply_update(s, key, grad, /*is_async=*/true);
+      } else {  // sync: merge all W workers, then update once
+        s->pushed_rounds[{key, sender}] += 1;
+        auto& acc = s->pending[key];
+        if (acc.empty())
+          acc = grad;
+        else
+          for (uint64_t i = 0; i < n; ++i) acc[i] += grad[i];
+        int cnt = ++s->pending_count[key];
+        if (cnt == s->size) {
+          std::vector<float> merged = std::move(acc);
+          s->pending.erase(key);
+          s->pending_count[key] = 0;
+          s->completed_rounds[key] += 1;
+          urc = apply_update(s, key, merged, /*is_async=*/false);
+        }
+      }
+      s->cv.notify_all();
+      lk.unlock();
+      if (urc != 0)
+        send_err(fd, "optimizer rule raised for key " + key);
+      else
+        send_resp(fd, 0, {});
+    } else if (op == 2) {  // PULL
+      int32_t sender;
+      std::memcpy(&sender, p, 4);
+      std::unique_lock<std::mutex> lk(s->mu);
+      double deadline = now_sec() + 600.0;
+      bool ok = s->cv.wait_until(
+          lk,
+          std::chrono::steady_clock::now() + std::chrono::seconds(600),
+          [&] {
+            if (s->values.find(key) == s->values.end()) return false;
+            auto pit = s->pushed_rounds.find({key, sender});
+            long need =
+                pit == s->pushed_rounds.end() ? 0 : pit->second;
+            return s->completed_rounds[key] >= need;
+          });
+      (void)deadline;
+      if (!ok) {
+        lk.unlock();
+        send_err(fd, "pull timeout on key " + key);
+        continue;
+      }
+      const auto& v = s->values[key];
+      std::vector<char> body;
+      body.reserve(8 + v.size() * 4);
+      put_u64(&body, v.size());
+      body.insert(body.end(),
+                  reinterpret_cast<const char*>(v.data()),
+                  reinterpret_cast<const char*>(v.data()) +
+                      v.size() * 4);
+      lk.unlock();
+      send_resp(fd, 2, body);
+    } else if (op == 3) {  // HB
+      int32_t sender;
+      std::memcpy(&sender, p, 4);
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        s->last_hb[sender] = now_sec();
+      }
+      send_resp(fd, 0, {});
+    } else if (op == 4) {  // DEAD
+      double timeout;
+      std::memcpy(&timeout, p, 8);
+      std::vector<int32_t> dead;
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        double t = now_sec();
+        for (int r = 0; r < s->size; ++r) {
+          auto it = s->last_hb.find(r);
+          if (it == s->last_hb.end() || t - it->second > timeout)
+            dead.push_back(r);
+        }
+      }
+      std::vector<char> body;
+      uint32_t m = static_cast<uint32_t>(dead.size());
+      body.insert(body.end(), reinterpret_cast<char*>(&m),
+                  reinterpret_cast<char*>(&m) + 4);
+      body.insert(body.end(),
+                  reinterpret_cast<const char*>(dead.data()),
+                  reinterpret_cast<const char*>(dead.data()) +
+                      dead.size() * 4);
+      send_resp(fd, 3, body);
+    } else {
+      send_err(fd, "unknown op");
+    }
+  }
+}
+
+void serve_conn(Shard* s, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  try {
+    serve_conn_inner(s, fd);
+  } catch (const std::exception& e) {
+    // a bad frame must cost one connection, not the whole training
+    // process (detached-thread exceptions call std::terminate)
+    send_err(fd, std::string("ps native server exception: ") +
+                     e.what());
+  } catch (...) {
+  }
+  ::close(fd);
+}
+
+void accept_loop(Shard* s) {
+  for (;;) {
+    int fd = ::accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        if (s->stopping) return;
+      }
+      // back off instead of busy-spinning on persistent failure
+      // (EMFILE under fd exhaustion)
+      ::usleep(10000);
+      continue;
+    }
+    std::thread(serve_conn, s, fd).detach();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// start the shard server; returns the listening port (or -1)
+int ps_native_start(int rank, int size) {
+  if (g_shard != nullptr) return g_shard->port;
+  Shard* s = new Shard();
+  s->rank = rank;
+  s->size = size;
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) return -1;
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one,
+               sizeof(one));
+  sockaddr_in addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = 0;
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0)
+    return -1;
+  socklen_t alen = sizeof(addr);
+  ::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+                &alen);
+  s->port = ntohs(addr.sin_port);
+  if (::listen(s->listen_fd, 64) != 0) return -1;
+  s->threads.emplace_back(accept_loop, s);
+  s->threads.back().detach();
+  g_shard = s;
+  return s->port;
+}
+
+void ps_native_set_updater(updater_fn fn) {
+  if (g_shard == nullptr) return;
+  std::lock_guard<std::mutex> lk(g_shard->mu);
+  g_shard->updater = fn;
+}
+
+}  // extern "C"

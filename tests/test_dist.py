@@ -141,3 +141,22 @@ def test_local_launcher_restarts_failed_worker(tmp_path):
     assert "worker 0 ok" in res.stdout and "worker 1 ok" in res.stdout
     assert "restarting" in res.stderr
     assert open(marker + "1").read() == "2"  # rank 1 ran twice
+
+
+@pytest.mark.parametrize("n", [3])
+def test_dist_async_python_ps_fallback(n):
+    """MXNET_PS_NATIVE=0 forces the pure-Python pickle shard — the
+    fallback for toolchain-less hosts must keep full semantics."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    cmd = [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+           "-n", str(n), "--cpu", "--env", "MXNET_PS_NATIVE=0",
+           sys.executable,
+           os.path.join(_REPO, "tests", "dist_async_worker.py")]
+    res = subprocess.run(cmd, env=env, cwd=_REPO, timeout=600,
+                         capture_output=True, text=True)
+    sys.stdout.write(res.stdout[-1500:])
+    sys.stderr.write(res.stderr[-2500:])
+    assert res.returncode == 0
+    for r in range(n):
+        assert f"[worker {r}] dist_async OK" in res.stdout
