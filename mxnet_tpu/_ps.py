@@ -355,6 +355,7 @@ class PSBackend:
         self.rank = rank
         self.size = size
         self._updaters = {}
+        self._shapes = {}  # key -> value shape (native shards store flat)
         self._native_cb = None  # keep the ctypes callback alive
         lib = _get_native_lib()
         port = lib.ps_native_start(rank, size) if lib is not None \
@@ -445,9 +446,9 @@ class PSBackend:
 
     # ----------------------------------------------------- operations
     def init(self, key, value):
-        self._request(self.owner(key),
-                      ("init", key, onp.asarray(value, onp.float32),
-                       self.rank))
+        v = onp.asarray(value, onp.float32)
+        self._shapes[key] = v.shape
+        self._request(self.owner(key), ("init", key, v, self.rank))
 
     def push(self, key, grad, mode, compressed_payload=None, meta=None):
         if compressed_payload is not None:
@@ -486,11 +487,18 @@ class PSBackend:
                 return 1
             from . import ndarray as nd
 
-            grad = onp.ctypeslib.as_array(grad_p, shape=(n,)).copy()
+            # the native shard stores values flat; give the optimizer
+            # rule the ORIGINAL shape (recorded at init on every
+            # worker) so axis-dependent rules behave identically on
+            # both transports
+            shape = self._shapes.get(key, (n,))
+            grad = onp.ctypeslib.as_array(
+                grad_p, shape=(n,)).copy().reshape(shape)
             value = onp.ctypeslib.as_array(value_p, shape=(n,))
-            stored = nd.array(value.copy())
+            stored = nd.array(value.copy().reshape(shape))
             updater(bare or key, nd.array(grad), stored)
-            value[:] = onp.asarray(stored.asnumpy(), onp.float32)
+            value[:] = onp.asarray(stored.asnumpy(),
+                                   onp.float32).ravel()
             return 0
         except Exception:
             import traceback

@@ -1,2 +1,2 @@
 """Gluon contrib (reference: python/mxnet/gluon/contrib/)."""
-from . import estimator, nn  # noqa: F401
+from . import data, estimator, nn, rnn  # noqa: F401

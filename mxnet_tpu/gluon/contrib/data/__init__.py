@@ -1,0 +1,5 @@
+"""Contrib data helpers (reference python/mxnet/gluon/contrib/data/)."""
+from . import sampler  # noqa: F401
+from .sampler import IntervalSampler  # noqa: F401
+
+__all__ = ["sampler", "IntervalSampler"]

@@ -1,0 +1,30 @@
+"""Contrib samplers (reference gluon/contrib/data/sampler.py)."""
+from __future__ import annotations
+
+from ...data.sampler import Sampler
+
+__all__ = ["IntervalSampler"]
+
+
+class IntervalSampler(Sampler):
+    """Sample at fixed intervals with rollover (reference
+    IntervalSampler: for length=N, interval=k yields
+    0, k, 2k, ..., 1, k+1, ... covering every index once)."""
+
+    def __init__(self, length, interval, rollover=True):
+        assert interval <= length, (
+            f"interval {interval} must not be larger than length "
+            f"{length}")
+        self._length = length
+        self._interval = interval
+        self._rollover = rollover
+
+    def __iter__(self):
+        starts = range(self._interval) if self._rollover else [0]
+        for start in starts:
+            yield from range(start, self._length, self._interval)
+
+    def __len__(self):
+        if self._rollover:
+            return self._length
+        return len(range(0, self._length, self._interval))

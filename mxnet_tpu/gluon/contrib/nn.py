@@ -3,9 +3,13 @@ basic_layers.py)."""
 from __future__ import annotations
 
 from ...base import MXNetError
-from ..nn.basic_layers import BatchNorm
+from ..block import HybridBlock
+from ..nn.basic_layers import (BatchNorm, HybridSequential,
+                               Sequential)
 
-__all__ = ["SyncBatchNorm"]
+__all__ = ["SyncBatchNorm", "Identity", "Concurrent",
+           "HybridConcurrent", "SparseEmbedding", "PixelShuffle1D",
+           "PixelShuffle2D", "PixelShuffle3D"]
 
 
 class SyncBatchNorm(BatchNorm):
@@ -58,3 +62,111 @@ class SyncBatchNorm(BatchNorm):
             return out
         return F.SyncBatchNorm(x, gamma, beta, running_mean, running_var,
                                **self._kwargs)
+
+
+class Identity(HybridBlock):
+    """Pass-through block (reference basic_layers.py Identity) — the
+    no-op branch for Concurrent/HybridConcurrent compositions."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class Concurrent(Sequential):
+    """Run children on the SAME input and concatenate their outputs
+    along ``axis`` (reference basic_layers.py Concurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from ... import ndarray as nd
+
+        outs = [child(x) for child in self._children.values()]
+        return nd.concat(*outs, dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (reference HybridConcurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        outs = [child(x) for child in self._children.values()]
+        return F.concat(*outs, dim=self.axis)
+
+
+class SparseEmbedding(HybridBlock):
+    """Embedding with the reference's row_sparse gradient surface
+    (contrib SparseEmbedding).  Storage is dense-backed on TPU (README
+    scope decision) but the call signature and semantics match."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._kwargs = {"input_dim": input_dim,
+                        "output_dim": output_dim}
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(input_dim, output_dim),
+                init=weight_initializer, dtype=dtype)
+
+    def hybrid_forward(self, F, x, weight):
+        return F.Embedding(x, weight, **self._kwargs)
+
+    def __repr__(self):
+        return (f"SparseEmbedding({self._kwargs['input_dim']} -> "
+                f"{self._kwargs['output_dim']})")
+
+
+class _PixelShuffle(HybridBlock):
+    """Rearrange channel blocks into spatial upscaling (reference
+    basic_layers.py PixelShuffle1D/2D/3D).  Implemented entirely with
+    F reshape/transpose (the reference's -4/-3 split-merge codes), so
+    it traces on BOTH the eager and the symbolic/export paths."""
+
+    def __init__(self, factor, ndim, **kwargs):
+        super().__init__(**kwargs)
+        self._factors = ((int(factor),) * ndim
+                         if isinstance(factor, int)
+                         else tuple(int(f) for f in factor))
+        assert len(self._factors) == ndim
+
+
+class PixelShuffle1D(_PixelShuffle):
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 1, **kwargs)
+
+    def hybrid_forward(self, F, x):
+        (f,) = self._factors
+        x = F.reshape(x, shape=(0, -4, -1, f, 0))   # (N, C, f, W)
+        x = F.transpose(x, axes=(0, 1, 3, 2))       # (N, C, W, f)
+        return F.reshape(x, shape=(0, 0, -3))       # (N, C, W*f)
+
+
+class PixelShuffle2D(_PixelShuffle):
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 2, **kwargs)
+
+    def hybrid_forward(self, F, x):
+        f1, f2 = self._factors
+        x = F.reshape(x, shape=(0, -4, -1, f1 * f2, 0, 0))
+        x = F.reshape(x, shape=(0, 0, -4, f1, f2, 0, 0))
+        x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))
+        return F.reshape(x, shape=(0, 0, -3, -3))
+
+
+class PixelShuffle3D(_PixelShuffle):
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 3, **kwargs)
+
+    def hybrid_forward(self, F, x):
+        f1, f2, f3 = self._factors
+        x = F.reshape(x, shape=(0, -4, -1, f1 * f2 * f3, 0, 0, 0))
+        x = F.reshape(x, shape=(0, 0, -4, f1, f2 * f3, 0, 0, 0))
+        x = F.reshape(x, shape=(0, 0, 0, -4, f2, f3, 0, 0, 0))
+        x = F.transpose(x, axes=(0, 1, 5, 2, 6, 3, 7, 4))
+        return F.reshape(x, shape=(0, 0, -3, -3, -3))

@@ -1,0 +1,233 @@
+"""Contrib recurrent cells (reference
+python/mxnet/gluon/contrib/rnn/{rnn_cell,conv_rnn_cell}.py):
+VariationalDropoutCell and the Conv1D/2D/3D-RNN/LSTM/GRU family.
+"""
+from __future__ import annotations
+
+from ..rnn.rnn_cell import HybridRecurrentCell, ModifierCell
+
+__all__ = [
+    "VariationalDropoutCell",
+    "Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+    "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+    "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell",
+]
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Variational (a.k.a. locked) dropout: ONE dropout mask per unroll
+    for each of inputs/states/outputs, reused at every time step (Gal &
+    Ghahramani; reference contrib/rnn/rnn_cell.py
+    VariationalDropoutCell)."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._input_mask = None
+        self._state_masks = None
+        self._output_mask = None
+
+    def _alias(self):
+        return "vardrop"
+
+    def reset(self):
+        super().reset()
+        self._input_mask = None
+        self._state_masks = None
+        self._output_mask = None
+
+    @staticmethod
+    def _mask(F, p, like):
+        # F-based like ZoneoutCell: keeps the modifier usable on the
+        # symbolic/export path wherever its base cell is
+        return F.Dropout(F.ones_like(like), p=p)
+
+    def hybrid_forward(self, F, inputs, states):
+        if self.drop_inputs:
+            if self._input_mask is None:
+                self._input_mask = self._mask(F, self.drop_inputs,
+                                              inputs)
+            inputs = inputs * self._input_mask
+        if self.drop_states:
+            if self._state_masks is None:
+                self._state_masks = [
+                    self._mask(F, self.drop_states, s) for s in states]
+            states = [s * m
+                      for s, m in zip(states, self._state_masks)]
+        output, next_states = self.base_cell(inputs, states)
+        if self.drop_outputs:
+            if self._output_mask is None:
+                self._output_mask = self._mask(
+                    F, self.drop_outputs, output)
+            output = output * self._output_mask
+        return output, next_states
+
+
+class _BaseConvRNNCell(HybridRecurrentCell):
+    """Shared machinery: i2h and h2h are Convolutions over the spatial
+    dims, states are feature maps (reference conv_rnn_cell.py
+    _BaseConvRNNCell)."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                 h2h_kernel, i2h_pad, activation, num_gates,
+                 prefix=None, params=None, conv_layout="NCHW"):
+        super().__init__(prefix=prefix, params=params)
+        self._input_shape = tuple(input_shape)  # (C, *spatial)
+        self._hidden_channels = hidden_channels
+        self._i2h_kernel = tuple(i2h_kernel)
+        self._h2h_kernel = tuple(h2h_kernel)
+        for k in self._h2h_kernel:
+            assert k % 2 == 1, (
+                "h2h kernel dims must be odd to preserve the state "
+                f"shape, got {h2h_kernel}")
+        self._i2h_pad = tuple(i2h_pad)
+        self._h2h_pad = tuple(k // 2 for k in self._h2h_kernel)
+        self._activation = activation
+        self._num_gates = num_gates
+        in_c = self._input_shape[0]
+        out_c = hidden_channels * num_gates
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight",
+                shape=(out_c, in_c) + self._i2h_kernel,
+                allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight",
+                shape=(out_c, hidden_channels) + self._h2h_kernel,
+                allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(out_c,), init="zeros",
+                allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(out_c,), init="zeros",
+                allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        # the state's spatial extent is the i2h conv's OUTPUT extent
+        # (stride 1): (in + 2p - k) + 1 per dim — for non-same i2h_pad
+        # (e.g. the valid-padding default of the reference) the state
+        # shrinks accordingly; h2h (odd kernel, same-pad) preserves it
+        spatial = tuple(
+            s + 2 * p - k + 1
+            for s, p, k in zip(self._input_shape[1:], self._i2h_pad,
+                               self._i2h_kernel))
+        shape = (batch_size, self._hidden_channels) + spatial
+        n_state = 2 if self._num_gates == 4 else 1
+        return [{"shape": shape, "__layout__": "NC" + "DHW"[-len(
+            spatial):]} for _ in range(n_state)]
+
+    def _conv(self, F, x, weight, bias, kernel, pad):
+        return F.Convolution(
+            x, weight, bias, kernel=kernel,
+            num_filter=self._hidden_channels * self._num_gates,
+            pad=pad)
+
+    def _gates(self, F, inputs, states, i2h_weight, h2h_weight,
+               i2h_bias, h2h_bias):
+        i2h = self._conv(F, inputs, i2h_weight, i2h_bias,
+                         self._i2h_kernel, self._i2h_pad)
+        h2h = self._conv(F, states[0], h2h_weight, h2h_bias,
+                         self._h2h_kernel, self._h2h_pad)
+        return i2h, h2h
+
+    def _act(self, F, x):
+        return F.Activation(x, act_type=self._activation)
+
+
+class _ConvRNNCell(_BaseConvRNNCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                 h2h_kernel, i2h_pad, activation="tanh", **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, activation, num_gates=1,
+                         **kwargs)
+
+    def _alias(self):
+        return "conv_rnn"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight,
+                       h2h_weight, i2h_bias, h2h_bias):
+        i2h, h2h = self._gates(F, inputs, states, i2h_weight,
+                               h2h_weight, i2h_bias, h2h_bias)
+        output = self._act(F, i2h + h2h)
+        return output, [output]
+
+
+class _ConvLSTMCell(_BaseConvRNNCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                 h2h_kernel, i2h_pad, activation="tanh", **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, activation, num_gates=4,
+                         **kwargs)
+
+    def _alias(self):
+        return "conv_lstm"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight,
+                       h2h_weight, i2h_bias, h2h_bias):
+        i2h, h2h = self._gates(F, inputs, states, i2h_weight,
+                               h2h_weight, i2h_bias, h2h_bias)
+        gates = i2h + h2h
+        slices = F.SliceChannel(gates, num_outputs=4, axis=1)
+        i = F.Activation(slices[0], act_type="sigmoid")
+        f = F.Activation(slices[1], act_type="sigmoid")
+        g = self._act(F, slices[2])
+        o = F.Activation(slices[3], act_type="sigmoid")
+        next_c = f * states[1] + i * g
+        next_h = o * self._act(F, next_c)
+        return next_h, [next_h, next_c]
+
+
+class _ConvGRUCell(_BaseConvRNNCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                 h2h_kernel, i2h_pad, activation="tanh", **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, activation, num_gates=3,
+                         **kwargs)
+
+    def _alias(self):
+        return "conv_gru"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight,
+                       h2h_weight, i2h_bias, h2h_bias):
+        i2h, h2h = self._gates(F, inputs, states, i2h_weight,
+                               h2h_weight, i2h_bias, h2h_bias)
+        i2h_s = F.SliceChannel(i2h, num_outputs=3, axis=1)
+        h2h_s = F.SliceChannel(h2h, num_outputs=3, axis=1)
+        reset = F.Activation(i2h_s[0] + h2h_s[0], act_type="sigmoid")
+        update = F.Activation(i2h_s[1] + h2h_s[1], act_type="sigmoid")
+        cand = self._act(F, i2h_s[2] + reset * h2h_s[2])
+        next_h = (1.0 - update) * cand + update * states[0]
+        return next_h, [next_h]
+
+
+def _specialize(base, ndim, name):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=3,
+                 h2h_kernel=3, i2h_pad=None, activation="tanh",
+                 prefix=None, params=None):
+        def tup(v):
+            return (v,) * ndim if isinstance(v, int) else tuple(v)
+
+        i2h_k = tup(i2h_kernel)
+        h2h_k = tup(h2h_kernel)
+        pad = tup(i2h_pad) if i2h_pad is not None else tuple(
+            k // 2 for k in i2h_k)
+        base.__init__(self, input_shape, hidden_channels, i2h_k, h2h_k,
+                      pad, activation=activation, prefix=prefix,
+                      params=params)
+
+    return type(name, (base,), {"__init__": __init__,
+                                "_spatial_ndim": ndim})
+
+
+Conv1DRNNCell = _specialize(_ConvRNNCell, 1, "Conv1DRNNCell")
+Conv2DRNNCell = _specialize(_ConvRNNCell, 2, "Conv2DRNNCell")
+Conv3DRNNCell = _specialize(_ConvRNNCell, 3, "Conv3DRNNCell")
+Conv1DLSTMCell = _specialize(_ConvLSTMCell, 1, "Conv1DLSTMCell")
+Conv2DLSTMCell = _specialize(_ConvLSTMCell, 2, "Conv2DLSTMCell")
+Conv3DLSTMCell = _specialize(_ConvLSTMCell, 3, "Conv3DLSTMCell")
+Conv1DGRUCell = _specialize(_ConvGRUCell, 1, "Conv1DGRUCell")
+Conv2DGRUCell = _specialize(_ConvGRUCell, 2, "Conv2DGRUCell")
+Conv3DGRUCell = _specialize(_ConvGRUCell, 3, "Conv3DGRUCell")

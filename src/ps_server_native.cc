@@ -176,6 +176,14 @@ void serve_conn_inner(Shard* s, int fd) {
     std::string key(p, p + klen);
     p += klen;
     const char* end = buf.data() + len;
+    // fixed per-op header sizes: reject truncated frames BEFORE any
+    // header memcpy (a crashed/version-skewed peer must cost an error
+    // response, not an out-of-bounds read)
+    static const uint64_t kHeader[5] = {12, 18, 4, 4, 8};
+    if (op > 4 || static_cast<uint64_t>(end - p) < kHeader[op]) {
+      send_err(fd, "truncated frame");
+      continue;
+    }
 
     if (op == 0) {  // INIT
       int32_t sender;
@@ -263,7 +271,6 @@ void serve_conn_inner(Shard* s, int fd) {
       int32_t sender;
       std::memcpy(&sender, p, 4);
       std::unique_lock<std::mutex> lk(s->mu);
-      double deadline = now_sec() + 600.0;
       bool ok = s->cv.wait_until(
           lk,
           std::chrono::steady_clock::now() + std::chrono::seconds(600),
@@ -274,7 +281,6 @@ void serve_conn_inner(Shard* s, int fd) {
                 pit == s->pushed_rounds.end() ? 0 : pit->second;
             return s->completed_rounds[key] >= need;
           });
-      (void)deadline;
       if (!ok) {
         lk.unlock();
         send_err(fd, "pull timeout on key " + key);

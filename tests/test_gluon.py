@@ -273,3 +273,97 @@ def test_sequential_getitem_len():
     net.add(nn.Dense(4), nn.Dense(5), nn.Dense(6))
     assert len(net) == 3
     assert isinstance(net[1], nn.Dense)
+
+
+# ------------------------------------------- gluon.contrib additions
+def test_contrib_nn_layers():
+    """Concurrent/HybridConcurrent/Identity/PixelShuffle/SparseEmbedding
+    (reference gluon/contrib/nn/basic_layers.py)."""
+    from mxnet_tpu.gluon.contrib import nn as cnn
+
+    x = mx.nd.random_uniform(shape=(2, 6))
+    ident = cnn.Identity()
+    onp.testing.assert_allclose(ident(x).asnumpy(), x.asnumpy())
+
+    conc = cnn.HybridConcurrent(axis=-1)
+    conc.add(cnn.Identity())
+    conc.add(gluon.nn.Dense(4, in_units=6))
+    conc.initialize()
+    out = conc(x)
+    assert out.shape == (2, 10)
+    onp.testing.assert_allclose(out.asnumpy()[:, :6], x.asnumpy(),
+                                rtol=1e-6)
+
+    ps = cnn.PixelShuffle2D(2)
+    img = mx.nd.array(onp.arange(16, dtype="float32").reshape(1, 4, 2, 2))
+    up = ps(img)
+    assert up.shape == (1, 1, 4, 4)
+    # block (0,0) of the upscaled image interleaves channels 0..3
+    onp.testing.assert_allclose(
+        up.asnumpy()[0, 0, :2, :2],
+        [[0.0, 4.0], [8.0, 12.0]])
+
+    emb = cnn.SparseEmbedding(10, 3)
+    emb.initialize()
+    vecs = emb(mx.nd.array([1, 5]))
+    assert vecs.shape == (2, 3)
+
+    ps1 = cnn.PixelShuffle1D(3)
+    seq = mx.nd.random_uniform(shape=(1, 6, 5))
+    assert ps1(seq).shape == (1, 2, 15)
+
+
+def test_contrib_conv_lstm_cell():
+    """Conv2DLSTMCell unrolls over feature maps (reference
+    contrib/rnn/conv_rnn_cell.py)."""
+    from mxnet_tpu.gluon.contrib import rnn as crnn
+
+    cell = crnn.Conv2DLSTMCell(input_shape=(3, 8, 8),
+                               hidden_channels=4, i2h_kernel=3,
+                               h2h_kernel=3)
+    cell.initialize()
+    seq = mx.nd.random_uniform(shape=(2, 5, 3, 8, 8))  # NTCHW
+    outputs, states = cell.unroll(5, seq, layout="NTC",
+                                  merge_outputs=False)
+    assert len(outputs) == 5
+    assert outputs[0].shape == (2, 4, 8, 8)
+    assert states[0].shape == (2, 4, 8, 8)  # h
+    assert states[1].shape == (2, 4, 8, 8)  # c
+    assert onp.isfinite(outputs[-1].asnumpy()).all()
+
+    gru = crnn.Conv1DGRUCell(input_shape=(2, 10), hidden_channels=3)
+    gru.initialize()
+    out, st = gru(mx.nd.random_uniform(shape=(2, 2, 10)),
+                  gru.begin_state(batch_size=2))
+    assert out.shape == (2, 3, 10)
+
+
+def test_contrib_variational_dropout_cell():
+    """VariationalDropoutCell: SAME mask at every time step of one
+    unroll (the defining property), fresh masks after reset."""
+    from mxnet_tpu.gluon.contrib import rnn as crnn
+    from mxnet_tpu import autograd
+
+    base = gluon.rnn.RNNCell(8, input_size=8)
+    cell = crnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    cell.initialize()
+    x = mx.nd.ones((2, 3, 8))
+    with autograd.record(train_mode=True):
+        cell.reset()
+        _ = cell.unroll(3, x, layout="NTC", merge_outputs=False)
+        mask1 = cell._input_mask.asnumpy()
+        # a second step in the SAME unroll reuses the mask object
+        _o, _s = cell(mx.nd.ones((2, 8)), cell.begin_state(batch_size=2))
+        mask2 = cell._input_mask.asnumpy()
+    onp.testing.assert_allclose(mask1, mask2)
+    assert (mask1 == 0).any() or (mask1 > 1).any()  # dropout happened
+
+
+def test_contrib_interval_sampler():
+    from mxnet_tpu.gluon.contrib.data import IntervalSampler
+
+    s = list(IntervalSampler(10, 3))
+    assert sorted(s) == list(range(10))
+    assert s[:4] == [0, 3, 6, 9]
+    s2 = list(IntervalSampler(10, 3, rollover=False))
+    assert s2 == [0, 3, 6, 9]
