@@ -181,8 +181,25 @@ class _ServerShard(threading.Thread):
                     else:
                         self.values[key] = self._apply(key, grad)
                 else:  # sync: merge all W, then update once
-                    self.pushed_rounds[(key, sender)] = \
-                        self.pushed_rounds.get((key, sender), 0) + 1
+                    # round-skew guard: a second push from the same
+                    # worker before the in-flight round merges would
+                    # collapse two of its grads into one round — WAIT
+                    # for the merge instead (each client connection has
+                    # its own serve thread, so blocking here only
+                    # stalls the skewed sender; its peers' pushes
+                    # arrive on their own connections and complete the
+                    # round)
+                    prev = self.pushed_rounds.get((key, sender), 0)
+                    skew_deadline = time.monotonic() + 600.0
+                    while prev > self.completed_rounds.get(key, 0):
+                        left = skew_deadline - time.monotonic()
+                        if left <= 0:
+                            raise MXNetError(
+                                f"sync push round skew on {key}: "
+                                f"worker {sender} is a full round "
+                                "ahead and the merge never completed")
+                        self._cv.wait(timeout=min(left, 1.0))
+                    self.pushed_rounds[(key, sender)] = prev + 1
                     acc = self.pending.get(key)
                     self.pending[key] = grad if acc is None else acc + grad
                     cnt = self.pending_count.get(key, 0) + 1

@@ -4,7 +4,8 @@ VariationalDropoutCell and the Conv1D/2D/3D-RNN/LSTM/GRU family.
 """
 from __future__ import annotations
 
-from ..rnn.rnn_cell import HybridRecurrentCell, ModifierCell
+from ..rnn.rnn_cell import (BidirectionalCell, HybridRecurrentCell,
+                            ModifierCell, SequentialRNNCell)
 
 __all__ = [
     "VariationalDropoutCell",
@@ -22,12 +23,25 @@ class VariationalDropoutCell(ModifierCell):
 
     def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
                  drop_outputs=0.0):
+        # reference guards: a bidirectional base cell reuses the cell in
+        # both directions, so a single locked mask is ill-defined
+        assert not isinstance(base_cell, BidirectionalCell), (
+            "BidirectionalCell doesn't support variational dropout; "
+            "apply VariationalDropoutCell to the cells underneath "
+            "instead.")
+        assert not (isinstance(base_cell, SequentialRNNCell)
+                    and any(isinstance(c, BidirectionalCell)
+                            for c in getattr(base_cell, "_children",
+                                             {}).values())), (
+            "Bidirectional SequentialRNNCell doesn't support "
+            "variational dropout; apply VariationalDropoutCell to "
+            "the cells underneath instead.")
         super().__init__(base_cell)
         self.drop_inputs = drop_inputs
         self.drop_states = drop_states
         self.drop_outputs = drop_outputs
         self._input_mask = None
-        self._state_masks = None
+        self._state_mask = None
         self._output_mask = None
 
     def _alias(self):
@@ -36,7 +50,7 @@ class VariationalDropoutCell(ModifierCell):
     def reset(self):
         super().reset()
         self._input_mask = None
-        self._state_masks = None
+        self._state_mask = None
         self._output_mask = None
 
     @staticmethod
@@ -52,11 +66,14 @@ class VariationalDropoutCell(ModifierCell):
                                               inputs)
             inputs = inputs * self._input_mask
         if self.drop_states:
-            if self._state_masks is None:
-                self._state_masks = [
-                    self._mask(F, self.drop_states, s) for s in states]
-            states = [s * m
-                      for s, m in zip(states, self._state_masks)]
+            if self._state_mask is None:
+                self._state_mask = self._mask(F, self.drop_states,
+                                              states[0])
+            # state dropout only applies to h (states[0]); the LSTM
+            # cell state c must flow through unmasked (reference
+            # contrib/rnn/rnn_cell.py hybrid_forward)
+            states = list(states)
+            states[0] = states[0] * self._state_mask
         output, next_states = self.base_cell(inputs, states)
         if self.drop_outputs:
             if self._output_mask is None:
@@ -212,8 +229,10 @@ def _specialize(base, ndim, name):
 
         i2h_k = tup(i2h_kernel)
         h2h_k = tup(h2h_kernel)
-        pad = tup(i2h_pad) if i2h_pad is not None else tuple(
-            k // 2 for k in i2h_k)
+        # reference default is VALID padding ((0,)*ndim —
+        # conv_rnn_cell.py:265/332/399); same-padding is an explicit
+        # opt-in via i2h_pad
+        pad = tup(i2h_pad) if i2h_pad is not None else (0,) * ndim
         base.__init__(self, input_shape, hidden_channels, i2h_k, h2h_k,
                       pad, activation=activation, prefix=prefix,
                       params=params)

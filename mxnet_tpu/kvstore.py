@@ -146,20 +146,6 @@ class GradientCompression:
             n *= d
         return self._codes_to_values(codes[:n].reshape(shape), dtype)
 
-    def decompress_sum(self, gathered, shape, dtype=jnp.float32):
-        """Decompress a (workers, payload_len) gather and sum over
-        workers in ONE fused XLA computation (per-worker padding makes
-        a flat reshape wrong, so unpack per row)."""
-        w = gathered.shape[0]
-        codes = self._unpack(gathered).reshape(w, -1)
-        n = 1
-        for d in shape:
-            n *= d
-        vals = self._codes_to_values(
-            codes[:, :n].reshape((w,) + tuple(shape)), dtype)
-        return vals.sum(axis=0)
-
-
 class KVStore:
     """Single-process KVStore covering local/device semantics; dist modes
     report rank/size from the jax.distributed runtime when initialized."""
@@ -532,14 +518,6 @@ class DistKVStore(KVStore):
         out = self._sum_fn(garr).addressable_data(0)
         return out.astype(narrow) if narrow is not None else out
 
-    def _gather_payloads(self, payload):
-        """Allgather of the packed wire payload: the bytes crossing the
-        process boundary ARE the compressed representation (reference
-        kvstore_dist.h:431 compresses the transmitted buffer)."""
-        from jax.experimental import multihost_utils
-
-        return multihost_utils.process_allgather(payload)
-
     def _broadcast0(self, arr):
         """Rank-0's value everywhere (init consistency, like the server
         owning the initial weights)."""
@@ -552,24 +530,26 @@ class DistKVStore(KVStore):
         return out.astype(narrow) if narrow is not None else out
 
     def _reduce(self, key, agg):
-        # NETWORK boundary (was ZPush/ZPull)
+        # NETWORK boundary (was ZPush/ZPull).  With compression at
+        # size>1 this path is unreachable: `_ps_active()` routes
+        # compressed pushes to the key-owner PS shard (O(N) wire per
+        # worker), so the ONLY compressed path here is the size==1
+        # local quantization round-trip (lossy semantics preserved so a
+        # 1-worker "dist" launch trains the same model it would in a
+        # group).  The round-3 allgather+host-sum branch was deleted —
+        # one compressed code path lives in push()/_ps.py.
         if self._compression is not None:
-            # per-worker compress BEFORE the collective: only the
-            # packed 2-bit payload crosses the wire; every worker
-            # decompresses all peers' payloads and sums
+            assert self._size == 1, (
+                "compressed dist push must go through the PS shard "
+                "(_ps_active); _reduce is the 1-worker degradation only")
             narrow = agg.dtype if agg.dtype in (jnp.float16,
                                                 jnp.bfloat16) else None
             a32 = agg.astype(jnp.float32) if narrow is not None else agg
             payload = self._compression.compress_packed(key, a32)
             self.last_wire_bytes = int(payload.nbytes)
             self.last_uncompressed_bytes = int(agg.nbytes)
-            if self._size == 1:
-                out = self._compression.decompress(payload, a32.shape,
-                                                   a32.dtype)
-            else:
-                gathered = self._gather_payloads(payload)
-                out = self._compression.decompress_sum(
-                    gathered, a32.shape, a32.dtype)
+            out = self._compression.decompress(payload, a32.shape,
+                                               a32.dtype)
             return out.astype(narrow) if narrow is not None else out
         self.last_wire_bytes = int(agg.nbytes)
         self.last_uncompressed_bytes = int(agg.nbytes)

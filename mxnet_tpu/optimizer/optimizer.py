@@ -532,6 +532,8 @@ class GroupAdaGrad(Optimizer):
         hist._adopt(new_h)
 
     def fused_update(self, w, g, state, t, key=None):
+        assert self.wd == 0.0, \
+            "GroupAdaGrad does not support weight decay"
         (hist,) = state
         new_w, new_h = _group_adagrad_step(
             w, hist, self._prep(g), self.learning_rate,

@@ -246,7 +246,24 @@ void serve_conn_inner(Shard* s, int fd) {
       if (mode == 1) {  // async: apply immediately
         urc = apply_update(s, key, grad, /*is_async=*/true);
       } else {  // sync: merge all W workers, then update once
-        s->pushed_rounds[{key, sender}] += 1;
+        // round-skew guard (mirrors _ServerShard): a second push from
+        // the same worker before the in-flight round merges would
+        // collapse two of its grads into one round — wait for the
+        // merge (blocking stalls only this connection's thread; the
+        // peers' pushes arrive on their own connections)
+        long prev = s->pushed_rounds[{key, sender}];
+        bool skew_ok = s->cv.wait_until(
+            lk,
+            std::chrono::steady_clock::now() +
+                std::chrono::seconds(600),
+            [&] { return s->completed_rounds[key] >= prev; });
+        if (!skew_ok) {
+          lk.unlock();
+          send_err(fd, "sync push round skew on key " + key +
+                           ": merge never completed");
+          continue;
+        }
+        s->pushed_rounds[{key, sender}] = prev + 1;
         auto& acc = s->pending[key];
         if (acc.empty())
           acc = grad;

@@ -320,7 +320,7 @@ def test_contrib_conv_lstm_cell():
 
     cell = crnn.Conv2DLSTMCell(input_shape=(3, 8, 8),
                                hidden_channels=4, i2h_kernel=3,
-                               h2h_kernel=3)
+                               h2h_kernel=3, i2h_pad=(1, 1))
     cell.initialize()
     seq = mx.nd.random_uniform(shape=(2, 5, 3, 8, 8))  # NTCHW
     outputs, states = cell.unroll(5, seq, layout="NTC",
@@ -331,11 +331,20 @@ def test_contrib_conv_lstm_cell():
     assert states[1].shape == (2, 4, 8, 8)  # c
     assert onp.isfinite(outputs[-1].asnumpy()).all()
 
+    # default i2h_pad is VALID (reference conv_rnn_cell.py:265/332/399):
+    # the state's spatial extent shrinks by k-1
+    vcell = crnn.Conv2DLSTMCell(input_shape=(3, 8, 8),
+                                hidden_channels=4, i2h_kernel=3)
+    vcell.initialize()
+    vout, vst = vcell(mx.nd.random_uniform(shape=(2, 3, 8, 8)),
+                      vcell.begin_state(batch_size=2))
+    assert vout.shape == (2, 4, 6, 6)
+
     gru = crnn.Conv1DGRUCell(input_shape=(2, 10), hidden_channels=3)
     gru.initialize()
     out, st = gru(mx.nd.random_uniform(shape=(2, 2, 10)),
                   gru.begin_state(batch_size=2))
-    assert out.shape == (2, 3, 10)
+    assert out.shape == (2, 3, 8)  # valid-pad default: 10 - (3-1)
 
 
 def test_contrib_variational_dropout_cell():
