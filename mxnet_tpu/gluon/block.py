@@ -540,44 +540,64 @@ class HybridBlock(Block):
         def _tracked(x):
             return x._is_var or x._node is not None
 
-        nd_params = [p.data() for p in all_params]
-        recording = autograd.is_recording() and (
-            any(_tracked(p) for p in nd_params)
-            or any(
-                isinstance(a, nd.NDArray) and _tracked(a) for a in flat_in
-            )
-        )
-        if recording:
-            def _f(ps, xs):
-                return jitted(key, ps, xs)
+        # trace-platform hint for kernel-backed ops (ops/pallas_conv):
+        # jax traces are platform-agnostic, so ops choosing between a
+        # Pallas kernel and plain jnp need to know where THIS program's
+        # concrete arguments live
+        from ..ops import pallas_conv as _pc
 
-            out_vals, vjp_fn = jax.vjp(_f, pdata, idata)
-
-            def _pullback(cots):
-                if not isinstance(cots, tuple):
-                    cots = (cots,)
-                gp, gx = vjp_fn(cots)
-                return list(gp) + list(gx)
-
-            node = autograd.TapeNode(
-                _pullback,
-                [p if _tracked(p) else None for p in nd_params]
-                + [
-                    a if isinstance(a, nd.NDArray) and _tracked(a) else None
+        plat = _pc.platform_of(pdata) or _pc.platform_of(idata)
+        _hint_prev = _pc.set_trace_platform(plat)
+        try:
+            nd_params = [p.data() for p in all_params]
+            recording = autograd.is_recording() and (
+                any(_tracked(p) for p in nd_params)
+                or any(
+                    isinstance(a, nd.NDArray) and _tracked(a)
                     for a in flat_in
-                ],
-                [(tuple(map(int, v.shape)), v.dtype) for v in out_vals],
-                op_name=f"jit:{self.name}",
+                )
             )
-            outs = []
-            for i, v in enumerate(out_vals):
-                o = nd.NDArray(v)
-                o._node = node
-                o._oidx = i
-                outs.append(o)
-        else:
-            out_vals = jitted(key, pdata, idata)
-            outs = [nd.NDArray(v) for v in out_vals]
+            if recording:
+                def _f(ps, xs):
+                    return jitted(key, ps, xs)
+
+                out_vals, vjp_fn = jax.vjp(_f, pdata, idata)
+
+                def _pullback(cots):
+                    if not isinstance(cots, tuple):
+                        cots = (cots,)
+                    # the custom-vjp bwd rules trace HERE (first
+                    # backward), so the platform hint must be live
+                    prev = _pc.set_trace_platform(plat)
+                    try:
+                        gp, gx = vjp_fn(cots)
+                    finally:
+                        _pc.set_trace_platform(prev)
+                    return list(gp) + list(gx)
+
+                node = autograd.TapeNode(
+                    _pullback,
+                    [p if _tracked(p) else None for p in nd_params]
+                    + [
+                        a if isinstance(a, nd.NDArray) and _tracked(a)
+                        else None
+                        for a in flat_in
+                    ],
+                    [(tuple(map(int, v.shape)), v.dtype)
+                     for v in out_vals],
+                    op_name=f"jit:{self.name}",
+                )
+                outs = []
+                for i, v in enumerate(out_vals):
+                    o = nd.NDArray(v)
+                    o._node = node
+                    o._oidx = i
+                    outs.append(o)
+            else:
+                out_vals = jitted(key, pdata, idata)
+                outs = [nd.NDArray(v) for v in out_vals]
+        finally:
+            _pc.set_trace_platform(_hint_prev)
 
         out_fmt, single, n_primary, upd_idx = entry["meta"]
         if upd_idx:

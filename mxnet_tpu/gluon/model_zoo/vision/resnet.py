@@ -27,6 +27,13 @@ def _conv3x3(channels, stride, in_channels):
                      use_bias=False, in_channels=in_channels)
 
 
+def _is_symbolic(F):
+    """True when hybrid_forward is tracing the symbolic graph (export
+    path) — the fused-kernel shortcut keeps the canonical layer graph
+    there so exported JSON matches the reference topology."""
+    return not hasattr(F, "NDArray")
+
+
 class BasicBlockV1(HybridBlock):
     # no_bias is accepted for API uniformity with BottleneckV1: every
     # conv in this block is already bias-free, so True is a no-op that
@@ -89,10 +96,55 @@ class BottleneckV1(HybridBlock):
             self.downsample.add(nn.BatchNorm())
         else:
             self.downsample = None
+        # fused bn2->relu->conv3 tail (ops/pallas_conv.py): eligible when
+        # the net is channel-last and conv3 is bias-free — the expansion
+        # conv's activation is private to it, so the one-pass Pallas
+        # backward can absorb the relu mask + BN reductions
+        self._fusable_tail = (not use_bias
+                              and nn.layout.is_channel_last())
+
+    def _fused_tail(self, F, t):
+        """bn2 -> relu -> conv3 through the fused kernel; replicates the
+        BatchNorm layer's running-stat update."""
+        from .... import autograd
+        from ....ops import pallas_conv
+
+        body = list(self.body._children.values())
+        bn2, conv3 = body[4], body[6]
+        if not (pallas_conv.enabled() and autograd.is_training()
+                and not bn2._kwargs["use_global_stats"]):
+            return None
+        try:
+            gamma, beta = bn2.gamma.data(), bn2.beta.data()
+            rmean, rvar = bn2.running_mean.data(), bn2.running_var.data()
+            weight = conv3.weight.data()
+        except Exception:  # deferred shapes: first eager pass runs plain
+            return None
+        y, bmean, bvar = F._contrib_BNReluConv(
+            t, gamma, beta, weight, eps=bn2._kwargs["eps"],
+            fix_gamma=bn2._kwargs["fix_gamma"])
+        m = bn2._kwargs["momentum"]
+        with autograd.pause():
+            rmean._adopt((m * rmean + (1.0 - m) * bmean)._data)
+            rvar._adopt((m * rvar + (1.0 - m) * bvar)._data)
+        return y
 
     def hybrid_forward(self, F, x):
         residual = x
-        x = self.body(x)
+        if self._fusable_tail and not _is_symbolic(F):
+            body = list(self.body._children.values())
+            t = x
+            for layer in body[:4]:   # conv1, bn1, relu, conv2(3x3)
+                t = layer(t)
+            y = self._fused_tail(F, t)
+            if y is not None:
+                x = body[7](y)       # bn3
+            else:                    # ineligible call: plain tail
+                x = t
+                for layer in body[4:]:
+                    x = layer(x)
+        else:
+            x = self.body(x)
         if self.downsample:
             residual = self.downsample(residual)
         return F.Activation(x + residual, act_type="relu")

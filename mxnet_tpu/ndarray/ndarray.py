@@ -487,7 +487,27 @@ def _invoke_impl(opdef, inputs, out, params):
                 xs = _amp.cast_inputs(opdef.name, list(xs))
             return opdef.fn(*xs, **params)
 
-        out_vals, vjp_fn = jax.vjp(_f, *arrs)
+        if opdef.platform_sensitive:
+            # kernel-or-jnp ops need the target platform, but jax.vjp
+            # traces abstractly; pin the hint from the concrete inputs
+            # around BOTH the forward trace and the later backward trace
+            from ..ops import pallas_conv as _pc
+
+            plat = _pc.platform_of(arrs)
+            prev = _pc.set_trace_platform(plat)
+            try:
+                out_vals, raw_vjp = jax.vjp(_f, *arrs)
+            finally:
+                _pc.set_trace_platform(prev)
+
+            def vjp_fn(cots, _raw=raw_vjp, _plat=plat):
+                p = _pc.set_trace_platform(_plat)
+                try:
+                    return _raw(cots)
+                finally:
+                    _pc.set_trace_platform(p)
+        else:
+            out_vals, vjp_fn = jax.vjp(_f, *arrs)
     else:
         if amp_on:
             arrs = _amp.cast_inputs(opdef.name, arrs)

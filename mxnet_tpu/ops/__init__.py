@@ -22,5 +22,6 @@ from . import (  # noqa: F401  (import-for-registration)
     quantization_ops,
     control_flow_ops,
     optimizer_ops,
+    pallas_conv,
 )
 from .registry import OpDef, alias_op, get_op, list_ops, register_op  # noqa: F401

@@ -57,6 +57,31 @@ def _dimnums(nd, channel_last=False):
 # ResNet shapes, and inside the full train step the dot form was a net
 # loss (it breaks the BN-reduce/relu fusions XLA builds around the
 # backward convs).
+#
+# r05 revisits this for CHANNEL-LAST only: in NHWC a 1x1 conv is a
+# native [N*H*W, Ci] @ [Ci, Co] matmul with no layout change, and XLA's
+# matmul emitters fuse elementwise epilogues at least as well as the
+# conv emitters.  Gated off by default pending the step-level A/B
+# (MXNET_CONV_1X1_DOT=1 to enable).
+
+
+def _conv1x1_dot(data, weight, stride, cl):
+    """Channel-last 1x1 conv as a dot_general over the channel dim.
+    data [N, *sp, Ci], weight [Co, *(1,)*nd, Ci] -> [N, *sp', Co]."""
+    import os
+
+    if not cl or os.environ.get("MXNET_CONV_1X1_DOT", "0") != "1":
+        return None
+    nd = data.ndim - 2
+    if any(s != 1 for s in stride):
+        idx = (slice(None),) + tuple(
+            slice(None, None, s) for s in stride) + (slice(None),)
+        data = data[idx]
+    co = weight.shape[0]
+    w2 = weight.reshape(co, data.shape[-1])
+    return jax.lax.dot_general(
+        data, w2, dimension_numbers=(((data.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=data.dtype)
 
 
 def _stem_space_to_depth(data, weight, jnp_pad=jnp.pad):
@@ -102,6 +127,10 @@ def convolution(data, weight, bias=None, *, kernel, num_filter, stride=None,
             and data.shape[1] <= 4 and data.shape[2] % 2 == 0
             and data.shape[3] % 2 == 0):
         out = _stem_space_to_depth(data, weight)
+    elif (kernel == (1,) * nd and pad == (0,) * nd
+          and dilate == (1,) * nd and num_group == 1
+          and (out := _conv1x1_dot(data, weight, stride, cl)) is not None):
+        pass  # NHWC 1x1 fast path (see _conv1x1_dot)
     else:
         dn = _dimnums(nd, cl)
         out = jax.lax.conv_general_dilated(

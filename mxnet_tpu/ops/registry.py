@@ -47,6 +47,10 @@ class OpDef:
     differentiable: bool = True
     key_param: Optional[str] = None
     train_param: Optional[str] = None  # injected with autograd.is_training()
+    #: op picks between a Pallas kernel and plain jnp by target platform
+    #: (ops/pallas_conv.py): the eager dispatcher must pin the trace-
+    #: platform hint from its concrete inputs around vjp tracing
+    platform_sensitive: bool = False
     doc: str = ""
 
     def out_count(self, params) -> int:
@@ -65,7 +69,7 @@ class OpDef:
 
 
 def register_op(name=None, *, aliases=(), num_outputs=1, differentiable=True,
-                key_param=None, train_param=None):
+                key_param=None, train_param=None, platform_sensitive=False):
     """Decorator: register a pure function as an operator.
 
     Positional (or *args) parameters are tensor inputs; keyword-only
@@ -81,6 +85,7 @@ def register_op(name=None, *, aliases=(), num_outputs=1, differentiable=True,
             differentiable=differentiable,
             key_param=key_param,
             train_param=train_param,
+            platform_sensitive=platform_sensitive,
             doc=fn.__doc__ or "",
         )
         if opname in _OPS:

@@ -1,0 +1,100 @@
+"""Fused BN+ReLU+1x1-conv block (ops/pallas_conv.py): numerical parity
+with the plain layer path.  On the CPU test mesh the op runs its jnp
+pass-1; on TPU the same custom_vjp dispatches the Pallas kernel (the
+kernel itself was verified against this math on-chip, r05)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+@pytest.fixture
+def fused_env():
+    os.environ["MXNET_FUSED_BNRELUCONV"] = "1"
+    yield
+    os.environ.pop("MXNET_FUSED_BNRELUCONV", None)
+
+
+def test_fused_op_matches_layer_tail():
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.pallas_conv import fused_bn_relu_conv1x1
+
+    mx.random.seed(3)
+    with nn.default_layout("NHWC"):
+        bn = nn.BatchNorm()
+        conv = nn.Conv2D(24, kernel_size=1, strides=1, use_bias=False)
+    bn.initialize()
+    conv.initialize()
+    x = nd.array(onp.random.RandomState(0).randn(2, 6, 6, 16)
+                 .astype("float32"))
+    _ = conv(nd.relu(bn(x)))  # resolve deferred shapes
+    bn.gamma.set_data(nd.array(onp.random.RandomState(1).rand(16) + 0.5))
+    bn.beta.set_data(nd.array(onp.random.RandomState(2).randn(16) * 0.2))
+
+    with autograd.record():
+        ref = conv(nd.relu(bn(x)))
+    y, bmean, bvar = fused_bn_relu_conv1x1(
+        x._data, bn.gamma.data()._data, bn.beta.data()._data,
+        conv.weight.data()._data, eps=bn._kwargs["eps"],
+        fix_gamma=bn._kwargs["fix_gamma"])
+    assert float(jnp.max(jnp.abs(ref._data - y))) < 1e-5
+    # batch stats match the BN op's
+    red = x._data.astype(jnp.float32).reshape(-1, 16)
+    onp.testing.assert_allclose(onp.asarray(bmean), red.mean(0),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_fused_bottleneck_block_parity(fused_env):
+    """BottleneckV1 with the fused tail: forward and every gradient
+    match the unfused block to fp32 tolerance."""
+    from mxnet_tpu.gluon.model_zoo.vision.resnet import BottleneckV1
+
+    mx.random.seed(7)
+    with nn.default_layout("NHWC"):
+        blk = BottleneckV1(64, 1, downsample=True, in_channels=16,
+                           no_bias=True)
+    blk.initialize()
+    x = nd.array(onp.random.RandomState(0).randn(2, 8, 8, 16)
+                 .astype("float32"))
+
+    os.environ["MXNET_FUSED_BNRELUCONV"] = "0"
+    with autograd.record():
+        l0 = blk(x).sum()
+    l0.backward()
+    g0 = {k: p.grad().asnumpy().copy()
+          for k, p in blk.collect_params().items()
+          if p.grad_req == "write"}
+
+    os.environ["MXNET_FUSED_BNRELUCONV"] = "1"
+    with autograd.record():
+        l1 = blk(x).sum()
+    l1.backward()
+
+    assert abs(float(l0.asnumpy()) - float(l1.asnumpy())) < 1e-3
+    for k, ref in g0.items():
+        got = blk.collect_params()[k].grad().asnumpy()
+        denom = onp.abs(ref).max() + 1e-8
+        assert onp.abs(ref - got).max() / denom < 1e-3, k
+
+
+def test_fused_tail_updates_running_stats(fused_env):
+    from mxnet_tpu.gluon.model_zoo.vision.resnet import BottleneckV1
+
+    mx.random.seed(9)
+    with nn.default_layout("NHWC"):
+        blk = BottleneckV1(32, 1, downsample=True, in_channels=8,
+                           no_bias=True)
+    blk.initialize()
+    x = nd.array(onp.random.RandomState(0).randn(2, 4, 4, 8)
+                 .astype("float32"))
+    with autograd.record():
+        _ = blk(x)
+    # bn2 (the fused one, body index 4) must have moved its stats
+    bn2 = list(blk.body._children.values())[4]
+    assert float(
+        onp.abs(bn2.running_var.data().asnumpy() - 1.0).max()) > 1e-6
