@@ -44,6 +44,14 @@ def _standard_inputs(large=False):
                        onp.ones(32, "float32"), onp.zeros(32, "float32"),
                        onp.zeros(32, "float32"), onp.ones(32, "float32")],
                       {}),
+        # fused bn->relu->1x1conv (ops/pallas_conv.py): NHWC input,
+        # channel-last O11I weight
+        "_contrib_BNReluConv": (
+            [onp.random.rand(4, 8, 8, 16).astype("float32") + 0.1,
+             onp.random.rand(16).astype("float32") + 0.5,
+             onp.random.rand(16).astype("float32") * 0.2,
+             onp.random.rand(24, 1, 1, 16).astype("float32") * 0.3],
+            {}),
         "softmax": ([a], {}),
         "sum": ([a], {}),
         "transpose": ([a], {}),

@@ -99,9 +99,26 @@ class BottleneckV1(HybridBlock):
         # fused bn2->relu->conv3 tail (ops/pallas_conv.py): eligible when
         # the net is channel-last and conv3 is bias-free — the expansion
         # conv's activation is private to it, so the one-pass Pallas
-        # backward can absorb the relu mask + BN reductions
+        # backward can absorb the relu mask + BN reductions.  The body
+        # structure is verified here so a future reshuffle DISABLES the
+        # fusion instead of silently fusing the wrong layers.
         self._fusable_tail = (not use_bias
-                              and nn.layout.is_channel_last())
+                              and nn.layout.is_channel_last()
+                              and self._tail_structure_ok())
+
+    def _tail_structure_ok(self):
+        body = list(self.body._children.values())
+        if len(body) != 8:
+            return False
+        bn2, act2, conv3 = body[4], body[5], body[6]
+        return (isinstance(bn2, nn.BatchNorm)
+                and isinstance(conv3, nn.Conv2D)
+                and isinstance(body[7], nn.BatchNorm)
+                and getattr(act2, "_act_type", None) == "relu"
+                and getattr(conv3, "_kwargs", {}).get("kernel")
+                == (1, 1)
+                and getattr(conv3, "_kwargs", {}).get("stride",
+                                                      (1, 1)) == (1, 1))
 
     def _fused_tail(self, F, t):
         """bn2 -> relu -> conv3 through the fused kernel; replicates the

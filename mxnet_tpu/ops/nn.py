@@ -170,7 +170,14 @@ def _bn_train_bwd(eps, axis, fix_gamma, res, cts):
     """Fused BN backward (the cuDNN BatchNormalizationBackward analog,
     reference batch_norm.cu): residuals are the ORIGINAL bf16 x plus
     per-channel stats — no fp32 activation-sized tensors survive the
-    forward, which halves the train-step HBM traffic."""
+    forward, which halves the train-step HBM traffic.
+
+    An output-recompute variant (InPlace-ABN: xhat = (y-beta)/gamma from
+    the materialized BN output) was tried in r05 and REVERTED: step time
+    measured neutral on v5e (XLA's fusion graph had already deduplicated
+    the y read), while gamma==0 — the standard zero-init-gamma residual
+    recipe — makes xhat unrecoverable and silently freezes dgamma at 0,
+    and small-|gamma| bf16 recovery cancels catastrophically."""
     data, gamma, mean, inv, red, bshape = res
     dy, dmean_ct, dvar_ct = cts
     n = 1

@@ -59,7 +59,12 @@ def enabled():
     pass-2 BN input gradient no longer fuses into the upstream conv's
     backward across the opaque boundary.  Kept as an opt-in fused op
     (correctness-tested vs the layer path); the win would need the
-    neighboring convs to speak default layouts too."""
+    neighboring convs to speak default layouts too.
+
+    Read at TRACE time: a hybridized block bakes the choice into its
+    cached program, so flipping the env var after the first call does
+    not retrace (same as every env-config knob read inside traced
+    code).  Toggle before building/hybridizing the net."""
     env = os.environ.get("MXNET_FUSED_BNRELUCONV")
     if env is not None:
         return env == "1"
@@ -85,6 +90,10 @@ def _bwd_kernel(dy_ref, u_ref, w_ref, g_ref, b_ref, mu_ref, inv_ref,
     rows = jax.lax.broadcasted_iota(jnp.int32, (block_m, 1), 0) + row0
     live = rows < rows_total
     mask = jnp.logical_and(mask, live)
+    # padded tail rows hold UNSPECIFIED bits: zero every operand that
+    # enters a contraction, not just one side — 0 * NaN is NaN and one
+    # poisoned row would corrupt dW/s2 for the whole call
+    dy = jnp.where(live, dy, jnp.zeros_like(dy))
     d_act = jax.lax.dot_general(
         dy, w_ref[:], dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -94,7 +103,7 @@ def _bwd_kernel(dy_ref, u_ref, w_ref, g_ref, b_ref, mu_ref, inv_ref,
     partw = jax.lax.dot_general(
         relu_act, dy, dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)         # [Ci, Co]
-    xhat = (u32 - mu_ref[:]) * inv_ref[:]
+    xhat = jnp.where(live, (u32 - mu_ref[:]) * inv_ref[:], 0.0)
     p1 = jnp.sum(d_bnout32, axis=0, keepdims=True)
     p2 = jnp.sum(d_bnout32 * xhat, axis=0, keepdims=True)
 
@@ -255,17 +264,12 @@ def _use_pallas(x):
 
 # ------------------------------------------------------------ composite
 def _stats(u2):
-    """fp32 batch stats over rows — EXACTLY ops/nn.py _bn_stats: one
-    pass (fusable sibling reduces) for half-precision data, two-pass
-    subtract-mean for fp32/64 where E[x^2]-E[x]^2 can cancel."""
-    u32 = u2.astype(jnp.float32)
-    mean = jnp.mean(u32, axis=0)
-    if u2.dtype in (jnp.bfloat16, jnp.float16):
-        ex2 = jnp.mean(jnp.square(u32), axis=0)
-        var = jnp.maximum(ex2 - jnp.square(mean), 0.0)
-    else:
-        var = jnp.mean(jnp.square(u32 - mean[None, :]), axis=0)
-    return mean, var
+    """fp32 batch stats over rows — delegates to ops/nn.py _bn_stats
+    (axis=1 on the [M, Ci] view) so the fused path can never diverge
+    from the BatchNorm layer's numerics policy."""
+    from .nn import _bn_stats
+
+    return _bn_stats(u2, 1)
 
 
 def _fwd_math(u2, gamma, beta, w2, eps, fix_gamma):

@@ -96,6 +96,10 @@ SKIP_JUSTIFICATIONS = {
 #: attention, batched-stat normalizers, resize): checked in f32 with a
 #: coarser eps/tolerance — an f64 FD only measures their cast noise
 F32_OPS = {
+    # fp32 is the op's DEFINED accumulation precision (TPU-native BN
+    # policy): under f64 FD probing the f32 primal noise swamps the
+    # 5e-3 tolerance, so these run in f32 mode with f32 tolerances
+    "_contrib_BNReluConv",
     "SyncBatchNorm", "AdaptiveAvgPooling2D", "BilinearResize2D",
     "_contrib_dot_product_attention",
     "_contrib_interleaved_matmul_selfatt_qk",
