@@ -310,11 +310,27 @@ def bench_op(opname, inputs, params, ctx, runs):
     op = get_op(opname)
     vals = [mx.nd.array(x, ctx=ctx)._data for x in inputs]
     kwargs = dict(params)
-    if op.key_param and op.key_param not in kwargs:
-        kwargs[op.key_param] = jax.random.key(0)
 
-    def fn(*args):
-        return op.fn(*args, **kwargs)
+    if not vals and op.key_param:
+        # zero-input sampler: the chained timer needs a data
+        # dependence or XLA hoists the draw out of the loop (measuring
+        # an empty body).  Fold the chain's perturbed dummy counter
+        # into the PRNG key so every iteration draws fresh.
+        base_key = jax.random.key(0)
+        dummy = mx.nd.array(onp.zeros((1,), "int32"), ctx=ctx)._data
+
+        def fn(d):
+            kw = dict(kwargs)
+            kw[op.key_param] = jax.random.fold_in(base_key, d[0])
+            return op.fn(**kw)
+
+        vals = [dummy]
+    else:
+        if op.key_param and op.key_param not in kwargs:
+            kwargs[op.key_param] = jax.random.key(0)
+
+        def fn(*args):
+            return op.fn(*args, **kwargs)
 
     dt, _ = device_chain_time(fn, vals, target_spread=0.4,
                               trials=max(3, min(runs // 8, 5)),
@@ -327,13 +343,152 @@ def bench_op(opname, inputs, params, ctx, runs):
 # index-typed inputs); everything else in the registry gets timed
 SKIP_OPS = frozenset((
     "_foreach", "_while_loop", "_cond",  # subgraph-JSON attrs
-    "_contrib_count_sketch",  # integer hash inputs
     "custom",  # user-provided op body
     # complex-valued iFFT is UNIMPLEMENTED on the axon TPU backend, and
     # a failed execution poisons the tunnel stream for every op after
     # it — keep it out of the sweep
     "_contrib_ifft",
 ))
+
+#: ops the chained timer CANNOT measure honestly, each with the reason
+#: (the grad sweep's SKIP_JUSTIFICATIONS discipline applied here —
+#: every registered non-alias op is timed or justified)
+JUSTIFIED_SKIPS = {
+    "_npi_hanning": "zero-input deterministic generator: loop-"
+                    "invariant, XLA hoists it out of the chained loop "
+                    "so only a per-iteration copy would be timed",
+    "_npi_hamming": "zero-input deterministic generator (see hanning)",
+    "_npi_blackman": "zero-input deterministic generator (see hanning)",
+    "_npi_bartlett": "zero-input deterministic generator (see hanning)",
+    "_npi_indices": "zero-input deterministic generator (see hanning)",
+    "_npi_tri": "zero-input deterministic generator (see hanning)",
+    "_contrib_count_sketch": "integer hash-index inputs: the chain's "
+                             "float perturbation corrupts them",
+    "_getitem": "python-object `key` parameter (slices/ellipsis): not "
+                "a tensor program knob; covered by crop/slice timings",
+}
+
+
+def _bench_extra_inputs():
+    """Curated specs for ops the auto-probe cannot type out: optimizer
+    update rules, scalar-compare family, quantized conv/fc, MultiBox*,
+    numpy tail ops, random samplers (reference
+    benchmark/opperf/utils/op_registry_utils.py keeps the same
+    per-family registries)."""
+    n = 1024
+    a = onp.random.rand(n, n).astype("float32")
+    v = onp.random.rand(n).astype("float32")
+    ints = onp.random.randint(0, 255, (n, n)).astype("int32")
+    q8 = onp.random.randint(-127, 127, (8, 32, 32, 32)).astype("int8")
+    w8 = onp.random.randint(-127, 127, (64, 32, 3, 3)).astype("int8")
+    mm = onp.float32
+    opt = {
+        "sgd_update": ([a, a], dict(lr=0.1)),
+        "sgd_mom_update": ([a, a, a], dict(lr=0.1, momentum=0.9)),
+        "nag_mom_update": ([a, a, a], dict(lr=0.1, momentum=0.9)),
+        "adam_update": ([a, a, a, a], dict(lr=0.1)),
+        "rmsprop_update": ([a, a, a], dict(lr=0.1)),
+        "rmspropalex_update": ([a, a, a, a, a], dict(lr=0.1)),
+        "ftrl_update": ([a, a, a, a], dict(lr=0.1)),
+        "signsgd_update": ([a, a], dict(lr=0.1)),
+        "signum_update": ([a, a, a], dict(lr=0.1, momentum=0.9)),
+        "multi_sgd_update": ([a, a], dict(lrs=(0.1,), wds=(0.0,),
+                                          num_weights=1)),
+        "multi_sgd_mom_update": ([a, a, a],
+                                 dict(lrs=(0.1,), wds=(0.0,),
+                                      num_weights=1)),
+        "multi_lars": ([v, v, v, v], dict(eta=0.001, eps=1e-8)),
+        # _sparse_adagrad_update is an alias of adagrad_update (timed)
+    }
+    scalar_cmp = {
+        name: ([a], dict(scalar=0.5))
+        for name in ("_equal_scalar", "_not_equal_scalar",
+                     "_greater_scalar", "_greater_equal_scalar",
+                     "_lesser_scalar", "_lesser_equal_scalar")
+    }
+    rand = {
+        # zero-input samplers: bench_op folds the chain's perturbed
+        # dummy into the PRNG key, so every iteration draws fresh
+        name: ([], dict(shape=(n, n)))
+        for name in ("_random_uniform", "_random_normal",
+                     "_random_exponential", "_random_poisson",
+                     "_random_gamma", "_random_negative_binomial",
+                     "_random_generalized_negative_binomial")
+    }
+    rand["_random_randint"] = ([], dict(low=0, high=100, shape=(n, n)))
+    npi = {
+        "_npi_bincount": ([onp.random.randint(0, 512, n * 16)
+                           .astype(mm)], {}),
+        "_npi_bitwise_and": ([ints, ints], {}),
+        "_npi_bitwise_or": ([ints, ints], {}),
+        "_npi_bitwise_xor": ([ints, ints], {}),
+        "_npi_bitwise_not": ([ints], {}),
+        "_npi_left_shift": ([ints, onp.full((n, n), 2, "int32")], {}),
+        "_npi_right_shift": ([ints, onp.full((n, n), 2, "int32")], {}),
+        "_npi_full_like": ([a], dict(fill_value=3.0)),
+        "_npi_delete": ([v], dict(obj=5, axis=0)),
+        "_npi_insert": ([v, onp.float32([1.5])], dict(obj=5, axis=0)),
+        "_npi_interp": ([onp.sort(v), onp.sort(v),
+                         onp.random.rand(n).astype(mm)], {}),
+        "_npi_percentile": ([a], dict(q=50.0)),
+        "_npi_quantile": ([a], dict(q=0.5)),
+        "_npi_resize": ([a], dict(new_shape=(n // 2, 2 * n))),
+        # bucketized static-size variants (the jit contract for
+        # value-dependent output shapes)
+        "_npi_unique": ([onp.random.randint(0, 256, (n * 64,))
+                         .astype(mm)], dict(size=256)),
+        "_npi_nonzero": ([(onp.random.rand(n, n) > 0.5)
+                          .astype(mm)], dict(size=n * n)),
+        "crop": ([a], dict(begin=(8, 8), end=(n - 8, n - 8))),
+    }
+    quant = {
+        "_contrib_quantize": ([a, onp.float32([0.0]),
+                               onp.float32([1.0])], {}),
+        "_contrib_requantize": (
+            [onp.random.randint(-2**20, 2**20, (n, n)).astype("int32"),
+             onp.float32([-1.0]), onp.float32([1.0])], {}),
+        "_contrib_quantized_conv": (
+            [q8, w8, onp.zeros(64, "int8"),
+             onp.float32([-1]), onp.float32([1]), onp.float32([-1]),
+             onp.float32([1]), onp.float32([-1]), onp.float32([1])],
+            dict(kernel=(3, 3), num_filter=64, pad=(1, 1))),
+        "_contrib_quantized_fully_connected": (
+            [onp.random.randint(-127, 127, (128, 256)).astype("int8"),
+             onp.random.randint(-127, 127, (512, 256)).astype("int8"),
+             onp.zeros(512, "int8"),
+             onp.float32([-1]), onp.float32([1]), onp.float32([-1]),
+             onp.float32([1]), onp.float32([-1]), onp.float32([1])],
+            dict(num_hidden=512)),
+    }
+    nb = 256  # boxes per image for the detection family
+    anchors = onp.random.rand(1, nb, 4).astype(mm)
+    det = {
+        "MultiBoxPrior": ([onp.random.rand(8, 3, 64, 64).astype(mm)],
+                          dict(sizes=(0.5, 0.25), ratios=(1.0, 2.0))),
+        "MultiBoxTarget": ([anchors,
+                            onp.random.rand(8, 4, 5).astype(mm),
+                            onp.random.rand(8, 4, nb).astype(mm)], {}),
+        "MultiBoxDetection": ([
+            onp.random.rand(8, 4, nb).astype(mm),
+            onp.random.rand(8, nb * 4).astype(mm), anchors], {}),
+        "_contrib_Proposal": ([
+            onp.random.rand(2, 2 * 9, 16, 16).astype(mm),
+            onp.random.rand(2, 4 * 9, 16, 16).astype(mm),
+            onp.tile(onp.float32([256, 256, 1.0]), (2, 1))],
+            dict(scales=(2, 4, 8), ratios=(0.5, 1, 2),
+                 rpn_pre_nms_top_n=512, rpn_post_nms_top_n=128,
+                 rpn_min_size=1)),
+        "_contrib_hawkesll": ([
+            onp.random.rand(4).astype(mm) + 0.5,
+            onp.random.rand(4).astype(mm) * 0.5,
+            onp.random.rand(4).astype(mm) + 0.5,
+            onp.zeros((8, 4), mm),
+            onp.random.rand(8, 100).astype(mm),
+            onp.random.randint(0, 4, (8, 100)).astype(mm),
+            onp.full((8,), 100.0, mm),
+            onp.full((8,), 120.0, mm)], {}),
+    }
+    return {**opt, **scalar_cmp, **rand, **npi, **quant, **det}
 
 
 def auto_inputs(opname):
@@ -383,7 +538,7 @@ def main():
                     prev[row["op"]] = row["avg_time_ms"]
 
     ctx = mx.gpu(0)
-    curated = _standard_inputs(args.large)
+    curated = {**_standard_inputs(args.large), **_bench_extra_inputs()}
     if args.ops:
         names = args.ops.split(",")
     else:
@@ -397,7 +552,11 @@ def main():
             seen_defs.setdefault(id(get_op(o)), o)  # dedupe aliases
         names = sorted(set(list(curated) + list(seen_defs.values())))
     skipped = []
+    justified = {}
     for name in names:
+        if name in JUSTIFIED_SKIPS:
+            justified[name] = JUSTIFIED_SKIPS[name]
+            continue
         if name in curated:
             spec = curated[name]
         else:
@@ -408,12 +567,12 @@ def main():
         try:
             dt = bench_op(name, spec[0], spec[1], ctx, args.runs)
         except Exception as e:
-            # auto-probed inputs legitimately miss some signatures, but
-            # an explicitly requested op failing must be visible
-            if args.ops:
+            # a curated or explicitly requested op failing must be
+            # visible; only blind auto-probe misses go to the skip list
+            if args.ops or name in curated:
                 print(json.dumps({"op": name, "error": repr(e)}),
                       flush=True)
-            else:
+            if not args.ops:
                 skipped.append(name)
             continue
         row = {"op": name, "avg_time_ms": round(dt * 1e3, 4),
@@ -423,9 +582,11 @@ def main():
             if prev[name] > 0 and dt > 0:
                 row["speedup_vs_prev"] = round(prev[name] / (dt * 1e3), 2)
         print(json.dumps(row), flush=True)
-    if skipped:
-        print(json.dumps({"skipped_unprobeable": len(skipped),
-                          "ops": skipped}), flush=True)
+    # coverage gate (the grad sweep's discipline): every registered
+    # non-alias op is timed, justified, or listed as a visible failure
+    print(json.dumps({"skipped_unprobeable": len(skipped),
+                      "ops": sorted(skipped),
+                      "justified_skips": justified}), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Bucketed LSTM word-LM with BucketingModule (reference:
+example/rnn/bucketing/lstm_bucketing.py — variable-length sequences
+batched into per-length buckets sharing one parameter set).
+
+Synthetic corpus by default (zero-egress environment); pass --data for
+a tokenized text file.
+
+    python example/rnn/bucketing/lstm_bucketing.py --steps 60
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as onp
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+from mxnet_tpu.io import DataBatch, DataDesc  # noqa: E402
+
+BUCKETS = (8, 16)
+
+
+def sym_gen_factory(vocab, embed, hidden):
+    """Per-bucket unrolled LSTM graph; parameters are shared across
+    buckets by name (the BucketingModule contract)."""
+    def sym_gen(seq_len):
+        data = sym.Variable("data")          # (batch, seq_len) ids
+        label = sym.Variable("softmax_label")
+        emb = sym.Embedding(data, input_dim=vocab, output_dim=embed,
+                            name="embed")
+        cell_out = sym.RNN(
+            sym.transpose(emb, axes=(1, 0, 2)),   # TNC for the op
+            state_size=hidden, num_layers=1, mode="lstm",
+            name="lstm")
+        # back to batch-major so the flattened positions line up with
+        # the batch-major flattened labels
+        bm = sym.transpose(cell_out, axes=(1, 0, 2), name="bm")
+        flat = sym.Reshape(bm, shape=(-1, hidden), name="flat")
+        fc = sym.FullyConnected(flat, num_hidden=vocab, name="decoder")
+        out = sym.SoftmaxOutput(fc, sym.Reshape(label, shape=(-1,)),
+                                name="softmax")
+        return out, ("data",), ("softmax_label",)
+    return sym_gen
+
+
+def synthetic_batches(rng, steps, batch_size, vocab):
+    """Markov-ish token streams cut to a random bucket per batch."""
+    for _ in range(steps):
+        L = BUCKETS[rng.randint(len(BUCKETS))]
+        base = rng.randint(0, vocab, (batch_size, 1))
+        seq = (base + onp.arange(L)) % vocab      # learnable structure
+        data = seq.astype("float32")
+        label = ((seq + 1) % vocab).astype("float32")
+        yield DataBatch(
+            data=[mx.nd.array(data)], label=[mx.nd.array(label)],
+            bucket_key=L,
+            provide_data=[DataDesc("data", (batch_size, L))],
+            provide_label=[DataDesc("softmax_label", (batch_size, L))])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--vocab", type=int, default=32)
+    ap.add_argument("--embed", type=int, default=16)
+    ap.add_argument("--hidden", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=0.5)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    ctx = mx.gpu(0) if mx.num_gpus() else mx.cpu()
+    mod = mx.mod.BucketingModule(
+        sym_gen_factory(args.vocab, args.embed, args.hidden),
+        default_bucket_key=max(BUCKETS), context=ctx)
+
+    rng = onp.random.RandomState(0)
+    warm = next(synthetic_batches(rng, 1, args.batch_size,
+                                  args.vocab))
+    mod.bind(data_shapes=warm.provide_data,
+             label_shapes=warm.provide_label)
+    mod.init_params(initializer=mx.init.Uniform(0.1))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", args.lr),
+                                         ("momentum", 0.9)))
+    metric = mx.metric.Perplexity(ignore_label=None)
+
+    first = last = None
+    for i, batch in enumerate(synthetic_batches(
+            rng, args.steps, args.batch_size, args.vocab)):
+        mod.forward(batch, is_train=True)
+        metric.reset()
+        mod.update_metric(metric, batch.label)
+        mod.backward()
+        mod.update()
+        ppl = metric.get()[1]
+        first = first if first is not None else ppl
+        last = ppl
+        if i % 10 == 0:
+            logging.info("step %d bucket %d perplexity %.2f",
+                         i, batch.bucket_key, ppl)
+    logging.info("perplexity %.2f -> %.2f", first, last)
+    assert last < first * 0.8, "perplexity did not improve"
+    print("lstm_bucketing OK")
+
+
+if __name__ == "__main__":
+    main()

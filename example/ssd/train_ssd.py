@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Train SSD-300 (VGG16-reduced backbone) on detection data
+(reference: example/ssd/train.py).
+
+Without a dataset this trains on synthetic boxes (like train_mnist's
+synthetic fallback) and asserts the multibox loss decreases — the CI
+smoke path; point --rec at an im2rec detection .rec for real data.
+
+    python example/ssd/train_ssd.py --batch-size 8 --steps 30
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon, nd  # noqa: E402
+
+
+def synthetic_batch(rng, batch_size, num_classes):
+    """Images + per-image ground-truth [cls, x1, y1, x2, y2] boxes."""
+    x = rng.rand(batch_size, 3, 300, 300).astype("float32")
+    labels = onp.full((batch_size, 3, 5), -1.0, "float32")
+    for i in range(batch_size):
+        for b in range(rng.randint(1, 3)):
+            x1, y1 = rng.uniform(0.0, 0.6, 2)
+            w, h = rng.uniform(0.2, 0.4, 2)
+            labels[i, b] = [rng.randint(0, num_classes),
+                            x1, y1, min(x1 + w, 1.0), min(y1 + h, 1.0)]
+    return nd.array(x), nd.array(labels)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--lr", type=float, default=0.004)
+    ap.add_argument("--num-classes", type=int, default=4)
+    ap.add_argument("--rec", default=None,
+                    help="detection .rec file (synthetic data if unset)")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    ctx = mx.gpu(0) if mx.num_gpus() else mx.cpu()
+    net = gluon.model_zoo.vision.ssd_300_vgg16_reduced(
+        num_classes=args.num_classes)
+    net.initialize(init=mx.init.Xavier(), ctx=ctx)
+    net(nd.zeros((1, 3, 300, 300), ctx=ctx))  # resolve shapes
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9,
+                             "wd": 5e-4})
+    mbox_target = mx.nd.contrib.MultiBoxTarget
+
+    if args.rec:
+        it = mx.io.ImageDetRecordIter(
+            path_imgrec=args.rec, batch_size=args.batch_size,
+            data_shape=(3, 300, 300))
+    rng = onp.random.RandomState(0)
+
+    first = last = None
+    for step in range(args.steps):
+        if args.rec:
+            try:
+                batch = next(it)
+            except StopIteration:
+                it.reset()
+                batch = next(it)
+            x = batch.data[0].as_in_context(ctx)
+            y = batch.label[0].as_in_context(ctx)
+        else:
+            x, y = synthetic_batch(rng, args.batch_size,
+                                   args.num_classes)
+            x, y = x.as_in_context(ctx), y.as_in_context(ctx)
+
+        with autograd.record():
+            cls_preds, loc_preds, anchors = net(x)
+            cls_prob = nd.softmax(cls_preds, axis=-1)
+            loc_t, loc_mask, cls_t = mbox_target(
+                anchors, y, cls_preds.transpose((0, 2, 1)),
+                overlap_threshold=0.5, negative_mining_ratio=3.0)
+            cls_loss = gluon.loss.SoftmaxCrossEntropyLoss()(
+                cls_preds.reshape((-1, args.num_classes + 1)),
+                cls_t.reshape((-1,)))
+            loc_loss = (nd.abs((loc_preds - loc_t) * loc_mask)).mean()
+            loss = cls_loss.mean() + loc_loss
+        loss.backward()
+        trainer.step(args.batch_size)
+        v = float(loss.asnumpy())
+        first = first if first is not None else v
+        last = v
+        if step % 10 == 0:
+            logging.info("step %d multibox loss %.4f", step, v)
+    logging.info("loss %.4f -> %.4f", first, last)
+    assert last < first, "multibox loss did not decrease"
+    print("train_ssd OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -90,4 +90,9 @@ def __getattr__(name):
         mod = _importlib.import_module(_LAZY[name], __name__)
         globals()[name] = mod
         return mod
+    if name == "AttrScope":  # reference surface: mx.AttrScope
+        from .symbol import AttrScope
+
+        globals()[name] = AttrScope
+        return AttrScope
     raise AttributeError(f"module 'mxnet_tpu' has no attribute {name!r}")

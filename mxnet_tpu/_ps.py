@@ -102,6 +102,13 @@ class _ServerShard(threading.Thread):
         # namespace can carry its own optimizer rule
         self.updaters = {}         # namespace -> updater callable
         self.last_hb = {}          # worker rank -> monotonic time
+        # server-side profiling (reference KVStoreServerProfilerCommand,
+        # include/mxnet/kvstore.h:49): op counters + wire bytes,
+        # controlled by worker "cmd" frames
+        self.profiling = False
+        self.stats = {"push": 0, "pull": 0, "spush": 0, "spull": 0,
+                      "bytes_in": 0, "bytes_out": 0}
+        self.commands = []         # (head, body) log for kController
         self._cv = threading.Condition()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -134,6 +141,13 @@ class _ServerShard(threading.Thread):
             conn.close()
 
     # ----------------------------------------------------------- logic
+    def _prof(self, op, bytes_in=0, bytes_out=0):
+        """Profiling counters; caller holds the lock."""
+        if self.profiling:
+            self.stats[op] += 1
+            self.stats["bytes_in"] += int(bytes_in)
+            self.stats["bytes_out"] += int(bytes_out)
+
     def _updater_for(self, key):
         ns = key.split("/", 1)[0] if "/" in key else ""
         return self.updaters.get(ns)
@@ -175,6 +189,7 @@ class _ServerShard(threading.Thread):
             with self._cv:
                 if key not in self.values:
                     raise MXNetError(f"push to uninitialized key {key}")
+                self._prof("push", bytes_in=getattr(grad, "nbytes", 0))
                 if mode == "async":
                     if self._updater_for(key) is None:
                         self.values[key] = self.values[key] + grad
@@ -216,6 +231,72 @@ class _ServerShard(threading.Thread):
                         self.pending_count[key] = cnt
                 self._cv.notify_all()
             return ("ok",)
+        if op == "spush":
+            # row_sparse push: only (rows, vals) crossed the wire
+            # (reference kvstore_dist.h PushRowSparse); the server's
+            # store stays dense — the WIRE is what is O(nnz)
+            _, key, rows, vals, mode, meta = msg
+            sender = meta.get("sender", -1)
+            rows = onp.asarray(rows, onp.int64)
+            vals = onp.asarray(vals, onp.float32)
+            with self._cv:
+                if key not in self.values:
+                    raise MXNetError(f"spush to uninitialized key {key}")
+                self._prof("spush",
+                           bytes_in=rows.nbytes + vals.nbytes)
+                if mode == "async":
+                    onp.add.at(self.values[key], rows, vals)
+                else:
+                    prev = self.pushed_rounds.get((key, sender), 0)
+                    skew_deadline = time.monotonic() + 600.0
+                    while prev > self.completed_rounds.get(key, 0):
+                        left = skew_deadline - time.monotonic()
+                        if left <= 0:
+                            raise MXNetError(
+                                f"sync spush round skew on {key}")
+                        self._cv.wait(timeout=min(left, 1.0))
+                    self.pushed_rounds[(key, sender)] = prev + 1
+                    acc = self.pending.get(key)
+                    if acc is None:
+                        acc = onp.zeros_like(self.values[key])
+                        self.pending[key] = acc
+                    onp.add.at(acc, rows, vals)
+                    cnt = self.pending_count.get(key, 0) + 1
+                    if cnt == self.size:
+                        merged = self.pending.pop(key)
+                        self.pending_count[key] = 0
+                        self.completed_rounds[key] = \
+                            self.completed_rounds.get(key, 0) + 1
+                        if self._updater_for(key) is None:
+                            self.values[key] = merged
+                        else:
+                            self.values[key] = self._apply(key, merged)
+                    else:
+                        self.pending_count[key] = cnt
+                self._cv.notify_all()
+            return ("ok",)
+        if op == "spull":
+            # pull ONLY the requested rows (kvstore_dist.h:344
+            # PullRowSparseImpl): the response is O(len(rows));
+            # rowlen is only needed by the flat-storage native shard
+            _, key, rows, sender, _rowlen = msg
+            rows = onp.asarray(rows, onp.int64)
+            deadline = time.monotonic() + 600.0
+            with self._cv:
+                def ready():
+                    if key not in self.values:
+                        return False
+                    need = self.pushed_rounds.get((key, sender), 0)
+                    return self.completed_rounds.get(key, 0) >= need
+                while not ready():
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        raise MXNetError(f"spull timeout on key {key}")
+                    self._cv.wait(timeout=min(left, 1.0))
+                out = self.values[key][rows]
+                self._prof("spull", bytes_in=rows.nbytes,
+                           bytes_out=out.nbytes)
+                return ("val", out)
         if op == "pull":
             _, key, sender = msg
             deadline = time.monotonic() + 600.0
@@ -233,11 +314,41 @@ class _ServerShard(threading.Thread):
                     if left <= 0:
                         raise MXNetError(f"pull timeout on key {key}")
                     self._cv.wait(timeout=min(left, 1.0))
+                self._prof("pull",
+                           bytes_out=self.values[key].nbytes)
                 return ("val", self.values[key])
         if op == "hb":
             _, sender = msg
             with self._cv:
                 self.last_hb[sender] = time.monotonic()
+            return ("ok",)
+        if op == "cmd":
+            # worker->server command channel (reference
+            # KVStore::SendCommandToServers, kvstore_dist_server.h
+            # CommandHandle).  head==0 carries the profiler protocol;
+            # other heads are logged for application use.
+            _, head, body = msg
+            with self._cv:
+                self.commands.append((int(head), str(body)))
+                if int(head) == 0:
+                    parts = str(body).split(":", 2)
+                    if parts[0] == "profile":
+                        if parts[1] == "start":
+                            self.profiling = True
+                            for k in self.stats:
+                                self.stats[k] = 0
+                        elif parts[1] == "stop":
+                            self.profiling = False
+                        elif parts[1] == "dump" and len(parts) == 3:
+                            import json
+
+                            # per-shard file: every shard receives the
+                            # broadcast, so the path gets .r<rank>
+                            with open(f"{parts[2]}.r{self.rank}",
+                                      "w") as f:
+                                json.dump({"rank": self.rank,
+                                           "profiling": self.profiling,
+                                           **self.stats}, f)
             return ("ok",)
         if op == "dead":
             _, timeout_s = msg
@@ -299,12 +410,27 @@ def _get_native_lib():
 
 # --------------------------------------------- native binary encoding
 def _n_encode(msg):
-    op_map = {"init": 0, "push": 1, "pull": 2, "hb": 3, "dead": 4}
+    op_map = {"init": 0, "push": 1, "pull": 2, "hb": 3, "dead": 4,
+              "spush": 5, "spull": 6, "cmd": 7}
     op = msg[0]
-    key = msg[1] if op in ("init", "push", "pull") else ""
+    key = msg[1] if op in ("init", "push", "pull", "spush",
+                           "spull") else ""
     kb = key.encode()
     head = struct.pack("<BI", op_map[op], len(kb)) + kb
-    if op == "init":
+    if op == "spush":
+        _, _, rows, vals, mode, meta = msg
+        rows = onp.ascontiguousarray(rows, onp.int64)
+        vals = onp.ascontiguousarray(vals, onp.float32)
+        rowlen = vals.size // max(rows.size, 1)
+        body = struct.pack(
+            "<iBQQ", meta["sender"], 0 if mode == "sync" else 1,
+            rows.size, rowlen) + rows.tobytes() + vals.tobytes()
+    elif op == "spull":
+        _, _, rows, sender, rowlen = msg
+        rows = onp.ascontiguousarray(rows, onp.int64)
+        body = struct.pack("<iQQ", sender, rows.size,
+                           rowlen) + rows.tobytes()
+    elif op == "init":
         _, _, value, sender = msg
         v = onp.ascontiguousarray(value, onp.float32)
         body = struct.pack("<iQ", sender, v.size) + v.tobytes()
@@ -326,6 +452,10 @@ def _n_encode(msg):
         body = struct.pack("<i", msg[2])
     elif op == "hb":
         body = struct.pack("<i", msg[1])
+    elif op == "cmd":
+        _, cmd_head, cbody = msg
+        cb = str(cbody).encode()
+        body = struct.pack("<iI", int(cmd_head), len(cb)) + cb
     else:  # dead
         body = struct.pack("<d", float(msg[1]))
     frame = head + body
@@ -481,6 +611,29 @@ class PSBackend:
     def pull(self, key):
         return self._request(self.owner(key), ("pull", key, self.rank))
 
+    def spush(self, key, rows, vals, mode):
+        """Row-sparse push: O(nnz) bytes on the wire."""
+        rows = onp.ascontiguousarray(rows, onp.int64)
+        vals = onp.ascontiguousarray(vals, onp.float32)
+        self._request(self.owner(key),
+                      ("spush", key, rows, vals, mode,
+                       {"sender": self.rank}))
+
+    def spull(self, key, rows):
+        """Pull only ``rows`` of the key: O(len(rows)) response."""
+        rows = onp.ascontiguousarray(rows, onp.int64)
+        shape = self._shapes.get(key)
+        rowlen = 1
+        if shape is not None and len(shape) >= 1:
+            n = 1
+            for d in shape[1:]:
+                n *= d
+            rowlen = n
+        out = self._request(self.owner(key),
+                            ("spull", key, rows, self.rank, rowlen))
+        return onp.asarray(out, onp.float32).reshape(
+            (rows.size,) + (tuple(shape[1:]) if shape else ()))
+
     def set_updater(self, namespace, updater):
         # in-process: this rank's shard applies with this updater; all
         # ranks run the same program so every shard gets the same rule
@@ -522,6 +675,14 @@ class PSBackend:
 
             traceback.print_exc()
             return -1
+
+    def command(self, head, body):
+        """Broadcast a (head, body) command to EVERY server shard
+        (reference KVStore::SendCommandToServers / ps-lite control).
+        head==0 drives server-side profiling: 'profile:start',
+        'profile:stop', 'profile:dump:<path>'."""
+        for r in range(self.size):
+            self._request(r, ("cmd", int(head), str(body)))
 
     def num_dead_node(self, timeout_s=60.0):
         """Count workers whose heartbeat is older than ``timeout_s``

@@ -301,7 +301,11 @@ class KVStore:
         self._updater = updater
 
     def _send_command_to_servers(self, head, body):
-        pass  # no server processes in the TPU design
+        """Single-process stores have no server group; DistKVStore
+        overrides this with the PS command channel."""
+        raise MXNetError(
+            "_send_command_to_servers needs a dist KVStore (the local "
+            "store has no server processes)")
 
 
 class DistKVStore(KVStore):
@@ -333,6 +337,10 @@ class DistKVStore(KVStore):
         # the process-wide PS backend
         self._ps_ns = f"s{DistKVStore._ps_counter}"
         DistKVStore._ps_counter += 1
+        # keys initialized with row_sparse values: their push/pull rides
+        # the PS shards with O(nnz) wire frames (kvstore_dist.h
+        # PushRowSparse / PullRowSparseImpl) in EVERY dist mode
+        self._sparse_keys = set()
         # wire accounting for the last push (tools/bandwidth.py and the
         # compression tests read these)
         self.last_wire_bytes = 0
@@ -376,6 +384,16 @@ class DistKVStore(KVStore):
     def _push_mode(self):
         return "async" if self.type == "dist_async" else "sync"
 
+    def _send_command_to_servers(self, head, body):
+        """Worker->server command channel over the PS protocol
+        (reference KVStore::SendCommandToServers,
+        kvstore_dist_server.h CommandHandle): broadcast to every
+        shard.  head==0 carries the server-profiler protocol
+        ('profile:start' / 'profile:stop' / 'profile:dump:<path>' —
+        the KVStoreServerProfilerCommand analog,
+        include/mxnet/kvstore.h:49)."""
+        self._ps_backend().command(head, body)
+
     def num_dead_node(self, node_id=0, timeout_sec=60.0):
         """Workers whose liveness heartbeat is older than
         ``timeout_sec`` (reference get_num_dead_node,
@@ -386,28 +404,86 @@ class DistKVStore(KVStore):
         return self._ps_backend().num_dead_node(timeout_sec)
 
     def init(self, key, value):
+        keys, _ = _key_list(key)
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        if len(keys) != len(vals):
+            raise MXNetError("key/value length mismatch")
         if self._ps_active():
-            keys, _ = _key_list(key)
-            vals = value if isinstance(value, (list, tuple)) else [value]
-            if len(keys) != len(vals):
-                raise MXNetError("key/value length mismatch")
             ps = self._ps_backend()
             for k, v in zip(keys, vals):
                 if k in self._store:
                     raise MXNetError(f"key {k} already initialized")
                 arr = v if isinstance(v, nd.NDArray) else nd.array(v)
+                if getattr(arr, "stype", "default") == "row_sparse":
+                    self._sparse_keys.add(k)
                 self._store[k] = arr.copy()  # dtype/shape record
                 ps.init(self._ps_key(k), arr.asnumpy())
             self.barrier()  # rank-0's value is authoritative on owners
             return
-        keys, _ = _key_list(key)
-        super(DistKVStore, self).init(key, value)
-        for k in keys:
-            # rank-0's value everywhere (the server owning initial
-            # weights, kvstore_dist_server.h init semantics)
-            self._store[k]._adopt(self._broadcast0(self._store[k]._data))
+        # PS inactive: sparse keys still live on the PS shards (their
+        # O(nnz) wire needs server support); dense keys keep the
+        # allreduce path WITH its rank-0 broadcast
+        sparse_pairs = [(k, v) for k, v in zip(keys, vals)
+                        if getattr(v, "stype", "default")
+                        == "row_sparse"]
+        dense_pairs = [(k, v) for k, v in zip(keys, vals)
+                       if getattr(v, "stype", "default")
+                       != "row_sparse"]
+        if sparse_pairs:
+            ps = self._ps_backend()
+            for k, v in sparse_pairs:
+                if k in self._store:
+                    raise MXNetError(f"key {k} already initialized")
+                self._sparse_keys.add(k)
+                self._store[k] = v.copy()
+                ps.init(self._ps_key(k), v.asnumpy())
+            self.barrier()
+        if dense_pairs:
+            super(DistKVStore, self).init(
+                [k for k, _ in dense_pairs],
+                [v for _, v in dense_pairs])
+            for k, _ in dense_pairs:
+                # rank-0's value everywhere (the server owning initial
+                # weights, kvstore_dist_server.h init semantics)
+                self._store[k]._adopt(
+                    self._broadcast0(self._store[k]._data))
+
+    def _push_sparse(self, k, vlist):
+        """Row-sparse push: aggregate the per-device grads, ship only
+        (rows, vals) to the key's owner shard — O(nnz) wire bytes."""
+        agg = vlist[0]
+        if len(vlist) > 1:
+            from .ndarray import sparse as _sp
+
+            dense = vlist[0]._data
+            for v in vlist[1:]:
+                dense = dense + v._data
+            agg = _sp.RowSparseNDArray(dense)
+        rows, vals = agg._compact()
+        rows_np = onp.asarray(rows, onp.int64)
+        vals_np = onp.asarray(vals, onp.float32)
+        self.last_wire_bytes = int(rows_np.nbytes + vals_np.nbytes)
+        self.last_uncompressed_bytes = int(agg._data.nbytes)
+        self._ps_backend().spush(self._ps_key(k), rows_np, vals_np,
+                                 self._push_mode())
 
     def push(self, key, value, priority=0):
+        keys, single = _key_list(key)
+        if any(k in self._sparse_keys for k in keys):
+            if single:
+                grouped = [value if isinstance(value, list) else [value]]
+            else:
+                grouped = [v if isinstance(v, list) else [v]
+                           for v in value]
+            for k, vlist in zip(keys, grouped):
+                if k not in self._store:
+                    raise MXNetError(f"key {k} not initialized")
+                if k in self._sparse_keys:
+                    self._push_sparse(k, vlist)
+                else:
+                    # mixed list: dense keys take their normal route
+                    DistKVStore.push(self, k, vlist, priority)
+            return
         if not self._ps_active():
             return super(DistKVStore, self).push(key, value, priority)
         keys, single = _key_list(key)
@@ -438,7 +514,65 @@ class DistKVStore(KVStore):
                 self.last_uncompressed_bytes = int(agg.nbytes)
                 ps.push(self._ps_key(k), onp.asarray(a32), mode)
 
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """O(len(row_ids)) wire: only the requested rows come back from
+        the owner shard (kvstore_dist.h:344 PullRowSparseImpl); the out
+        array holds those rows densely with zeros elsewhere."""
+        keys, single = _key_list(key)
+        if not any(k in self._sparse_keys for k in keys):
+            return super(DistKVStore, self).row_sparse_pull(
+                key, out, priority, row_ids)
+        if row_ids is None:
+            raise MXNetError("row_sparse_pull needs row_ids")
+        if single:
+            outs = [out if isinstance(out, list) else [out]]
+            rows = [row_ids if isinstance(row_ids, list) else [row_ids]]
+        else:
+            outs = [o if isinstance(o, list) else [o] for o in out]
+            rows = [r if isinstance(r, list) else [r] for r in row_ids]
+        ps = self._ps_backend()
+        for k, olist, rlist in zip(keys, outs, rows):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            if k not in self._sparse_keys:
+                # mixed list: dense keys keep the base row-slice path
+                super(DistKVStore, self).row_sparse_pull(
+                    k, olist, priority, rlist)
+                continue
+            for o, rids in zip(olist, rlist):
+                idx = onp.asarray(
+                    rids.asnumpy() if isinstance(rids, nd.NDArray)
+                    else rids, onp.int64).reshape(-1)
+                vals = ps.spull(self._ps_key(k), idx)
+                self.last_wire_bytes = int(idx.nbytes + vals.nbytes)
+                self.last_uncompressed_bytes = int(
+                    self._store[k]._data.nbytes)
+                dense = jnp.zeros(self._store[k].shape,
+                                  self._store[k]._data.dtype)
+                dense = dense.at[jnp.asarray(idx)].set(
+                    jnp.asarray(vals).astype(dense.dtype))
+                o._adopt(dense.astype(o._data.dtype))
+
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, single = _key_list(key)
+        if any(k in self._sparse_keys for k in keys) \
+                and not self._ps_active():
+            # sparse keys live on the PS shards even in plain dist_sync
+            outs = [out if isinstance(out, list) else [out]] if single \
+                else [o if isinstance(o, list) else [o] for o in out]
+            ps = self._ps_backend()
+            for k, olist in zip(keys, outs):
+                if k not in self._store:
+                    raise MXNetError(f"key {k} not initialized")
+                if k in self._sparse_keys:
+                    val = jnp.asarray(ps.pull(self._ps_key(k))).reshape(
+                        self._store[k].shape)
+                    for o in olist:
+                        o._adopt(val.astype(o._data.dtype))
+                else:
+                    DistKVStore.pull(self, k, olist, priority,
+                                     ignore_sparse)
+            return
         if not self._ps_active():
             return super(DistKVStore, self).pull(key, out, priority,
                                                  ignore_sparse)

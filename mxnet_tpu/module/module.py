@@ -67,6 +67,18 @@ class Module(BaseModule):
         self._arg_params = None  # preloaded checkpoint weights (load())
         self._aux_params = None
         self._grad_req = None
+        self._monitor = None
+        # reference group2ctxs: one group->ctx dict per data-parallel
+        # context; the TPU Module runs ONE executor, so a single dict
+        # (or a 1-element list of dicts) maps groups to devices
+        if isinstance(group2ctxs, (list, tuple)):
+            if len(group2ctxs) > 1:
+                raise MXNetError(
+                    "group2ctxs: the TPU Module is one SPMD executor — "
+                    "pass one group->Context dict (data parallelism "
+                    "comes from context=[...], not per-ctx groups)")
+            group2ctxs = group2ctxs[0] if group2ctxs else None
+        self._group2ctx = group2ctxs
 
     # ------------------------------------------------------- descriptors
     @property
@@ -129,10 +141,16 @@ class Module(BaseModule):
                 req[n] = grad_req if for_training else "null"
         self._grad_req = req
         self._exec = self._symbol.simple_bind(
-            self._context, grad_req=req, **shape_kwargs)
+            self._context, grad_req=req, group2ctx=self._group2ctx,
+            **shape_kwargs)
         if self._mesh is not None:
+            if self._group2ctx:
+                raise MXNetError("group2ctxs cannot combine with a "
+                                 "multi-context data mesh")
             self._place_on_mesh()
         self.binded = True
+        if self._monitor is not None:
+            self._monitor.install(self._exec)
         if shared_module is not None and shared_module._exec is not None:
             # share the actual parameter NDArray objects (reference:
             # shared_exec memory pool, bucketing_module.py) — an update
@@ -332,4 +350,11 @@ class Module(BaseModule):
         return mod
 
     def install_monitor(self, mon):
-        pass  # monitor integration lands with mx.monitor
+        """Attach a ``mx.monitor.Monitor`` to this module's executor
+        (reference module.py install_monitor -> executor monitor
+        callback): every forward records output stats under the
+        monitor's tic/toc protocol.  Installs now if bound, else at
+        bind."""
+        self._monitor = mon
+        if self._exec is not None:
+            mon.install(self._exec)

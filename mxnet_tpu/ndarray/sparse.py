@@ -1,18 +1,34 @@
 """Sparse NDArray API surface: CSRNDArray / RowSparseNDArray.
 
 Reference parity: python/mxnet/ndarray/sparse.py over the row_sparse/csr
-storage types (include/mxnet/ndarray.h:61-65) and cast_storage
-(src/operator/tensor/cast_storage.cc).
+storage types (include/mxnet/ndarray.h:61-65), cast_storage
+(src/operator/tensor/cast_storage.cc), sparse dot
+(src/operator/tensor/dot-inl.h DotCsrDnsDns/DotCsrTDnsRsp) and the lazy
+sparse optimizer updates (src/operator/optimizer_op.cc SGD/AdaGrad
+row_sparse kernels).
 
-TPU-native reality (SURVEY.md §7 "hard parts"): XLA/TPU has no sparse
-buffer type, so sparse arrays are *dense-backed with sparse metadata* —
-the API (indices/indptr/data, retain, cast_storage) is preserved while the
-math runs dense on the MXU.  This keeps sparse-using reference workloads
-(sparse FM, row_sparse embeddings/optimizers) functional; the memory win
-is deferred to a host-side (CPU backend) representation if ever needed.
+TPU-native design (SURVEY.md §7 "hard parts"): XLA/TPU has no sparse
+buffer type, so sparse arrays stay *dense-backed with sparse metadata*
+for general API use — but the EXECUTION tier below runs real sparse
+compute on static-shape compressed forms:
+
+  * CSR x dense matmuls run on a padded per-row COO view
+    (``_csr_padded`` — [B, K] column ids + values, K = max row nnz),
+    i.e. gather + contraction, touching O(nnz) weight rows instead of
+    the dense [B, F] product;
+  * the transposed product dot(csr.T, dense) scatter-adds into the
+    touched feature rows only, returning a row_sparse gradient;
+  * lazy optimizer updates (``sgd_update``/``adagrad_update`` here)
+    gather the touched rows, apply the rule, and scatter back — rows
+    the gradient does not touch keep bit-identical weight AND state
+    (the reference's lazy_update contract).
+
+Together these make embedding/FM-style sparse training cost O(nnz)
+compute + memory traffic on the accelerator, not O(rows).
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as onp
 
@@ -37,17 +53,22 @@ class BaseSparseNDArray(NDArray):
         self._meta_cache = None
         super()._adopt(data)
 
-    def _cached_meta(self, name, compute):
+    def _cached_raw(self, name, compute):
+        """Memoize ``compute()`` against the backing buffer (cleared by
+        _adopt); single cache protocol for all metadata views."""
         store = getattr(self, "_meta_cache", None)
         if store is None:
             store = {}
             self._meta_cache = store
         if name not in store:
             store[name] = compute()
+        return store[name]
+
+    def _cached_meta(self, name, compute):
         # fresh wrapper over the (immutable) cached jax buffer: zero
         # recompute/copy cost, and caller-side __setitem__ adopts a new
         # buffer in the wrapper without touching the cache
-        cached = store[name]
+        cached = self._cached_raw(name, compute)
         return type(cached)(cached._data)
 
 
@@ -88,6 +109,26 @@ class CSRNDArray(BaseSparseNDArray):
         if stype == "csr":
             return self
         raise MXNetError(f"cast_storage csr->{stype} unsupported")
+
+    def _padded(self):
+        """Static-shape compressed view: (cols [B, K] int32, vals
+        [B, K]) with K = max row nnz, zero-padded — the TPU-native CSR
+        form (gathers/scatters with static shapes; padding lanes carry
+        value 0 so they contribute nothing to contractions)."""
+        def compute():
+            a = onp.asarray(self._data)
+            counts = onp.count_nonzero(a, axis=1)
+            k = max(int(counts.max()) if counts.size else 0, 1)
+            rows, cols = onp.nonzero(a)
+            pc = onp.zeros((a.shape[0], k), onp.int32)
+            pv = onp.zeros((a.shape[0], k), a.dtype)
+            pos = onp.concatenate([[0], onp.cumsum(counts)])
+            within = onp.arange(len(rows)) - pos[rows]
+            pc[rows, within] = cols
+            pv[rows, within] = a[rows, cols]
+            return array(pc, dtype="int32"), array(pv)
+        pc, pv = self._cached_raw("padded", compute)
+        return pc._data, pv._data
 
 
 class RowSparseNDArray(BaseSparseNDArray):
@@ -130,6 +171,22 @@ class RowSparseNDArray(BaseSparseNDArray):
             return self
         raise MXNetError(f"cast_storage row_sparse->{stype} unsupported")
 
+    def _compact(self):
+        """(rows [R] int32, vals [R, ...]) — the nonzero rows and their
+        values; the O(nnz) form the kvstore wire and the lazy optimizer
+        updates run on."""
+        def compute():
+            a = onp.asarray(self._data)
+            nz = onp.nonzero(a.reshape(a.shape[0], -1).any(axis=1))[0]
+            # all-zero grad: R=0 — every downstream op (take, scatter,
+            # wire frame) is a well-defined no-op, and the lazy-update
+            # contract (untouched rows bit-identical, even under wd)
+            # holds for EVERY row
+            return (array(nz.astype(onp.int32), dtype="int32"),
+                    array(a[nz]))
+        rows, vals = self._cached_raw("compact", compute)
+        return rows._data, vals._data
+
 
 def cast_storage(arr, stype):
     if stype == "default":
@@ -170,3 +227,78 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
 def zeros(stype, shape, ctx=None, dtype=None):
     d = _dense_zeros(shape, ctx=ctx, dtype=dtype)
     return cast_storage(d, stype)
+
+
+# ---------------------------------------------------------------------
+# sparse execution tier: O(nnz) compute on static-shape compressed forms
+# ---------------------------------------------------------------------
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """``mx.nd.sparse.dot`` (reference dot-inl.h stype dispatch):
+
+    * dot(csr, dense)   -> dense: padded-COO gather + contraction —
+      touches O(nnz) rows of ``rhs`` (DotCsrDnsDns);
+    * dot(csr.T, dense) -> row_sparse: scatter-add into the touched
+      feature rows (DotCsrTDnsRsp) — the embedding/FM gradient path.
+    """
+    if transpose_b:
+        raise MXNetError("sparse.dot: transpose_b is not supported "
+                         "(reference parity)")
+    if isinstance(lhs, CSRNDArray):
+        cols, vals = lhs._padded()          # [B, K]
+        r = rhs._data
+        if not transpose_a:
+            # out[b, ...] = sum_k vals[b,k] * rhs[cols[b,k], ...]
+            gathered = jnp.take(r, cols, axis=0)     # [B, K, ...]
+            v = vals.reshape(vals.shape + (1,) * (r.ndim - 1))
+            return NDArray(jnp.sum(gathered * v.astype(r.dtype), axis=1))
+        # out[f, ...] += sum over nnz at column f: vals[b,k]*rhs[b, ...]
+        nrows = lhs.shape[1]
+        flat_cols = cols.reshape(-1)
+        contrib = (vals.reshape(vals.shape + (1,) * (r.ndim - 1))
+                   .astype(r.dtype)
+                   * r[:, None])                     # [B, K, ...]
+        out = jnp.zeros((nrows,) + r.shape[1:], r.dtype)
+        out = out.at[flat_cols].add(
+            contrib.reshape((-1,) + r.shape[1:]))
+        return RowSparseNDArray(out)
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        a = lhs._data.T if transpose_a else lhs._data
+        return NDArray(jnp.dot(a, rhs._data))
+    raise MXNetError("sparse.dot: unsupported operand types")
+
+
+def _lazy_rows(weight, grad):
+    rows, vals = grad._compact()
+    return rows, vals, weight._data
+
+
+def sgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0):
+    """Lazy row_sparse SGD (reference optimizer_op.cc SGDUpdateRspImpl):
+    only the gradient's nonzero rows are gathered, updated, and
+    scattered back — untouched rows are bit-identical."""
+    rows, vals, w = _lazy_rows(weight, grad)
+    g = vals * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    wr = jnp.take(w, rows, axis=0)
+    new = wr - lr * (g + wd * wr)
+    weight._adopt(w.at[rows].set(new.astype(w.dtype)))
+    return weight
+
+
+def adagrad_update(weight, grad, history, *, lr, epsilon=1e-7,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    """Lazy row_sparse AdaGrad (reference AdagradUpdateRspRspRspImpl —
+    the _sparse_adagrad_update op): history rows the gradient does not
+    touch are NOT decayed or written (lazy_update contract)."""
+    rows, vals, w = _lazy_rows(weight, grad)
+    h = history._data
+    g = vals * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    hr = jnp.take(h, rows, axis=0) + jnp.square(g)
+    wr = jnp.take(w, rows, axis=0) - lr * g / (jnp.sqrt(hr) + epsilon)
+    history._adopt(h.at[rows].set(hr.astype(h.dtype)))
+    weight._adopt(w.at[rows].set(wr.astype(w.dtype)))
+    return weight

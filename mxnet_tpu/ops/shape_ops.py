@@ -175,7 +175,11 @@ def slice_like(x, y, *, axes=()):
 
 @register_op("take")
 def take(x, indices, *, axis=0, mode="clip"):
-    idx = indices.astype(jnp.int32)
+    # int32 indexing is the fast path; axes past 2^31-1 elements need
+    # int64 offsets (the reference's MXNET_LARGE_TENSOR build; here the
+    # large-tensor tier runs under JAX x64 — tests/test_large_array.py)
+    big = x.shape[axis % x.ndim] > 2 ** 31 - 1
+    idx = indices.astype(jnp.int64 if big else jnp.int32)
     if mode == "wrap":
         idx = jnp.mod(idx, x.shape[axis])
         mode = "clip"

@@ -35,7 +35,8 @@ from ..base import MXNetError
 __all__ = ["get_mesh", "functionalize", "make_train_step",
            "DataParallelTrainer", "Mesh", "NamedSharding", "P",
            "NORM_STAT_SUFFIXES", "amp_cast_params", "auto_tp_spec",
-           "ring", "pipeline", "moe"]
+           "ring", "pipeline", "moe",
+           "make_predict_fn", "tune_microbatch"]
 
 #: parameter-name suffixes that stay fp32 under mixed precision (the AMP
 #: policy the reference encodes in contrib/amp/lists: norm affine+stats)
@@ -383,3 +384,4 @@ class DataParallelTrainer:
 
 
 from . import moe, pipeline, ring  # noqa: E402  (submodule re-exports)
+from .predict import make_predict_fn, tune_microbatch  # noqa: E402

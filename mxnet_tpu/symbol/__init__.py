@@ -1,6 +1,7 @@
 """``mx.sym`` — symbolic front-end (reference: python/mxnet/symbol/)."""
 from .symbol import *  # noqa: F401,F403
-from .symbol import Symbol, Variable, var, Group, load, load_json  # noqa: F401
+from .symbol import (  # noqa: F401
+    AttrScope, Symbol, Variable, var, Group, load, load_json)
 from . import _op_namespace  # noqa: F401  (populates sym.<Op> functions)
 from ._op_namespace import *  # noqa: F401,F403
 from . import contrib  # noqa: E402,F401  (sym.contrib.foreach/while_loop/cond)

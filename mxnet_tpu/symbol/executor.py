@@ -23,25 +23,45 @@ __all__ = ["Executor"]
 _BN_OPS = ("BatchNorm", "BatchNorm_v1", "SyncBatchNorm")
 
 
-def _eval_graph(sym, value_of, key, train):
+def _eval_graph(sym, value_of, key, train, placement=None):
     """Evaluate the DAG: value_of maps variable name -> jax value.
 
     Returns (outputs list, aux_updates {aux_name: new value}).  During
     training, BatchNorm batch stats fold into the moving aux values
     (reference: the op mutates its aux inputs in place,
     src/operator/nn/batch_norm.cc — no XLA analog, so we thread the
-    update out functionally)."""
+    update out functionally).
+
+    placement: {group_name_or_None: jax device} from bind's group2ctx —
+    ops tagged ``__ctx_group__`` (AttrScope) run on their group's
+    device with device_put transfers at group boundaries, the
+    TPU-native analog of the reference's AssignContext +
+    _CrossDeviceCopy (graph_executor.cc:1038, cross_device_copy.cc).
+    """
     results = {}  # id(node) -> list of jax values
     aux_updates = {}
 
+    def dev_of(node):
+        if placement is None:
+            return None
+        grp = node.attr_dict.get("__ctx_group__") if node.attr_dict \
+            else None
+        return placement.get(grp, placement.get(None))
+
     with _rng.trace_key_scope(key), autograd._Scope(False, train):
         for node in sym._topo():
+            dev = dev_of(node)
             if node.op is None:
-                results[id(node)] = [value_of[node.name]]
+                v = value_of[node.name]
+                if dev is not None:
+                    v = jax.device_put(v, dev)
+                results[id(node)] = [v]
                 continue
             if node.op == "_group":
                 continue
             vals = [results[id(inp)][oi] for (inp, oi) in node.inputs]
+            if dev is not None:
+                vals = [jax.device_put(v, dev) for v in vals]
             opdef = get_op(node.op)
             params = dict(node.attrs)
             if opdef.key_param:
@@ -72,9 +92,18 @@ def _eval_graph(sym, value_of, key, train):
 class Executor:
     """Graph executor (reference GraphExecutor)."""
 
-    def __init__(self, symbol, ctx, args, args_grad, grad_req, aux_states):
+    def __init__(self, symbol, ctx, args, args_grad, grad_req, aux_states,
+                 group2ctx=None):
         self._symbol = symbol
         self._ctx = ctx or current_context()
+        # manual model parallelism (group2ctx): group name -> device.
+        # None maps ungrouped nodes to the default bind context.
+        if group2ctx:
+            self._placement = {None: self._ctx.jax_device()}
+            for g, c in group2ctx.items():
+                self._placement[g] = c.jax_device()
+        else:
+            self._placement = None
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
 
@@ -105,26 +134,42 @@ class Executor:
             if n not in self.aux_dict:
                 raise MXNetError(f"missing auxiliary state {n}")
 
-        # co-locate: params loaded from disk are host arrays while data
-        # may already live on the chip — a mixed-device bind would fail
-        # inside jit.  Unify onto the first argument's device (normally
-        # the data input), or onto an explicitly-given bind ctx.
-        movable = [v for v in list(self.arg_dict.values())
-                   + list(self.aux_dict.values())
-                   if hasattr(v._data, "devices")]  # skips tracers
-        devs = {next(iter(v._data.devices())) for v in movable}
-        if len(devs) > 1 or (ctx is not None and movable):
-            if ctx is not None:
-                target = ctx.jax_device()
-            else:
-                first = self.arg_dict.get(arg_names[0])
-                target = next(iter(first._data.devices())) \
-                    if first is not None and hasattr(first._data,
-                                                     "devices") \
-                    else next(iter(devs))
-            for v in movable:
-                if next(iter(v._data.devices())) != target:
-                    v._data = jax.device_put(v._data, target)
+        if self._placement is not None:
+            # grouped bind: pre-place every variable on its group's
+            # device so per-forward device_puts are no-ops for params
+            for node in symbol._topo():
+                if node.op is not None:
+                    continue
+                grp = (node.attr_dict or {}).get("__ctx_group__")
+                target = self._placement.get(grp, self._placement[None])
+                holder = self.arg_dict.get(node.name)
+                if holder is None:
+                    holder = self.aux_dict.get(node.name)
+                if holder is not None and hasattr(holder._data,
+                                                  "devices"):
+                    holder._data = jax.device_put(holder._data, target)
+        else:
+            # co-locate: params loaded from disk are host arrays while
+            # data may already live on the chip — a mixed-device bind
+            # would fail inside jit.  Unify onto the first argument's
+            # device (normally the data input), or onto an
+            # explicitly-given bind ctx.
+            movable = [v for v in list(self.arg_dict.values())
+                       + list(self.aux_dict.values())
+                       if hasattr(v._data, "devices")]  # skips tracers
+            devs = {next(iter(v._data.devices())) for v in movable}
+            if len(devs) > 1 or (ctx is not None and movable):
+                if ctx is not None:
+                    target = ctx.jax_device()
+                else:
+                    first = self.arg_dict.get(arg_names[0])
+                    target = next(iter(first._data.devices())) \
+                        if first is not None and hasattr(first._data,
+                                                         "devices") \
+                        else next(iter(devs))
+                for v in movable:
+                    if next(iter(v._data.devices())) != target:
+                        v._data = jax.device_put(v._data, target)
 
         if isinstance(grad_req, str):
             self._grad_req = {n: grad_req for n in arg_names}
@@ -158,7 +203,8 @@ class Executor:
         return nd.array(onp.asarray(v))
 
     @classmethod
-    def _simple_bind(cls, symbol, ctx, grad_req, shape_kwargs):
+    def _simple_bind(cls, symbol, ctx, grad_req, shape_kwargs,
+                     group2ctx=None):
         """Allocate args/grads from inferred shapes (reference
         simple_bind, graph_executor.cc:803)."""
         arg_shapes, _, aux_shapes = symbol.infer_shape(**shape_kwargs)
@@ -175,7 +221,8 @@ class Executor:
             if (grad_req if isinstance(grad_req, str)
                 else grad_req.get(n, "write")) != "null"
         }
-        return cls(symbol, ctx, args, grads, grad_req, aux)
+        return cls(symbol, ctx, args, grads, grad_req, aux,
+                   group2ctx=group2ctx)
 
     # ------------------------------------------------------------- run
     def _fwd_key(self, train):
@@ -197,16 +244,23 @@ class Executor:
             aux_names = self._aux_names
             entry = {"aux_order": None}
 
+            placement = self._placement
+
             def _run(arg_vals, aux_vals, key):
                 value_of = dict(zip(self._arg_names, arg_vals))
                 value_of.update(zip(aux_names, aux_vals))
                 outs, aux_updates = _eval_graph(sym, value_of, key,
-                                                is_train)
+                                                is_train,
+                                                placement=placement)
                 entry["aux_order"] = tuple(sorted(aux_updates))
                 return tuple(outs) + tuple(
                     aux_updates[n] for n in sorted(aux_updates))
 
-            entry["fn"] = jax.jit(_run)
+            # grouped (group2ctx) executors run per-op with explicit
+            # cross-device transfers — jit rejects operands committed
+            # to different devices, and XLA compiles one device per
+            # program; vjp still traces through the transfers
+            entry["fn"] = jax.jit(_run) if placement is None else _run
             self._fwd_jit[sig] = entry
 
         arg_vals = [self.arg_dict[n]._data for n in self._arg_names]
@@ -224,6 +278,11 @@ class Executor:
             self._vjp_fn = vjp_fn
             self._out_avals = [(tuple(map(int, o.shape)), o.dtype)
                                for o in outs]
+            # grouped executors: remember where each output lives so
+            # backward can seed cotangents on the matching device
+            self._out_devices = [
+                next(iter(o.devices())) if self._placement is not None
+                and hasattr(o, "devices") else None for o in outs]
             self._n_primary = n_out
         else:
             outs = entry["fn"](arg_vals, aux_vals, key)
@@ -252,6 +311,11 @@ class Executor:
         # aux-update extras carry no cotangent
         cts += [jnp.zeros(s, d)
                 for (s, d) in self._out_avals[n_primary:]]
+        if self._placement is not None:
+            # grouped graph: each cotangent must live where its output
+            # does, or the first transposed op mixes devices
+            cts = [jax.device_put(c, dev) if dev is not None else c
+                   for c, dev in zip(cts, self._out_devices)]
         (arg_grads,) = self._vjp_fn(tuple(cts))  # _run returns a tuple
         self._vjp_fn = None
         for n, g in zip(self._arg_names, arg_grads):

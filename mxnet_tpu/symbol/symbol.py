@@ -20,7 +20,44 @@ from .. import _rng, autograd
 from ..base import MXNetError
 from ..ops.registry import get_op
 
-__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "AttrScope"]
+
+
+class AttrScope:
+    """Attribute scope applied to every symbol created inside it
+    (reference python/mxnet/attribute.py AttrScope): the manual
+    model-parallel API tags ops with a context group,
+
+        with mx.AttrScope(ctx_group="dev1"):
+            h = mx.sym.FullyConnected(x, num_hidden=128)
+
+    and ``bind(group2ctx={"dev1": mx.gpu(0)})`` maps each group to a
+    device (see symbol/executor.py).  Keys are stored decorated as
+    ``__key__`` (the reference's convention for framework attrs)."""
+
+    import threading as _threading
+
+    _local = _threading.local()
+
+    def __init__(self, **attrs):
+        self._attrs = {f"__{k}__": str(v) for k, v in attrs.items()}
+        self._prev = None
+
+    @classmethod
+    def current(cls):
+        return getattr(cls._local, "attrs", {})
+
+    def __enter__(self):
+        self._prev = dict(self.current())
+        merged = dict(self._prev)
+        merged.update(self._attrs)
+        AttrScope._local.attrs = merged
+        return self
+
+    def __exit__(self, *exc):
+        AttrScope._local.attrs = self._prev
+        return False
 
 _UNNAMED_COUNT = {}
 
@@ -331,13 +368,15 @@ class Symbol:
                     shared_exec=None, shared_buffer=None, **kwargs):
         from .executor import Executor
 
-        return Executor._simple_bind(self, ctx, grad_req, kwargs)
+        return Executor._simple_bind(self, ctx, grad_req, kwargs,
+                                     group2ctx=group2ctx)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
         from .executor import Executor
 
-        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states,
+                        group2ctx=group2ctx)
 
     def eval(self, ctx=None, **kwargs):
         ex = self.bind(ctx, kwargs)
@@ -421,6 +460,9 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
     if init is not None:
         attr_dict["__init__"] = init if isinstance(init, str) else (
             init.dumps())
+    scoped = AttrScope.current()
+    if scoped:
+        attr_dict = {**scoped, **attr_dict}
     node = _Node(None, name, {}, [], attr_dict=attr_dict)
     return Symbol(node)
 
@@ -462,6 +504,9 @@ def _make_op_symbol(opname, input_syms, attrs, name, num_outputs=None):
         if slot < len(inputs) and inputs[slot][0].op is None:
             inputs[slot][0].attr_dict["__aux__"] = True
     node = _Node(opname, name, attrs, inputs, num_outputs=num_outputs)
+    scoped = AttrScope.current()
+    if scoped:
+        node.attr_dict.update(scoped)
     return Symbol(node)
 
 

@@ -17,6 +17,16 @@
 //     op 2 PULL: [i32 sender]
 //     op 3 HB:   [i32 sender]
 //     op 4 DEAD: [f64 timeout_sec]
+//     op 5 SPUSH: [i32 sender][u8 mode][u64 nrows][u64 rowlen]
+//                 [i64 rows x nrows][f32 vals x nrows*rowlen]
+//                 row-sparse push: only touched rows cross the wire
+//                 (reference kvstore_dist.h PushRowSparse)
+//     op 6 SPULL: [i32 sender][u64 nrows][u64 rowlen][i64 rows x nrows]
+//                 responds VAL with nrows*rowlen f32 (PullRowSparseImpl)
+//     op 7 CMD:  [i32 head][u32 blen][body bytes] — the
+//                SendCommandToServers channel; head==0 drives the
+//                server profiler (profile:start/stop/dump:<path>, the
+//                KVStoreServerProfilerCommand analog)
 //   response = [u64 len][u8 status][payload]
 //     status 0 OK: empty      status 1 ERR: utf-8 message
 //     status 2 VAL: [u64 n][f32 x n]
@@ -68,6 +78,10 @@ struct Shard {
   std::unordered_map<int, double> last_hb;
   std::vector<std::thread> threads;
   bool stopping = false;
+  // server-side profiling (KVStoreServerProfilerCommand analog)
+  bool profiling = false;
+  uint64_t n_push = 0, n_pull = 0, n_spush = 0, n_spull = 0;
+  uint64_t bytes_in = 0, bytes_out = 0;
 };
 
 Shard* g_shard = nullptr;
@@ -179,8 +193,8 @@ void serve_conn_inner(Shard* s, int fd) {
     // fixed per-op header sizes: reject truncated frames BEFORE any
     // header memcpy (a crashed/version-skewed peer must cost an error
     // response, not an out-of-bounds read)
-    static const uint64_t kHeader[5] = {12, 18, 4, 4, 8};
-    if (op > 4 || static_cast<uint64_t>(end - p) < kHeader[op]) {
+    static const uint64_t kHeader[8] = {12, 18, 4, 4, 8, 21, 20, 8};
+    if (op > 7 || static_cast<uint64_t>(end - p) < kHeader[op]) {
       send_err(fd, "truncated frame");
       continue;
     }
@@ -241,6 +255,10 @@ void serve_conn_inner(Shard* s, int fd) {
         lk.unlock();
         send_err(fd, "push to uninitialized key " + key);
         continue;
+      }
+      if (s->profiling) {
+        s->n_push++;
+        s->bytes_in += compressed ? (n + 3) / 4 : n * 4;
       }
       int urc = 0;
       if (mode == 1) {  // async: apply immediately
@@ -304,6 +322,10 @@ void serve_conn_inner(Shard* s, int fd) {
         continue;
       }
       const auto& v = s->values[key];
+      if (s->profiling) {
+        s->n_pull++;
+        s->bytes_out += v.size() * 4;
+      }
       std::vector<char> body;
       body.reserve(8 + v.size() * 4);
       put_u64(&body, v.size());
@@ -313,6 +335,149 @@ void serve_conn_inner(Shard* s, int fd) {
                       v.size() * 4);
       lk.unlock();
       send_resp(fd, 2, body);
+    } else if (op == 5) {  // SPUSH (row-sparse, O(nnz) wire)
+      int32_t sender;
+      uint8_t mode;
+      uint64_t nrows, rowlen;
+      std::memcpy(&sender, p, 4);
+      p += 4;
+      mode = static_cast<uint8_t>(*p++);
+      std::memcpy(&nrows, p, 8);
+      p += 8;
+      std::memcpy(&rowlen, p, 8);
+      p += 8;
+      uint64_t avail = static_cast<uint64_t>(end - p);
+      if (nrows > (1u << 28) || rowlen > (1u << 28) ||
+          nrows * 8 > avail ||
+          nrows * rowlen > (avail - nrows * 8) / 4) {
+        send_err(fd, "short spush payload");
+        continue;
+      }
+      const int64_t* rows = reinterpret_cast<const int64_t*>(p);
+      const float* vals =
+          reinterpret_cast<const float*>(p + nrows * 8);
+      std::unique_lock<std::mutex> lk(s->mu);
+      auto it = s->values.find(key);
+      if (it == s->values.end()) {
+        lk.unlock();
+        send_err(fd, "spush to uninitialized key " + key);
+        continue;
+      }
+      uint64_t total = it->second.size();
+      bool oob = false;
+      for (uint64_t r = 0; r < nrows; ++r) {
+        if (rows[r] < 0 ||
+            (static_cast<uint64_t>(rows[r]) + 1) * rowlen > total)
+          oob = true;
+      }
+      if (oob) {
+        lk.unlock();
+        send_err(fd, "spush row out of range for key " + key);
+        continue;
+      }
+      if (s->profiling) {
+        s->n_spush++;
+        s->bytes_in += nrows * 8 + nrows * rowlen * 4;
+      }
+      auto scatter_add = [&](std::vector<float>& dst) {
+        for (uint64_t r = 0; r < nrows; ++r) {
+          float* base = dst.data() + rows[r] * rowlen;
+          const float* src = vals + r * rowlen;
+          for (uint64_t j = 0; j < rowlen; ++j) base[j] += src[j];
+        }
+      };
+      if (mode == 1) {  // async: apply immediately
+        scatter_add(it->second);
+      } else {          // sync: merge all W per round
+        long prev = s->pushed_rounds[{key, sender}];
+        bool skew_ok = s->cv.wait_until(
+            lk,
+            std::chrono::steady_clock::now() +
+                std::chrono::seconds(600),
+            [&] { return s->completed_rounds[key] >= prev; });
+        if (!skew_ok) {
+          lk.unlock();
+          send_err(fd, "sync spush round skew on key " + key);
+          continue;
+        }
+        s->pushed_rounds[{key, sender}] = prev + 1;
+        auto& acc = s->pending[key];
+        if (acc.empty()) acc.assign(total, 0.0f);
+        scatter_add(acc);
+        int cnt = ++s->pending_count[key];
+        if (cnt == s->size) {
+          std::vector<float> merged = std::move(acc);
+          s->pending.erase(key);
+          s->pending_count[key] = 0;
+          s->completed_rounds[key] += 1;
+          int urc = apply_update(s, key, merged, /*is_async=*/false);
+          if (urc != 0) {
+            s->cv.notify_all();
+            lk.unlock();
+            send_err(fd, "optimizer rule raised for key " + key);
+            continue;
+          }
+        }
+      }
+      s->cv.notify_all();
+      lk.unlock();
+      send_resp(fd, 0, {});
+    } else if (op == 6) {  // SPULL (row subset, O(len(rows)) response)
+      int32_t sender;
+      uint64_t nrows, rowlen;
+      std::memcpy(&sender, p, 4);
+      p += 4;
+      std::memcpy(&nrows, p, 8);
+      p += 8;
+      std::memcpy(&rowlen, p, 8);
+      p += 8;
+      if (nrows > static_cast<uint64_t>(end - p) / 8) {
+        send_err(fd, "short spull payload");
+        continue;
+      }
+      const int64_t* rows = reinterpret_cast<const int64_t*>(p);
+      std::unique_lock<std::mutex> lk(s->mu);
+      bool ok = s->cv.wait_until(
+          lk,
+          std::chrono::steady_clock::now() + std::chrono::seconds(600),
+          [&] {
+            if (s->values.find(key) == s->values.end()) return false;
+            auto pit = s->pushed_rounds.find({key, sender});
+            long need =
+                pit == s->pushed_rounds.end() ? 0 : pit->second;
+            return s->completed_rounds[key] >= need;
+          });
+      if (!ok) {
+        lk.unlock();
+        send_err(fd, "spull timeout on key " + key);
+        continue;
+      }
+      const auto& v = s->values[key];
+      uint64_t total = v.size();
+      if (s->profiling) {
+        s->n_spull++;
+        s->bytes_in += nrows * 8;
+        s->bytes_out += nrows * rowlen * 4;
+      }
+      std::vector<char> body;
+      body.reserve(8 + nrows * rowlen * 4);
+      put_u64(&body, nrows * rowlen);
+      bool oob = false;
+      for (uint64_t r = 0; r < nrows; ++r) {
+        if (rows[r] < 0 ||
+            (static_cast<uint64_t>(rows[r]) + 1) * rowlen > total) {
+          oob = true;
+          break;
+        }
+        const char* base = reinterpret_cast<const char*>(
+            v.data() + rows[r] * rowlen);
+        body.insert(body.end(), base, base + rowlen * 4);
+      }
+      lk.unlock();
+      if (oob)
+        send_err(fd, "spull row out of range for key " + key);
+      else
+        send_resp(fd, 2, body);
     } else if (op == 3) {  // HB
       int32_t sender;
       std::memcpy(&sender, p, 4);
@@ -321,6 +486,56 @@ void serve_conn_inner(Shard* s, int fd) {
         s->last_hb[sender] = now_sec();
       }
       send_resp(fd, 0, {});
+    } else if (op == 7) {  // CMD (SendCommandToServers)
+      int32_t head;
+      uint32_t blen;
+      std::memcpy(&head, p, 4);
+      p += 4;
+      std::memcpy(&blen, p, 4);
+      p += 4;
+      if (blen > static_cast<uint64_t>(end - p)) {
+        send_err(fd, "short cmd payload");
+        continue;
+      }
+      std::string body(p, p + blen);
+      bool ok = true;
+      if (head == 0 && body.rfind("profile:", 0) == 0) {
+        std::string sub = body.substr(8);
+        std::lock_guard<std::mutex> lk(s->mu);
+        if (sub == "start") {
+          s->profiling = true;
+          s->n_push = s->n_pull = s->n_spush = s->n_spull = 0;
+          s->bytes_in = s->bytes_out = 0;
+        } else if (sub == "stop") {
+          s->profiling = false;
+        } else if (sub.rfind("dump:", 0) == 0) {
+          // per-shard file: every shard receives the broadcast
+          std::string path =
+              sub.substr(5) + ".r" + std::to_string(s->rank);
+          FILE* f = std::fopen(path.c_str(), "w");
+          if (f == nullptr) {
+            ok = false;
+          } else {
+            std::fprintf(
+                f,
+                "{\"rank\": %d, \"profiling\": %s, \"push\": %llu, "
+                "\"pull\": %llu, \"spush\": %llu, \"spull\": %llu, "
+                "\"bytes_in\": %llu, \"bytes_out\": %llu}\n",
+                s->rank, s->profiling ? "true" : "false",
+                (unsigned long long)s->n_push,
+                (unsigned long long)s->n_pull,
+                (unsigned long long)s->n_spush,
+                (unsigned long long)s->n_spull,
+                (unsigned long long)s->bytes_in,
+                (unsigned long long)s->bytes_out);
+            std::fclose(f);
+          }
+        }
+      }
+      if (ok)
+        send_resp(fd, 0, {});
+      else
+        send_err(fd, "cmd failed: " + body);
     } else if (op == 4) {  // DEAD
       double timeout;
       std::memcpy(&timeout, p, 8);

@@ -133,6 +133,67 @@ def main():
     expect[[1, 3]] = base[[1, 3]]
     onp.testing.assert_allclose(out.asnumpy(), expect)
 
+    # --- row_sparse PS tier: O(nnz) wire in BOTH directions
+    # (kvstore_dist.h PushRowSparse / PullRowSparseImpl); fresh store —
+    # kv carries an updater from the section above, and the server-side
+    # rule would also apply to the merged sparse grad
+    kvr = kvs.create("dist_sync")
+    rows_total, dim = 512, 16
+    kvr.init("emb", mx.nd.sparse.zeros("row_sparse", (rows_total, dim)))
+    kvr.barrier()
+    # each worker touches its own row r and the shared row 0
+    gd = onp.zeros((rows_total, dim), "float32")
+    gd[0] = 1.0
+    gd[r + 1] = float(r + 1)
+    kvr.push("emb", mx.nd.sparse.row_sparse_array(
+        gd, shape=(rows_total, dim)))
+    dense_bytes = rows_total * dim * 4
+    assert kvr.last_wire_bytes < dense_bytes // 8, (
+        kvr.last_wire_bytes, dense_bytes)  # 2 rows' worth vs 512 rows
+    kvr.barrier()
+    want_rows = onp.arange(0, n + 1, dtype="int64")
+    out = mx.nd.zeros((rows_total, dim))
+    kvr.row_sparse_pull("emb", out=out,
+                        row_ids=mx.nd.array(want_rows))
+    got = out.asnumpy()
+    onp.testing.assert_allclose(got[0], onp.full((dim,), float(n)))
+    for w in range(n):
+        onp.testing.assert_allclose(got[w + 1],
+                                    onp.full((dim,), float(w + 1)))
+    assert (got[n + 1:] == 0).all()
+    # pull wire carried only the requested rows
+    assert kvr.last_wire_bytes <= (len(want_rows) * (8 + dim * 4) + 64), \
+        kvr.last_wire_bytes
+
+    # --- server-side profiling channel (reference
+    # tests/nightly/test_server_profiling.py; KVStoreServerProfiler
+    # commands over SendCommandToServers)
+    import json
+    import tempfile
+
+    prof_base = os.path.join(
+        tempfile.gettempdir(), f"mxps_prof_{os.getppid()}")
+    kvr._send_command_to_servers(0, "profile:start")
+    kvr.barrier()
+    gd2 = onp.zeros((rows_total, dim), "float32")
+    gd2[r] = 1.0
+    kvr.push("emb", mx.nd.sparse.row_sparse_array(
+        gd2, shape=(rows_total, dim)))
+    kvr.barrier()
+    if r == 0:
+        kvr._send_command_to_servers(0, f"profile:dump:{prof_base}")
+    kvr.barrier()
+    total_spush = 0
+    for w in range(n):
+        with open(f"{prof_base}.r{w}") as f:
+            stats = json.load(f)
+        assert stats["rank"] == w
+        total_spush += stats["spush"]
+        if stats["spush"]:
+            assert stats["bytes_in"] > 0
+    # every worker's spush round landed on the owning shard
+    assert total_spush >= n, total_spush
+
     print(f"[worker {r}] dist_sync_kvstore OK ({n} workers)", flush=True)
 
 

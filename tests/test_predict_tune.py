@@ -1,0 +1,62 @@
+"""Micro-batch predictor (parallel/predict.py): split/reassembly
+semantics and the tuner's divisibility handling (CPU mesh)."""
+import numpy as onp
+
+import jax.numpy as jnp
+import pytest
+
+from mxnet_tpu.parallel import make_predict_fn, tune_microbatch
+
+
+def _apply(params, x):
+    # pytree output: (affine, per-sample sum) exercises leaf reassembly
+    y = x @ params["w"] + params["b"]
+    return y, jnp.sum(x, axis=tuple(range(1, x.ndim)))
+
+
+@pytest.fixture
+def setup():
+    rng = onp.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.rand(5, 3).astype("float32")),
+              "b": jnp.asarray(rng.rand(3).astype("float32"))}
+    x = jnp.asarray(rng.rand(8, 5).astype("float32"))
+    return params, x
+
+
+def test_microbatch_matches_full(setup):
+    params, x = setup
+    ref = make_predict_fn(_apply, microbatch=1)(params, x)
+    for k in (2, 4, 8):
+        got = make_predict_fn(_apply, microbatch=k)(params, x)
+        for r, g in zip(ref, got):
+            onp.testing.assert_allclose(onp.asarray(r), onp.asarray(g),
+                                        rtol=1e-6)
+
+
+def test_microbatch_indivisible_raises(setup):
+    params, x = setup
+    with pytest.raises(ValueError, match="not divisible"):
+        make_predict_fn(_apply, microbatch=3)(params, x)
+
+
+def test_tune_skips_nondivisors_and_returns_best(setup):
+    params, x = setup
+    best, results = tune_microbatch(_apply, params, x,
+                                    candidates=(1, 2, 3, 8), iters=4)
+    ks = {k for k, _ in results}
+    assert 3 not in ks                # 8 % 3 != 0 -> skipped
+    assert ks <= {1, 2, 8}
+    assert best in results
+    assert results[best] == min(results.values())
+    # k>1 candidates are probed in both loop forms, k==1 in one
+    assert (1, False) in results and (1, True) not in results
+    assert (2, False) in results and (2, True) in results
+
+
+def test_unrolled_matches_map(setup):
+    params, x = setup
+    ref = make_predict_fn(_apply, microbatch=4)(params, x)
+    got = make_predict_fn(_apply, microbatch=4, unroll=True)(params, x)
+    for r, g in zip(ref, got):
+        onp.testing.assert_allclose(onp.asarray(r), onp.asarray(g),
+                                    rtol=1e-6)

@@ -1,0 +1,165 @@
+"""Sparse execution tier (ndarray/sparse.py): O(nnz) dot, lazy
+optimizer updates, and sparse factorization-machine training
+convergence (reference tests/python/train/test_sparse_fm.py;
+dot-inl.h DotCsrDnsDns/DotCsrTDnsRsp; optimizer_op.cc sparse kernels).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _rand_csr(rng, b, f, density=0.1):
+    dense = rng.rand(b, f).astype("float32")
+    dense[rng.rand(b, f) > density] = 0.0
+    # ensure every sample has at least one active feature
+    for i in range(b):
+        if not dense[i].any():
+            dense[i, rng.randint(f)] = rng.rand()
+    return dense, nd.sparse.csr_matrix(dense)
+
+
+def test_sparse_dot_matches_dense():
+    rng = onp.random.RandomState(0)
+    dense, csr = _rand_csr(rng, 16, 40)
+    w = nd.array(rng.rand(40, 8).astype("float32"))
+    out = nd.sparse.dot(csr, w)
+    onp.testing.assert_allclose(out.asnumpy(), dense @ w.asnumpy(),
+                                rtol=1e-5)
+    # 1-D rhs via [F, 1]
+    w1 = nd.array(rng.rand(40, 1).astype("float32"))
+    out1 = nd.sparse.dot(csr, w1)
+    onp.testing.assert_allclose(out1.asnumpy(), dense @ w1.asnumpy(),
+                                rtol=1e-5)
+
+
+def test_sparse_dot_transpose_returns_row_sparse():
+    rng = onp.random.RandomState(1)
+    dense, csr = _rand_csr(rng, 12, 30)
+    dy = nd.array(rng.rand(12, 4).astype("float32"))
+    g = nd.sparse.dot(csr, dy, transpose_a=True)
+    assert isinstance(g, nd.sparse.RowSparseNDArray)
+    onp.testing.assert_allclose(g.asnumpy(), dense.T @ dy.asnumpy(),
+                                rtol=1e-5, atol=1e-6)
+    # untouched feature rows are exactly zero
+    untouched = ~dense.any(axis=0)
+    assert untouched.any()
+    assert (g.asnumpy()[untouched] == 0).all()
+
+
+def test_lazy_adagrad_leaves_untouched_rows_bit_identical():
+    rng = onp.random.RandomState(2)
+    w = nd.array(rng.rand(20, 4).astype("float32"))
+    h = nd.array(rng.rand(20, 4).astype("float32"))
+    w0, h0 = w.asnumpy().copy(), h.asnumpy().copy()
+    gd = onp.zeros((20, 4), "float32")
+    touched = [3, 7, 11]
+    gd[touched] = rng.rand(3, 4)
+    grad = nd.sparse.row_sparse_array(gd)
+    nd.sparse.adagrad_update(w, grad, h, lr=0.1)
+    wn, hn = w.asnumpy(), h.asnumpy()
+    mask = onp.ones(20, bool)
+    mask[touched] = False
+    assert (wn[mask] == w0[mask]).all()       # bit-identical
+    assert (hn[mask] == h0[mask]).all()       # lazy: no history decay
+    assert (wn[touched] != w0[touched]).any()
+    # touched rows follow the dense adagrad rule
+    hr = h0[touched] + gd[touched] ** 2
+    wr = w0[touched] - 0.1 * gd[touched] / (onp.sqrt(hr) + 1e-7)
+    onp.testing.assert_allclose(wn[touched], wr, rtol=1e-5)
+
+
+def test_lazy_sgd_update():
+    rng = onp.random.RandomState(3)
+    w = nd.array(rng.rand(10, 3).astype("float32"))
+    w0 = w.asnumpy().copy()
+    gd = onp.zeros((10, 3), "float32")
+    gd[[1, 4]] = 1.0
+    nd.sparse.sgd_update(w, nd.sparse.row_sparse_array(gd), lr=0.5)
+    wn = w.asnumpy()
+    onp.testing.assert_allclose(wn[[1, 4]], w0[[1, 4]] - 0.5)
+    mask = onp.ones(10, bool)
+    mask[[1, 4]] = False
+    assert (wn[mask] == w0[mask]).all()
+
+
+def test_sparse_fm_training_converges():
+    """Factorization machine on sparse features, trained end to end
+    with sparse dots and lazy AdaGrad (the reference's test_sparse_fm
+    scenario).  Loss must drop by >5x."""
+    rng = onp.random.RandomState(7)
+    B, F, K = 64, 120, 4
+    dense, csr = _rand_csr(rng, B, F, density=0.08)
+    true_w = rng.randn(F, 1).astype("float32")
+    y = dense @ true_w + 0.1 * (dense @ rng.randn(F, K).astype(
+        "float32")).prod(axis=1, keepdims=True)
+    y = y.astype("float32")
+
+    w1 = nd.array(onp.zeros((F, 1), "float32"))
+    h1 = nd.array(onp.zeros((F, 1), "float32"))
+    V = nd.array((rng.randn(F, K) * 0.01).astype("float32"))
+    hV = nd.array(onp.zeros((F, K), "float32"))
+    xsq = nd.sparse.csr_matrix(dense ** 2)
+
+    losses = []
+    for step in range(60):
+        s = nd.sparse.dot(csr, V)                      # [B, K]
+        lin = nd.sparse.dot(csr, w1)                   # [B, 1]
+        pair = 0.5 * (s ** 2 - nd.sparse.dot(
+            xsq, V * V)).sum(axis=1, keepdims=True)
+        pred = lin + pair
+        err = pred - nd.array(y)                       # dL/dpred (L2/2)
+        losses.append(float((err ** 2).mean().asnumpy()))
+        dldp = err * (2.0 / B)
+        gw1 = nd.sparse.dot(csr, dldp, transpose_a=True)
+        gV_a = nd.sparse.dot(csr, dldp * s, transpose_a=True)
+        gV_b = nd.sparse.dot(xsq, dldp, transpose_a=True) * V
+        gV = nd.sparse.RowSparseNDArray((gV_a - gV_b)._data)
+        nd.sparse.adagrad_update(w1, gw1, h1, lr=0.3)
+        nd.sparse.adagrad_update(V, gV, hV, lr=0.3)
+    assert losses[-1] < losses[0] / 5, losses[::10]
+
+
+def test_all_zero_grad_is_a_true_noop():
+    """An empty-batch row_sparse gradient must leave EVERY row (and
+    state) bit-identical — even with weight decay (the lazy contract
+    has no fabricated rows)."""
+    w = nd.array(onp.random.RandomState(5).rand(6, 3).astype("float32"))
+    h = nd.array(onp.ones((6, 3), "float32"))
+    w0, h0 = w.asnumpy().copy(), h.asnumpy().copy()
+    zg = nd.sparse.row_sparse_array(onp.zeros((6, 3), "float32"))
+    nd.sparse.sgd_update(w, zg, lr=0.5, wd=0.1)
+    nd.sparse.adagrad_update(w, zg, h, lr=0.5)
+    assert (w.asnumpy() == w0).all()
+    assert (h.asnumpy() == h0).all()
+
+
+def test_kvstore_sparse_wire_single_worker():
+    """Sparse keys ride the PS shard even in a 1-worker dist group:
+    push ships (rows, vals) and row_sparse_pull returns only the
+    requested rows — O(nnz) wire accounting in both directions."""
+    kv = mx.kv.create("dist_sync")
+    rows_total, dim = 256, 8
+    kv.init("semb", nd.sparse.zeros("row_sparse", (rows_total, dim)))
+    gd = onp.zeros((rows_total, dim), "float32")
+    gd[[2, 200]] = 3.0
+    kv.push("semb", nd.sparse.row_sparse_array(
+        gd, shape=(rows_total, dim)))
+    dense_bytes = rows_total * dim * 4
+    assert kv.last_wire_bytes < dense_bytes // 8
+    out = nd.zeros((rows_total, dim))
+    kv.row_sparse_pull("semb", out=out, row_ids=nd.array([2, 5, 200]))
+    got = out.asnumpy()
+    onp.testing.assert_allclose(got[2], onp.full((dim,), 3.0))
+    onp.testing.assert_allclose(got[200], onp.full((dim,), 3.0))
+    assert (got[5] == 0).all() and (got[3] == 0).all()
+    assert kv.last_wire_bytes <= 3 * (8 + dim * 4) + 64
+
+
+def test_csr_padded_caches():
+    rng = onp.random.RandomState(4)
+    _, csr = _rand_csr(rng, 8, 20)
+    c1, v1 = csr._padded()
+    c2, v2 = csr._padded()
+    assert c1 is c2 and v1 is v2  # cached against the backing buffer

@@ -275,3 +275,44 @@ def test_feedforward_legacy_api(tmp_path):
     ff2 = mx.model.FeedForward.load(prefix, 8, ctx=mx.cpu())
     assert ff2.arg_params is not None
     assert "fc1_weight" in ff2.arg_params
+
+
+def test_module_install_monitor_records_stats():
+    """install_monitor wires mx.mon.Monitor through the executor
+    (reference module install_monitor -> set_monitor_callback): a fit
+    step under tic/toc yields per-output stats."""
+    rng = onp.random.RandomState(3)
+    X = rng.rand(64, 10).astype("float32")
+    y = (X.sum(axis=1) > 5).astype("float32")
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd")
+    mon = mx.monitor.Monitor(interval=1)
+    mod.install_monitor(mon)
+    batch = next(iter(train))
+    mon.tic()
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    stats = mon.toc()
+    assert stats, "monitor recorded nothing"
+    names = {name for (_, name, _) in stats}
+    assert any("output" in n for n in names), names
+
+
+def test_module_install_monitor_before_bind():
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mon = mx.monitor.Monitor(interval=1)
+    mod.install_monitor(mon)          # pre-bind: deferred
+    rng = onp.random.RandomState(4)
+    X = rng.rand(32, 10).astype("float32")
+    y = onp.zeros(32, "float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mon.tic()
+    mod.forward(next(iter(it)), is_train=False)
+    assert mon.toc(), "deferred install did not record"
