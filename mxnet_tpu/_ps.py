@@ -109,6 +109,7 @@ class _ServerShard(threading.Thread):
         self.stats = {"push": 0, "pull": 0, "spush": 0, "spull": 0,
                       "bytes_in": 0, "bytes_out": 0}
         self.commands = []         # (head, body) log for kController
+        self._live_conns = set()
         self._cv = threading.Condition()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -123,6 +124,8 @@ class _ServerShard(threading.Thread):
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            with self._cv:
+                self._live_conns.add(conn)
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
@@ -139,6 +142,9 @@ class _ServerShard(threading.Thread):
                 _send_msg(conn, resp)
         except (ConnectionError, EOFError, OSError):
             conn.close()
+        finally:
+            with self._cv:
+                self._live_conns.discard(conn)
 
     # ----------------------------------------------------------- logic
     def _prof(self, op, bytes_in=0, bytes_out=0):
@@ -165,17 +171,26 @@ class _ServerShard(threading.Thread):
         bare = key.split("/", 1)[1] if "/" in key else key
         stored = nd.array(self.values[key])
         updater(bare, nd.array(grad), stored)
-        return onp.asarray(stored.asnumpy(), onp.float32)
+        return onp.asarray(stored.asnumpy(),
+                           self.values[key].dtype)
 
     def _handle(self, msg):
         op = msg[0]
         if op == "init":
-            _, key, value, sender = msg
+            _, key, value, sender, *rest = msg
+            refill = bool(rest[0]) if rest else False
             with self._cv:
                 # rank-0's init wins (reference: the server keeps the
-                # first controller-blessed value)
-                if sender == 0 or key not in self.values:
-                    self.values[key] = onp.asarray(value, onp.float32)
+                # first controller-blessed value) — EXCEPT refills
+                # (shard-restart recovery), which are set-if-absent so
+                # a late refill never clobbers re-accumulated pushes
+                if (sender == 0 and not refill) \
+                        or key not in self.values:
+                    # store the PUSHED dtype (reference
+                    # kvstore_dist_server.h stores recvd blobs as-is;
+                    # the old unconditional f32 cast silently degraded
+                    # f64 keys and corrupted int keys)
+                    self.values[key] = onp.asarray(value)
                 self._cv.notify_all()
             return ("ok",)
         if op == "push":
@@ -185,10 +200,15 @@ class _ServerShard(threading.Thread):
                 grad = _decompress_2bit(payload, meta["shape"],
                                         meta["threshold"])
             else:
-                grad = onp.asarray(payload, onp.float32)
+                grad = onp.asarray(payload)
             with self._cv:
                 if key not in self.values:
                     raise MXNetError(f"push to uninitialized key {key}")
+                if grad.dtype != self.values[key].dtype:
+                    # half-precision wires widen into the stored
+                    # dtype's arithmetic; the stored dtype never
+                    # changes after init
+                    grad = grad.astype(self.values[key].dtype)
                 self._prof("push", bytes_in=getattr(grad, "nbytes", 0))
                 if mode == "async":
                     if self._updater_for(key) is None:
@@ -238,10 +258,10 @@ class _ServerShard(threading.Thread):
             _, key, rows, vals, mode, meta = msg
             sender = meta.get("sender", -1)
             rows = onp.asarray(rows, onp.int64)
-            vals = onp.asarray(vals, onp.float32)
             with self._cv:
                 if key not in self.values:
                     raise MXNetError(f"spush to uninitialized key {key}")
+                vals = onp.asarray(vals, self.values[key].dtype)
                 self._prof("spush",
                            bytes_in=rows.nbytes + vals.nbytes)
                 if mode == "async":
@@ -361,10 +381,33 @@ class _ServerShard(threading.Thread):
 
     def stop(self):
         self._stop = True
+        # shutdown BEFORE close: a thread blocked in accept() holds a
+        # kernel reference that keeps the listener alive (and still
+        # accepting!) after close(); shutdown wakes it with an error so
+        # the port actually dies
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
             pass
+        # a stopped shard must go SILENT: established connections keep
+        # serving otherwise, and peers would never fail over to the
+        # restarted incarnation
+        with self._cv:
+            conns = list(self._live_conns)
+            self._live_conns.clear()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
 
 # ------------------------------------------------- native shard loader
@@ -409,6 +452,38 @@ def _get_native_lib():
 
 
 # --------------------------------------------- native binary encoding
+def _np_bf16():
+    import ml_dtypes
+
+    return onp.dtype(ml_dtypes.bfloat16)
+
+
+def _dt_code(dtype):
+    """Wire dtype codes (must match ps_server_native.cc)."""
+    name = onp.dtype(dtype).name
+    codes = {"float32": 0, "float64": 1, "bfloat16": 2, "float16": 3,
+             "int32": 4, "int64": 5, "int8": 6, "uint8": 7}
+    if name not in codes:
+        return None
+    return codes[name]
+
+
+def _dt_of_code(code):
+    if code == 2:
+        return _np_bf16()
+    return onp.dtype(["float32", "float64", None, "float16", "int32",
+                      "int64", "int8", "uint8"][code])
+
+
+def _wire_array(a):
+    """Contiguous array in a wire-supported dtype (unsupported dtypes
+    widen to f32, the old behavior)."""
+    a = onp.ascontiguousarray(a)
+    if _dt_code(a.dtype) is None:
+        a = a.astype(onp.float32)
+    return a
+
+
 def _n_encode(msg):
     op_map = {"init": 0, "push": 1, "pull": 2, "hb": 3, "dead": 4,
               "spush": 5, "spull": 6, "cmd": 7}
@@ -420,20 +495,23 @@ def _n_encode(msg):
     if op == "spush":
         _, _, rows, vals, mode, meta = msg
         rows = onp.ascontiguousarray(rows, onp.int64)
-        vals = onp.ascontiguousarray(vals, onp.float32)
+        vals = _wire_array(vals)
         rowlen = vals.size // max(rows.size, 1)
         body = struct.pack(
-            "<iBQQ", meta["sender"], 0 if mode == "sync" else 1,
-            rows.size, rowlen) + rows.tobytes() + vals.tobytes()
+            "<iBBQQ", meta["sender"], 0 if mode == "sync" else 1,
+            _dt_code(vals.dtype), rows.size,
+            rowlen) + rows.tobytes() + vals.tobytes()
     elif op == "spull":
         _, _, rows, sender, rowlen = msg
         rows = onp.ascontiguousarray(rows, onp.int64)
         body = struct.pack("<iQQ", sender, rows.size,
                            rowlen) + rows.tobytes()
     elif op == "init":
-        _, _, value, sender = msg
-        v = onp.ascontiguousarray(value, onp.float32)
-        body = struct.pack("<iQ", sender, v.size) + v.tobytes()
+        _, _, value, sender, *rest = msg
+        refill = bool(rest[0]) if rest else False
+        v = _wire_array(value)
+        body = struct.pack("<iBBQ", sender, 1 if refill else 0,
+                           _dt_code(v.dtype), v.size) + v.tobytes()
     elif op == "push":
         _, _, payload, mode, meta = msg
         if meta.get("compressed"):
@@ -441,13 +519,13 @@ def _n_encode(msg):
             for d in meta["shape"]:
                 n *= d
             body = struct.pack(
-                "<iBBfQ", meta["sender"], 0 if mode == "sync" else 1,
-                1, float(meta["threshold"]), n) + bytes(payload)
+                "<iBBBfQ", meta["sender"], 0 if mode == "sync" else 1,
+                1, 0, float(meta["threshold"]), n) + bytes(payload)
         else:
-            v = onp.ascontiguousarray(payload, onp.float32)
+            v = _wire_array(payload)
             body = struct.pack(
-                "<iBBfQ", meta["sender"], 0 if mode == "sync" else 1,
-                0, 0.0, v.size) + v.tobytes()
+                "<iBBBfQ", meta["sender"], 0 if mode == "sync" else 1,
+                0, _dt_code(v.dtype), 0.0, v.size) + v.tobytes()
     elif op == "pull":
         body = struct.pack("<i", msg[2])
     elif op == "hb":
@@ -472,9 +550,10 @@ def _n_roundtrip(sock, msg):
     if status == 1:
         raise MXNetError(f"ps server error: {data[1:].decode()}")
     if status == 2:
-        (n,) = struct.unpack_from("<Q", data, 1)
-        return onp.frombuffer(data, onp.float32, count=n,
-                              offset=9).copy()
+        dt = data[1]
+        (n,) = struct.unpack_from("<Q", data, 2)
+        return onp.frombuffer(data, _dt_of_code(dt), count=n,
+                              offset=10).copy()
     if status == 3:
         (m,) = struct.unpack_from("<I", data, 1)
         return list(struct.unpack_from(f"<{m}i", data, 5))
@@ -529,15 +608,7 @@ class PSBackend:
         self._hb.start()
 
     # ----------------------------------------------------- bootstrap
-    def _exchange_addrs(self):
-        host = socket.gethostname()
-        try:
-            my_ip = socket.gethostbyname(host)
-        except OSError:
-            my_ip = "127.0.0.1"
-        mine = f"{self._proto}:{my_ip}:{self._port}"
-        if self.size == 1:
-            return {0: mine}
+    def _kv_client(self):
         from jax._src import distributed as _jd
 
         client = _jd.global_state.client
@@ -545,16 +616,79 @@ class PSBackend:
             raise MXNetError(
                 "parameter-server backend needs jax.distributed (launch "
                 "with tools/launch.py) for address exchange")
-        client.key_value_set(f"mxps/addr/{self.rank}", mine)
+        return client
+
+    def _exchange_addrs(self):
+        """Epoch-keyed address exchange: a RESTARTED worker (launch.py
+        --max-restarts) finds its old incarnation's key still present
+        and registers under the next epoch; peers re-resolve on
+        connection failure (see _refresh_addr) — the re-registration
+        half of the ps-lite node-recovery story."""
+        host = socket.gethostname()
+        try:
+            my_ip = socket.gethostbyname(host)
+        except OSError:
+            my_ip = "127.0.0.1"
+        mine = f"{self._proto}:{my_ip}:{self._port}"
+        self._addr_epoch = {r: 0 for r in range(self.size)}
+        if self.size == 1:
+            return {0: mine}
+        client = self._kv_client()
+        epoch = 0
+        while True:
+            try:
+                client.key_value_set(
+                    f"mxps/addr/{self.rank}/e{epoch}", mine)
+                break
+            except Exception:  # stale key from a prior incarnation
+                epoch += 1
+                if epoch > 1000:
+                    raise
         addrs = {}
         for r in range(self.size):
             addrs[r] = client.blocking_key_value_get(
-                f"mxps/addr/{r}", 60_000)
+                f"mxps/addr/{r}/e0", 60_000)
+        self._addr_epoch[self.rank] = epoch
+        addrs[self.rank] = mine
         return addrs
+
+    def _refresh_addr(self, r):
+        """A peer's shard stopped answering: wait for its restarted
+        incarnation to register under the next epoch and adopt the new
+        address (blocking up to 120 s — the launcher's relaunch
+        window)."""
+        if self.size == 1:
+            return
+        client = self._kv_client()
+        e = self._addr_epoch.get(r, 0) + 1
+        addr = client.blocking_key_value_get(
+            f"mxps/addr/{r}/e{e}", 120_000)
+        self._addr_epoch[r] = e
+        self._addrs[r] = addr
 
     def _addr_of(self, r):
         proto, host, port = self._addrs[r].split(":", 2)
         return proto, host, int(port)
+
+    @staticmethod
+    def _dial(host, port, timeout):
+        """create_connection with TCP self-connect detection: dialing a
+        CLOSED localhost port can 'succeed' when the kernel picks the
+        same value as the ephemeral source port, yielding a socket
+        connected to ITSELF — the client would then read its own
+        request back as the response and silently drop the operation
+        (observed in the shard-restart drill)."""
+        s = socket.create_connection((host, port), timeout=timeout)
+        try:
+            if s.getsockname() == s.getpeername():
+                s.close()
+                raise ConnectionError(
+                    f"self-connect to {host}:{port} (no listener)")
+        except OSError:
+            s.close()
+            raise
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
 
     def _conn(self, r):
         # guarded: the heartbeat thread and the worker thread race to
@@ -563,13 +697,11 @@ class PSBackend:
         with self._conn_create:
             if r not in self._conns:
                 _, host, port = self._addr_of(r)
-                s = socket.create_connection((host, port), timeout=600)
-                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._conns[r] = s
+                self._conns[r] = self._dial(host, port, timeout=600)
                 self._conn_locks[r] = threading.Lock()
         return self._conns[r], self._conn_locks[r]
 
-    def _request(self, r, msg):
+    def _do_request(self, r, msg):
         proto = self._addr_of(r)[0]
         sock, lock = self._conn(r)
         with lock:
@@ -583,7 +715,31 @@ class PSBackend:
             return resp[1]
         if resp[0] == "err":
             raise MXNetError(f"ps server error: {resp[1]}")
+        if resp[0] != "ok":
+            # garbage frame (e.g. our own request echoed back): treat
+            # as a dead transport so the retry/re-resolve path engages
+            raise ConnectionError(f"ps: malformed response {resp[:1]}")
         return None
+
+    def _request(self, r, msg):
+        try:
+            return self._do_request(r, msg)
+        except (ConnectionError, EOFError, OSError):
+            # TRANSIENT failure first: redial the same address (a
+            # dropped TCP conn on a healthy shard must not stall in
+            # the epoch wait below).  At-least-once delivery: an
+            # applied-but-unacked push may repeat — the same window
+            # ps-lite's resend has.
+            self._drop_conn(r)
+            try:
+                return self._do_request(r, msg)
+            except (ConnectionError, EOFError, OSError):
+                pass
+            # still dead: wait for a restarted incarnation to register
+            # under the next address epoch, then retry once more
+            self._drop_conn(r)
+            self._refresh_addr(r)
+            return self._do_request(r, msg)
 
     def owner(self, key):
         # stable across processes (NOT python hash(): PYTHONHASHSEED)
@@ -592,10 +748,11 @@ class PSBackend:
         return zlib.crc32(str(key).encode()) % self.size
 
     # ----------------------------------------------------- operations
-    def init(self, key, value):
-        v = onp.asarray(value, onp.float32)
+    def init(self, key, value, refill=False):
+        v = onp.asarray(value)
         self._shapes[key] = v.shape
-        self._request(self.owner(key), ("init", key, v, self.rank))
+        self._request(self.owner(key),
+                      ("init", key, v, self.rank, refill))
 
     def push(self, key, grad, mode, compressed_payload=None, meta=None):
         if compressed_payload is not None:
@@ -603,7 +760,7 @@ class PSBackend:
             meta = dict(meta or {})
             meta["compressed"] = True
         else:
-            payload = onp.asarray(grad, onp.float32)
+            payload = onp.asarray(grad)
             meta = {"compressed": False}
         meta["sender"] = self.rank
         self._request(self.owner(key), ("push", key, payload, mode, meta))
@@ -612,9 +769,10 @@ class PSBackend:
         return self._request(self.owner(key), ("pull", key, self.rank))
 
     def spush(self, key, rows, vals, mode):
-        """Row-sparse push: O(nnz) bytes on the wire."""
+        """Row-sparse push: O(nnz) bytes on the wire, in the value's
+        native dtype."""
         rows = onp.ascontiguousarray(rows, onp.int64)
-        vals = onp.ascontiguousarray(vals, onp.float32)
+        vals = onp.ascontiguousarray(vals)
         self._request(self.owner(key),
                       ("spush", key, rows, vals, mode,
                        {"sender": self.rank}))
@@ -631,7 +789,7 @@ class PSBackend:
             rowlen = n
         out = self._request(self.owner(key),
                             ("spull", key, rows, self.rank, rowlen))
-        return onp.asarray(out, onp.float32).reshape(
+        return onp.asarray(out).reshape(
             (rows.size,) + (tuple(shape[1:]) if shape else ()))
 
     def set_updater(self, namespace, updater):
@@ -686,42 +844,69 @@ class PSBackend:
 
     def num_dead_node(self, timeout_s=60.0):
         """Count workers whose heartbeat is older than ``timeout_s``
-        (reference get_num_dead_node, include/mxnet/kvstore.h:380)."""
-        dead = self._request(0, ("dead", float(timeout_s)))
-        return len(dead)
+        (reference get_num_dead_node, include/mxnet/kvstore.h:380).
+        Queries shards in rank order and takes the first answer, so the
+        probe survives rank-0 shard death (heartbeats FAN OUT to every
+        shard)."""
+        return len(self.dead_nodes(timeout_s))
 
     def dead_nodes(self, timeout_s=60.0):
-        return self._request(0, ("dead", float(timeout_s)))
+        last_err = None
+        for r in range(self.size):
+            try:
+                # _do_request, NOT _request: the probe must fail over
+                # to the next shard immediately, not block waiting for
+                # the dead one's restarted incarnation
+                return self._do_request(r, ("dead", float(timeout_s)))
+            except Exception as e:  # dead shard: ask the next one
+                last_err = e
+                self._drop_conn(r)
+        raise MXNetError(f"liveness probe failed on every shard: "
+                         f"{last_err!r}")
+
+    def _drop_conn(self, r):
+        with self._conn_create:
+            conn = self._conns.pop(r, None)
+            self._conn_locks.pop(r, None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _heartbeat_loop(self):
-        # DEDICATED connection: the shared per-server socket is held
+        # DEDICATED connections: the shared per-server socket is held
         # for the full duration of a blocking sync pull, and a worker
         # silently not heartbeating while it WAITS would make the
         # liveness probe report healthy-but-blocked workers dead —
-        # the exact confusion the probe exists to resolve
+        # the exact confusion the probe exists to resolve.
+        # FAN-OUT: every shard gets the beat, so the probe keeps
+        # working when rank-0's shard dies.
         interval = float(os.environ.get("MXNET_PS_HEARTBEAT_SEC", "0.3"))
-        conn = None
-        proto = self._addr_of(0)[0]
+        conns = {}
         while not self._hb_stop.is_set():
-            try:
-                if conn is None:
-                    _, host, port = self._addr_of(0)
-                    conn = socket.create_connection(
-                        (host, port), timeout=30)
-                    conn.setsockopt(socket.IPPROTO_TCP,
-                                    socket.TCP_NODELAY, 1)
-                if proto == "n":
-                    _n_roundtrip(conn, ("hb", self.rank))
-                else:
-                    _send_msg(conn, ("hb", self.rank))
-                    _recv_msg(conn)
-            except Exception:
+            for r in range(self.size):
                 try:
-                    if conn is not None:
-                        conn.close()
-                except OSError:
-                    pass
-                conn = None
+                    if r not in conns:
+                        proto, host, port = self._addr_of(r)
+                        # SHORT dial timeout: one blackholed shard must
+                        # not starve the beat to the live ones (serial
+                        # fan-out; probes run with windows of seconds)
+                        c = self._dial(host, port, timeout=2)
+                        conns[r] = (proto, c)
+                    proto, c = conns[r]
+                    if proto == "n":
+                        _n_roundtrip(c, ("hb", self.rank))
+                    else:
+                        _send_msg(c, ("hb", self.rank))
+                        _recv_msg(c)
+                except Exception:
+                    pc = conns.pop(r, None)
+                    if pc is not None:
+                        try:
+                            pc[1].close()
+                        except OSError:
+                            pass
             self._hb_stop.wait(interval)
 
     def stop_heartbeat(self):

@@ -384,6 +384,25 @@ class DistKVStore(KVStore):
     def _push_mode(self):
         return "async" if self.type == "dist_async" else "sync"
 
+    def _ps_op(self, k, fn):
+        """Run a PS operation with shard-restart recovery: a restarted
+        shard (launch.py --max-restarts) comes back EMPTY, so the first
+        op against it gets 'uninitialized key' — every worker then
+        refills from its own last-known value (rank-0's refill wins on
+        the server, the init contract) and retries.  The round counters
+        on the fresh shard start at zero, so sync pulls resume
+        consistently; the round in flight at the crash is lost — the
+        same loss the reference takes without a server checkpoint."""
+        try:
+            return fn()
+        except MXNetError as e:
+            if "uninitialized key" not in str(e):
+                raise
+            self._ps_backend().init(self._ps_key(k),
+                                    self._store[k].asnumpy(),
+                                    refill=True)
+            return fn()
+
     def _send_command_to_servers(self, head, body):
         """Worker->server command channel over the PS protocol
         (reference KVStore::SendCommandToServers,
@@ -461,11 +480,11 @@ class DistKVStore(KVStore):
             agg = _sp.RowSparseNDArray(dense)
         rows, vals = agg._compact()
         rows_np = onp.asarray(rows, onp.int64)
-        vals_np = onp.asarray(vals, onp.float32)
+        vals_np = onp.asarray(vals)  # native dtype on the wire
         self.last_wire_bytes = int(rows_np.nbytes + vals_np.nbytes)
         self.last_uncompressed_bytes = int(agg._data.nbytes)
-        self._ps_backend().spush(self._ps_key(k), rows_np, vals_np,
-                                 self._push_mode())
+        self._ps_op(k, lambda: self._ps_backend().spush(
+            self._ps_key(k), rows_np, vals_np, self._push_mode()))
 
     def push(self, key, value, priority=0):
         keys, single = _key_list(key)
@@ -499,20 +518,27 @@ class DistKVStore(KVStore):
             agg = vlist[0]._data
             for v in vlist[1:]:
                 agg = agg + v._data
-            a32 = agg.astype(jnp.float32)
             if self._compression is not None:
+                # quantization math is f32; the packed wire stays 2-bit
+                a32 = agg.astype(jnp.float32)
                 payload = onp.asarray(
                     self._compression.compress_packed(k, a32))
                 self.last_wire_bytes = int(payload.nbytes)
                 self.last_uncompressed_bytes = int(agg.nbytes)
-                ps.push(self._ps_key(k), None, mode,
-                        compressed_payload=payload,
-                        meta={"shape": tuple(a32.shape),
-                              "threshold": self._compression.threshold})
+                self._ps_op(k, lambda: ps.push(
+                    self._ps_key(k), None, mode,
+                    compressed_payload=payload,
+                    meta={"shape": tuple(a32.shape),
+                          "threshold": self._compression.threshold}))
             else:
-                self.last_wire_bytes = int(a32.nbytes)
+                # NATIVE dtype on the wire (the servers store and merge
+                # per-dtype; the old unconditional f32 cast degraded
+                # f64 and doubled half-precision wire bytes)
+                wire = onp.asarray(agg)
+                self.last_wire_bytes = int(wire.nbytes)
                 self.last_uncompressed_bytes = int(agg.nbytes)
-                ps.push(self._ps_key(k), onp.asarray(a32), mode)
+                self._ps_op(k, lambda: ps.push(self._ps_key(k), wire,
+                                               mode))
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """O(len(row_ids)) wire: only the requested rows come back from
@@ -543,7 +569,8 @@ class DistKVStore(KVStore):
                 idx = onp.asarray(
                     rids.asnumpy() if isinstance(rids, nd.NDArray)
                     else rids, onp.int64).reshape(-1)
-                vals = ps.spull(self._ps_key(k), idx)
+                vals = self._ps_op(
+                    k, lambda: ps.spull(self._ps_key(k), idx))
                 self.last_wire_bytes = int(idx.nbytes + vals.nbytes)
                 self.last_uncompressed_bytes = int(
                     self._store[k]._data.nbytes)
@@ -565,7 +592,8 @@ class DistKVStore(KVStore):
                 if k not in self._store:
                     raise MXNetError(f"key {k} not initialized")
                 if k in self._sparse_keys:
-                    val = jnp.asarray(ps.pull(self._ps_key(k))).reshape(
+                    val = jnp.asarray(self._ps_op(
+                        k, lambda: ps.pull(self._ps_key(k)))).reshape(
                         self._store[k].shape)
                     for o in olist:
                         o._adopt(val.astype(o._data.dtype))
@@ -585,8 +613,9 @@ class DistKVStore(KVStore):
         for k, olist in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized")
-            val = jnp.asarray(ps.pull(self._ps_key(k)))
-            # the native shard returns flat f32; restore the key's shape
+            val = jnp.asarray(
+                self._ps_op(k, lambda: ps.pull(self._ps_key(k))))
+            # the native shard returns flat values; restore the shape
             val = val.reshape(self._store[k].shape)
             self._store[k]._adopt(
                 val.astype(self._store[k]._data.dtype))

@@ -8,28 +8,36 @@
 // through a C callback (the reference ships optimizers to its servers
 // the same way, just compiled in).
 //
+// Values carry their NATIVE dtype end to end (reference
+// kvstore_dist_server.h stores received blobs as-is): the wire frames
+// tag every payload with a dtype code, the server stores raw bytes in
+// that dtype, and merge arithmetic widens through double per element.
+// dtype codes: 0=f32 1=f64 2=bf16 3=f16 4=s32 5=s64 6=s8 7=u8.
+//
 // Wire format (all little-endian):
 //   request  = [u64 len][u8 op][u32 klen][key bytes][op payload]
-//     op 0 INIT: [i32 sender][u64 n][f32 x n]
+//     op 0 INIT: [i32 sender][u8 refill][u8 dt][u64 n][elem x n]
+//                refill=1 (shard-restart recovery) is set-if-absent:
+//                it never clobbers re-accumulated pushes
 //     op 1 PUSH: [i32 sender][u8 mode 0=sync 1=async][u8 compressed]
-//                [f32 threshold][u64 n][payload: f32 x n, or
+//                [u8 dt][f32 threshold][u64 n][payload: elem x n, or
 //                 u8 x ceil(n/4) packed 2-bit codes]
 //     op 2 PULL: [i32 sender]
 //     op 3 HB:   [i32 sender]
 //     op 4 DEAD: [f64 timeout_sec]
-//     op 5 SPUSH: [i32 sender][u8 mode][u64 nrows][u64 rowlen]
-//                 [i64 rows x nrows][f32 vals x nrows*rowlen]
+//     op 5 SPUSH: [i32 sender][u8 mode][u8 dt][u64 nrows][u64 rowlen]
+//                 [i64 rows x nrows][elem x nrows*rowlen]
 //                 row-sparse push: only touched rows cross the wire
 //                 (reference kvstore_dist.h PushRowSparse)
 //     op 6 SPULL: [i32 sender][u64 nrows][u64 rowlen][i64 rows x nrows]
-//                 responds VAL with nrows*rowlen f32 (PullRowSparseImpl)
+//                 responds VAL with the rows' elems (PullRowSparseImpl)
 //     op 7 CMD:  [i32 head][u32 blen][body bytes] — the
 //                SendCommandToServers channel; head==0 drives the
 //                server profiler (profile:start/stop/dump:<path>, the
 //                KVStoreServerProfilerCommand analog)
 //   response = [u64 len][u8 status][payload]
 //     status 0 OK: empty      status 1 ERR: utf-8 message
-//     status 2 VAL: [u64 n][f32 x n]
+//     status 2 VAL: [u8 dt][u64 n][elem x n]
 //     status 3 DEAD: [u32 m][i32 x m ranks]
 
 #include <arpa/inet.h>
@@ -41,6 +49,7 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <map>
 #include <mutex>
@@ -57,9 +66,108 @@ namespace {
 // and < 0 on a Python-side error — the server must surface that to
 // the client, NOT fall back silently.  Runs under the server
 // connection thread; the Python side re-acquires the GIL (ctypes does
-// this automatically).
+// this automatically).  f32-only: non-f32 keys use default merge.
 typedef int (*updater_fn)(const char* key, const float* grad,
                           float* value, uint64_t n);
+
+// ----------------------------------------------------- dtype helpers
+size_t esize(uint8_t dt) {
+  switch (dt) {
+    case 1: case 5: return 8;   // f64, s64
+    case 2: case 3: return 2;   // bf16, f16
+    case 6: case 7: return 1;   // s8, u8
+    default: return 4;          // f32, s32
+  }
+}
+
+float half_to_float(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t mant = h & 0x3ffu;
+  uint32_t bits;
+  if (exp == 0) {
+    if (mant == 0) {
+      bits = sign;
+    } else {  // subnormal
+      exp = 127 - 15 + 1;
+      while (!(mant & 0x400u)) {
+        mant <<= 1;
+        exp--;
+      }
+      mant &= 0x3ffu;
+      bits = sign | (exp << 23) | (mant << 13);
+    }
+  } else if (exp == 0x1f) {
+    bits = sign | 0x7f800000u | (mant << 13);
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+uint16_t float_to_half(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint32_t sign = (bits >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((bits >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = bits & 0x7fffffu;
+  if (exp <= 0) return static_cast<uint16_t>(sign);  // flush to zero
+  if (exp >= 0x1f)
+    return static_cast<uint16_t>(sign | 0x7c00u |
+                                 ((bits & 0x7f800000u) == 0x7f800000u
+                                      ? (mant ? 0x200u : 0u)
+                                      : 0u));
+  return static_cast<uint16_t>(sign | (exp << 10) | (mant >> 13));
+}
+
+double get_el(const char* p, uint8_t dt, uint64_t i) {
+  switch (dt) {
+    case 0: { float v; std::memcpy(&v, p + 4 * i, 4); return v; }
+    case 1: { double v; std::memcpy(&v, p + 8 * i, 8); return v; }
+    case 2: { uint16_t h; std::memcpy(&h, p + 2 * i, 2);
+              uint32_t b = static_cast<uint32_t>(h) << 16;
+              float v; std::memcpy(&v, &b, 4); return v; }
+    case 3: { uint16_t h; std::memcpy(&h, p + 2 * i, 2);
+              return half_to_float(h); }
+    case 4: { int32_t v; std::memcpy(&v, p + 4 * i, 4); return v; }
+    case 5: { int64_t v; std::memcpy(&v, p + 8 * i, 8);
+              return static_cast<double>(v); }
+    case 6: { int8_t v; std::memcpy(&v, p + i, 1); return v; }
+    default: { uint8_t v; std::memcpy(&v, p + i, 1); return v; }
+  }
+}
+
+void set_el(char* p, uint8_t dt, uint64_t i, double v) {
+  switch (dt) {
+    case 0: { float f = static_cast<float>(v);
+              std::memcpy(p + 4 * i, &f, 4); break; }
+    case 1: std::memcpy(p + 8 * i, &v, 8); break;
+    case 2: { float f = static_cast<float>(v);
+              uint32_t b; std::memcpy(&b, &f, 4);
+              // round-to-nearest-even on the dropped 16 bits
+              uint32_t rounded = b + 0x7fffu + ((b >> 16) & 1u);
+              uint16_t h = static_cast<uint16_t>(rounded >> 16);
+              std::memcpy(p + 2 * i, &h, 2); break; }
+    case 3: { uint16_t h = float_to_half(static_cast<float>(v));
+              std::memcpy(p + 2 * i, &h, 2); break; }
+    case 4: { int32_t x = static_cast<int32_t>(v);
+              std::memcpy(p + 4 * i, &x, 4); break; }
+    case 5: { int64_t x = static_cast<int64_t>(v);
+              std::memcpy(p + 8 * i, &x, 8); break; }
+    case 6: { int8_t x = static_cast<int8_t>(v);
+              std::memcpy(p + i, &x, 1); break; }
+    default: { uint8_t x = static_cast<uint8_t>(v);
+               std::memcpy(p + i, &x, 1); break; }
+  }
+}
+
+struct TVal {
+  uint8_t dt = 0;
+  uint64_t n = 0;
+  std::vector<char> raw;
+};
 
 struct Shard {
   int rank = 0;
@@ -70,8 +178,9 @@ struct Shard {
 
   std::mutex mu;
   std::condition_variable cv;
-  std::unordered_map<std::string, std::vector<float>> values;
-  std::unordered_map<std::string, std::vector<float>> pending;
+  std::unordered_map<std::string, TVal> values;
+  // merge accumulators widen through double for every dtype
+  std::unordered_map<std::string, std::vector<double>> pending;
   std::unordered_map<std::string, int> pending_count;
   std::unordered_map<std::string, long> completed_rounds;
   std::map<std::pair<std::string, int>, long> pushed_rounds;
@@ -134,11 +243,20 @@ bool send_err(int fd, const std::string& msg) {
   return send_resp(fd, 1, body);
 }
 
+bool send_val(int fd, uint8_t dt, const char* data, uint64_t n) {
+  std::vector<char> body;
+  body.reserve(9 + n * esize(dt));
+  body.push_back(static_cast<char>(dt));
+  put_u64(&body, n);
+  body.insert(body.end(), data, data + n * esize(dt));
+  return send_resp(fd, 2, body);
+}
+
 // decode the 2-bit packed payload (see GradientCompression): code 1 ->
 // +t, 2 -> -t, 0/3 -> 0
 void decompress_2bit(const uint8_t* p, uint64_t n, float t,
-                     std::vector<float>* out) {
-  out->assign(n, 0.0f);
+                     std::vector<double>* out) {
+  out->assign(n, 0.0);
   for (uint64_t i = 0; i < n; ++i) {
     uint8_t code = (p[i >> 2] >> ((i & 3) * 2)) & 3;
     if (code == 1)
@@ -151,19 +269,39 @@ void decompress_2bit(const uint8_t* p, uint64_t n, float t,
 // returns 0 on success, -1 if the python updater errored (the caller
 // must send an error response and leave the value untouched)
 int apply_update(Shard* s, const std::string& key,
-                 const std::vector<float>& grad, bool is_async) {
+                 const std::vector<double>& grad, bool is_async) {
   // caller holds s->mu
-  auto& val = s->values[key];
+  TVal& val = s->values[key];
   if (s->updater != nullptr) {
-    int rc = s->updater(key.c_str(), grad.data(), val.data(),
-                        static_cast<uint64_t>(val.size()));
-    if (rc == 0) return 0;  // python rule applied in place
+    // the optimizer callback speaks f32; non-f32 values round-trip
+    // through an f32 view so the rule applies to EVERY dtype exactly
+    // like the python shard does (silently skipping it would make the
+    // two interchangeable transports diverge)
+    std::vector<float> g32(grad.begin(), grad.end());
+    int rc;
+    if (val.dt == 0) {
+      rc = s->updater(key.c_str(), g32.data(),
+                      reinterpret_cast<float*>(val.raw.data()), val.n);
+    } else {
+      std::vector<float> v32(val.n);
+      for (uint64_t i = 0; i < val.n; ++i)
+        v32[i] = static_cast<float>(
+            get_el(val.raw.data(), val.dt, i));
+      rc = s->updater(key.c_str(), g32.data(), v32.data(), val.n);
+      if (rc == 0)
+        for (uint64_t i = 0; i < val.n; ++i)
+          set_el(val.raw.data(), val.dt, i, v32[i]);
+    }
+    if (rc == 0) return 0;  // python rule applied
     if (rc < 0) return -1;  // python rule RAISED: surface, don't merge
   }
   if (is_async) {
-    for (size_t i = 0; i < val.size(); ++i) val[i] += grad[i];
+    for (uint64_t i = 0; i < val.n; ++i)
+      set_el(val.raw.data(), val.dt, i,
+             get_el(val.raw.data(), val.dt, i) + grad[i]);
   } else {
-    val = grad;  // sync, no updater: value becomes the merged sum
+    for (uint64_t i = 0; i < val.n; ++i)
+      set_el(val.raw.data(), val.dt, i, grad[i]);
   }
   return 0;
 }
@@ -193,7 +331,7 @@ void serve_conn_inner(Shard* s, int fd) {
     // fixed per-op header sizes: reject truncated frames BEFORE any
     // header memcpy (a crashed/version-skewed peer must cost an error
     // response, not an out-of-bounds read)
-    static const uint64_t kHeader[8] = {12, 18, 4, 4, 8, 21, 20, 8};
+    static const uint64_t kHeader[8] = {14, 19, 4, 4, 8, 22, 20, 8};
     if (op > 7 || static_cast<uint64_t>(end - p) < kHeader[op]) {
       send_err(fd, "truncated frame");
       continue;
@@ -201,38 +339,44 @@ void serve_conn_inner(Shard* s, int fd) {
 
     if (op == 0) {  // INIT
       int32_t sender;
+      uint8_t refill, dt;
       uint64_t n;
       std::memcpy(&sender, p, 4);
       p += 4;
+      refill = static_cast<uint8_t>(*p++);
+      dt = static_cast<uint8_t>(*p++);
       std::memcpy(&n, p, 8);
       p += 8;
-      if (n > static_cast<uint64_t>(end - p) / 4) {
+      if (dt > 7 || n > static_cast<uint64_t>(end - p) / esize(dt)) {
         send_err(fd, "short init payload");
         continue;
       }
       std::unique_lock<std::mutex> lk(s->mu);
-      if (sender == 0 || s->values.find(key) == s->values.end()) {
-        auto& v = s->values[key];
-        v.resize(n);
-        std::memcpy(v.data(), p, n * 4);
+      if ((sender == 0 && !refill) ||
+          s->values.find(key) == s->values.end()) {
+        TVal& v = s->values[key];
+        v.dt = dt;
+        v.n = n;
+        v.raw.assign(p, p + n * esize(dt));
       }
       s->cv.notify_all();
       lk.unlock();
       send_resp(fd, 0, {});
     } else if (op == 1) {  // PUSH
       int32_t sender;
-      uint8_t mode, compressed;
+      uint8_t mode, compressed, dt;
       float threshold;
       uint64_t n;
       std::memcpy(&sender, p, 4);
       p += 4;
       mode = static_cast<uint8_t>(*p++);
       compressed = static_cast<uint8_t>(*p++);
+      dt = static_cast<uint8_t>(*p++);
       std::memcpy(&threshold, p, 4);
       p += 4;
       std::memcpy(&n, p, 8);
       p += 8;
-      std::vector<float> grad;
+      std::vector<double> grad;
       if (compressed) {
         if (n > (1ull << 33) ||
             (n + 3) / 4 > static_cast<uint64_t>(end - p)) {
@@ -242,23 +386,24 @@ void serve_conn_inner(Shard* s, int fd) {
         decompress_2bit(reinterpret_cast<const uint8_t*>(p), n,
                         threshold, &grad);
       } else {
-        if (n > static_cast<uint64_t>(end - p) / 4) {
+        if (dt > 7 ||
+            n > static_cast<uint64_t>(end - p) / esize(dt)) {
           send_err(fd, "short push payload");
           continue;
         }
         grad.resize(n);
-        std::memcpy(grad.data(), p, n * 4);
+        for (uint64_t i = 0; i < n; ++i) grad[i] = get_el(p, dt, i);
       }
       std::unique_lock<std::mutex> lk(s->mu);
       auto it = s->values.find(key);
-      if (it == s->values.end() || it->second.size() != n) {
+      if (it == s->values.end() || it->second.n != n) {
         lk.unlock();
         send_err(fd, "push to uninitialized key " + key);
         continue;
       }
       if (s->profiling) {
         s->n_push++;
-        s->bytes_in += compressed ? (n + 3) / 4 : n * 4;
+        s->bytes_in += compressed ? (n + 3) / 4 : n * esize(dt);
       }
       int urc = 0;
       if (mode == 1) {  // async: apply immediately
@@ -283,13 +428,11 @@ void serve_conn_inner(Shard* s, int fd) {
         }
         s->pushed_rounds[{key, sender}] = prev + 1;
         auto& acc = s->pending[key];
-        if (acc.empty())
-          acc = grad;
-        else
-          for (uint64_t i = 0; i < n; ++i) acc[i] += grad[i];
+        if (acc.empty()) acc.assign(n, 0.0);
+        for (uint64_t i = 0; i < n; ++i) acc[i] += grad[i];
         int cnt = ++s->pending_count[key];
         if (cnt == s->size) {
-          std::vector<float> merged = std::move(acc);
+          std::vector<double> merged = std::move(acc);
           s->pending.erase(key);
           s->pending_count[key] = 0;
           s->completed_rounds[key] += 1;
@@ -302,60 +445,27 @@ void serve_conn_inner(Shard* s, int fd) {
         send_err(fd, "optimizer rule raised for key " + key);
       else
         send_resp(fd, 0, {});
-    } else if (op == 2) {  // PULL
-      int32_t sender;
-      std::memcpy(&sender, p, 4);
-      std::unique_lock<std::mutex> lk(s->mu);
-      bool ok = s->cv.wait_until(
-          lk,
-          std::chrono::steady_clock::now() + std::chrono::seconds(600),
-          [&] {
-            if (s->values.find(key) == s->values.end()) return false;
-            auto pit = s->pushed_rounds.find({key, sender});
-            long need =
-                pit == s->pushed_rounds.end() ? 0 : pit->second;
-            return s->completed_rounds[key] >= need;
-          });
-      if (!ok) {
-        lk.unlock();
-        send_err(fd, "pull timeout on key " + key);
-        continue;
-      }
-      const auto& v = s->values[key];
-      if (s->profiling) {
-        s->n_pull++;
-        s->bytes_out += v.size() * 4;
-      }
-      std::vector<char> body;
-      body.reserve(8 + v.size() * 4);
-      put_u64(&body, v.size());
-      body.insert(body.end(),
-                  reinterpret_cast<const char*>(v.data()),
-                  reinterpret_cast<const char*>(v.data()) +
-                      v.size() * 4);
-      lk.unlock();
-      send_resp(fd, 2, body);
     } else if (op == 5) {  // SPUSH (row-sparse, O(nnz) wire)
       int32_t sender;
-      uint8_t mode;
+      uint8_t mode, dt;
       uint64_t nrows, rowlen;
       std::memcpy(&sender, p, 4);
       p += 4;
       mode = static_cast<uint8_t>(*p++);
+      dt = static_cast<uint8_t>(*p++);
       std::memcpy(&nrows, p, 8);
       p += 8;
       std::memcpy(&rowlen, p, 8);
       p += 8;
       uint64_t avail = static_cast<uint64_t>(end - p);
-      if (nrows > (1u << 28) || rowlen > (1u << 28) ||
+      if (dt > 7 || nrows > (1u << 28) || rowlen > (1u << 28) ||
           nrows * 8 > avail ||
-          nrows * rowlen > (avail - nrows * 8) / 4) {
+          nrows * rowlen > (avail - nrows * 8) / esize(dt)) {
         send_err(fd, "short spush payload");
         continue;
       }
       const int64_t* rows = reinterpret_cast<const int64_t*>(p);
-      const float* vals =
-          reinterpret_cast<const float*>(p + nrows * 8);
+      const char* vals = p + nrows * 8;
       std::unique_lock<std::mutex> lk(s->mu);
       auto it = s->values.find(key);
       if (it == s->values.end()) {
@@ -363,7 +473,8 @@ void serve_conn_inner(Shard* s, int fd) {
         send_err(fd, "spush to uninitialized key " + key);
         continue;
       }
-      uint64_t total = it->second.size();
+      TVal& tv = it->second;
+      uint64_t total = tv.n;
       bool oob = false;
       for (uint64_t r = 0; r < nrows; ++r) {
         if (rows[r] < 0 ||
@@ -377,17 +488,20 @@ void serve_conn_inner(Shard* s, int fd) {
       }
       if (s->profiling) {
         s->n_spush++;
-        s->bytes_in += nrows * 8 + nrows * rowlen * 4;
+        s->bytes_in += nrows * 8 + nrows * rowlen * esize(dt);
       }
-      auto scatter_add = [&](std::vector<float>& dst) {
+      auto scatter_add_value = [&]() {
         for (uint64_t r = 0; r < nrows; ++r) {
-          float* base = dst.data() + rows[r] * rowlen;
-          const float* src = vals + r * rowlen;
-          for (uint64_t j = 0; j < rowlen; ++j) base[j] += src[j];
+          uint64_t base = rows[r] * rowlen;
+          for (uint64_t j = 0; j < rowlen; ++j) {
+            double g = get_el(vals, dt, r * rowlen + j);
+            set_el(tv.raw.data(), tv.dt, base + j,
+                   get_el(tv.raw.data(), tv.dt, base + j) + g);
+          }
         }
       };
       if (mode == 1) {  // async: apply immediately
-        scatter_add(it->second);
+        scatter_add_value();
       } else {          // sync: merge all W per round
         long prev = s->pushed_rounds[{key, sender}];
         bool skew_ok = s->cv.wait_until(
@@ -402,11 +516,15 @@ void serve_conn_inner(Shard* s, int fd) {
         }
         s->pushed_rounds[{key, sender}] = prev + 1;
         auto& acc = s->pending[key];
-        if (acc.empty()) acc.assign(total, 0.0f);
-        scatter_add(acc);
+        if (acc.empty()) acc.assign(total, 0.0);
+        for (uint64_t r = 0; r < nrows; ++r) {
+          uint64_t base = rows[r] * rowlen;
+          for (uint64_t j = 0; j < rowlen; ++j)
+            acc[base + j] += get_el(vals, dt, r * rowlen + j);
+        }
         int cnt = ++s->pending_count[key];
         if (cnt == s->size) {
-          std::vector<float> merged = std::move(acc);
+          std::vector<double> merged = std::move(acc);
           s->pending.erase(key);
           s->pending_count[key] = 0;
           s->completed_rounds[key] += 1;
@@ -452,15 +570,17 @@ void serve_conn_inner(Shard* s, int fd) {
         send_err(fd, "spull timeout on key " + key);
         continue;
       }
-      const auto& v = s->values[key];
-      uint64_t total = v.size();
+      const TVal& v = s->values[key];
+      uint64_t total = v.n;
+      size_t es = esize(v.dt);
       if (s->profiling) {
         s->n_spull++;
         s->bytes_in += nrows * 8;
-        s->bytes_out += nrows * rowlen * 4;
+        s->bytes_out += nrows * rowlen * es;
       }
       std::vector<char> body;
-      body.reserve(8 + nrows * rowlen * 4);
+      body.reserve(9 + nrows * rowlen * es);
+      body.push_back(static_cast<char>(v.dt));
       put_u64(&body, nrows * rowlen);
       bool oob = false;
       for (uint64_t r = 0; r < nrows; ++r) {
@@ -469,23 +589,14 @@ void serve_conn_inner(Shard* s, int fd) {
           oob = true;
           break;
         }
-        const char* base = reinterpret_cast<const char*>(
-            v.data() + rows[r] * rowlen);
-        body.insert(body.end(), base, base + rowlen * 4);
+        const char* base = v.raw.data() + rows[r] * rowlen * es;
+        body.insert(body.end(), base, base + rowlen * es);
       }
       lk.unlock();
       if (oob)
         send_err(fd, "spull row out of range for key " + key);
       else
         send_resp(fd, 2, body);
-    } else if (op == 3) {  // HB
-      int32_t sender;
-      std::memcpy(&sender, p, 4);
-      {
-        std::lock_guard<std::mutex> lk(s->mu);
-        s->last_hb[sender] = now_sec();
-      }
-      send_resp(fd, 0, {});
     } else if (op == 7) {  // CMD (SendCommandToServers)
       int32_t head;
       uint32_t blen;
@@ -536,6 +647,43 @@ void serve_conn_inner(Shard* s, int fd) {
         send_resp(fd, 0, {});
       else
         send_err(fd, "cmd failed: " + body);
+    } else if (op == 2) {  // PULL
+      int32_t sender;
+      std::memcpy(&sender, p, 4);
+      std::unique_lock<std::mutex> lk(s->mu);
+      bool ok = s->cv.wait_until(
+          lk,
+          std::chrono::steady_clock::now() + std::chrono::seconds(600),
+          [&] {
+            if (s->values.find(key) == s->values.end()) return false;
+            auto pit = s->pushed_rounds.find({key, sender});
+            long need =
+                pit == s->pushed_rounds.end() ? 0 : pit->second;
+            return s->completed_rounds[key] >= need;
+          });
+      if (!ok) {
+        lk.unlock();
+        send_err(fd, "pull timeout on key " + key);
+        continue;
+      }
+      const TVal& v = s->values[key];
+      if (s->profiling) {
+        s->n_pull++;
+        s->bytes_out += v.n * esize(v.dt);
+      }
+      uint8_t dt = v.dt;
+      std::vector<char> raw = v.raw;  // copy under lock
+      uint64_t n = v.n;
+      lk.unlock();
+      send_val(fd, dt, raw.data(), n);
+    } else if (op == 3) {  // HB
+      int32_t sender;
+      std::memcpy(&sender, p, 4);
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        s->last_hb[sender] = now_sec();
+      }
+      send_resp(fd, 0, {});
     } else if (op == 4) {  // DEAD
       double timeout;
       std::memcpy(&timeout, p, 8);

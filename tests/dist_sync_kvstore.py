@@ -56,6 +56,49 @@ def main():
     kv.pushpull("pp", mx.nd.full((4, 4), float(r + 1)), out=out)
     onp.testing.assert_allclose(out.asnumpy(), onp.full((4, 4), total))
 
+    # --- PS wire dtype fidelity (VERDICT r04 #6): the server shards
+    # store the PUSHED dtype — f64 keeps f64 precision, int stays
+    # exact, bf16 rides the wire at 2 bytes/elem.  dist_async routes
+    # through the PS (native C++ frames when the toolchain is present,
+    # python pickle otherwise — both must hold).
+    kva = kvs.create("dist_async")
+    ps = kva._ps_backend()
+    kva.barrier()
+
+    # f64: the 1e-12 tail survives ONLY on an f64 wire+store (the old
+    # unconditional f32 server cast flattened it)
+    f64v = onp.full((6,), 1.0 + 1e-12, "float64")
+    ps.init("dt/f64", onp.zeros((6,), "float64"))
+    kva.barrier()
+    ps.push("dt/f64", f64v, "async")
+    kva.barrier()
+    got64 = ps.pull("dt/f64")
+    assert got64.dtype == onp.float64, got64.dtype
+    onp.testing.assert_allclose(got64, n * f64v, rtol=0, atol=1e-12)
+    assert abs(float(got64[0]) - n) > 1e-13, "f64 tail lost on wire"
+
+    # int32: exact integer accumulation, 4-byte wire elems
+    iv = onp.array([2**20, 1, -7, 0, 3], "int32")
+    ps.init("dt/i32", onp.zeros((5,), "int32"))
+    kva.barrier()
+    ps.push("dt/i32", iv, "async")
+    kva.barrier()
+    gi = ps.pull("dt/i32")
+    assert gi.dtype == onp.int32, gi.dtype
+    onp.testing.assert_array_equal(gi, n * iv)
+
+    # bf16: 2 bytes/elem on the wire, bf16 store
+    import ml_dtypes
+    bf = onp.ones((8,), ml_dtypes.bfloat16)
+    ps.init("dt/b16", onp.zeros((8,), ml_dtypes.bfloat16))
+    kva.barrier()
+    ps.push("dt/b16", bf, "async")
+    kva.barrier()
+    gb = ps.pull("dt/b16")
+    assert gb.dtype == onp.dtype(ml_dtypes.bfloat16), gb.dtype
+    onp.testing.assert_allclose(
+        gb.astype("float32"), onp.full((8,), float(n)), rtol=1e-2)
+
     # --- fp16 path (reference tests fp16 keys crossing bigarray_bound)
     kv.init("h", mx.nd.zeros((64, 65)).astype("float16"))
     kv.push("h", mx.nd.full((64, 65), float(r + 1)).astype("float16"))

@@ -160,3 +160,44 @@ def test_dist_async_python_ps_fallback(n):
     assert res.returncode == 0
     for r in range(n):
         assert f"[worker {r}] dist_async OK" in res.stdout
+
+
+@pytest.mark.parametrize("n", [8])
+def test_dist_sync_kvstore_eight_workers(n):
+    """Sync semantics hold at 8 workers (VERDICT r04 #6: beyond the
+    3-process floor)."""
+    res = _launch(n, "dist_sync_kvstore.py", timeout=900)
+    sys.stdout.write(res.stdout[-2000:])
+    sys.stderr.write(res.stderr[-4000:])
+    assert res.returncode == 0
+    for r in range(n):
+        assert f"[worker {r}] dist_sync_kvstore OK" in res.stdout
+
+
+@pytest.mark.parametrize("n", [8])
+def test_dist_async_kvstore_eight_workers(n):
+    res = _launch(n, "dist_async_worker.py", timeout=900)
+    sys.stdout.write(res.stdout[-2000:])
+    sys.stderr.write(res.stderr[-4000:])
+    assert res.returncode == 0
+    for r in range(n):
+        assert f"[worker {r}] dist_async OK" in res.stdout
+
+
+def test_ps_shard_restart_and_heartbeat_failover():
+    """Shard re-registration (epoch-keyed addresses), value refill on
+    'uninitialized key', and rank-0-death liveness failover — the
+    VERDICT r04 #6 recovery drill, on the stoppable python shard."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["MXNET_PS_NATIVE"] = "0"
+    cmd = [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+           "-n", "3", "--cpu", sys.executable,
+           os.path.join(_REPO, "tests", "dist_ps_restart_worker.py")]
+    res = subprocess.run(cmd, env=env, cwd=_REPO, timeout=600,
+                         capture_output=True, text=True)
+    sys.stdout.write(res.stdout[-2000:])
+    sys.stderr.write(res.stderr[-4000:])
+    assert res.returncode == 0
+    for r in range(3):
+        assert f"[worker {r}] ps_restart drill OK" in res.stdout

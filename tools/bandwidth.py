@@ -30,11 +30,74 @@ def _time(fn, runs=10):
     return (time.perf_counter() - t0) / runs
 
 
+def scaling_model():
+    """The >=90%-at-256-chips argument (BASELINE north star; reference
+    anchor example/image-classification/README.md:307-319 reports 90.1%
+    at 256 GPUs over ethernet + dist_device_sync).
+
+    Model: data-parallel ResNet-50, bs128/chip.  Per-step wire cost is
+    the gradient allreduce; on a bidirectional ring (the ICI torus
+    degenerate case — real 2D/3D tori only do better),
+    t_comm = 2*(N-1)/N * G / B with G = grad bytes and B = per-chip
+    allreduce bandwidth.  XLA overlaps the allreduce with the backward
+    (grads for layer k are ready while k-1 still computes), so the
+    exposed time is max(0, t_comm - overlap_window).  Efficiency =
+    t_step / (t_step + exposed).
+
+    Anchors: t_step = 44.9 ms measured on the chip (BENCH_r04/r05,
+    device-chained); G = 102.2 MB (25.56M fp32 grads; the fused step
+    all-reduces fp32 master grads — dryrun_collectives confirms the
+    per-step collective bytes scale with exactly this term); the
+    backward is ~60% of the step (XPlane r05: bwd convs 26.5 of
+    44.9 ms), giving a 26.9 ms overlap window.
+
+    B sweep: 45 GB/s is one v5e ICI link direction; a 2D torus axis
+    gives ~90; 25 is a pessimistic DCN-limited figure (multi-pod
+    slice where the reduce crosses data-center network).  Even at
+    25 GB/s the exposed time is 0 — the window covers t_comm by 3x —
+    so the efficiency bound is >=99% at every N; the reference's 90.1%
+    anchor is cleared with an order of magnitude of slack.  The real
+    risk at 256 chips is stragglers/jitter, not bandwidth — which the
+    elastic heartbeat + supervised relaunch path (kvstore num_dead_node,
+    tools/launch.py --max-restarts) addresses.
+    """
+    t_step = 44.9e-3
+    grad_bytes = 25.56e6 * 4
+    overlap = 0.6 * t_step
+    rows = []
+    for n in (8, 64, 256):
+        for bw in (25e9, 45e9, 90e9):
+            t_comm = 2 * (n - 1) / n * grad_bytes / bw
+            exposed = max(0.0, t_comm - overlap)
+            eff = t_step / (t_step + exposed)
+            rows.append({"chips": n, "allreduce_GBps": bw / 1e9,
+                         "t_comm_ms": round(t_comm * 1e3, 2),
+                         "exposed_ms": round(exposed * 1e3, 2),
+                         "efficiency": round(eff, 4)})
+    print(json.dumps({
+        "metric": "scaling_model_resnet50_bs128",
+        "anchors": {"t_step_ms": 44.9, "grad_MB": 102.2,
+                    "overlap_window_ms": 26.9,
+                    "target": ">=0.90 efficiency at 256 chips "
+                              "(example/image-classification/"
+                              "README.md:307-319)"},
+        "rows": rows,
+        "argument": scaling_model.__doc__.strip(),
+    }))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--size-mb", type=float, default=64)
     ap.add_argument("--runs", type=int, default=10)
+    ap.add_argument("--scaling-model", action="store_true",
+                    help="emit the 256-chip scaling-efficiency model "
+                         "row and exit (no device needed)")
     args = ap.parse_args()
+
+    if args.scaling_model:
+        scaling_model()
+        return
 
     import jax
     import jax.numpy as jnp
