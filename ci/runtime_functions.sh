@@ -27,8 +27,16 @@ multichip_dryrun() {
 }
 
 sanity_bench() {
-    # smoke the headline bench (prints one JSON line)
+    # the headline bench (prints one JSON line; heartbeats on stderr,
+    # internal deadline degrades instead of dying — see bench.py)
     python bench.py
+}
+
+sanity_bench_smoke() {
+    # full bench control flow on CPU in seconds; ALSO run inside
+    # tier-1 (tests/test_bench_smoke.py) so a silent-hang regression
+    # in the harness turns the unit suite red
+    python bench.py --smoke
 }
 
 "$@"

@@ -204,11 +204,19 @@ class _ServerShard(threading.Thread):
             with self._cv:
                 if key not in self.values:
                     raise MXNetError(f"push to uninitialized key {key}")
-                if grad.dtype != self.values[key].dtype:
-                    # half-precision wires widen into the stored
-                    # dtype's arithmetic; the stored dtype never
-                    # changes after init
-                    grad = grad.astype(self.values[key].dtype)
+                stored_dt = self.values[key].dtype
+                half = stored_dt == onp.float16 \
+                    or stored_dt.name == "bfloat16"
+                if mode != "async" and half:
+                    # sync merges half-precision keys in fp32 (the
+                    # native shard widens through double): per-addition
+                    # f16/bf16 rounding across W workers diverged from
+                    # the native transport — the stored dtype applies
+                    # only at the end-of-round apply below
+                    grad = grad.astype(onp.float32)
+                elif grad.dtype != stored_dt:
+                    # the stored dtype never changes after init
+                    grad = grad.astype(stored_dt)
                 self._prof("push", bytes_in=getattr(grad, "nbytes", 0))
                 if mode == "async":
                     if self._updater_for(key) is None:
@@ -240,6 +248,10 @@ class _ServerShard(threading.Thread):
                     cnt = self.pending_count.get(key, 0) + 1
                     if cnt == self.size:
                         merged = self.pending.pop(key)
+                        if merged.dtype != stored_dt:
+                            # apply-time cast: ONE rounding of the
+                            # fp32-accumulated round sum
+                            merged = merged.astype(stored_dt)
                         self.pending_count[key] = 0
                         self.completed_rounds[key] = \
                             self.completed_rounds.get(key, 0) + 1
@@ -261,7 +273,14 @@ class _ServerShard(threading.Thread):
             with self._cv:
                 if key not in self.values:
                     raise MXNetError(f"spush to uninitialized key {key}")
-                vals = onp.asarray(vals, self.values[key].dtype)
+                stored_dt = self.values[key].dtype
+                half = stored_dt == onp.float16 \
+                    or stored_dt.name == "bfloat16"
+                # sync rounds merge half-precision keys in fp32 (see
+                # the dense push path / native-shard double widening)
+                merge_dt = onp.float32 if (mode != "async" and half) \
+                    else stored_dt
+                vals = onp.asarray(vals, merge_dt)
                 self._prof("spush",
                            bytes_in=rows.nbytes + vals.nbytes)
                 if mode == "async":
@@ -278,12 +297,16 @@ class _ServerShard(threading.Thread):
                     self.pushed_rounds[(key, sender)] = prev + 1
                     acc = self.pending.get(key)
                     if acc is None:
-                        acc = onp.zeros_like(self.values[key])
+                        acc = onp.zeros(self.values[key].shape,
+                                        merge_dt)
                         self.pending[key] = acc
                     onp.add.at(acc, rows, vals)
                     cnt = self.pending_count.get(key, 0) + 1
                     if cnt == self.size:
                         merged = self.pending.pop(key)
+                        if merged.dtype != stored_dt:
+                            # apply-time cast of the fp32 round sum
+                            merged = merged.astype(stored_dt)
                         self.pending_count[key] = 0
                         self.completed_rounds[key] = \
                             self.completed_rounds.get(key, 0) + 1

@@ -19,7 +19,7 @@ from typing import Any, Callable, Optional
 from .base import MXNetError
 
 __all__ = ["register_env", "get_env", "list_env", "describe_env",
-           "ParamStruct", "field"]
+           "setup_compilation_cache", "ParamStruct", "field"]
 
 _ENV: dict[str, "EnvVar"] = {}
 
@@ -97,12 +97,67 @@ register_env("MXNET_EXEC_BULK_EXEC_TRAIN", True, bool,
 register_env("MXNET_GPU_MEM_POOL_TYPE", "Naive", str,
              "Reference allocator strategy; XLA owns HBM pooling.",
              live=False)
+register_env("JAX_COMPILATION_CACHE_DIR", "", str,
+             "Persistent XLA compilation cache directory.  When set, "
+             "every jitted program (train step, CachedOp, executor, "
+             "predictor) is cached on disk keyed by HLO, so re-binds "
+             "and bench recaptures skip recompilation entirely.  The "
+             "reference analog is the cuDNN algo registry persisting "
+             "autotune winners across Bind calls.")
+register_env("MXNET_CONV_1X1_DOT", False, bool,
+             "Lower channel-last 1x1 convolutions to dot_general "
+             "(native MXU matmul, no layout change).  Off by default; "
+             "bench.py's --conv-ab switch measures the step-level A/B.")
+register_env("MXNET_EXEC_DONATE", True, bool,
+             "Donate dead executor state buffers (updated BatchNorm "
+             "moving stats in the CachedOp/Executor jit paths) back to "
+             "XLA for in-place reuse — the TPU-native analog of the "
+             "reference's static_alloc memory sharing.")
 register_env("DMLC_NUM_WORKER", 1, int,
              "Distributed worker count (tools/launch.py contract).")
 register_env("DMLC_WORKER_ID", 0, int, "This worker's rank.")
 register_env("DMLC_PS_ROOT_URI", "127.0.0.1", str,
              "Coordinator address (worker 0).")
 register_env("DMLC_PS_ROOT_PORT", "9091", str, "Coordinator port.")
+
+
+# ------------------------------------------- persistent compilation cache
+_CC_STATE = {"dir": None}
+
+
+def setup_compilation_cache(path=None):
+    """Enable jax's persistent compilation cache (no-op when unset).
+
+    Reads ``JAX_COMPILATION_CACHE_DIR`` from the registry unless an
+    explicit ``path`` is given; returns the active cache dir or None.
+    Wired into bench.py, ``Module.bind``, ``make_train_step`` and the
+    parallel predictor so a recapture/re-bind of an already-seen
+    program costs a disk read instead of an XLA compile (the cuDNN
+    algo-registry persistence analog,
+    src/operator/nn/cudnn/cudnn_algoreg-inl.h).
+
+    The min-compile-time/min-entry-size thresholds are dropped to zero
+    so even small programs (the smoke-bench net, the K1 loop) hit the
+    cache — bench recapture robustness matters more here than cache
+    hygiene.
+    """
+    p = path if path is not None else get_env("JAX_COMPILATION_CACHE_DIR")
+    if not p:
+        return None
+    if _CC_STATE["dir"] == p:
+        return p  # already active — config.update churn is not free
+    import jax
+
+    os.makedirs(p, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", p)
+    for opt, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                     ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(opt, val)
+        except (AttributeError, KeyError):
+            pass  # knob absent in this jax — the cache still works
+    _CC_STATE["dir"] = p
+    return p
 
 
 # ------------------------------------------------------------ ParamStruct

@@ -463,6 +463,44 @@ class HybridBlock(Block):
             "implement shape inference"
         )
 
+    @staticmethod
+    def _donating_fn(entry, pdata, idata):
+        """Donating twin of the cached jit program (``static_alloc``
+        memory reuse): parameters the traced program MUTATES (BatchNorm
+        moving stats adopted a new value — ``upd_idx`` in the entry
+        meta) are passed as a separate donated argument, so XLA writes
+        each update into its input's buffer instead of allocating.
+        Returns None (caller uses the plain program) until the first
+        call has populated the meta, when mutation is absent, when
+        donation is disabled, or when a mutated buffer is aliased into
+        a non-donated slot (shared parameters)."""
+        from .. import config as _config
+
+        meta = entry["meta"]
+        if meta is None or not meta[3] or \
+                not _config.get_env("MXNET_EXEC_DONATE"):
+            return None
+        upd_idx = meta[3]
+        upd_set = set(upd_idx)
+        upd_ids = {id(pdata[i]) for i in upd_idx}
+        aliased = any(id(v) in upd_ids for i, v in enumerate(pdata)
+                      if i not in upd_set)
+        aliased = aliased or any(v is not None and id(v) in upd_ids
+                                 for v in idata)
+        if aliased:
+            return None
+        if entry.get("fn_d") is None:
+            run = entry["run"]
+
+            def _run_d(key, upd_vals, rest_vals, in_vals):
+                pv = list(rest_vals)
+                for j, i in enumerate(upd_idx):
+                    pv[i] = upd_vals[j]
+                return run(key, pv, in_vals)
+
+            entry["fn_d"] = jax.jit(_run_d, donate_argnums=(1,))
+        return entry["fn_d"]
+
     def _call_cached(self, *args):
         """jit path: one compiled program, one autograd tape node.
 
@@ -529,6 +567,7 @@ class HybridBlock(Block):
                 return tuple(o._data for o in flat_out) + tuple(upd_vals)
 
             entry["fn"] = jax.jit(_run)
+            entry["run"] = _run  # donating twin builds lazily from it
             self._jit_cache[sig] = entry
 
         jitted = entry["fn"]
@@ -594,7 +633,16 @@ class HybridBlock(Block):
                     o._oidx = i
                     outs.append(o)
             else:
-                out_vals = jitted(key, pdata, idata)
+                fn_d = self._donating_fn(entry, pdata, idata)
+                if fn_d is not None:
+                    upd_idx = entry["meta"][3]
+                    upd_set = set(upd_idx)
+                    upd_vals = [pdata[i] for i in upd_idx]
+                    rest = [None if i in upd_set else v
+                            for i, v in enumerate(pdata)]
+                    out_vals = fn_d(key, upd_vals, rest, idata)
+                else:
+                    out_vals = jitted(key, pdata, idata)
                 outs = [nd.NDArray(v) for v in out_vals]
         finally:
             _pc.set_trace_platform(_hint_prev)

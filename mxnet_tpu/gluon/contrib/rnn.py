@@ -23,19 +23,24 @@ class VariationalDropoutCell(ModifierCell):
 
     def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
                  drop_outputs=0.0):
-        # reference guards: a bidirectional base cell reuses the cell in
-        # both directions, so a single locked mask is ill-defined
-        assert not isinstance(base_cell, BidirectionalCell), (
-            "BidirectionalCell doesn't support variational dropout; "
-            "apply VariationalDropoutCell to the cells underneath "
-            "instead.")
-        assert not (isinstance(base_cell, SequentialRNNCell)
-                    and any(isinstance(c, BidirectionalCell)
-                            for c in getattr(base_cell, "_children",
-                                             {}).values())), (
-            "Bidirectional SequentialRNNCell doesn't support "
-            "variational dropout; apply VariationalDropoutCell to "
-            "the cells underneath instead.")
+        # reference guards (contrib/rnn/rnn_cell.py:41): only a STATE
+        # mask is ill-defined over a bidirectional base cell (the two
+        # directions would share one locked h mask); input/output-only
+        # dropout is well-defined and stays allowed
+        assert not drop_states or \
+            not isinstance(base_cell, BidirectionalCell), (
+                "BidirectionalCell doesn't support variational "
+                "state dropout; apply VariationalDropoutCell to the "
+                "cells underneath instead.")
+        assert not drop_states or \
+            not (isinstance(base_cell, SequentialRNNCell)
+                 and any(isinstance(c, BidirectionalCell)
+                         for c in getattr(base_cell, "_children",
+                                          {}).values())), (
+                "Bidirectional SequentialRNNCell doesn't support "
+                "variational state dropout; apply "
+                "VariationalDropoutCell to the cells underneath "
+                "instead.")
         super().__init__(base_cell)
         self.drop_inputs = drop_inputs
         self.drop_states = drop_states
@@ -58,6 +63,58 @@ class VariationalDropoutCell(ModifierCell):
         # F-based like ZoneoutCell: keeps the modifier usable on the
         # symbolic/export path wherever its base cell is
         return F.Dropout(F.ones_like(like), p=p)
+
+    def _base_not_steppable(self):
+        base = self.base_cell
+        return isinstance(base, BidirectionalCell) or (
+            isinstance(base, SequentialRNNCell)
+            and any(isinstance(c, BidirectionalCell)
+                    for c in getattr(base, "_children", {}).values()))
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        # state dropout rides the recurrent loop (the locked h mask
+        # applies inside every step) — that needs the step-wise base
+        # unroll.  Input/output-only dropout is ONE mask broadcast
+        # along the time axis, so for a base cell that cannot be
+        # stepped (BidirectionalCell) it wraps the base cell's OWN
+        # unroll instead — which is what makes io-only variational
+        # dropout work over a BidirectionalCell again (reference
+        # contrib/rnn/rnn_cell.py VariationalDropoutCell.unroll).
+        if self.drop_states or not self._base_not_steppable():
+            return super().unroll(length, inputs, begin_state, layout,
+                                  merge_outputs,
+                                  valid_length=valid_length)
+        from ... import ndarray as nd
+        from ..rnn.rnn_cell import (_format_sequence, _get_begin_state,
+                                    _mask_sequence_variable_length)
+
+        self.reset()
+        inputs, axis, batch_size = _format_sequence(length, inputs,
+                                                    layout, True)
+        states = _get_begin_state(self, nd, begin_state, inputs,
+                                  batch_size)
+        if self.drop_inputs:
+            inputs = nd.Dropout(inputs, p=self.drop_inputs,
+                                axes=(axis,))
+        self.base_cell._modified = False
+        try:
+            outputs, states = self.base_cell.unroll(
+                length, inputs, states, layout, merge_outputs=True,
+                valid_length=valid_length)
+        finally:
+            self.base_cell._modified = True
+        if self.drop_outputs:
+            outputs = nd.Dropout(outputs, p=self.drop_outputs,
+                                 axes=(axis,))
+        merge_outputs = isinstance(outputs, nd.NDArray) if \
+            merge_outputs is None else merge_outputs
+        outputs, _, _ = _format_sequence(length, outputs, layout,
+                                         merge_outputs)
+        if valid_length is not None:
+            outputs = _mask_sequence_variable_length(
+                nd, outputs, length, valid_length, axis, merge_outputs)
+        return outputs, states
 
     def hybrid_forward(self, F, inputs, states):
         if self.drop_inputs:

@@ -574,10 +574,16 @@ class DistKVStore(KVStore):
                 self.last_wire_bytes = int(idx.nbytes + vals.nbytes)
                 self.last_uncompressed_bytes = int(
                     self._store[k]._data.nbytes)
-                dense = jnp.zeros(self._store[k].shape,
-                                  self._store[k]._data.dtype)
-                dense = dense.at[jnp.asarray(idx)].set(
-                    jnp.asarray(vals).astype(dense.dtype))
+                store = self._store[k]
+                jidx = jnp.asarray(idx)
+                jvals = jnp.asarray(vals)
+                dense = jnp.zeros(store.shape, store._data.dtype)
+                dense = dense.at[jidx].set(jvals.astype(dense.dtype))
+                # merge the authoritative pulled rows into the local
+                # mirror (dense-path parity): a later refill must not
+                # re-seed the shard with this key's init-time rows
+                store._adopt(store._data.at[jidx].set(
+                    jvals.astype(store._data.dtype)))
                 o._adopt(dense.astype(o._data.dtype))
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
@@ -595,6 +601,12 @@ class DistKVStore(KVStore):
                     val = jnp.asarray(self._ps_op(
                         k, lambda: ps.pull(self._ps_key(k)))).reshape(
                         self._store[k].shape)
+                    # refresh the local mirror too (dense-path parity):
+                    # without it a post-restart refill re-seeds the
+                    # shard from init-time values, silently discarding
+                    # the training the pull just fetched
+                    self._store[k]._adopt(
+                        val.astype(self._store[k]._data.dtype))
                     for o in olist:
                         o._adopt(val.astype(o._data.dtype))
                 else:

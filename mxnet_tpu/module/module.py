@@ -118,6 +118,13 @@ class Module(BaseModule):
              shared_module=None, grad_req="write"):
         if self.binded and not force_rebind:
             return
+        # persistent compilation cache (no-op unless
+        # JAX_COMPILATION_CACHE_DIR is set): a re-bind of a shape
+        # already compiled — the common restart/recapture path — loads
+        # the XLA executable from disk instead of recompiling
+        from ..config import setup_compilation_cache
+
+        setup_compilation_cache()
         self.for_training = for_training
         self._data_shapes = [(d[0], tuple(d[1])) for d in data_shapes]
         self._label_shapes = ([(d[0], tuple(d[1]))

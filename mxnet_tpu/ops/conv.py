@@ -68,9 +68,9 @@ def _dimnums(nd, channel_last=False):
 def _conv1x1_dot(data, weight, stride, cl):
     """Channel-last 1x1 conv as a dot_general over the channel dim.
     data [N, *sp, Ci], weight [Co, *(1,)*nd, Ci] -> [N, *sp', Co]."""
-    import os
+    from ..config import get_env
 
-    if not cl or os.environ.get("MXNET_CONV_1X1_DOT", "0") != "1":
+    if not cl or not get_env("MXNET_CONV_1X1_DOT"):
         return None
     nd = data.ndim - 2
     if any(s != 1 for s in stride):

@@ -201,7 +201,20 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
     doubles the scale every 2000 consecutive finite steps and halves it
     on overflow, skipping the update (reference: contrib/amp loss scaler
     + all_finite, src/operator/contrib/all_finite.cc).
+
+    donate=True (the default) donates the params/opt_state buffers to
+    XLA: the step writes its updated state in place instead of
+    allocating a second copy — the reference's ``static_alloc`` memory
+    reuse (SURVEY §7 maps static_alloc ≈ donate_argnums).  The caller
+    contract is the functional one this signature already imposes: the
+    INPUT params/opt_state are dead after the call (you must thread the
+    returned ones), donation just makes XLA exploit that.  Pass
+    donate=False to keep calling with the same buffers (step-parity
+    tests do).
     """
+    from ..config import setup_compilation_cache
+
+    setup_compilation_cache()
     params, apply_fn = functionalize(block, train=True)
     if mesh is None:
         # commit params to the accelerator once; otherwise every step

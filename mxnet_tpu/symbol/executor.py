@@ -231,7 +231,32 @@ class Executor:
             for n in self._arg_names)
         return (shapes, train)
 
+    def _updated_aux(self, is_train):
+        """Aux names whose buffers `_eval_graph` will replace this
+        forward — statically readable from the graph (BatchNorm moving
+        stats in training mode).  These are the executor's aliasable
+        state: the input buffer is dead the moment its update is
+        adopted, so the jit path can donate it (the reference's
+        static_alloc in-place aux mutation, src/operator/nn/
+        batch_norm.cc writes the moving stats into the same blobs)."""
+        if not is_train:
+            return ()
+        names = set()
+        for node in self._symbol._topo():
+            if node.op not in _BN_OPS:
+                continue
+            if dict(node.attrs).get("use_global_stats", False):
+                continue
+            for slot in (3, 4):
+                if slot < len(node.inputs):
+                    inp, _ = node.inputs[slot]
+                    if inp.op is None and inp.name in self.aux_dict:
+                        names.add(inp.name)
+        return tuple(sorted(names))
+
     def forward(self, is_train=False, **kwargs):
+        from ..config import get_env
+
         for k, v in kwargs.items():
             if k not in self.arg_dict:
                 raise MXNetError(f"unknown argument {k}")
@@ -241,14 +266,18 @@ class Executor:
         entry = self._fwd_jit.get(sig)
         if entry is None:
             sym = self._symbol
-            aux_names = self._aux_names
-            entry = {"aux_order": None}
+            don_names = self._updated_aux(is_train)
+            rest_names = tuple(n for n in self._aux_names
+                               if n not in don_names)
+            entry = {"aux_order": None, "don_names": don_names,
+                     "rest_names": rest_names}
 
             placement = self._placement
 
-            def _run(arg_vals, aux_vals, key):
+            def _run(arg_vals, don_vals, rest_vals, key):
                 value_of = dict(zip(self._arg_names, arg_vals))
-                value_of.update(zip(aux_names, aux_vals))
+                value_of.update(zip(don_names, don_vals))
+                value_of.update(zip(rest_names, rest_vals))
                 outs, aux_updates = _eval_graph(sym, value_of, key,
                                                 is_train,
                                                 placement=placement)
@@ -261,10 +290,20 @@ class Executor:
             # to different devices, and XLA compiles one device per
             # program; vjp still traces through the transfers
             entry["fn"] = jax.jit(_run) if placement is None else _run
+            # donating twin for the direct-call path: every don_vals
+            # leaf has a bit-identical-shaped update output, so XLA
+            # aliases each moving-stat buffer instead of allocating a
+            # fresh one per step
+            entry["fn_d"] = (jax.jit(_run, donate_argnums=(1,))
+                             if placement is None and don_names
+                             else None)
             self._fwd_jit[sig] = entry
 
         arg_vals = [self.arg_dict[n]._data for n in self._arg_names]
-        aux_vals = [self.aux_dict[n]._data for n in self._aux_names]
+        don_names = entry["don_names"]
+        don_vals = [self.aux_dict[n]._data for n in don_names]
+        rest_vals = [self.aux_dict[n]._data
+                     for n in entry["rest_names"]]
         key = _rng.take_key()
         n_out = self._symbol.num_outputs
 
@@ -272,7 +311,7 @@ class Executor:
             fn = entry["fn"]
 
             def _f(avals):
-                return fn(avals, aux_vals, key)
+                return fn(avals, don_vals, rest_vals, key)
 
             outs, vjp_fn = jax.vjp(_f, arg_vals)
             self._vjp_fn = vjp_fn
@@ -285,7 +324,22 @@ class Executor:
                 and hasattr(o, "devices") else None for o in outs]
             self._n_primary = n_out
         else:
-            outs = entry["fn"](arg_vals, aux_vals, key)
+            fn_d = entry["fn_d"]
+            # donation is only legal when (a) the first (non-donating)
+            # trace confirmed every donated buffer really gets a
+            # same-shaped update output to alias, and (b) the donated
+            # buffers are not aliased into the non-donated operands (a
+            # shared NDArray bound as both arg and aux would be
+            # consumed while still referenced)
+            donate = (fn_d is not None and get_env("MXNET_EXEC_DONATE")
+                      and entry["aux_order"] is not None
+                      and set(entry["aux_order"]) == set(don_names)
+                      and not ({id(v) for v in don_vals}
+                               & {id(v) for v in arg_vals + rest_vals}))
+            if donate:
+                outs = fn_d(arg_vals, don_vals, rest_vals, key)
+            else:
+                outs = entry["fn"](arg_vals, don_vals, rest_vals, key)
             self._vjp_fn = None
         # fold BatchNorm moving-stat updates back into aux state
         for name, val in zip(entry["aux_order"] or (), outs[n_out:]):

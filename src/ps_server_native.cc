@@ -477,8 +477,10 @@ void serve_conn_inner(Shard* s, int fd) {
       uint64_t total = tv.n;
       bool oob = false;
       for (uint64_t r = 0; r < nrows; ++r) {
+        // division form: (rows[r]+1)*rowlen can wrap for huge indices
         if (rows[r] < 0 ||
-            (static_cast<uint64_t>(rows[r]) + 1) * rowlen > total)
+            (rowlen != 0 &&
+             static_cast<uint64_t>(rows[r]) + 1 > total / rowlen))
           oob = true;
       }
       if (oob) {
@@ -549,7 +551,11 @@ void serve_conn_inner(Shard* s, int fd) {
       p += 8;
       std::memcpy(&rowlen, p, 8);
       p += 8;
-      if (nrows > static_cast<uint64_t>(end - p) / 8) {
+      // same caps as SPUSH: a version-skewed frame with a huge rowlen
+      // would wrap (rows[r]+1)*rowlen in uint64 below and read out of
+      // bounds
+      if (nrows > (1u << 28) || rowlen > (1u << 28) ||
+          nrows > static_cast<uint64_t>(end - p) / 8) {
         send_err(fd, "short spull payload");
         continue;
       }
@@ -584,8 +590,10 @@ void serve_conn_inner(Shard* s, int fd) {
       put_u64(&body, nrows * rowlen);
       bool oob = false;
       for (uint64_t r = 0; r < nrows; ++r) {
+        // division form: (rows[r]+1)*rowlen can wrap for huge indices
         if (rows[r] < 0 ||
-            (static_cast<uint64_t>(rows[r]) + 1) * rowlen > total) {
+            (rowlen != 0 &&
+             static_cast<uint64_t>(rows[r]) + 1 > total / rowlen)) {
           oob = true;
           break;
         }

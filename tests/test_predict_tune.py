@@ -55,8 +55,76 @@ def test_tune_skips_nondivisors_and_returns_best(setup):
 
 def test_unrolled_matches_map(setup):
     params, x = setup
-    ref = make_predict_fn(_apply, microbatch=4)(params, x)
+    ref = make_predict_fn(_apply, microbatch=4, unroll=False)(params, x)
     got = make_predict_fn(_apply, microbatch=4, unroll=True)(params, x)
     for r, g in zip(ref, got):
         onp.testing.assert_allclose(onp.asarray(r), onp.asarray(g),
                                     rtol=1e-6)
+
+
+def test_auto_unroll_default(setup):
+    """The default chunking is UNROLLED for small k (each chunk
+    compiles like a standalone call — the r05 lax.map body lost
+    cross-iteration double-buffering and re-opened the fp32
+    batch-scaling regression) and lax.map only beyond the unroll
+    limit."""
+    import jax
+
+    params, _ = setup
+    x16 = jnp.asarray(onp.random.RandomState(1)
+                      .rand(16, 5).astype("float32"))
+    jx4 = str(jax.make_jaxpr(
+        lambda p, v: make_predict_fn(_apply, microbatch=4)(p, v))(
+            params, x16))
+    assert "scan" not in jx4 and "while" not in jx4
+    jx16 = str(jax.make_jaxpr(
+        lambda p, v: make_predict_fn(_apply, microbatch=16)(p, v))(
+            params, x16))
+    assert "scan" in jx16 or "while" in jx16
+    # values agree across all three forms
+    ref = make_predict_fn(_apply, microbatch=1)(params, x16)
+    for k in (4, 16):
+        got = make_predict_fn(_apply, microbatch=k)(params, x16)
+        for r, g in zip(ref, got):
+            onp.testing.assert_allclose(onp.asarray(r),
+                                        onp.asarray(g), rtol=1e-6)
+
+
+def test_inference_per_image_time_nonincreasing_bs32_to_bs128():
+    """The fp32 batch-scaling contract (reference perf.md:194-196
+    scales UP with batch; r04/r05 regressed 22% at bs128): per-image
+    inference time must not increase from bs32 to bs128 when bs128
+    runs through the default (unrolled) microbatch predictor."""
+    from mxnet_tpu.parallel.predict import _chain_time
+
+    rng = onp.random.RandomState(0)
+    # wide enough that per-chunk compute dominates the fixed chunking
+    # overhead (reshape/concat/dispatch), as it does at ResNet scale
+    w1 = jnp.asarray(rng.rand(128, 512).astype("float32") * 0.05)
+    w2 = jnp.asarray(rng.rand(512, 512).astype("float32") * 0.05)
+    w3 = jnp.asarray(rng.rand(512, 32).astype("float32") * 0.05)
+    params = {"w1": w1, "w2": w2, "w3": w3}
+
+    def apply_fn(p, x):
+        h = jnp.maximum(x @ p["w1"], 0.0)
+        h = jnp.maximum(h @ p["w2"], 0.0)
+        return h @ p["w3"]
+
+    x32 = jnp.asarray(rng.rand(32, 128).astype("float32"))
+    x128 = jnp.asarray(rng.rand(128, 128).astype("float32"))
+    p32 = make_predict_fn(apply_fn, microbatch=1)
+    p128 = make_predict_fn(apply_fn, microbatch=4)  # default: unrolled
+
+    def per_image(pred, x, runs=3):
+        # best-of-N chained slopes: robust to scheduler noise on the
+        # shared CI host
+        t = min(_chain_time(lambda xv, pp: pred(pp, xv), [x, params],
+                            iters=12) for _ in range(runs))
+        return t / x.shape[0]
+
+    t32 = per_image(p32, x32)
+    t128 = per_image(p128, x128)
+    # non-increasing, with a 15% cushion for host timing jitter only
+    assert t128 <= t32 * 1.15, (
+        f"per-image time regressed: bs32 {t32*1e6:.1f}us -> "
+        f"bs128 {t128*1e6:.1f}us")
