@@ -28,8 +28,9 @@ HARNESS PROTOCOL (round 6 — r05's run died silent at rc=124 and cost
 the round its headline artifact):
 
 * every phase prints a heartbeat line ``[bench] phase=<name> t=+S.Ss``
-  to STDERR (import / device_init / build / compile / K1 / K2 / trials
-  / peak / done), so a hung run shows WHERE it hung;
+  to STDERR (import / device_init / build / autotune / compile / K1 /
+  K2 / trials / peak / feed / done), so a hung run shows WHERE it
+  hung;
 * stdout carries exactly ONE JSON line;
 * an internal wall-clock deadline (``--deadline`` / BENCH_DEADLINE_S,
   default 1500 s) degrades instead of dying: the K schedule shrinks,
@@ -44,7 +45,14 @@ the round its headline artifact):
   regression turns the suite red instead of costing a round;
 * ``--conv-ab`` measures the step-level MXNET_CONV_1X1_DOT A/B
   (channel-last 1x1 convs as dot_general) in NHWC, the untried lever
-  from VERDICT r05 weak #7.
+  from VERDICT r05 weak #7;
+* the in-step variant autotuner (mxnet_tpu/autotune.py) races
+  registered lowerings inside a chained run of the REAL step and
+  persists winners in autotune.json; its report lands under
+  ``"autotune"`` in the JSON (``--no-autotune`` skips);
+* the async device feed A/B (``"device_feed"`` in the JSON) runs real
+  steps fed blocking vs through io.DeviceFeedIter and reports the
+  per-phase feed/compute overlap.
 
 Also reported: achieved TFLOP/s from ``compiled.cost_analysis()`` and
 MFU relative to the chip's bf16 matmul peak measured in-process by a
@@ -145,7 +153,7 @@ def _build_net(smoke, layout):
     return net, classes
 
 
-def _make_step(net, classes, batch, smoke, layout):
+def _make_step(net, classes, batch, smoke, layout, autotune=False):
     import numpy as onp
 
     import jax
@@ -154,13 +162,6 @@ def _make_step(net, classes, batch, smoke, layout):
     from mxnet_tpu.parallel import make_train_step
 
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
-    # donate=True (the default): params/opt_state are dead after each
-    # call by construction of the fori_loop carry; donation lets XLA
-    # update them in place (static_alloc ≡ donate_argnums, SURVEY §7)
-    step_fn, params, opt_state = make_train_step(
-        net, loss_fn, optimizer="sgd", learning_rate=0.1, momentum=0.9,
-        donate=True,
-        compute_dtype=None if smoke else "bfloat16")
     side = 16 if smoke else 224
     xshp = (batch, 3, side, side) if layout == "NCHW" \
         else (batch, side, side, 3)
@@ -169,6 +170,19 @@ def _make_step(net, classes, batch, smoke, layout):
     y = jnp.asarray(
         onp.random.randint(0, classes, size=(batch,)).astype("float32"))
     key = jax.random.key(0)
+    # donate=True (the default): params/opt_state are dead after each
+    # call by construction of the fori_loop carry; donation lets XLA
+    # update them in place (static_alloc ≡ donate_argnums, SURVEY §7).
+    # autotune=True additionally races the registered in-step variants
+    # (conv 1x1 dot vs conv emitter, ...) inside a chained run of THIS
+    # step on the sample batch; the winner persists in autotune.json
+    # and the returned step traces under it (mxnet_tpu/autotune.py).
+    step_fn, params, opt_state = make_train_step(
+        net, loss_fn, optimizer="sgd", learning_rate=0.1, momentum=0.9,
+        donate=True,
+        compute_dtype=None if smoke else "bfloat16",
+        sample_data=(x, y) if autotune else None,
+        autotune=None if autotune else False)
     return step_fn, params, opt_state, x, y, key
 
 
@@ -262,6 +276,79 @@ def _measure(step_fn, params, opt_state, x, y, key, batch, deadline,
             "degraded": degraded, "reasons": reasons}
 
 
+def _measure_feed(step_fn, params, opt_state, x, y, key, smoke,
+                  deadline):
+    """Feed/compute overlap A/B: N REAL train steps fed (a) blocking —
+    per-step host batch assembly + device_put inline in the loop — vs
+    (b) through ``DeviceFeedIter`` with assembly + H2D in its producer
+    thread.  Returns (report, params, opt_state) — params/opt_state are
+    threaded through because the step donates its inputs.
+
+    Host-loop wall timing is acceptable HERE: both arms run the
+    identical loop and only their ratio (the overlap) is the result;
+    the headline ms/step stays on the chained-K methodology above."""
+    import numpy as onp
+
+    import jax
+    from mxnet_tpu.config import get_env
+    from mxnet_tpu.io.device_feed import DeviceFeedIter
+
+    n = 6 if smoke else 16
+    depth = get_env("MXNET_DEVICE_FEED_DEPTH")
+    xf = onp.asarray(x).astype("float32")
+    yh = onp.asarray(y)
+    xdt = onp.asarray(x).dtype
+
+    def assemble(i):
+        # representative host tail work (normalize + cast), varied per
+        # batch so nothing can be hoisted/cached across iterations
+        a = (xf * (1.0 / 255.0) - 0.45 + 1e-6 * i) * (1.0 / 0.225)
+        return a.astype(xdt), yh
+
+    def run_blocking(p, o):
+        t0 = time.perf_counter()
+        loss = None
+        for i in range(n):
+            xb, yb = assemble(i)
+            xb = jax.device_put(xb)
+            yb = jax.device_put(yb)
+            loss, p, o = step_fn(p, o, xb, yb, key, 1.0)
+        _ = float(loss)  # drain
+        return time.perf_counter() - t0, p, o
+
+    def run_feed(p, o):
+        it = DeviceFeedIter((assemble(i) for i in range(n)),
+                            depth=depth)
+        t0 = time.perf_counter()
+        loss = None
+        for xb, yb in it:
+            loss, p, o = step_fn(p, o, xb._data, yb._data, key, 1.0)
+        _ = float(loss)
+        return time.perf_counter() - t0, it.stats(), p, o
+
+    # warm the direct single-step program (the AOT compile above does
+    # not populate the jit call cache) — outside both timed arms
+    loss, params, opt_state = step_fn(params, opt_state, x, y, key, 1.0)
+    _ = float(loss)
+    t_block, params, opt_state = run_blocking(params, opt_state)
+    t_feed, stats, params, opt_state = run_feed(params, opt_state)
+    report = {
+        "batches": n,
+        "depth": depth,
+        "blocking_ms_per_step": round(t_block / n * 1e3, 3),
+        "feed_ms_per_step": round(t_feed / n * 1e3, 3),
+        "feed_wait_ms_per_step": round(
+            stats["consumer_wait_s"] / max(stats["batches"], 1) * 1e3,
+            3),
+        "producer_busy_ms_per_step": round(
+            stats["producer_busy_s"] / max(stats["batches"], 1) * 1e3,
+            3),
+        "overlap_frac": round(max(0.0, 1.0 - t_feed / t_block), 3)
+        if t_block > 0 else None,
+    }
+    return report, params, opt_state
+
+
 def _conv_ab(batch, smoke, deadline):
     """Step-level MXNET_CONV_1X1_DOT A/B in NHWC (the flag only lowers
     CHANNEL-LAST 1x1 convs to dot_general — ops/conv.py:60-83).
@@ -301,6 +388,10 @@ def main(argv=None):
     ap.add_argument("--conv-ab", action="store_true",
                     help="also measure the MXNET_CONV_1X1_DOT step A/B "
                          "(NHWC)")
+    ap.add_argument("--no-autotune", action="store_true",
+                    help="skip the in-step variant autotuner (winners "
+                         "otherwise persist in autotune.json and apply "
+                         "to the measured step)")
     ap.add_argument("--deadline", type=float, default=None,
                     help="internal wall-clock budget in seconds "
                          "(BENCH_DEADLINE_S; default 1500, smoke 240)")
@@ -365,8 +456,19 @@ def main(argv=None):
     _heartbeat("build")
     t_build0 = time.monotonic()
     net, classes = _build_net(args.smoke, layout)
+    # in-step autotune rides inside make_train_step (skipped when the
+    # remaining budget could not absorb the extra variant compiles;
+    # a warm autotune.json costs lookups only)
+    do_tune = not args.no_autotune and (
+        args.smoke or not deadline.exceeded(margin=300.0))
+    if do_tune:
+        _heartbeat("autotune")
     step_fn, params, opt_state, x, y, key = _make_step(
-        net, classes, batch, args.smoke, layout)
+        net, classes, batch, args.smoke, layout, autotune=do_tune)
+    from mxnet_tpu import autotune as _at
+
+    out["autotune"] = _at.last_report() if do_tune else {
+        "skipped": "disabled" if args.no_autotune else "deadline"}
     if deadline.exceeded():
         return bail("deadline exceeded during model build")
 
@@ -420,6 +522,24 @@ def main(argv=None):
                        "donated params/opt_state, persistent "
                        "compilation cache",
     })
+
+    # per-phase feed/compute overlap (async device feed vs blocking
+    # per-step H2D) — the DeviceFeedIter A/B runs REAL steps
+    if deadline.exceeded(margin=0.0 if args.smoke else 60.0):
+        out["device_feed"] = "skipped (deadline)"
+        out["degraded"] = True
+        reasons.append("deadline: skipped device-feed phase")
+    else:
+        _heartbeat("feed")
+        try:
+            feed_report, params, opt_state = _measure_feed(
+                step_fn, params, opt_state, x, y, key, args.smoke,
+                deadline)
+            out["device_feed"] = feed_report
+        except Exception as exc:  # auxiliary metric: never kill the run
+            out["device_feed"] = {"error": repr(exc)}
+            out["degraded"] = True
+            reasons.append(f"device-feed phase failed: {exc!r}")
 
     if args.conv_ab or args.smoke:
         # the A/B costs roughly two more build+compile+measure passes

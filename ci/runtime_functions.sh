@@ -39,4 +39,18 @@ sanity_bench_smoke() {
     python bench.py --smoke
 }
 
+opperf_smoke() {
+    # per-op benchmark smoke on CPU: a representative slice of the
+    # curated tables — including the r05 per-op input registries
+    # (optimizer updates, zero-input samplers, npi tail, quantized,
+    # detection) — so expanded op coverage keeps producing a committed
+    # OPPERF_*.jsonl artifact instead of silently lapsing.  One JSON
+    # line per op lands in OPPERF_smoke.jsonl (diffable across PRs).
+    JAX_PLATFORMS=cpu python benchmark/opperf.py --runs 8 --ops \
+dot,Convolution,BatchNorm,FullyConnected,softmax,SyncBatchNorm,\
+_contrib_BNReluConv,sgd_update,adam_update,multi_lars,_random_uniform,\
+_npi_interp,_npi_full_like,_contrib_quantize,MultiBoxPrior \
+        | tee OPPERF_smoke.jsonl
+}
+
 "$@"

@@ -1,0 +1,369 @@
+"""In-step variant autotuner — the cuDNN algo-registry analog, on TPU.
+
+Reference parity: ``cudnn_tune='fastest'`` (src/operator/nn/cudnn/
+cudnn_convolution-inl.h) benchmarks candidate convolution algorithms at
+Bind time and ``cudnn_algoreg-inl.h`` caches the winner per
+(shape, dtype) so later binds skip the timing.  On TPU the "algorithm"
+space is which lowering a registered op uses: channel-last 1x1 convs as
+``dot_general`` vs the conv emitter (ops/conv.py), the Pallas fused
+BN+ReLU+conv backward vs stock XLA (ops/pallas_conv.py), the
+predictor's micro-batch chunking (parallel/predict.py).
+
+The r05 lesson drives the design: the Pallas kernel WON in isolation
+(0.48 vs 1.18 ms) and LOST in-step (54.8 vs 46.3 ms) because XLA's
+layout assignment and fusion decisions around the variant change with
+it.  So variants are timed **inside a jitted representative step** —
+the caller's real train/predict program, chained through a
+``lax.fori_loop`` carry so iterations serialize and ONE readback
+closes the pipeline (host-loop timing is unreliable on the tunnel,
+bench.py MEASUREMENT NOTE) — never as isolated kernels.
+
+Winners persist on disk (``autotune.json`` next to the XLA compilation
+cache) keyed on (op, shape, dtype, platform, mesh); a process that
+sees the same key again — or a different process on the same host —
+loads the winner instead of re-timing, exactly like the cuDNN algo
+registry persisting across Bind calls.
+
+Decision precedence at trace time (``variant_choice``):
+
+  1. ``force(...)``   — the tuner's own scope while timing a variant;
+  2. an explicitly-set env var (``MXNET_CONV_1X1_DOT=1`` etc.) — the
+     user's hand override, also what bench.py --conv-ab uses per arm;
+  3. ``program_scope(...)`` — cached winners applied by the jit entry
+     points (make_train_step, CachedOp, Executor) for their program's
+     input signature;
+  4. the op's registered default.
+
+``MXNET_AUTOTUNE`` (config.py): 0 = off (no consult, no tune);
+1 = consult cache + tune where the caller provides sample data
+(default); 2 = re-tune even on a cache hit (cudnn_tune='fastest'
+semantics on every bind).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["variant_choice", "force", "program_scope", "lookup",
+           "record", "tune", "tune_train_step", "mesh_desc",
+           "cache_path", "cache_clear", "last_report",
+           "VARIANT_OPS"]
+
+#: op -> {variant name: forced value}.  The forced value is what the
+#: op's trace-time ``variant_choice`` consumer receives.
+VARIANT_OPS = {
+    "conv1x1_dot": {"conv": False, "dot": True},
+    "pallas_bnreluconv": {"jnp": False, "pallas": True},
+}
+
+#: env var that explicitly overrides each variant op (precedence 2)
+_ENV_OVERRIDE = {
+    "conv1x1_dot": "MXNET_CONV_1X1_DOT",
+}
+
+_tls = threading.local()
+_lock = threading.Lock()
+_mem = {"path": None, "mtime": None, "entries": {}}
+_last_report = {}
+
+
+# ------------------------------------------------------------ decisions
+def _get_scope(name):
+    return getattr(_tls, name, None) or {}
+
+
+class _Scope:
+    def __init__(self, name, choices):
+        self._name = name
+        self._choices = dict(choices)
+
+    def __enter__(self):
+        self._prev = getattr(_tls, self._name, None)
+        merged = dict(self._prev or {})
+        merged.update(self._choices)
+        setattr(_tls, self._name, merged)
+        return self
+
+    def __exit__(self, *exc):
+        setattr(_tls, self._name, self._prev)
+
+
+def force(**choices):
+    """Tuning scope: pin variant ops to concrete values while the
+    representative step traces (wins over everything)."""
+    return _Scope("forced", choices)
+
+
+def variant_choice(op, default=None):
+    """The trace-time decision an op consults (see module docstring for
+    the precedence ladder).  Returns the chosen value or ``default``."""
+    forced = _get_scope("forced")
+    if op in forced:
+        return forced[op]
+    env = _ENV_OVERRIDE.get(op)
+    if env is not None:
+        raw = os.environ.get(env)
+        if raw is not None:
+            return raw.lower() in ("1", "true", "yes", "on")
+    applied = _get_scope("applied")
+    if op in applied:
+        return applied[op]
+    return default
+
+
+def program_scope(shape, dtype, platform=None, mesh=None):
+    """Apply every cached winner matching this program's input
+    signature (entered by the jit entry points around trace/call:
+    make_train_step's step, CachedOp._call_cached, Executor.forward).
+    No-op when autotune is off or nothing is cached for the key."""
+    if not enabled():
+        return _Scope("applied", {})
+    entries = _load(cache_path())  # one stat/load for all variant ops
+    choices = {}
+    if entries:
+        for op, variants in VARIANT_OPS.items():
+            entry = entries.get(_key(op, shape, dtype, platform, mesh))
+            winner = entry.get("winner") if entry else None
+            if winner is not None and winner in variants:
+                choices[op] = variants[winner]
+    return _Scope("applied", choices)
+
+
+# ------------------------------------------------------------ the cache
+def enabled(override=None):
+    lvl = autotune_level() if override is None else int(bool(override))
+    return lvl >= 1
+
+
+def autotune_level():
+    from .config import get_env
+
+    try:
+        return int(get_env("MXNET_AUTOTUNE"))
+    except Exception:
+        return 1
+
+
+def cache_path():
+    """``autotune.json`` next to the persistent XLA compilation cache
+    (the cudnn algo registry persisted beside the cubin cache)."""
+    from .config import get_env
+
+    d = get_env("MXNET_AUTOTUNE_CACHE_DIR") or \
+        get_env("JAX_COMPILATION_CACHE_DIR") or \
+        os.path.join(os.path.expanduser("~"), ".cache", "mxnet_tpu")
+    return os.path.join(d, "autotune.json")
+
+
+def _current_platform():
+    try:
+        from .ops import pallas_conv as _pc
+
+        hint = getattr(_pc._hint, "platform", None)
+        if hint is not None:
+            return hint
+    except Exception:
+        pass
+    try:
+        import jax
+
+        return jax.local_devices()[0].platform
+    except Exception:
+        return "unknown"
+
+
+def mesh_desc(mesh):
+    """Stable string key for a jax Mesh (or None)."""
+    if mesh is None:
+        return "none"
+    try:
+        return ",".join(f"{n}={s}" for n, s in
+                        zip(mesh.axis_names, mesh.devices.shape))
+    except Exception:
+        return "mesh"
+
+
+def _key(op, shape, dtype, platform, mesh):
+    platform = platform or _current_platform()
+    mesh = mesh if isinstance(mesh, str) else mesh_desc(mesh)
+    return "|".join((op, str(tuple(shape)), str(dtype), platform, mesh))
+
+
+def _load(path):
+    """mtime-checked load so winners recorded by ANOTHER process on the
+    same host are visible without restarting (algo-registry sharing)."""
+    try:
+        mtime = os.stat(path).st_mtime_ns
+    except OSError:
+        return {}
+    with _lock:
+        if _mem["path"] == path and _mem["mtime"] == mtime:
+            return _mem["entries"]
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        entries = data.get("entries", {}) if isinstance(data, dict) else {}
+    except (OSError, ValueError):
+        entries = {}
+    with _lock:
+        _mem.update(path=path, mtime=mtime, entries=entries)
+    return entries
+
+
+def _save(path, new_entries):
+    """Read-merge-write under an exclusive flock + atomic rename:
+    concurrent tuners — other threads via _lock, other PROCESSES via
+    the .lock file — lose no winners (last writer wins per key only)."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with _lock:
+        lock_f = open(f"{path}.lock", "a+")
+        try:
+            try:
+                import fcntl
+
+                fcntl.flock(lock_f, fcntl.LOCK_EX)
+            except ImportError:  # non-POSIX: thread lock only
+                pass
+            try:
+                with open(path) as f:
+                    on_disk = json.load(f).get("entries", {})
+            except (OSError, ValueError):
+                on_disk = {}
+            on_disk.update(new_entries)
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                json.dump({"version": 1, "entries": on_disk}, f,
+                          indent=1)
+            os.replace(tmp, path)
+            _mem.update(path=path, entries=on_disk,
+                        mtime=os.stat(path).st_mtime_ns)
+        finally:
+            lock_f.close()  # releases the flock
+
+
+def lookup(op, shape, dtype, platform=None, mesh=None):
+    """Cached winner (variant name / JSON value) or None."""
+    entry = _load(cache_path()).get(_key(op, shape, dtype, platform,
+                                         mesh))
+    if entry is None:
+        return None
+    return entry.get("winner")
+
+
+def lookup_entry(op, shape, dtype, platform=None, mesh=None):
+    return _load(cache_path()).get(_key(op, shape, dtype, platform,
+                                        mesh))
+
+
+def record(op, shape, dtype, winner, timings=None, platform=None,
+           mesh=None):
+    """Persist a winner (timings in seconds ride along for the report)."""
+    entry = {"winner": winner, "timings": timings or {},
+             "recorded": time.time()}
+    _save(cache_path(), {_key(op, shape, dtype, platform, mesh): entry})
+    return entry
+
+
+def cache_clear():
+    """Drop the in-memory mirror (tests poke the cache dir env var)."""
+    with _lock:
+        _mem.update(path=None, mtime=None, entries={})
+
+
+def last_report():
+    """The most recent tuning session's report (bench.py JSON)."""
+    return dict(_last_report)
+
+
+# ------------------------------------------------------------- the tuner
+def _step_chain_time(step, params, opt_state, x, y, key, iters=8):
+    """Marginal sec/step of ``step(params, opt_state, x, y, key, t) ->
+    (loss, params, opt_state)`` measured INSIDE one jitted program: a
+    dynamic-bound fori_loop threads params/opt_state through the carry
+    (iterations serialize by construction), one loss readback drains
+    the pipeline, and the two-K slope cancels the dispatch+readback
+    constant (bench.py methodology; host timing loops alone are
+    untrustworthy on the tunnel)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def multi(k, p, o):
+        def body(i, carry):
+            p_, o_, _ = carry
+            loss, p2, o2 = step(p_, o_, x, y, key,
+                                (i + 1).astype(jnp.float32))
+            return (p2, o2, loss)
+
+        return jax.lax.fori_loop(0, k, body,
+                                 (p, o, jnp.float32(0.0)))[2]
+
+    def run(k):
+        t0 = time.perf_counter()
+        _ = float(multi(jnp.int32(k), params, opt_state))
+        return time.perf_counter() - t0
+
+    run(2)  # compile (the dynamic bound keeps it to ONE program)
+    t1 = run(2)
+    t2 = run(2 + iters)
+    return max(t2 - t1, 1e-9) / iters
+
+
+def tune(op, shape, dtype, variants, measure, platform=None, mesh=None,
+         level=None):
+    """Generic variant race: ``measure(variant_value)`` is called under
+    ``force(op=value)`` for each candidate; the fastest wins and is
+    recorded.  A cache hit (level 1) returns the stored winner WITHOUT
+    measuring — the reload-skips-retiming contract.
+
+    Returns (winner_name, report) where report carries timings (sec)
+    and whether the cache answered."""
+    lvl = autotune_level() if level is None else level
+    if lvl < 1:
+        return None, {"enabled": False}
+    if lvl == 1:
+        entry = lookup_entry(op, shape, dtype, platform=platform,
+                             mesh=mesh)
+        if entry is not None and entry.get("winner") in variants:
+            return entry["winner"], {"cached": True,
+                                     "timings": entry.get("timings", {})}
+    timings = {}
+    for name, value in variants.items():
+        with force(**{op: value}):
+            timings[name] = measure(value)
+    winner = min(timings, key=timings.get)
+    record(op, shape, dtype, winner, timings=timings, platform=platform,
+           mesh=mesh)
+    return winner, {"cached": False, "timings": timings}
+
+
+def tune_train_step(step, params, opt_state, x, y, key,
+                    variant_ops=("conv1x1_dot",), platform=None,
+                    mesh=None, iters=8, level=None):
+    """Race each listed variant op inside the REAL train step (the
+    others held at their current decision), greedily one op at a time.
+    Keyed on the step's batch-input signature — the program signature
+    the winners later apply to via ``program_scope``.
+
+    Called by make_train_step when the caller supplies sample data;
+    cheap on a warm cache (pure lookups, zero compiles)."""
+    global _last_report
+    report = {}
+    decided = {}  # earlier winners pinned while later ops race
+    for op in variant_ops:
+        variants = VARIANT_OPS[op]
+
+        def measure(_value, _decided=dict(decided)):
+            with force(**_decided):
+                return _step_chain_time(step, params, opt_state, x, y,
+                                        key, iters=iters)
+
+        winner, info = tune(op, x.shape, x.dtype, variants, measure,
+                            platform=platform, mesh=mesh, level=level)
+        if winner is not None:
+            decided[op] = variants[winner]
+            report[op] = {"winner": winner, **info}
+    _last_report = report
+    return report

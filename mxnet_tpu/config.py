@@ -107,7 +107,27 @@ register_env("JAX_COMPILATION_CACHE_DIR", "", str,
 register_env("MXNET_CONV_1X1_DOT", False, bool,
              "Lower channel-last 1x1 convolutions to dot_general "
              "(native MXU matmul, no layout change).  Off by default; "
-             "bench.py's --conv-ab switch measures the step-level A/B.")
+             "bench.py's --conv-ab switch measures the step-level A/B. "
+             "When set explicitly it overrides any autotuned winner.")
+register_env("MXNET_AUTOTUNE", 1, int,
+             "In-step variant autotuner (mxnet_tpu.autotune; the "
+             "cudnn_tune/cudnn_algoreg analog): 0 = off, 1 = consult "
+             "the persisted winner cache and tune where sample data "
+             "is provided, 2 = re-tune even on a cache hit "
+             "(cudnn_tune='fastest' on every bind).")
+register_env("MXNET_AUTOTUNE_CACHE_DIR", "", str,
+             "Directory for autotune.json (persisted variant winners). "
+             "Empty = next to JAX_COMPILATION_CACHE_DIR, falling back "
+             "to ~/.cache/mxnet_tpu.")
+register_env("MXNET_DEVICE_FEED", True, bool,
+             "Async double-buffered device feed: DataLoader / "
+             "Module.fit / bench.py wrap their batch source in "
+             "io.DeviceFeedIter so host batch assembly and the "
+             "host->HBM transfer overlap the running step.  0 restores "
+             "the blocking per-step device_put.")
+register_env("MXNET_DEVICE_FEED_DEPTH", 2, int,
+             "Batches DeviceFeedIter keeps already device_put (and "
+             "mesh-sharded) ahead of the consumer.")
 register_env("MXNET_EXEC_DONATE", True, bool,
              "Donate dead executor state buffers (updated BatchNorm "
              "moving stats in the CachedOp/Executor jit paths) back to "

@@ -583,10 +583,21 @@ class HybridBlock(Block):
         # jax traces are platform-agnostic, so ops choosing between a
         # Pallas kernel and plain jnp need to know where THIS program's
         # concrete arguments live
+        from .. import autotune as _at
         from ..ops import pallas_conv as _pc
 
         plat = _pc.platform_of(pdata) or _pc.platform_of(idata)
         _hint_prev = _pc.set_trace_platform(plat)
+        # autotuned variant winners for this program's input signature
+        # apply while the cached program traces (cudnn algo registry
+        # consulted at CachedOp::Forward graph build)
+        _probe = next((a for a in flat_in if isinstance(a, nd.NDArray)),
+                      None)
+        _scope = _at.program_scope(
+            _probe.shape if _probe is not None else (),
+            _probe.dtype if _probe is not None else "none",
+            platform=plat)
+        _scope.__enter__()
         try:
             nd_params = [p.data() for p in all_params]
             recording = autograd.is_recording() and (
@@ -645,6 +656,7 @@ class HybridBlock(Block):
                     out_vals = jitted(key, pdata, idata)
                 outs = [nd.NDArray(v) for v in out_vals]
         finally:
+            _scope.__exit__(None, None, None)
             _pc.set_trace_platform(_hint_prev)
 
         out_fmt, single, n_primary, upd_idx = entry["meta"]

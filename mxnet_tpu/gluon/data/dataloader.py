@@ -123,10 +123,17 @@ class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False,
                  sampler=None, last_batch=None, batch_sampler=None,
                  batchify_fn=None, num_workers=0, pin_memory=False,
-                 prefetch=None, thread_pool=False):
+                 prefetch=None, thread_pool=False, device_feed=None,
+                 feed_depth=None):
         self._dataset = dataset
         self._pin_memory = pin_memory
         self._thread_pool = thread_pool
+        # async device feed (io.DeviceFeedIter): batches are already
+        # device_put while the consumer's step runs.  None follows
+        # MXNET_DEVICE_FEED (default on) — gluon training overlaps
+        # host assembly + H2D with compute by default.
+        self._device_feed = device_feed
+        self._feed_depth = feed_depth
         if batch_sampler is None:
             if batch_size is None:
                 raise ValueError(
@@ -180,14 +187,26 @@ class DataLoader:
                         [self._dataset[idx] for idx in batch])
                     yield ret
 
-            return same_process_iter()
-        return _MultiWorkerIter(
-            self._worker_pool, self._batchify_fn, self._batch_sampler,
-            pin_memory=self._pin_memory, worker_fn=_worker_fn,
-            prefetch=self._prefetch,
-            # fork-Pool workers get the dataset via _worker_initializer;
-            # ThreadPool workers share our address space and need it passed
-            dataset=self._dataset if self._thread_pool else None)
+            it = same_process_iter()
+        else:
+            it = _MultiWorkerIter(
+                self._worker_pool, self._batchify_fn,
+                self._batch_sampler,
+                pin_memory=self._pin_memory, worker_fn=_worker_fn,
+                prefetch=self._prefetch,
+                # fork-Pool workers get the dataset via
+                # _worker_initializer; ThreadPool workers share our
+                # address space and need it passed
+                dataset=self._dataset if self._thread_pool else None)
+        from ...io.device_feed import DeviceFeedIter, device_feed_enabled
+
+        feed = self._device_feed
+        if feed is None:
+            feed = device_feed_enabled()
+        if feed:
+            # fresh wrapper per epoch (the inner iterator is one-shot)
+            return DeviceFeedIter(it, depth=self._feed_depth)
+        return it
 
     def __len__(self):
         return len(self._batch_sampler)

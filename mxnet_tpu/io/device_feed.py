@@ -1,0 +1,251 @@
+"""Async double-buffered device feed.
+
+Reference analog: src/io/iter_prefetcher.h double-buffers HOST batches;
+the reference's GPU copy then overlaps via CUDA streams inside the
+engine.  XLA has no implicit H2D overlap for python-side ``device_put``
+— every step in the old path paid a blocking host->HBM transfer after
+``next()`` returned.  ``DeviceFeedIter`` closes that gap: a background
+thread pulls host batches from any iterator and ``device_put``s them
+(mesh-sharded when the consuming step is SPMD) so up to ``depth``
+batches are already resident in HBM while the current step runs.
+Host assembly AND the H2D transfer overlap compute; the consumer's
+``next()`` returns device-committed arrays.
+
+Wired in by default (``MXNET_DEVICE_FEED``): ``gluon.data.DataLoader``
+wraps its per-epoch iterator, ``Module.fit`` wraps ``train_data``, and
+``bench.py`` feeds its measured steps through one.  Works with any
+source: ``DataIter`` subclasses (DataBatch items), ``DataLoader``
+iterators (lists of NDArrays), or plain generators of numpy arrays.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+from .. import ndarray as nd
+from ..base import MXNetError
+from .io import DataBatch, DataIter
+
+__all__ = ["DeviceFeedIter", "as_device_batch", "device_feed_enabled"]
+
+_END = object()
+
+
+class _Err:
+    def __init__(self, exc):
+        self.exc = exc
+
+
+def _q_put(q, stop, item):
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _produce(base, q, stop, stats, sharding, device, n_shards):
+    """Producer loop (module-level on purpose: it must not hold a
+    reference to the DeviceFeedIter, or an abandoned iterator could
+    never be garbage-collected and its finalizer never fire)."""
+    try:
+        src = iter(base)
+        while not stop.is_set():
+            try:
+                item = next(src)
+            except StopIteration:
+                _q_put(q, stop, _END)
+                return
+            t0 = time.perf_counter()
+            out = as_device_batch(item, sharding, device, n_shards)
+            stats["producer_busy_s"] += time.perf_counter() - t0
+            if not _q_put(q, stop, out):
+                return
+    except BaseException as e:  # noqa: BLE001 — surfaced on next()
+        _q_put(q, stop, _Err(e))
+
+
+def device_feed_enabled():
+    from ..config import get_env
+
+    return bool(get_env("MXNET_DEVICE_FEED"))
+
+
+def _batch_sharding(mesh, data_axis):
+    if mesh is None:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(data_axis))
+
+
+def _put_array(v, sharding, device, n_shards):
+    import jax
+
+    if sharding is not None and getattr(v, "ndim", 0) >= 1 \
+            and v.shape[0] % n_shards == 0:
+        return jax.device_put(v, sharding)
+    if device is not None:
+        return jax.device_put(v, device)
+    return jax.device_put(v)
+
+
+def as_device_batch(item, sharding=None, device=None, n_shards=1):
+    """Recursively move a batch's arrays to the device: NDArrays stay
+    NDArrays (committed), numpy arrays become committed NDArrays, raw
+    jax arrays stay raw; DataBatch structure/pad/index are preserved."""
+    import numpy as onp
+
+    import jax
+
+    if item is None:
+        return None
+    if isinstance(item, DataBatch):
+        return DataBatch(
+            data=as_device_batch(item.data, sharding, device, n_shards),
+            label=as_device_batch(item.label, sharding, device,
+                                  n_shards),
+            pad=item.pad, index=item.index, bucket_key=item.bucket_key,
+            provide_data=item.provide_data,
+            provide_label=item.provide_label)
+    if isinstance(item, (list, tuple)):
+        mapped = [as_device_batch(x, sharding, device, n_shards)
+                  for x in item]
+        return type(item)(mapped) if isinstance(item, tuple) else mapped
+    if isinstance(item, nd.NDArray):
+        return nd.NDArray(_put_array(item._data, sharding, device,
+                                     n_shards))
+    if isinstance(item, onp.ndarray):
+        return nd.NDArray(_put_array(item, sharding, device, n_shards))
+    if isinstance(item, jax.Array):
+        return _put_array(item, sharding, device, n_shards)
+    return item
+
+
+class DeviceFeedIter(DataIter):
+    """Wrap any batch iterator; keep ``depth`` batches device-resident
+    ahead of the consumer (mesh-sharded over ``data_axis`` when a mesh
+    is given).
+
+    ``reset()`` restarts the producer and resets the wrapped source, so
+    the wrapper drops into ``Module.fit``'s epoch loop in place of the
+    raw iterator.  ``stats()`` reports how long the consumer actually
+    waited vs how long the producer spent assembling+transferring — the
+    feed/compute overlap evidence bench.py puts in its JSON.
+    """
+
+    def __init__(self, base, depth=None, mesh=None, data_axis="data",
+                 device=None):
+        from ..config import get_env
+
+        super().__init__(getattr(base, "batch_size", 0))
+        self._base = base
+        self._depth = max(1, int(depth if depth is not None
+                                 else get_env("MXNET_DEVICE_FEED_DEPTH")))
+        self._sharding = _batch_sharding(mesh, data_axis)
+        self._n_shards = int(mesh.devices.size) if mesh is not None else 1
+        self._device = device
+        self._stats = {"batches": 0, "epochs": 0,
+                       "consumer_wait_s": 0.0, "producer_busy_s": 0.0}
+        self._thread = None
+        self._done = False
+        self._start()
+
+    # --------------------------------------------------------- producer
+    def _start(self):
+        import weakref
+
+        self._stop = threading.Event()
+        self._q = queue.Queue(maxsize=self._depth)
+        # the thread closes over the queue/event/stats — NOT self — so
+        # an abandoned wrapper (consumer broke out of the epoch and
+        # dropped it) stays collectible; the GC finalizer then releases
+        # the producer instead of leaking a thread + `depth` device
+        # batches for the life of the process
+        self._thread = threading.Thread(
+            target=_produce,
+            args=(self._base, self._q, self._stop, self._stats,
+                  self._sharding, self._device, self._n_shards),
+            name="DeviceFeedIter", daemon=True)
+        self._finalizer = weakref.finalize(self, self._stop.set)
+        self._thread.start()
+
+    def _halt(self):
+        self._stop.set()
+        while True:  # unblock a producer stuck on a full queue
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    # --------------------------------------------------------- consumer
+    def __iter__(self):
+        return self
+
+    def __len__(self):
+        # generator bases have no length; raise the TypeError len()
+        # itself would, so try/except-len consumers (tqdm et al.) fall
+        # back exactly as they would on the unwrapped iterator
+        if getattr(type(self._base), "__len__", None) is None:
+            raise TypeError(
+                "DeviceFeedIter: wrapped source has no length")
+        return len(self._base)
+
+    def next(self):
+        if self._done:  # exhausted: don't block on a dead producer
+            raise StopIteration
+        t0 = time.perf_counter()
+        while True:
+            try:
+                item = self._q.get(timeout=0.5)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    raise MXNetError(
+                        "DeviceFeedIter: producer thread died without "
+                        "a sentinel")
+        self._stats["consumer_wait_s"] += time.perf_counter() - t0
+        if item is _END:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, _Err):
+            self._done = True
+            raise item.exc
+        self._stats["batches"] += 1
+        return item
+
+    def reset(self):
+        self._halt()
+        if hasattr(self._base, "reset"):
+            self._base.reset()
+        self._stats["epochs"] += 1
+        self._done = False
+        self._start()
+
+    def close(self):
+        """Stop the producer WITHOUT touching the wrapped source.  An
+        owner that wrapped someone else's iterator (Module.fit) must
+        close before handing the source back — a live producer keeps
+        consuming from it and would race the next consumer."""
+        self._halt()
+
+    @property
+    def base(self):
+        return self._base
+
+    def stats(self):
+        return dict(self._stats)
+
+    # ------------------------------------------------- passthrough meta
+    @property
+    def provide_data(self):
+        return getattr(self._base, "provide_data", None)
+
+    @property
+    def provide_label(self):
+        return getattr(self._base, "provide_label", None)

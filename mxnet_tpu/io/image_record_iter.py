@@ -54,7 +54,7 @@ class ImageRecordIter(DataIter):
                  part_index=0, num_parts=1, seed=0, dtype="float32",
                  random_h=0, random_s=0, random_l=0, pca_noise=0.0,
                  max_random_contrast=0.0, max_random_illumination=0.0,
-                 **kwargs):
+                 device_feed=None, **kwargs):
         super().__init__(batch_size)
         if len(data_shape) != 3:
             raise MXNetError("data_shape must be (c, h, w)")
@@ -89,6 +89,15 @@ class ImageRecordIter(DataIter):
         self._round_batch = round_batch
         self._rng = onp.random.RandomState(seed)
         self._dtype = dtype
+        if device_feed is None:
+            from .device_feed import device_feed_enabled
+
+            device_feed = device_feed_enabled()
+        # device feed: the producer thread builds the DEVICE batch
+        # (nd.array = host->HBM device_put), so up to prefetch_buffer
+        # batches sit HBM-resident while the consumer's step runs —
+        # next() hands them over without a blocking transfer
+        self._device_feed = bool(device_feed)
 
         # mmap + frame the record file once (host page cache does the
         # streaming; the reference reads chunks instead)
@@ -155,10 +164,28 @@ class ImageRecordIter(DataIter):
             batch, lab_arr = self._make_batch(idx)
             if self._stop.is_set():
                 break
-            self._queue.put((batch, lab_arr,
-                             pad if self._round_batch else 0))
+            pad_out = pad if self._round_batch else 0
+            if self._device_feed:
+                self._queue.put(("ready",
+                                 self._emit(batch, lab_arr, pad_out)))
+            else:
+                self._queue.put((batch, lab_arr, pad_out))
         if not self._stop.is_set():
             self._queue.put(None)
+
+    def _emit(self, batch, labels, pad):
+        """numpy batch -> DataBatch of device NDArrays; in device-feed
+        mode this runs in the PRODUCER thread so the H2D transfer
+        overlaps the consumer's running step."""
+        from .. import ndarray as nd
+
+        data = nd.array(batch.astype(self._dtype)
+                        if self._dtype != "float32" else batch,
+                        dtype=self._dtype)
+        lab = nd.array(labels[:, 0]
+                       if (self.label_width == 1 and labels.ndim == 2)
+                       else labels)
+        return DataBatch(data=[data], label=[lab], pad=pad)
 
     def _make_batch(self, idx):
         """Decode+augment one index batch; subclasses override for
@@ -338,8 +365,6 @@ class ImageRecordIter(DataIter):
         self._worker.start()
 
     def next(self):
-        from .. import ndarray as nd
-
         if self._done:  # exhausted epoch: don't block on a dead producer
             raise StopIteration
         item = self._queue.get()
@@ -350,12 +375,11 @@ class ImageRecordIter(DataIter):
                 item[0] == "error":
             self._done = True
             raise item[1]
+        if isinstance(item, tuple) and len(item) == 2 and \
+                item[0] == "ready":  # device-feed: already on device
+            return item[1]
         batch, labels, pad = item
-        data = nd.array(batch.astype(self._dtype)
-                        if self._dtype != "float32" else batch,
-                        dtype=self._dtype)
-        lab = nd.array(labels[:, 0] if (self.label_width == 1 and labels.ndim == 2) else labels)
-        return DataBatch(data=[data], label=[lab], pad=pad)
+        return self._emit(batch, labels, pad)
 
     def close(self):
         self._stop.set()

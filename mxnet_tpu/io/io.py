@@ -331,9 +331,16 @@ class ResizeIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """Background-thread prefetch over one or more iterators (reference
-    io.py:347; C++ analog src/io/iter_prefetcher.h)."""
+    io.py:347; C++ analog src/io/iter_prefetcher.h).
 
-    def __init__(self, iters, rename_data=None, rename_label=None):
+    ``device_feed`` (None = follow MXNET_DEVICE_FEED, default on)
+    additionally ``device_put``s each prefetched batch inside the
+    prefetch thread, so the host->HBM transfer of the NEXT batch
+    overlaps the running step instead of blocking it — the reference
+    prefetcher only double-buffered host memory."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 device_feed=None):
         super().__init__()
         if not isinstance(iters, list):
             iters = [iters]
@@ -342,6 +349,11 @@ class PrefetchingIter(DataIter):
         self.iters = iters
         self.rename_data = rename_data
         self.rename_label = rename_label
+        if device_feed is None:
+            from .device_feed import device_feed_enabled
+
+            device_feed = device_feed_enabled()
+        self._device_feed = bool(device_feed)
         self.batch_size = self.provide_data[0][1][0]
         self.data_ready = [threading.Event() for _ in range(self.n_iter)]
         self.data_taken = [threading.Event() for _ in range(self.n_iter)]
@@ -357,7 +369,12 @@ class PrefetchingIter(DataIter):
                 if not self.started:
                     break
                 try:
-                    self.next_batch[i] = self.iters[i].next()
+                    batch = self.iters[i].next()
+                    if self._device_feed:
+                        from .device_feed import as_device_batch
+
+                        batch = as_device_batch(batch)
+                    self.next_batch[i] = batch
                 except StopIteration:
                     self.next_batch[i] = None
                 self.data_taken[i].clear()

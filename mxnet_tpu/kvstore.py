@@ -388,11 +388,19 @@ class DistKVStore(KVStore):
         """Run a PS operation with shard-restart recovery: a restarted
         shard (launch.py --max-restarts) comes back EMPTY, so the first
         op against it gets 'uninitialized key' — every worker then
-        refills from its own last-known value (rank-0's refill wins on
-        the server, the init contract) and retries.  The round counters
-        on the fresh shard start at zero, so sync pulls resume
-        consistently; the round in flight at the crash is lost — the
-        same loss the reference takes without a server checkpoint."""
+        refills from its own last-known value and retries.  Refills are
+        deliberately FIRST-WINS set-if-absent on the server (both the
+        python and native shards, _ps.py _handle/init): unlike a fresh
+        ``init``, where rank-0's value is authoritative, a refill can
+        arrive AFTER another worker's refill has already absorbed new
+        pushes on the recovered shard, and a late rank-0 overwrite
+        would silently discard those updates.  Workers' last-known
+        values differ by at most the lost in-flight round, so whichever
+        refill lands first is an equally valid restart point.  The
+        round counters on the fresh shard start at zero, so sync pulls
+        resume consistently; the round in flight at the crash is lost —
+        the same loss the reference takes without a server
+        checkpoint."""
         try:
             return fn()
         except MXNetError as e:

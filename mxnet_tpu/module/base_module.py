@@ -134,6 +134,39 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
+        # async device feed (MXNET_DEVICE_FEED, default on): host batch
+        # assembly + the H2D transfer of the NEXT batch overlap the
+        # running step; batches arrive device-committed (mesh-sharded
+        # under a data mesh), so forward()'s own device_put is a no-op.
+        # fit OWNS the wrapper it creates: it must be closed on the way
+        # out or its producer keeps pulling from the caller's iterator
+        # and races whatever consumes it next (predict/score).
+        from ..io.device_feed import DeviceFeedIter, device_feed_enabled
+
+        owned_feed = None
+        if device_feed_enabled() and \
+                not isinstance(train_data, DeviceFeedIter):
+            train_data = owned_feed = DeviceFeedIter(
+                train_data, mesh=getattr(self, "_mesh", None))
+        try:
+            self._fit_epochs(
+                train_data, eval_data, eval_metric, validation_metric,
+                begin_epoch, num_epoch, monitor, batch_end_callback,
+                epoch_end_callback, eval_end_callback,
+                eval_batch_end_callback)
+        finally:
+            if owned_feed is not None:
+                owned_feed.close()
+                # restore the caller's end-of-fit contract: the source
+                # iterator comes back reset, not part-consumed by the
+                # producer's final read-ahead
+                if hasattr(owned_feed.base, "reset"):
+                    owned_feed.base.reset()
+
+    def _fit_epochs(self, train_data, eval_data, eval_metric,
+                    validation_metric, begin_epoch, num_epoch, monitor,
+                    batch_end_callback, epoch_end_callback,
+                    eval_end_callback, eval_batch_end_callback):
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()

@@ -67,10 +67,15 @@ def _dimnums(nd, channel_last=False):
 
 def _conv1x1_dot(data, weight, stride, cl):
     """Channel-last 1x1 conv as a dot_general over the channel dim.
-    data [N, *sp, Ci], weight [Co, *(1,)*nd, Ci] -> [N, *sp', Co]."""
-    from ..config import get_env
+    data [N, *sp, Ci], weight [Co, *(1,)*nd, Ci] -> [N, *sp', Co].
 
-    if not cl or not get_env("MXNET_CONV_1X1_DOT"):
+    The lowering choice is an autotune variant ("conv1x1_dot"): the
+    in-step tuner forces it while racing, a cached winner applies via
+    the jit entry points' program_scope, and an explicitly-set
+    MXNET_CONV_1X1_DOT overrides both (autotune.variant_choice)."""
+    from ..autotune import variant_choice
+
+    if not cl or not variant_choice("conv1x1_dot", default=False):
         return None
     nd = data.ndim - 2
     if any(s != 1 for s in stride):

@@ -257,9 +257,26 @@ def _target_is_tpu(x):
 
 
 def _use_pallas(x):
-    if os.environ.get("MXNET_PALLAS", "1") == "0":
+    env = os.environ.get("MXNET_PALLAS")  # None = unset (default on)
+    if env == "0":
         return False
-    return _HAVE_PALLAS and (_target_is_tpu(x) or _INTERPRET)
+    if not _HAVE_PALLAS:
+        return False
+    feasible = _target_is_tpu(x) or _INTERPRET
+    if env == "1":
+        # EXPLICITLY set: the user's hand override beats any cached
+        # autotune winner (the same precedence MXNET_CONV_1X1_DOT gets)
+        return feasible
+    # autotune variant "pallas_bnreluconv": a tuner race or a cached
+    # per-program winner overrides the platform heuristic (the r05
+    # lesson — isolated kernel wins can be in-step losses, so the
+    # kernel-vs-XLA call is owned by in-step timing where available)
+    from ..autotune import variant_choice
+
+    choice = variant_choice("pallas_bnreluconv")
+    if choice is not None:
+        return bool(choice) and feasible
+    return feasible
 
 
 # ------------------------------------------------------------ composite

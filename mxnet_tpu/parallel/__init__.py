@@ -35,8 +35,22 @@ from ..base import MXNetError
 __all__ = ["get_mesh", "functionalize", "make_train_step",
            "DataParallelTrainer", "Mesh", "NamedSharding", "P",
            "NORM_STAT_SUFFIXES", "amp_cast_params", "auto_tp_spec",
-           "ring", "pipeline", "moe",
+           "ring", "pipeline", "moe", "compat_shard_map",
            "make_predict_fn", "tune_microbatch"]
+
+
+def compat_shard_map(f, mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` with one signature across jax releases: it
+    graduated from ``jax.experimental.shard_map`` (kwarg ``check_rep``)
+    to top-level ``jax.shard_map`` (kwarg ``check_vma``) — 0.4.x wheels
+    only carry the former."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
 
 #: parameter-name suffixes that stay fp32 under mixed precision (the AMP
 #: policy the reference encodes in contrib/amp/lists: norm affine+stats)
@@ -179,7 +193,8 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
                     momentum=0.9, wd=0.0, beta1=0.9, beta2=0.999,
                     epsilon=1e-8, mesh=None, data_axis="data",
                     param_spec=None, donate=True, compute_dtype=None,
-                    loss_scale=None, **opt_kwargs):
+                    loss_scale=None, sample_data=None, autotune=None,
+                    variant_ops=("conv1x1_dot",), **opt_kwargs):
     """Build ONE fully-fused jitted SPMD train step.
 
     Returns (step_fn, params, opt_state) where
@@ -211,7 +226,20 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
     returned ones), donation just makes XLA exploit that.  Pass
     donate=False to keep calling with the same buffers (step-parity
     tests do).
+
+    sample_data=(x, y): enables the in-step variant autotuner
+    (mxnet_tpu.autotune, the cudnn_tune analog): each op in
+    ``variant_ops`` races inside a jitted chained run of THIS step on
+    the sample batch, the winner persists keyed on (op, batch shape,
+    dtype, platform, mesh), and the returned step traces under it.
+    On a warm cache the race is skipped (pure lookups).  autotune=None
+    follows MXNET_AUTOTUNE; autotune=False disables for this step.
+    Without sample_data no timing runs, but cached winners still apply
+    to the returned step via the program scope.  In-step timing is
+    single-device for now: under a mesh, sample_data warns and is
+    ignored (mesh-keyed cached winners still apply).
     """
+    from .. import autotune as _at
     from ..config import setup_compilation_cache
 
     setup_compilation_cache()
@@ -313,6 +341,42 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
         new_p, new_s = _apply_updates(params_, opt_state_, grads, t, key)
         return loss, new_p, new_s
 
+    # ---- in-step variant autotuning (mxnet_tpu.autotune) -------------
+    mesh_d = _at.mesh_desc(mesh)
+    try:
+        plat = jax.local_devices()[0].platform
+    except Exception:
+        plat = None
+    _tune_level = None if autotune is None else int(autotune)
+    if sample_data is not None and _at.enabled(_tune_level):
+        if mesh is None:
+            xs, ys = sample_data
+            _at.tune_train_step(
+                step, params, opt_state, jnp.asarray(xs),
+                jnp.asarray(ys), jax.random.key(0),
+                variant_ops=variant_ops, platform=plat, mesh=mesh_d,
+                level=_tune_level)
+        else:
+            # in-step timing under a mesh needs sharded sample state
+            # (not built yet at this point) — be loud, not silent:
+            # cached winners recorded for this mesh key still apply
+            import warnings
+
+            warnings.warn(
+                "make_train_step: in-step autotuning under a mesh is "
+                "not yet supported; sample_data ignored (cached "
+                "winners for this mesh key still apply)", stacklevel=2)
+
+    def _scoped_step(params_, opt_state_, x, y, key, t):
+        # cached winners for this program signature apply at TRACE time
+        # (the scope is entered on every call; only the first traces);
+        # autotune=False opts this step out entirely
+        if not _at.enabled(_tune_level):
+            return step(params_, opt_state_, x, y, key, t)
+        with _at.program_scope(x.shape, x.dtype, platform=plat,
+                               mesh=mesh_d):
+            return step(params_, opt_state_, x, y, key, t)
+
     donate_argnums = (0, 1) if donate else ()
     if donate:
         # device_put of an already-committed array aliases it, so the
@@ -340,7 +404,7 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
                 for n in opt_state
             }
         step_fn = jax.jit(
-            step,
+            _scoped_step,
             in_shardings=(p_shard, opt_shard, batch_sharding,
                           batch_sharding, None, None),
             out_shardings=(None, p_shard, opt_shard),
@@ -349,7 +413,7 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
         params = jax.device_put(params, p_shard)
         opt_state = jax.device_put(opt_state, opt_shard)
     else:
-        step_fn = jax.jit(step, donate_argnums=donate_argnums,
+        step_fn = jax.jit(_scoped_step, donate_argnums=donate_argnums,
                           static_argnums=())
     return step_fn, params, opt_state
 

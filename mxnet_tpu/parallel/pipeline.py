@@ -53,7 +53,7 @@ def stack_stage_params(param_dicts):
 
 
 def pipeline_apply_sharded(stage_fn, params, x, axis_name,
-                           n_microbatches):
+                           n_microbatches, axis_size=None):
     """Per-device GPipe body (call inside shard_map).
 
     params: this device's stage parameters with a leading local-stage
@@ -61,8 +61,13 @@ def pipeline_apply_sharded(stage_fn, params, x, axis_name,
     x: the FULL batch (replicated across the pipe axis); reshaped to
     (M, mb, ...) microbatches internally.  Returns the full output
     batch, replicated (psum-masked from the last stage).
+
+    axis_size: static pipe depth; required on jax 0.4.x, where
+    ``lax.axis_size`` does not exist (the tick count and permutation
+    table must be static).
     """
-    n = lax.axis_size(axis_name)
+    n = int(axis_size) if axis_size is not None \
+        else lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     p = jax.tree_util.tree_map(lambda a: a[0], params)
 
@@ -107,7 +112,7 @@ def pipeline_apply_sharded(stage_fn, params, x, axis_name,
 @functools.lru_cache(maxsize=64)
 def _build_pipeline_fn(stage_fn, mesh, axis_name, n_microbatches,
                        treedef, leaf_ndims, x_ndim):
-    from jax import shard_map
+    from . import compat_shard_map
 
     param_spec = treedef.unflatten(
         [P(axis_name, *([None] * (nd - 1))) for nd in leaf_ndims])
@@ -115,11 +120,12 @@ def _build_pipeline_fn(stage_fn, mesh, axis_name, n_microbatches,
 
     def body(params, x):
         return pipeline_apply_sharded(stage_fn, params, x, axis_name,
-                                      n_microbatches)
+                                      n_microbatches,
+                                      axis_size=mesh.shape[axis_name])
 
-    mapped = shard_map(body, mesh=mesh,
-                       in_specs=(param_spec, x_spec),
-                       out_specs=x_spec, check_vma=False)
+    mapped = compat_shard_map(body, mesh=mesh,
+                              in_specs=(param_spec, x_spec),
+                              out_specs=x_spec, check_vma=False)
     return jax.jit(mapped)
 
 

@@ -29,17 +29,22 @@ __all__ = ["ring_attention", "ring_attention_sharded"]
 
 
 def ring_attention_sharded(q, k, v, axis_name, causal=False,
-                           sm_scale=None):
+                           sm_scale=None, axis_size=None):
     """Per-device ring attention body (call inside shard_map).
 
     q, k, v: (batch, heads, seq_local, d) local shards; the sequence
     axis is sharded over ``axis_name``.  Returns the local output
     shard.  Exact: the K/V ring rotation + online softmax reproduces
     full softmax(QK^T)V.
+
+    axis_size: static ring length; required on jax 0.4.x, where
+    ``lax.axis_size`` does not exist (the scan length and permutation
+    table below must be static, so a traced psum-of-1 cannot stand in).
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
-    n = lax.axis_size(axis_name)
+    n = int(axis_size) if axis_size is not None \
+        else lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape
 
@@ -84,12 +89,13 @@ def ring_attention_sharded(q, k, v, axis_name, causal=False,
 
 @functools.lru_cache(maxsize=64)
 def _build_ring_fn(mesh, axis_name, causal, sm_scale):
-    from jax import shard_map
+    from . import compat_shard_map
 
     spec = P(None, None, axis_name, None)
     fn = functools.partial(ring_attention_sharded, axis_name=axis_name,
-                           causal=causal, sm_scale=sm_scale)
-    mapped = shard_map(
+                           causal=causal, sm_scale=sm_scale,
+                           axis_size=mesh.shape[axis_name])
+    mapped = compat_shard_map(
         lambda q_, k_, v_: fn(q_, k_, v_),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)

@@ -307,40 +307,63 @@ class Executor:
         key = _rng.take_key()
         n_out = self._symbol.num_outputs
 
-        if is_train and any(r != "null" for r in self._grad_req.values()):
-            fn = entry["fn"]
+        # trace-platform hint + autotuned variant winners for this
+        # program's input signature (the cudnn algo registry consulted
+        # at GraphExecutor bind/forward) — active while the jitted
+        # graph traces
+        from .. import autotune as _at
+        from ..ops import pallas_conv as _pc
 
-            def _f(avals):
-                return fn(avals, don_vals, rest_vals, key)
+        plat = _pc.platform_of(arg_vals) or _pc.platform_of(
+            don_vals + rest_vals)
+        _hint_prev = _pc.set_trace_platform(plat)
+        _scope = _at.program_scope(
+            tuple(arg_vals[0].shape) if arg_vals else (),
+            arg_vals[0].dtype if arg_vals else "none", platform=plat)
+        _scope.__enter__()
+        try:
+            if is_train and any(r != "null"
+                                for r in self._grad_req.values()):
+                fn = entry["fn"]
 
-            outs, vjp_fn = jax.vjp(_f, arg_vals)
-            self._vjp_fn = vjp_fn
-            self._out_avals = [(tuple(map(int, o.shape)), o.dtype)
-                               for o in outs]
-            # grouped executors: remember where each output lives so
-            # backward can seed cotangents on the matching device
-            self._out_devices = [
-                next(iter(o.devices())) if self._placement is not None
-                and hasattr(o, "devices") else None for o in outs]
-            self._n_primary = n_out
-        else:
-            fn_d = entry["fn_d"]
-            # donation is only legal when (a) the first (non-donating)
-            # trace confirmed every donated buffer really gets a
-            # same-shaped update output to alias, and (b) the donated
-            # buffers are not aliased into the non-donated operands (a
-            # shared NDArray bound as both arg and aux would be
-            # consumed while still referenced)
-            donate = (fn_d is not None and get_env("MXNET_EXEC_DONATE")
-                      and entry["aux_order"] is not None
-                      and set(entry["aux_order"]) == set(don_names)
-                      and not ({id(v) for v in don_vals}
-                               & {id(v) for v in arg_vals + rest_vals}))
-            if donate:
-                outs = fn_d(arg_vals, don_vals, rest_vals, key)
+                def _f(avals):
+                    return fn(avals, don_vals, rest_vals, key)
+
+                outs, vjp_fn = jax.vjp(_f, arg_vals)
+                self._vjp_fn = vjp_fn
+                self._out_avals = [(tuple(map(int, o.shape)), o.dtype)
+                                   for o in outs]
+                # grouped executors: remember where each output lives so
+                # backward can seed cotangents on the matching device
+                self._out_devices = [
+                    next(iter(o.devices()))
+                    if self._placement is not None
+                    and hasattr(o, "devices") else None for o in outs]
+                self._n_primary = n_out
             else:
-                outs = entry["fn"](arg_vals, don_vals, rest_vals, key)
-            self._vjp_fn = None
+                fn_d = entry["fn_d"]
+                # donation is only legal when (a) the first
+                # (non-donating) trace confirmed every donated buffer
+                # really gets a same-shaped update output to alias, and
+                # (b) the donated buffers are not aliased into the
+                # non-donated operands (a shared NDArray bound as both
+                # arg and aux would be consumed while still referenced)
+                donate = (fn_d is not None
+                          and get_env("MXNET_EXEC_DONATE")
+                          and entry["aux_order"] is not None
+                          and set(entry["aux_order"]) == set(don_names)
+                          and not ({id(v) for v in don_vals}
+                                   & {id(v) for v in
+                                      arg_vals + rest_vals}))
+                if donate:
+                    outs = fn_d(arg_vals, don_vals, rest_vals, key)
+                else:
+                    outs = entry["fn"](arg_vals, don_vals, rest_vals,
+                                       key)
+                self._vjp_fn = None
+        finally:
+            _scope.__exit__(None, None, None)
+            _pc.set_trace_platform(_hint_prev)
         # fold BatchNorm moving-stat updates back into aux state
         for name, val in zip(entry["aux_order"] or (), outs[n_out:]):
             self.aux_dict[name]._adopt(val)

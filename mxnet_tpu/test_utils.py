@@ -21,7 +21,23 @@ __all__ = [
     "rand_shape_nd", "rand_ndarray", "random_arrays", "numeric_grad",
     "check_numeric_gradient", "check_symbolic_forward",
     "check_symbolic_backward", "check_consistency", "simple_forward",
+    "enable_x64",
 ]
+
+
+def enable_x64():
+    """Context manager enabling 64-bit jax types, on any jax release:
+    ``jax.enable_x64`` became a top-level context manager only in
+    recent jax; 0.4.x wheels carry the identical manager under
+    ``jax.experimental``.  Used by the f64 reference rungs of the
+    dtype ladder and the FD gradient sweeps."""
+    import jax
+
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(True)
+    from jax.experimental import enable_x64 as _ex64
+
+    return _ex64()
 
 _DEFAULT_RTOL = {
     onp.dtype(onp.float16): 1e-2,
@@ -217,10 +233,24 @@ def check_numeric_gradient(sym_or_fn, location, aux_states=None,
 
         names = sym.list_arguments()
 
+        # ONE reusable no-grad executor for the whole numeric sweep:
+        # the old simple_forward-per-probe re-bound a fresh executor —
+        # a fresh jit cache, so XLA recompiled the graph for EVERY
+        # +-eps evaluation (2 per element; an LSTM-projection FD check
+        # paid ~200 compiles ~= 83 s).  Adopting the perturbed values
+        # into one executor compiles once and replays.
+        eval_exe = sym.bind(
+            ctx, args={k: nd.array(v, ctx=ctx)
+                       for k, v in location.items()},
+            grad_req="null",
+            aux_states={k: nd.array(v, ctx=ctx)
+                        for k, v in (aux_states or {}).items()})
+
         def f(*xs):
-            loc = {k: v for k, v in zip(names, xs)}
-            return simple_forward(sym, ctx=ctx,
-                                  is_train=use_forward_train, **loc)
+            f_outs = eval_exe.forward(is_train=use_forward_train,
+                                      **dict(zip(names, xs)))
+            f_outs = [o.asnumpy() for o in f_outs]
+            return f_outs[0] if len(f_outs) == 1 else f_outs
 
         loc_list = [location[k] for k in names]
         keep_idx = {i for i, k in enumerate(names) if k in grad_nodes}

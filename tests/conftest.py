@@ -24,6 +24,23 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache for the whole suite (the
+# config.setup_compilation_cache semantics, inlined here because
+# mxnet_tpu must not be imported before the platform is forced):
+# identical programs re-bound across tests — executors, jit twins,
+# repeated small MLP graphs — load from disk instead of recompiling,
+# and a re-run of the tier starts warm.  Keyed by HLO hash, so
+# staleness is impossible; /tmp keeps it off the repo.
+_cc_dir = os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                                "/tmp/mxnet_tpu_tier1_xla_cache")
+jax.config.update("jax_compilation_cache_dir", _cc_dir)
+for _opt, _val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                   ("jax_persistent_cache_min_entry_size_bytes", -1)):
+    try:
+        jax.config.update(_opt, _val)
+    except (AttributeError, KeyError):
+        pass
+
 import numpy as onp
 import pytest
 

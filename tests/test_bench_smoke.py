@@ -49,9 +49,23 @@ def test_smoke_emits_valid_json_with_heartbeats():
     # the conv 1x1 A/B ran both arms
     ab = out["conv_1x1_ab"]
     assert ab["conv"] > 0 and ab["dot"] > 0 and "dot_speedup" in ab
+    # the in-step autotuner ran (or reloaded) the conv1x1 race and
+    # reported it
+    tune = out["autotune"]
+    assert tune["conv1x1_dot"]["winner"] in ("conv", "dot")
+    assert set(tune["conv1x1_dot"]["timings"]) == {"conv", "dot"}
+    # the device-feed phase measured real steps both ways and reported
+    # the per-phase feed/compute overlap
+    feed = out["device_feed"]
+    assert feed["batches"] > 0
+    assert feed["blocking_ms_per_step"] > 0
+    assert feed["feed_ms_per_step"] > 0
+    assert "feed_wait_ms_per_step" in feed
+    assert "overlap_frac" in feed
     # a heartbeat per phase, so a hang is attributable
-    for phase in ("import", "device_init", "build", "compile", "K1",
-                  "K2", "trials", "conv_ab", "done"):
+    for phase in ("import", "device_init", "build", "autotune",
+                  "compile", "K1", "K2", "trials", "feed", "conv_ab",
+                  "done"):
         assert f"phase={phase}" in r.stderr, f"missing phase {phase}"
 
 

@@ -40,10 +40,10 @@ def test_syncbn_no_mesh_matches_batchnorm():
 def test_syncbn_mesh_stats_reduce_over_devices():
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from mxnet_tpu.ops.contrib_tail import sync_batch_norm
+    from mxnet_tpu.parallel import compat_shard_map as shard_map
 
     onp.random.seed(1)
     devs = jax.devices()

@@ -18,7 +18,7 @@ TOLS = {"float32": (1e-4, 1e-5), "bfloat16": (5e-2, 5e-2)}
 
 def _ladder(build, arg_shapes, scale=1.0, aux_ones=()):
     """Run the symbol across the dtype ladder and compare to f64."""
-    import jax
+    from mxnet_tpu.test_utils import enable_x64 as _enable_x64
 
     rng = onp.random.RandomState(0)
     s = build()
@@ -28,7 +28,7 @@ def _ladder(build, arg_shapes, scale=1.0, aux_ones=()):
     outs = {}
     # x64 must be live or the float64 rung silently truncates to f32
     # and the ladder compares f32 against itself
-    with jax.enable_x64(True):
+    with _enable_x64():
         for dtype in ("float64", "float32", "bfloat16"):
             args = {k: mx.nd.array(v.astype("float32")).astype(dtype)
                     for k, v in args64.items()}

@@ -111,6 +111,37 @@ F32_OPS = {
 _CURATED = None
 
 
+def _grad_shapes():
+    """Sweep-only input overrides for ops whose opperf/auto shapes are
+    benchmark-scale: an FD identity verifies the MATH, not throughput,
+    and the x64 sweep pays real compute for oversized probes.  The
+    worst offenders ran 19-36 s EACH at probe shapes (auto-probed
+    128x128 kron/outer/diagflat materialize 16384^2 f64 outputs; the
+    opperf Convolution spec is a benchmark shape) — together over 40%
+    of the whole sweep's runtime."""
+    r = onp.random.RandomState(7)
+
+    def f32(*s):
+        return r.rand(*s).astype("float32")
+
+    return {
+        "_npi_kron": ([f32(4, 5), f32(3, 4)], {}),
+        "_npi_outer": ([f32(12), f32(9)], {}),
+        "_npi_diagflat": ([f32(11)], {}),
+        "Convolution": ([f32(2, 4, 8, 8), f32(8, 4, 3, 3),
+                         onp.zeros(8, "float32")],
+                        dict(kernel=(3, 3), num_filter=8, pad=(1, 1))),
+        "DeformableConvolution": (
+            [f32(1, 4, 8, 8), onp.zeros((1, 18, 8, 8), "float32"),
+             f32(8, 4, 3, 3)],
+            dict(kernel=(3, 3), num_filter=8, pad=(1, 1),
+                 no_bias=True)),
+    }
+
+
+_GRAD_SHAPES = _grad_shapes()
+
+
 def _curated():
     global _CURATED
     if _CURATED is None:
@@ -119,6 +150,8 @@ def _curated():
 
 
 def _spec_for(name):
+    if name in _GRAD_SHAPES:
+        return _GRAD_SHAPES[name]
     cur = _curated()
     if name in cur:
         return cur[name]
@@ -178,8 +211,10 @@ def test_directional_gradient(name):
     import jax
     import jax.numpy as jnp
 
+    from mxnet_tpu.test_utils import enable_x64
+
     spec = _spec_for(name)
-    with jax.enable_x64(True):
+    with enable_x64():
         _run_directional(name, spec, jax, jnp)
 
 
@@ -223,12 +258,18 @@ def _run_directional(name, spec, jax, jnp):
         return v
 
     fvals = [prep(vals[i]) for i in fidx]
+    # jit the probe loss once per op: the sweep evaluates f ~(3 + 2 per
+    # input) times, and x64 EAGER dispatch dominated the old runtime
+    # (conv-sized ops ran seconds per eval; the jitted program runs in
+    # ms after one compile).  Every differentiable op here is traceable
+    # by construction — jax.grad already traces it.
+    f = jax.jit(f)
     base = f(*fvals)
     if base is None:
         CHECKED.add(name)
         pytest.skip("no floating outputs")
-    grads = jax.grad(lambda *fv: f(*fv), argnums=tuple(range(len(fidx))))(
-        *fvals)
+    grads = jax.jit(jax.grad(lambda *fv: f(*fv),
+                             argnums=tuple(range(len(fidx)))))(*fvals)
     import zlib
 
     rng = onp.random.RandomState(zlib.crc32(name.encode()) % (2**31))
@@ -256,9 +297,31 @@ def _run_directional(name, spec, jax, jnp):
             # (near-)orthogonal to the gradient, nothing to compare
             checked_any = True
             continue
-        assert abs(fd - an) / scale < tol, (
-            f"{name} input {gi}: finite-diff {fd:.6g} vs autodiff "
-            f"{an:.6g}")
+        if abs(fd - an) / scale >= tol:
+            # Disagreement: a real VJP bug, or an FD probe drowned in
+            # roundoff?  f32_mode losses reduce cos() over up to ~1e5
+            # elements, so each f() evaluation carries accumulation
+            # noise of many ulps of |f|~1 and fd inherits noise/(2*eps)
+            # — ~1e-4..1e-3 absolute, backend-dependent (the r05
+            # SyncBatchNorm "7.6% gap" on moving_mean was exactly this:
+            # the op's inference path has no custom VJP to be wrong,
+            # and the mismatch scaled with the reduce order, not the
+            # math).  Re-probe at 2*eps: roundoff noise halves while a
+            # true directional derivative is stable, so probe noise
+            # shows up as fd scatter and a genuine gradient bug does
+            # not (fd and fd2 agree with each other, not with an).
+            args_p2 = [fv if k != gi else fv + 2 * eps * d
+                       for k, fv in enumerate(fvals)]
+            args_m2 = [fv if k != gi else fv - 2 * eps * d
+                       for k, fv in enumerate(fvals)]
+            fd2 = float((f(*args_p2) - f(*args_m2)) / (4 * eps))
+            if abs(fd - fd2) > 0.5 * abs(fd - an):
+                # FD cannot resolve this direction at this precision
+                checked_any = True
+                continue
+            raise AssertionError(
+                f"{name} input {gi}: finite-diff {fd:.6g} (at 2*eps: "
+                f"{fd2:.6g}, stable) vs autodiff {an:.6g}")
         checked_any = True
     if not checked_any:
         pytest.skip("no non-degenerate direction")

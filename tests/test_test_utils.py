@@ -152,13 +152,16 @@ SPECS = {
                         + onp.eye(4, dtype="float32").reshape(2, 2, 2, 2)],
                        dict(ind=2)),
     "_npi_tensorsolve": ([_spd(4).reshape(2, 2, 2, 2), _n((2, 2))], {}),
-    "ROIPooling": ([_u((1, 2, 6, 6)),
+    # per-element FD costs 2 evals/element: these three ran 36 s
+    # combined at their old benchmark-ish shapes; the VJP under test is
+    # identical at probe scale
+    "ROIPooling": ([_u((1, 1, 5, 5)),
                     onp.array([[0, 1, 1, 4, 4]], dtype="float32")],
                    dict(pooled_size=(2, 2), spatial_scale=1.0), [0]),
-    "_contrib_dot_product_attention": ([_n((2, 8, 16)), _n((2, 8, 16)),
-                                        _n((2, 8, 16))],
+    "_contrib_dot_product_attention": ([_n((2, 4, 8)), _n((2, 4, 8)),
+                                        _n((2, 4, 8))],
                                        dict(num_heads=2)),
-    "_contrib_ROIAlign": ([_u((1, 2, 6, 6)),
+    "_contrib_ROIAlign": ([_u((1, 1, 5, 5)),
                            onp.array([[0, 1, 1, 4, 4]],
                                      dtype="float32")],
                           dict(pooled_size=(2, 2), spatial_scale=1.0),
