@@ -52,7 +52,13 @@ the round its headline artifact):
   ``"autotune"`` in the JSON (``--no-autotune`` skips);
 * the async device feed A/B (``"device_feed"`` in the JSON) runs real
   steps fed blocking vs through io.DeviceFeedIter and reports the
-  per-phase feed/compute overlap.
+  per-phase feed/compute overlap;
+* ``--checkpoint PREFIX`` writes timed atomic checkpoints
+  (resilience.checkpoint) after the measure and feed phases — write
+  cost lands under ``"checkpoint": {"write_s": ...}`` in the JSON
+  (smoke mode always exercises the writer); ``--resume-from PREFIX``
+  restores params/opt state from a verified checkpoint before
+  measuring and records ``"resumed": true``.
 
 Also reported: achieved TFLOP/s from ``compiled.cost_analysis()`` and
 MFU relative to the chip's bf16 matmul peak measured in-process by a
@@ -349,6 +355,53 @@ def _measure_feed(step_fn, params, opt_state, x, y, key, smoke,
     return report, params, opt_state
 
 
+def _ckpt_save(prefix, epoch, params, opt_state):
+    """Atomic checkpoint of the trained params/opt state
+    (resilience.checkpoint); returns the timed write duration so the
+    JSON records checkpoint cost per phase."""
+    import pickle
+
+    import numpy as onp
+
+    import jax
+    from mxnet_tpu.resilience.checkpoint import CheckpointManager
+
+    arg = {k: onp.asarray(v) for k, v in params.items()}
+    states = pickle.dumps(jax.tree_util.tree_map(
+        lambda a: onp.asarray(a), opt_state))
+    t0 = time.perf_counter()
+    CheckpointManager(prefix, keep_n=2).save(
+        epoch, arg_params=arg, optimizer_states=states, step=epoch)
+    return time.perf_counter() - t0
+
+
+def _ckpt_resume(prefix, params, opt_state):
+    """Restore params/opt state from a checkpoint prefix (the newest
+    version that verifies); dtypes follow the live params so a bf16
+    run resumes a bf16 run."""
+    import pickle
+
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.resilience.checkpoint import CheckpointManager
+
+    st = CheckpointManager(prefix).load()
+    loaded = st["arg_params"]
+    params = {k: (jnp.asarray(loaded[k].asnumpy(),
+                              getattr(params[k], "dtype", None))
+                  if k in loaded else params[k]) for k in params}
+    if st["optimizer_states"]:
+        opt_state = jax.tree_util.tree_map(
+            jnp.asarray, pickle.loads(st["optimizer_states"]))
+    # jnp.asarray may alias the host numpy buffers (zero-copy on CPU);
+    # the donating step would then free memory it does not own — a
+    # jitted identity materializes fresh XLA-owned buffers, same as
+    # make_train_step's own donate path
+    params = jax.jit(lambda p: p)(params)
+    opt_state = jax.jit(lambda s: s)(opt_state)
+    return params, opt_state, st["epoch"]
+
+
 def _conv_ab(batch, smoke, deadline):
     """Step-level MXNET_CONV_1X1_DOT A/B in NHWC (the flag only lowers
     CHANNEL-LAST 1x1 convs to dot_general — ops/conv.py:60-83).
@@ -396,6 +449,17 @@ def main(argv=None):
                     help="internal wall-clock budget in seconds "
                          "(BENCH_DEADLINE_S; default 1500, smoke 240)")
     ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--checkpoint", default=None,
+                    help="atomic-checkpoint the trained params/opt "
+                         "state to this prefix after the measure and "
+                         "feed phases (write times land under "
+                         "'checkpoint' in the JSON); smoke mode "
+                         "defaults to a temp prefix so CI exercises "
+                         "the writer")
+    ap.add_argument("--resume-from", dest="resume_from", default=None,
+                    help="restore params/opt state from a checkpoint "
+                         "prefix before measuring; the JSON records "
+                         "resumed: true")
     args = ap.parse_args(argv)
 
     default_deadline = 240.0 if args.smoke else 1500.0
@@ -472,6 +536,14 @@ def main(argv=None):
     if deadline.exceeded():
         return bail("deadline exceeded during model build")
 
+    out["resumed"] = False
+    if args.resume_from:
+        _heartbeat("resume", prefix=args.resume_from)
+        params, opt_state, from_epoch = _ckpt_resume(
+            args.resume_from, params, opt_state)
+        out["resumed"] = True
+        out["resumed_from_epoch"] = from_epoch
+
     _heartbeat("compile")
     # static program cost (flops/bytes) for the MFU report; also
     # populates the persistent cache with the single-step program
@@ -493,6 +565,27 @@ def main(argv=None):
     out["degraded"] = m["degraded"]
     reasons.extend(m["reasons"])
     dt = m["ms_per_step"] / 1e3
+
+    # per-phase atomic checkpoint writes (--checkpoint; smoke always):
+    # write-time is a first-class cost for elastic training, so it
+    # lands in the JSON next to the throughput it taxes
+    ckpt_prefix = args.checkpoint
+    ckpt_tmpdir = None
+    if args.smoke and ckpt_prefix is None:
+        import tempfile
+
+        ckpt_tmpdir = tempfile.mkdtemp(prefix="mxnet_tpu_bench_ckpt_")
+        ckpt_prefix = os.path.join(ckpt_tmpdir, "bench")
+    ckpt_times = {}
+    if ckpt_prefix:
+        _heartbeat("checkpoint", after="measure")
+        try:
+            ckpt_times["measure"] = round(
+                _ckpt_save(ckpt_prefix, 1, params, opt_state), 4)
+        except Exception as exc:  # auxiliary: never kill the run
+            ckpt_times["measure"] = None
+            out["degraded"] = True
+            reasons.append(f"checkpoint (measure) failed: {exc!r}")
 
     peak = None  # smoke: no matmul-peak probe on CPU (mfu is null)
     if args.smoke:
@@ -540,6 +633,30 @@ def main(argv=None):
             out["device_feed"] = {"error": repr(exc)}
             out["degraded"] = True
             reasons.append(f"device-feed phase failed: {exc!r}")
+
+    if ckpt_prefix:
+        _heartbeat("checkpoint", after="feed")
+        try:
+            ckpt_times["feed"] = round(
+                _ckpt_save(ckpt_prefix, 2, params, opt_state), 4)
+            from mxnet_tpu.resilience.checkpoint import CheckpointManager
+
+            verified = CheckpointManager(ckpt_prefix).latest_epoch()
+            out["checkpoint"] = {"prefix": ckpt_prefix,
+                                 "write_s": ckpt_times,
+                                 "verified": verified is not None}
+        except Exception as exc:
+            out["checkpoint"] = {"prefix": ckpt_prefix,
+                                 "write_s": ckpt_times,
+                                 "error": repr(exc)}
+            out["degraded"] = True
+            reasons.append(f"checkpoint (feed) failed: {exc!r}")
+        if ckpt_tmpdir:
+            # the smoke default wrote to a private tempdir — repeated
+            # CI runs must not accumulate checkpoint garbage
+            import shutil
+
+            shutil.rmtree(ckpt_tmpdir, ignore_errors=True)
 
     if args.conv_ab or args.smoke:
         # the A/B costs roughly two more build+compile+measure passes

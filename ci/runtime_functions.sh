@@ -39,6 +39,16 @@ sanity_bench_smoke() {
     python bench.py --smoke
 }
 
+resilience_smoke() {
+    # the fault-spec suite on CPU in seconds: atomic-checkpoint crash
+    # safety (injected ckpt.write:crash), SIGTERM drain + bit-exact
+    # resume_from, NaN-guard skip/abort/restore, PS client retry with
+    # backoff + MXNET_PS_DEADLINE_SEC, DeviceFeedIter close/join
+    # bounds.  Also collected by tier-1, so a regression turns the
+    # unit suite red between CI runs.
+    JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q
+}
+
 opperf_smoke() {
     # per-op benchmark smoke on CPU: a representative slice of the
     # curated tables — including the r05 per-op input registries

@@ -41,8 +41,19 @@ import time
 import numpy as onp
 
 from .base import MXNetError
+from .resilience import faultsim
+from .resilience.retry import retry_call
 
 _LEN = struct.Struct("!Q")
+
+
+def _deadline_sec():
+    """Skew/readiness wait budget (was four hard-coded 600 s
+    constants): MXNET_PS_DEADLINE_SEC, read per-wait so tests can
+    lower it at runtime."""
+    from .config import get_env
+
+    return float(get_env("MXNET_PS_DEADLINE_SEC"))
 
 
 def _send_msg(sock, obj):
@@ -233,7 +244,7 @@ class _ServerShard(threading.Thread):
                     # arrive on their own connections and complete the
                     # round)
                     prev = self.pushed_rounds.get((key, sender), 0)
-                    skew_deadline = time.monotonic() + 600.0
+                    skew_deadline = time.monotonic() + _deadline_sec()
                     while prev > self.completed_rounds.get(key, 0):
                         left = skew_deadline - time.monotonic()
                         if left <= 0:
@@ -287,7 +298,7 @@ class _ServerShard(threading.Thread):
                     onp.add.at(self.values[key], rows, vals)
                 else:
                     prev = self.pushed_rounds.get((key, sender), 0)
-                    skew_deadline = time.monotonic() + 600.0
+                    skew_deadline = time.monotonic() + _deadline_sec()
                     while prev > self.completed_rounds.get(key, 0):
                         left = skew_deadline - time.monotonic()
                         if left <= 0:
@@ -324,7 +335,7 @@ class _ServerShard(threading.Thread):
             # rowlen is only needed by the flat-storage native shard
             _, key, rows, sender, _rowlen = msg
             rows = onp.asarray(rows, onp.int64)
-            deadline = time.monotonic() + 600.0
+            deadline = time.monotonic() + _deadline_sec()
             with self._cv:
                 def ready():
                     if key not in self.values:
@@ -342,7 +353,7 @@ class _ServerShard(threading.Thread):
                 return ("val", out)
         if op == "pull":
             _, key, sender = msg
-            deadline = time.monotonic() + 600.0
+            deadline = time.monotonic() + _deadline_sec()
             with self._cv:
                 # wait for init, and for every round THIS worker pushed
                 # to be merged (round-aware: other workers may already
@@ -744,20 +755,42 @@ class PSBackend:
             raise ConnectionError(f"ps: malformed response {resp[:1]}")
         return None
 
+    #: injection points on the client ops (resilience.faultsim):
+    #: armed `raise` faults are retried like real transport errors, so
+    #: the backoff path is exercised end-to-end
+    _FAULT_POINTS = {"push": "ps.push", "spush": "ps.push",
+                     "pull": "ps.pull", "spull": "ps.pull"}
+
     def _request(self, r, msg):
-        try:
+        point = self._FAULT_POINTS.get(msg[0])
+
+        def once():
+            if point is not None:
+                faultsim.inject(point)
             return self._do_request(r, msg)
+
+        def on_retry(attempt, exc):
+            # TRANSIENT transport failure: drop + redial the same
+            # address (a dropped TCP conn on a healthy shard must not
+            # stall in the epoch wait below); injected faults keep
+            # their connection.
+            if not isinstance(exc, faultsim.FaultInjected):
+                self._drop_conn(r)
+
+        try:
+            # bounded exponential backoff with jitter: at-least-once
+            # delivery — an applied-but-unacked push may repeat, the
+            # same window ps-lite's resend has
+            return retry_call(
+                once,
+                retry_on=(ConnectionError, EOFError, OSError,
+                          faultsim.FaultInjected),
+                attempts=3, base_delay=0.05, max_delay=1.0,
+                deadline=time.monotonic() + _deadline_sec(),
+                on_retry=on_retry)
+        except faultsim.FaultInjected:
+            raise  # exhausted injected faults stay injected faults
         except (ConnectionError, EOFError, OSError):
-            # TRANSIENT failure first: redial the same address (a
-            # dropped TCP conn on a healthy shard must not stall in
-            # the epoch wait below).  At-least-once delivery: an
-            # applied-but-unacked push may repeat — the same window
-            # ps-lite's resend has.
-            self._drop_conn(r)
-            try:
-                return self._do_request(r, msg)
-            except (ConnectionError, EOFError, OSError):
-                pass
             # still dead: wait for a restarted incarnation to register
             # under the next address epoch, then retry once more
             self._drop_conn(r)

@@ -9,27 +9,35 @@ __all__ = ["module_checkpoint", "do_checkpoint", "log_train_metric",
            "Speedometer", "ProgressBar", "LogValidationMetricsCallback"]
 
 
-def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
-    """Checkpoint the Module at the end of every `period` epochs."""
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False,
+                      keep_n=None):
+    """Checkpoint the Module at the end of every `period` epochs.
+
+    Writes route through the atomic versioned writer
+    (resilience.checkpoint): rename-atomic payloads, CRC manifest,
+    `latest` pointer.  ``keep_n`` prunes older versions (None keeps
+    all, the historical behavior)."""
     period = int(max(1, period))
 
     def _callback(iter_no, sym=None, arg=None, aux=None):
         if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+            mod.save_checkpoint(prefix, iter_no + 1,
+                                save_optimizer_states, keep_n=keep_n)
 
     return _callback
 
 
-def do_checkpoint(prefix, period=1):
+def do_checkpoint(prefix, period=1, keep_n=None):
     """Checkpoint params (+symbol) every `period` epochs (reference
-    callback.py:55)."""
+    callback.py:55), atomically (see ``module_checkpoint``)."""
     from . import model
 
     period = int(max(1, period))
 
     def _callback(iter_no, sym, arg, aux):
         if (iter_no + 1) % period == 0:
-            model.save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+            model.save_checkpoint(prefix, iter_no + 1, sym, arg, aux,
+                                  keep_n=keep_n)
 
     return _callback
 

@@ -133,6 +133,36 @@ register_env("MXNET_EXEC_DONATE", True, bool,
              "moving stats in the CachedOp/Executor jit paths) back to "
              "XLA for in-place reuse — the TPU-native analog of the "
              "reference's static_alloc memory sharing.")
+register_env("MXNET_PS_DEADLINE_SEC", 600.0, float,
+             "Parameter-server wait deadline (seconds) for sync "
+             "round-skew waits and pull/spull readiness waits — was "
+             "four hard-coded 600 s constants in _ps.py.  Lower it so "
+             "fault-injection tests fail in seconds; raise it for "
+             "slow-merge real deployments.")
+register_env("MXNET_FAULT_SPEC", "", str,
+             "Deterministic fault injection spec for "
+             "resilience.faultsim, e.g. "
+             "'ckpt.write:crash@3;ps.push:delay=2.0@7' — "
+             "point:action[=value]@hits clauses armed by per-point "
+             "hit count.  Empty = disarmed (counters only).")
+register_env("MXNET_BAD_STEP_LIMIT", 0, int,
+             "Step-level NaN/Inf guard: >0 arms it — a non-finite "
+             "step is skipped (params/optimizer state held, like "
+             "dynamic loss scaling) and after this many CONSECUTIVE "
+             "bad steps Module.fit restores the last good checkpoint "
+             "and raises a diagnostic error.  0 disables the guard "
+             "(no per-step device sync on the fast path).")
+register_env("MXNET_CKPT_KEEP", 3, int,
+             "Checkpoint versions Module.fit's internal manager "
+             "retains (resilience.checkpoint keep_n); older "
+             "params/states/manifest files are pruned after each "
+             "save.  Explicit CheckpointManager users choose their "
+             "own keep_n (None = keep all).")
+register_env("MXNET_FEED_JOIN_TIMEOUT_SEC", 10.0, float,
+             "Bound on DeviceFeedIter.close()'s producer-thread join: "
+             "a wedged producer is abandoned (daemon) after this many "
+             "seconds so a preemption drain can never hang fit "
+             "teardown.")
 register_env("DMLC_NUM_WORKER", 1, int,
              "Distributed worker count (tools/launch.py contract).")
 register_env("DMLC_WORKER_ID", 0, int, "This worker's rank.")

@@ -51,6 +51,9 @@ def _produce(base, q, stop, stats, sharding, device, n_shards):
     """Producer loop (module-level on purpose: it must not hold a
     reference to the DeviceFeedIter, or an abandoned iterator could
     never be garbage-collected and its finalizer never fire)."""
+    from ..resilience import faultsim
+    from ..resilience.retry import retry_call
+
     try:
         src = iter(base)
         while not stop.is_set():
@@ -60,7 +63,18 @@ def _produce(base, q, stop, stats, sharding, device, n_shards):
                 _q_put(q, stop, _END)
                 return
             t0 = time.perf_counter()
-            out = as_device_batch(item, sharding, device, n_shards)
+
+            def put_batch(it=item):
+                # feed.h2d: the injection point for transfer faults;
+                # transient failures (injected or OS-level) retry with
+                # bounded backoff instead of killing the epoch
+                faultsim.inject("feed.h2d")
+                return as_device_batch(it, sharding, device, n_shards)
+
+            out = retry_call(
+                put_batch,
+                retry_on=(faultsim.FaultInjected, OSError),
+                attempts=3, base_delay=0.02, max_delay=0.5)
             stats["producer_busy_s"] += time.perf_counter() - t0
             if not _q_put(q, stop, out):
                 return
@@ -152,6 +166,7 @@ class DeviceFeedIter(DataIter):
                        "consumer_wait_s": 0.0, "producer_busy_s": 0.0}
         self._thread = None
         self._done = False
+        self._closed = False
         self._start()
 
     # --------------------------------------------------------- producer
@@ -173,15 +188,35 @@ class DeviceFeedIter(DataIter):
         self._finalizer = weakref.finalize(self, self._stop.set)
         self._thread.start()
 
-    def _halt(self):
+    def _halt(self, timeout=None):
+        """Stop the producer with a BOUNDED join: a wedged producer
+        (stuck inside a native H2D call) is abandoned as a daemon
+        after the timeout instead of hanging fit teardown — the stop
+        event keeps it from ever touching the queue again.  Returns
+        True when the thread actually exited."""
+        if self._thread is None:
+            return True
         self._stop.set()
         while True:  # unblock a producer stuck on a full queue
             try:
                 self._q.get_nowait()
             except queue.Empty:
                 break
-        if self._thread is not None:
-            self._thread.join(timeout=10.0)
+        if timeout is None:
+            from ..config import get_env
+
+            timeout = float(get_env("MXNET_FEED_JOIN_TIMEOUT_SEC"))
+        t = self._thread
+        t.join(timeout=timeout)
+        joined = not t.is_alive()
+        if not joined:
+            import logging
+
+            logging.warning(
+                "DeviceFeedIter: producer did not join within %.1fs; "
+                "abandoning daemon thread", timeout)
+        self._thread = None
+        return joined
 
     # --------------------------------------------------------- consumer
     def __iter__(self):
@@ -225,13 +260,23 @@ class DeviceFeedIter(DataIter):
             self._base.reset()
         self._stats["epochs"] += 1
         self._done = False
+        self._closed = False
         self._start()
 
     def close(self):
         """Stop the producer WITHOUT touching the wrapped source.  An
         owner that wrapped someone else's iterator (Module.fit) must
         close before handing the source back — a live producer keeps
-        consuming from it and would race the next consumer."""
+        consuming from it and would race the next consumer.
+
+        Idempotent, and the producer join is bounded
+        (MXNET_FEED_JOIN_TIMEOUT_SEC) so a preemption drain can never
+        hang in teardown; after close(), next() raises StopIteration
+        until reset() revives the wrapper."""
+        if self._closed:
+            return
+        self._closed = True
+        self._done = True
         self._halt()
 
     @property

@@ -288,8 +288,11 @@ class KVStore:
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
             raise MXNetError("updater is not initialized")
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer))
+        # rename-atomic (resilience.checkpoint): a crash mid-write
+        # leaves the previous .states intact, never a torn pickle
+        from .resilience.checkpoint import atomic_write_bytes
+
+        atomic_write_bytes(fname, self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):
         if self._updater is None:

@@ -25,21 +25,35 @@ class BatchEndParam:
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
-                    remove_amp_cast=True):
+                    remove_amp_cast=True, keep_n=None):
     """Save `prefix-symbol.json` + `prefix-NNNN.params` (reference
-    model.py:394)."""
-    if symbol is not None:
-        symbol.save(f"{prefix}-symbol.json")
-    save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
-    save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
-    param_name = f"{prefix}-{epoch:04d}.params"
-    nd.save(param_name, save_dict)
-    logging.info("Saved checkpoint to \"%s\"", param_name)
+    model.py:394), routed through the atomic versioned writer
+    (resilience.checkpoint): write-to-temp + fsync + rename, a CRC32
+    manifest, and a `latest` pointer — a crash mid-write can no longer
+    leave a torn ``.params`` that ``load_checkpoint`` loads blindly.
+    The legacy file layout is unchanged; ``keep_n`` optionally prunes
+    old versions (None keeps all, the historical behavior)."""
+    from .resilience.checkpoint import CheckpointManager
+
+    CheckpointManager(prefix, keep_n=keep_n).save(
+        epoch, symbol=symbol, arg_params=arg_params,
+        aux_params=aux_params)
+    logging.info("Saved checkpoint to \"%s-%04d.params\"", prefix,
+                 epoch)
 
 
 def load_params(prefix, epoch):
-    """(arg_params, aux_params) from a .params file."""
-    save_dict = nd.load(f"{prefix}-{epoch:04d}.params")
+    """(arg_params, aux_params) from a .params file.
+
+    When the checkpoint carries a manifest (every save since the
+    atomic writer landed), the payload is CRC-verified in the SAME
+    read that decodes it: a truncated/corrupt file raises instead of
+    silently loading garbage weights;
+    ``CheckpointManager(prefix).load()`` falls back to the previous
+    good version instead."""
+    from .resilience.checkpoint import CheckpointManager
+
+    save_dict = CheckpointManager(prefix).load_params_dict(epoch)
     arg_params, aux_params = {}, {}
     for k, v in save_dict.items():
         tp, name = k.split(":", 1)

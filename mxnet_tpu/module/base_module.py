@@ -107,13 +107,61 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None):
-        """Full training loop (reference base_module.py:409-538)."""
+            monitor=None, sparse_row_id_fn=None, resume_from=None,
+            checkpoint=None, checkpoint_period=1):
+        """Full training loop (reference base_module.py:409-538).
+
+        Elastic extensions (resilience subsystem):
+
+        * ``checkpoint`` — a prefix (or CheckpointManager) fit
+          checkpoints to: atomically at every ``checkpoint_period``
+          epoch boundary, and mid-epoch on a SIGTERM/SIGINT drain.
+          Retention follows ``MXNET_CKPT_KEEP`` for prefix arguments.
+        * ``resume_from`` — a prefix (or CheckpointManager) to restore
+          from: params, optimizer state, host+device RNG and the
+          epoch/batch cursor all come back, and the data iterator is
+          skipped ahead, so a killed-and-relaunched fit continues
+          bit-exactly (given the same seed and a deterministic
+          iterator).  Overrides ``arg_params``/``begin_epoch``.
+        * a SIGTERM/SIGINT during the epoch loop drains: the in-flight
+          step finishes, a final checkpoint flushes (cursor included),
+          the device-feed producer closes, and the signal is re-raised.
+        * ``MXNET_BAD_STEP_LIMIT`` > 0 arms the step-level NaN/Inf
+          guard: non-finite steps are skipped (update withheld); after
+          that many consecutive bad steps fit restores the last good
+          checkpoint and raises a diagnostic error.
+        """
         assert num_epoch is not None, "please specify number of epochs"
         from .. import initializer as init_mod
+        from ..config import get_env
+        from ..resilience.checkpoint import (CheckpointManager,
+                                             restore_rng)
+        from ..resilience.preempt import PreemptionDrain
 
         if initializer is None:
             initializer = init_mod.Uniform(0.01)
+
+        resume_state = None
+        if resume_from is not None:
+            rmgr = resume_from if isinstance(resume_from,
+                                             CheckpointManager) \
+                else CheckpointManager(str(resume_from))
+            resume_state = rmgr.load()
+            arg_params = resume_state["arg_params"]
+            aux_params = resume_state["aux_params"]
+            begin_epoch = int(resume_state["epoch"])
+            force_init = True
+            allow_missing = False
+            self.logger.info(
+                "Resuming fit from checkpoint epoch %d (batch cursor "
+                "%d)", begin_epoch, resume_state["batch_cursor"])
+
+        ckpt_mgr = None
+        if checkpoint is not None:
+            ckpt_mgr = checkpoint if isinstance(checkpoint,
+                                                CheckpointManager) \
+                else CheckpointManager(str(checkpoint),
+                                       keep_n=get_env("MXNET_CKPT_KEEP"))
 
         self.bind(
             data_shapes=train_data.provide_data,
@@ -129,10 +177,34 @@ class BaseModule:
             kvstore=kvstore, optimizer=optimizer,
             optimizer_params=optimizer_params)
 
+        resume_cursor = 0
+        if resume_state is not None:
+            states = resume_state.get("optimizer_states")
+            if states:
+                set_states = getattr(self, "_set_optimizer_states",
+                                     None)
+                if set_states is not None:
+                    set_states(states)
+            restore_rng(resume_state.get("rng"))
+            resume_cursor = int(resume_state.get("batch_cursor", 0))
+
         if validation_metric is None:
             validation_metric = eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
+
+        if resume_cursor > 0:
+            # mid-epoch resume: skip the batches the interrupted run
+            # already trained on, BEFORE the device-feed wrapper exists
+            # — skipped batches must not pay host assembly + H2D just
+            # to be discarded.  (The iterator must be deterministic for
+            # bit-exact resume: same seed, same order.)
+            skip_iter = iter(train_data)
+            for _ in range(resume_cursor):
+                try:
+                    next(skip_iter)
+                except StopIteration:
+                    break
 
         # async device feed (MXNET_DEVICE_FEED, default on): host batch
         # assembly + the H2D transfer of the NEXT batch overlap the
@@ -148,12 +220,17 @@ class BaseModule:
                 not isinstance(train_data, DeviceFeedIter):
             train_data = owned_feed = DeviceFeedIter(
                 train_data, mesh=getattr(self, "_mesh", None))
+        drain = PreemptionDrain()
         try:
-            self._fit_epochs(
-                train_data, eval_data, eval_metric, validation_metric,
-                begin_epoch, num_epoch, monitor, batch_end_callback,
-                epoch_end_callback, eval_end_callback,
-                eval_batch_end_callback)
+            with drain:
+                self._fit_epochs(
+                    train_data, eval_data, eval_metric,
+                    validation_metric, begin_epoch, num_epoch, monitor,
+                    batch_end_callback, epoch_end_callback,
+                    eval_end_callback, eval_batch_end_callback,
+                    drain=drain, ckpt_mgr=ckpt_mgr,
+                    checkpoint_period=checkpoint_period,
+                    resume_cursor=resume_cursor)
         finally:
             if owned_feed is not None:
                 owned_feed.close()
@@ -162,24 +239,148 @@ class BaseModule:
                 # producer's final read-ahead
                 if hasattr(owned_feed.base, "reset"):
                     owned_feed.base.reset()
+        # drained: the final checkpoint is on disk and the feed is
+        # closed — hand the signal back to its original disposition
+        drain.reraise()
+
+    def _save_fit_checkpoint(self, ckpt_mgr, epoch, batch_cursor):
+        """Flush one atomic checkpoint version of the live module
+        state (params, optimizer state when available, RNG via the
+        manifest).
+
+        Version ids are strictly monotonic — an existing version is
+        NEVER rewritten in place, because per-version atomicity would
+        not survive a crash landing between the params and manifest
+        replaces of an in-place overwrite (the old good version would
+        be gone and the new one would fail CRC).  The manifest's
+        epoch/batch_cursor fields carry the resume truth; the filename
+        number is just a version id (it equals the epoch for clean
+        uninterrupted runs, and shifts past it after a mid-epoch
+        drain)."""
+        arg_p, aux_p = self.get_params()
+        states = None
+        get_states = getattr(self, "_get_optimizer_states", None)
+        if get_states is not None:
+            try:
+                states = get_states()
+            except MXNetError:
+                states = None  # optimizer not initialized yet
+        existing = ckpt_mgr.epochs()
+        version = max(existing) + 1 if existing else max(1, int(epoch))
+        ckpt_mgr.save(version, symbol=self._symbol, arg_params=arg_p,
+                      aux_params=aux_p, optimizer_states=states,
+                      batch_cursor=batch_cursor, epoch=epoch)
+
+    def _outputs_finite(self):
+        """NaN/Inf probe over the step's outputs (forces a device
+        sync — only ever called with the bad-step guard armed)."""
+        for out in self.get_outputs():
+            a = out.asnumpy() if hasattr(out, "asnumpy") \
+                else onp.asarray(out)
+            if not onp.isfinite(a).all():
+                return False
+        return True
+
+    def _step_finite(self):
+        """Whether the step just run is safe to apply.  Subclasses
+        with gradient access (Module) extend this to probe the grads
+        too — finite outputs with a non-finite gradient (log(0) in the
+        loss backward, bf16 overflow in backprop) would otherwise
+        slip a poisoned update through the guard."""
+        return self._outputs_finite()
 
     def _fit_epochs(self, train_data, eval_data, eval_metric,
                     validation_metric, begin_epoch, num_epoch, monitor,
                     batch_end_callback, epoch_end_callback,
-                    eval_end_callback, eval_batch_end_callback):
+                    eval_end_callback, eval_batch_end_callback,
+                    drain=None, ckpt_mgr=None, checkpoint_period=1,
+                    resume_cursor=0):
+        from ..config import get_env
+        from ..resilience import faultsim
+
+        bad_limit = int(get_env("MXNET_BAD_STEP_LIMIT"))
+        bad_run = 0
+        checkpoint_period = int(max(1, checkpoint_period))
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
             nbatch = 0
             data_iter = iter(train_data)
+            if epoch == begin_epoch and resume_cursor > 0:
+                # fit() already skipped the source ahead (pre-wrap);
+                # only the batch numbering resumes here
+                nbatch = resume_cursor
             end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
+            boundary_resume = False
+            next_data_batch = None
+            try:
+                next_data_batch = next(data_iter)
+            except StopIteration:
+                if epoch == begin_epoch and resume_cursor > 0:
+                    # resume landed exactly on the epoch boundary:
+                    # nothing left to train, but the epoch-end contract
+                    # below (callbacks, boundary checkpoint, eval)
+                    # still runs so the checkpoint cadence matches an
+                    # uninterrupted run
+                    boundary_resume = True
+                else:
+                    # a genuinely empty iterator stays the loud failure
+                    # it always was, not a silent no-op training run
+                    raise
+            drained = False
+            while not end_of_batch and not boundary_resume:
                 data_batch = next_data_batch
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
-                self.update()
+                bad_step = False
+                if bad_limit > 0:
+                    bad_step = (faultsim.inject("step.loss_nan")
+                                == "nan") or not self._step_finite()
+                if bad_step:
+                    # skip-and-count, like dynamic loss scaling: the
+                    # update is withheld so one NaN batch cannot poison
+                    # the params
+                    bad_run += 1
+                    self.logger.warning(
+                        "Epoch[%d] Batch[%d] non-finite step — update "
+                        "skipped (%d/%d consecutive)", epoch, nbatch,
+                        bad_run, bad_limit)
+                    if bad_run >= bad_limit:
+                        restored = None
+                        if ckpt_mgr is not None:
+                            restored = ckpt_mgr.latest_epoch()
+                            if restored is not None:
+                                # full rollback, not just weights: a
+                                # caller that catches and resumes must
+                                # not pair rolled-back params with
+                                # post-divergence optimizer moments
+                                from ..resilience.checkpoint import \
+                                    restore_rng as _restore_rng
+
+                                state = ckpt_mgr.load(restored)
+                                self.set_params(state["arg_params"],
+                                                state["aux_params"])
+                                set_states = getattr(
+                                    self, "_set_optimizer_states",
+                                    None)
+                                if set_states is not None and \
+                                        state.get("optimizer_states"):
+                                    set_states(
+                                        state["optimizer_states"])
+                                _restore_rng(state.get("rng"))
+                        raise MXNetError(
+                            f"aborting fit: {bad_run} consecutive "
+                            f"non-finite steps (MXNET_BAD_STEP_LIMIT="
+                            f"{bad_limit}) at epoch {epoch} batch "
+                            f"{nbatch}; parameters "
+                            + (f"restored to checkpoint epoch "
+                               f"{restored}" if restored is not None
+                               else "left as of the last finite step "
+                               "(no checkpoint to restore)"))
+                else:
+                    bad_run = 0
+                    self.update()
                 try:
                     next_data_batch = next(data_iter)
                 except StopIteration:
@@ -191,16 +392,41 @@ class BaseModule:
                     for cb in _as_list(batch_end_callback):
                         cb(_BatchEndParam(epoch, nbatch, eval_metric))
                 nbatch += 1
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, toc - tic)
+                if drain is not None and drain.requested is not None:
+                    # preemption drain: the in-flight step is done —
+                    # flush a final checkpoint with the batch cursor,
+                    # then unwind (fit closes the feed and re-raises)
+                    if ckpt_mgr is not None:
+                        self._save_fit_checkpoint(ckpt_mgr, epoch,
+                                                  nbatch)
+                    self.logger.info(
+                        "Preemption drain (signal %s): checkpoint at "
+                        "epoch %d batch %d", drain.requested, epoch,
+                        nbatch)
+                    drained = True
+                    break
+            if drained:
+                return
+            if not boundary_resume:
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch,
+                                     name, val)
+                toc = time.time()
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                                 toc - tic)
 
             arg_p, aux_p = self.get_params()
             self.set_params(arg_p, aux_p)
             if epoch_end_callback is not None:
                 for callback in _as_list(epoch_end_callback):
                     callback(epoch, self.symbol, arg_p, aux_p)
+            if ckpt_mgr is not None \
+                    and (epoch + 1) % checkpoint_period == 0:
+                # epoch boundary: cursor 0, epoch field = next epoch.
+                # The schedule is ABSOLUTE (epoch number, not epochs
+                # since begin_epoch), so a resume keeps the
+                # uninterrupted run's checkpoint cadence.
+                self._save_fit_checkpoint(ckpt_mgr, epoch + 1, 0)
 
             if eval_data is not None:
                 res = self.score(

@@ -337,15 +337,46 @@ class Module(BaseModule):
         return [self._exec.grad_dict.get(n) for n in self._data_names]
 
     # --------------------------------------------------------------- io
-    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
-        from .. import model
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        keep_n=None):
+        """One atomic checkpoint version via resilience.checkpoint:
+        params, optional optimizer state, symbol, CRC manifest and the
+        `latest` pointer land together or not at all (legacy
+        `prefix-NNNN.params`/`.states` layout preserved)."""
+        from ..resilience.checkpoint import CheckpointManager
 
         arg_params, aux_params = self.get_params()
-        model.save_checkpoint(prefix, epoch, self._symbol, arg_params,
-                              aux_params)
+        states = None
         if save_optimizer_states:
-            with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
-                f.write(self._updater.get_states())
+            states = self._get_optimizer_states()
+        CheckpointManager(prefix, keep_n=keep_n).save(
+            epoch, symbol=self._symbol, arg_params=arg_params,
+            aux_params=aux_params, optimizer_states=states)
+
+    def _step_finite(self):
+        """Outputs AND gradients: finite predictions can still carry a
+        non-finite gradient (log(0) in the loss backward), and the
+        guard's whole point is that such a step must not update."""
+        if not self._outputs_finite():
+            return False
+        for name in self._param_names:
+            if self._grad_req.get(name, "null") == "null":
+                continue
+            g = self._exec.grad_dict.get(name)
+            if g is not None and not onp.isfinite(g.asnumpy()).all():
+                return False
+        return True
+
+    # optimizer-state hooks for fit's checkpoint/resume plumbing
+    def _get_optimizer_states(self):
+        if self._updater is None:
+            raise MXNetError("optimizer not initialized")
+        return self._updater.get_states()
+
+    def _set_optimizer_states(self, states):
+        if self._updater is None:
+            raise MXNetError("optimizer not initialized")
+        self._updater.set_states(states)
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):

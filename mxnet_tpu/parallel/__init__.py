@@ -194,7 +194,8 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
                     epsilon=1e-8, mesh=None, data_axis="data",
                     param_spec=None, donate=True, compute_dtype=None,
                     loss_scale=None, sample_data=None, autotune=None,
-                    variant_ops=("conv1x1_dot",), **opt_kwargs):
+                    variant_ops=("conv1x1_dot",), nan_guard=None,
+                    **opt_kwargs):
     """Build ONE fully-fused jitted SPMD train step.
 
     Returns (step_fn, params, opt_state) where
@@ -238,6 +239,15 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
     to the returned step via the program scope.  In-step timing is
     single-device for now: under a mesh, sample_data warns and is
     ignored (mesh-keyed cached winners still apply).
+
+    nan_guard: step-level NaN/Inf guard compiled INTO the program
+    (skip-and-count, the same selection dynamic loss scaling uses): a
+    step whose loss or any gradient is non-finite leaves params and
+    optimizer state untouched, and ``opt_state['_bad_steps']`` counts
+    CONSECUTIVE bad steps (reset to 0 by any finite step) so the host
+    can enforce MXNET_BAD_STEP_LIMIT without a per-step sync.  None
+    follows that env var (>0 arms it); dynamic loss scaling already
+    skips non-finite updates, so the guard stays off there.
     """
     from .. import autotune as _at
     from ..config import setup_compilation_cache
@@ -276,6 +286,13 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
             jnp.float32(2.0 ** 16),  # initial scale (reference amp)
             jnp.zeros((), jnp.int32),  # consecutive-finite counter
         )
+    if nan_guard is None:
+        from ..config import get_env
+
+        nan_guard = get_env("MXNET_BAD_STEP_LIMIT") > 0
+    nan_guard = bool(nan_guard) and not dynamic_scaling
+    if nan_guard:
+        opt_state["_bad_steps"] = jnp.zeros((), jnp.int32)
 
     def _apply_updates(params_, opt_state_, grads, t, key):
         new_p, new_s = {}, {}
@@ -338,6 +355,28 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
                 lambda g: g / static_scale, grads)
         else:
             loss, grads = jax.value_and_grad(loss_of)(params_, x, y, key)
+        if nan_guard:
+            # skip-and-count: a non-finite step leaves params/opt state
+            # untouched and bumps the consecutive-bad counter; any
+            # finite step resets it (MXNET_BAD_STEP_LIMIT policy is
+            # enforced by the host reading _bad_steps)
+            finite = jnp.isfinite(loss)
+            for g in jax.tree_util.tree_leaves(grads):
+                finite = finite & jnp.isfinite(g).all()
+            up_p, up_s = _apply_updates(
+                params_, {n: opt_state_[n] for n in names}, grads, t,
+                key)
+            new_p = {n: jnp.where(finite, up_p[n], params_[n])
+                     for n in names}
+            new_s = {
+                n: jax.tree_util.tree_map(
+                    lambda u, o: jnp.where(finite, u, o),
+                    up_s[n], opt_state_[n])
+                for n in names
+            }
+            new_s["_bad_steps"] = jnp.where(
+                finite, jnp.int32(0), opt_state_["_bad_steps"] + 1)
+            return loss, new_p, new_s
         new_p, new_s = _apply_updates(params_, opt_state_, grads, t, key)
         return loss, new_p, new_s
 
@@ -415,6 +454,34 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
     else:
         step_fn = jax.jit(_scoped_step, donate_argnums=donate_argnums,
                           static_argnums=())
+    from ..resilience import faultsim
+
+    if faultsim.armed("step.loss_nan"):
+        # fault harness only (MXNET_FAULT_SPEC names the point): armed
+        # hits poison the batch with NaN BEFORE the compiled step, so
+        # the in-graph guard sees a genuinely non-finite step; the
+        # disarmed fast path never grows this wrapper
+        inner_step = step_fn
+
+        def step_fn(p, o, x, y, key, t, _inner=inner_step):
+            if faultsim.inject("step.loss_nan") == "nan":
+                # integer dtypes have no NaN — poisoning them is a
+                # silent no-op, so pick the first inexact input (token
+                # id models poison through their float labels)
+                x, y = jnp.asarray(x), jnp.asarray(y)
+                if jnp.issubdtype(x.dtype, jnp.inexact):
+                    x = x * jnp.asarray(jnp.nan, x.dtype)
+                elif jnp.issubdtype(y.dtype, jnp.inexact):
+                    y = y * jnp.asarray(jnp.nan, y.dtype)
+                else:
+                    import warnings
+
+                    warnings.warn(
+                        "step.loss_nan injection skipped: neither x "
+                        "nor y has an inexact dtype to poison",
+                        stacklevel=2)
+            return _inner(p, o, x, y, key, t)
+
     return step_fn, params, opt_state
 
 

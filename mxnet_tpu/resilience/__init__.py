@@ -1,0 +1,47 @@
+"""Elastic training runtime (SURVEY.md §5.3 "a floor, not a ceiling").
+
+The resilience layer the reference never had, on top of the
+shard-restart recovery already proven in ``mxnet_tpu._ps``:
+
+* :mod:`.checkpoint` — atomic, versioned, CRC-verified checkpoints
+  with a ``latest`` pointer and previous-good fallback.
+* :mod:`.preempt` — SIGTERM/SIGINT drain-to-checkpoint for
+  ``Module.fit``.
+* :mod:`.faultsim` — deterministic, hit-count-armed fault injection
+  (``MXNET_FAULT_SPEC``).
+* :mod:`.retry` — the shared bounded exponential-backoff-with-jitter
+  helper (device-feed producer, PS client ops).
+
+``faultsim``/``retry`` are import-light (hot paths import them);
+``checkpoint``/``preempt`` load lazily because they pull in the
+ndarray stack.
+"""
+from . import faultsim  # noqa: F401
+from .retry import retry_call  # noqa: F401
+
+__all__ = ["faultsim", "retry_call", "checkpoint", "preempt",
+           "CheckpointManager", "PreemptionDrain", "atomic_write_bytes",
+           "restore_rng"]
+
+
+def __getattr__(name):
+    if name in ("checkpoint", "preempt"):
+        import importlib
+
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
+    if name in ("CheckpointManager", "atomic_write_bytes",
+                "capture_rng", "restore_rng"):
+        from . import checkpoint as _ckpt
+
+        val = getattr(_ckpt, name)
+        globals()[name] = val
+        return val
+    if name == "PreemptionDrain":
+        from .preempt import PreemptionDrain
+
+        globals()[name] = PreemptionDrain
+        return PreemptionDrain
+    raise AttributeError(
+        f"module 'mxnet_tpu.resilience' has no attribute {name!r}")

@@ -1,0 +1,455 @@
+"""Atomic, versioned, verifiable checkpoints.
+
+The reference's checkpoint story is ``nd.save`` straight onto the final
+path (python/mxnet/model.py:394): a crash mid-write leaves a torn
+``prefix-NNNN.params`` that ``load_checkpoint`` loads blindly.  This
+module is the Check-N-Run-style fix every save path now routes through:
+
+* **Atomic writes** — every payload goes write-to-temp + fsync +
+  ``os.replace``; the final path is either its previous content or the
+  complete new content, never a torn mix.  An injected/real crash
+  mid-write leaves only a stray temp file.
+* **Versioned manifests** — each checkpoint carries a JSON manifest
+  (epoch, step, batch cursor, per-payload size + CRC32, host+device RNG
+  state, autotune winners-file hash) and a ``prefix-latest.json``
+  pointer written LAST, so "the latest checkpoint" is itself an atomic
+  concept.
+* **Verification + fallback** — :meth:`CheckpointManager.verify`
+  detects truncated/corrupt payloads by size+CRC; ``load()`` /
+  ``latest_epoch()`` fall back to the newest version that verifies.
+* **Retention** — ``keep_n`` prunes old versions after each save
+  (``None`` keeps everything — the legacy ``do_checkpoint`` behavior).
+
+Layout stays legacy-compatible: ``prefix-symbol.json`` +
+``prefix-NNNN.params`` (+ ``prefix-NNNN.states``) are exactly the
+reference files, so old ``load_checkpoint`` callers keep working; the
+manifest and pointer are additive.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+import zlib
+
+import numpy as onp
+
+from ..base import MXNetError
+from . import faultsim
+
+__all__ = ["CheckpointManager", "atomic_write_bytes", "capture_rng",
+           "restore_rng"]
+
+
+def atomic_write_bytes(path, data, inject_point="ckpt.write"):
+    """Write ``data`` to ``path`` atomically: temp file in the same
+    directory, fsync, then rename over the target (plus a directory
+    fsync so the rename itself is durable).
+
+    The fault-injection point fires MID-payload, so an armed
+    ``ckpt.write:crash`` leaves a truncated *temp* file and the final
+    path untouched — exactly the torn-write scenario the old direct
+    ``nd.save`` could not survive.
+    """
+    path = os.fspath(path)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(
+        d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    try:
+        with open(tmp, "wb") as f:
+            half = len(data) // 2
+            f.write(data[:half])
+            if inject_point:
+                faultsim.inject(inject_point)
+            f.write(data[half:])
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    try:
+        dfd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass  # platforms/filesystems without directory fsync
+
+
+def capture_rng():
+    """Snapshot host (numpy) and device (mxnet_tpu._rng key) RNG state
+    as JSON-serializable data, so a resumed run continues the exact
+    random stream of the interrupted one."""
+    st = onp.random.get_state()
+    state = {"numpy": [st[0], onp.asarray(st[1]).tolist(), int(st[2]),
+                       int(st[3]), float(st[4])],
+             "device": None}
+    try:
+        import jax
+
+        from .. import _rng
+
+        if _rng._S.key is not None:
+            state["device"] = onp.asarray(
+                jax.random.key_data(_rng._S.key),
+                onp.uint32).tolist()
+    except Exception:
+        pass  # key API absent or backend not initialized: host-only
+    return state
+
+
+def restore_rng(state):
+    """Restore a :func:`capture_rng` snapshot (missing parts no-op)."""
+    if not state:
+        return
+    np_st = state.get("numpy")
+    if np_st:
+        onp.random.set_state((np_st[0],
+                              onp.asarray(np_st[1], onp.uint32),
+                              int(np_st[2]), int(np_st[3]),
+                              float(np_st[4])))
+    dev = state.get("device")
+    if dev is not None:
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            from .. import _rng
+
+            _rng._S.key = jax.random.wrap_key_data(
+                jnp.asarray(dev, jnp.uint32))
+        except Exception:
+            pass
+
+
+def _autotune_hash():
+    """SHA-256 of the persisted autotune winners file, recorded so a
+    resume can tell whether it is replaying under the same variant
+    choices the checkpointed run trained with."""
+    try:
+        from .. import autotune
+
+        p = autotune.cache_path()
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                return hashlib.sha256(f.read()).hexdigest()
+    except Exception:
+        pass
+    return None
+
+
+def _crc(blob):
+    return zlib.crc32(blob) & 0xFFFFFFFF
+
+
+def _as_nd(v):
+    from .. import ndarray as nd
+
+    return v if isinstance(v, nd.NDArray) else nd.array(onp.asarray(v))
+
+
+def _split_params(save_dict):
+    """Split a loaded ``arg:``/``aux:``-keyed dict (the reference
+    .params convention) into (arg_params, aux_params)."""
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return arg_params, aux_params
+
+
+class CheckpointManager:
+    """Owner of one checkpoint series under ``prefix``.
+
+    Files per version ``NNNN`` (all written atomically, manifest after
+    payloads, ``latest`` pointer last):
+
+    * ``prefix-NNNN.params``        — ``arg:``/``aux:`` blobs, the
+      reference binary format (``load_checkpoint`` compatible)
+    * ``prefix-NNNN.states``        — pickled optimizer state (optional)
+    * ``prefix-NNNN.manifest.json`` — epoch/step/cursor, per-payload
+      size+CRC32, RNG snapshot, autotune winners hash
+    * ``prefix-symbol.json``        — the network (shared across versions)
+    * ``prefix-latest.json``        — pointer to the newest version
+    """
+
+    MANIFEST_FORMAT = 1
+
+    def __init__(self, prefix, keep_n=None):
+        self.prefix = os.fspath(prefix)
+        self.keep_n = keep_n
+
+    # ------------------------------------------------------------ paths
+    def params_path(self, epoch):
+        return f"{self.prefix}-{int(epoch):04d}.params"
+
+    def states_path(self, epoch):
+        return f"{self.prefix}-{int(epoch):04d}.states"
+
+    def manifest_path(self, epoch):
+        return f"{self.prefix}-{int(epoch):04d}.manifest.json"
+
+    def symbol_path(self):
+        return f"{self.prefix}-symbol.json"
+
+    def latest_path(self):
+        return f"{self.prefix}-latest.json"
+
+    def _dir(self):
+        return os.path.dirname(os.path.abspath(self.prefix)) or "."
+
+    # ------------------------------------------------------------- save
+    def save(self, version, symbol=None, arg_params=None,
+             aux_params=None, optimizer_states=None, step=None,
+             batch_cursor=0, extra=None, epoch=None):
+        """Write one atomic checkpoint version; returns its manifest.
+
+        ``version`` names the files (``prefix-NNNN.*``); ``epoch`` is
+        the training epoch recorded in the manifest and defaults to
+        the version — they coincide for clean epoch-boundary saves,
+        and diverge when fit's mid-epoch drain allocates a fresh
+        version id to avoid rewriting an existing one in place.
+        ``batch_cursor`` records how many batches of that epoch were
+        already consumed (0 = a clean epoch boundary) — the resume
+        cursor for mid-epoch preemption drains.
+        """
+        version = int(version)
+        epoch = version if epoch is None else int(epoch)
+        arg_params = arg_params or {}
+        aux_params = aux_params or {}
+        save_dict = {f"arg:{k}": _as_nd(v) for k, v in
+                     arg_params.items()}
+        save_dict.update({f"aux:{k}": _as_nd(v) for k, v in
+                          aux_params.items()})
+        from .. import ndarray as nd
+
+        files = {}
+        payload = nd.save_buffer(save_dict)
+        ppath = self.params_path(version)
+        atomic_write_bytes(ppath, payload)
+        files[os.path.basename(ppath)] = {
+            "bytes": len(payload), "crc32": _crc(payload)}
+        if optimizer_states is not None:
+            spath = self.states_path(version)
+            atomic_write_bytes(spath, optimizer_states)
+            files[os.path.basename(spath)] = {
+                "bytes": len(optimizer_states),
+                "crc32": _crc(optimizer_states)}
+        if symbol is not None:
+            atomic_write_bytes(self.symbol_path(),
+                               symbol.tojson().encode())
+        manifest = {
+            "format": self.MANIFEST_FORMAT,
+            "version": version,
+            "epoch": epoch,
+            "step": step,
+            "batch_cursor": int(batch_cursor),
+            "files": files,
+            "rng": capture_rng(),
+            "autotune_sha256": _autotune_hash(),
+            "time": time.time(),
+            "extra": extra or {},
+        }
+        atomic_write_bytes(self.manifest_path(version),
+                           json.dumps(manifest, indent=1).encode())
+        # the pointer goes LAST: a crash anywhere above leaves `latest`
+        # naming the previous complete version
+        atomic_write_bytes(
+            self.latest_path(),
+            json.dumps({"epoch": version,
+                        "manifest": os.path.basename(
+                            self.manifest_path(version))}).encode())
+        self._apply_retention()
+        return manifest
+
+    def _apply_retention(self):
+        if not self.keep_n or int(self.keep_n) <= 0:
+            return
+        eps = self.epochs()
+        for e in eps[:-int(self.keep_n)]:
+            for p in (self.params_path(e), self.states_path(e),
+                      self.manifest_path(e)):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+
+    # ----------------------------------------------------------- lookup
+    def epochs(self):
+        """All versions on disk (ascending), from their manifests."""
+        base = os.path.basename(self.prefix)
+        out = []
+        try:
+            names = os.listdir(self._dir())
+        except OSError:
+            return out
+        suffix = ".manifest.json"
+        for n in names:
+            if n.startswith(base + "-") and n.endswith(suffix):
+                num = n[len(base) + 1:-len(suffix)]
+                if num.isdigit():
+                    out.append(int(num))
+        return sorted(out)
+
+    def _read_manifest(self, epoch):
+        with open(self.manifest_path(epoch), "rb") as f:
+            return json.loads(f.read().decode())
+
+    def has_manifest(self, epoch):
+        return os.path.exists(self.manifest_path(epoch))
+
+    def _read_verified(self, epoch):
+        """Manifest + every payload in ONE read each, CRC-checked as
+        read.  The recovery path (load) decodes from these buffers
+        directly, so verification never doubles the disk I/O of a
+        multi-GB resume."""
+        man = self._read_manifest(epoch)
+        blobs = {}
+        for fname, meta in man["files"].items():
+            fp = os.path.join(self._dir(), fname)
+            with open(fp, "rb") as f:
+                blob = f.read()
+            if len(blob) != meta.get("bytes") \
+                    or _crc(blob) != meta.get("crc32"):
+                raise MXNetError(
+                    f"checkpoint payload {fp!r} failed verification "
+                    "(truncated or corrupt)")
+            blobs[fname] = blob
+        return man, blobs
+
+    def verify(self, epoch):
+        """True iff the manifest parses and every payload matches its
+        recorded size and CRC32 — catches truncation, bit rot, and
+        torn non-atomic writes from foreign tools."""
+        try:
+            self._read_verified(epoch)
+            return True
+        except (OSError, ValueError, KeyError, MXNetError):
+            return False
+
+    def _latest_candidates(self):
+        """Version numbers to try, newest-first: the ``latest``
+        pointer's target, then every other on-disk version."""
+        candidates = []
+        try:
+            with open(self.latest_path(), "rb") as f:
+                candidates.append(int(json.loads(f.read())["epoch"]))
+        except (OSError, ValueError, KeyError):
+            pass
+        for e in reversed(self.epochs()):
+            if e not in candidates:
+                candidates.append(e)
+        return candidates
+
+    def latest_epoch(self):
+        """Newest version that VERIFIES, or None.
+
+        The ``latest`` pointer is consulted first; a corrupt or
+        missing candidate falls back through older versions (newest
+        first) — the previous-good-version guarantee.
+        """
+        for e in self._latest_candidates():
+            if self.verify(e):
+                return e
+        return None
+
+    # ------------------------------------------------------------- load
+    def load(self, epoch=None, ctx=None):
+        """Load a verified checkpoint.
+
+        ``epoch=None`` loads the newest version that verifies (falling
+        back past corrupt ones); an explicit version number raises
+        :class:`MXNetError` when that version fails verification —
+        detection, not silent substitution, for a pinned request.
+
+        Returns a dict with ``version`` (the file id), ``epoch`` (the
+        training epoch from the manifest — diverges from the version
+        after mid-epoch drains), ``step``, ``batch_cursor``,
+        ``arg_params``, ``aux_params`` (NDArray dicts),
+        ``optimizer_states`` (bytes or None), ``rng`` and ``extra``.
+        """
+        from .. import ndarray as nd
+
+        man, blobs = {}, {}
+        if epoch is None:
+            # newest-good fallback, ONE read per candidate: the blobs
+            # that verified are the blobs that get decoded
+            for cand in self._latest_candidates():
+                try:
+                    man, blobs = self._read_verified(cand)
+                    epoch = cand
+                    break
+                except (OSError, ValueError, KeyError, MXNetError):
+                    continue
+            if epoch is None:
+                raise MXNetError(
+                    f"no verifiable checkpoint under {self.prefix!r}")
+        else:
+            epoch = int(epoch)
+            if self.has_manifest(epoch):
+                try:
+                    man, blobs = self._read_verified(epoch)
+                except MXNetError as e:
+                    raise MXNetError(
+                        f"checkpoint {self.params_path(epoch)!r} "
+                        "failed verification (truncated or corrupt "
+                        "payload); load(epoch=None) falls back to the "
+                        "last good version") from e
+            # manifest-less versions (pre-atomic-writer files) load
+            # blind, the legacy behavior
+
+        pname = os.path.basename(self.params_path(epoch))
+        if pname in blobs:
+            save_dict = nd.load_buffer(blobs[pname], ctx=ctx)
+        else:
+            save_dict = nd.load(self.params_path(epoch), ctx=ctx)
+        arg_params, aux_params = _split_params(save_dict)
+        sname = os.path.basename(self.states_path(epoch))
+        states = blobs.get(sname)
+        if states is None and os.path.exists(self.states_path(epoch)):
+            with open(self.states_path(epoch), "rb") as f:
+                states = f.read()
+        return {
+            "version": int(epoch),
+            "epoch": int(man.get("epoch", epoch)),
+            "step": man.get("step"),
+            "batch_cursor": int(man.get("batch_cursor", 0)),
+            "arg_params": arg_params,
+            "aux_params": aux_params,
+            "optimizer_states": states,
+            "rng": man.get("rng"),
+            "autotune_sha256": man.get("autotune_sha256"),
+            "extra": man.get("extra", {}),
+        }
+
+    def load_params_dict(self, version, ctx=None):
+        """One version's ``.params`` dict in a SINGLE read: with a
+        manifest the payload is CRC-verified and decoded from the same
+        buffer (raises on mismatch — detection for a pinned version);
+        manifest-less files load blind, the legacy behavior."""
+        from .. import ndarray as nd
+
+        version = int(version)
+        if self.has_manifest(version):
+            try:
+                _, blobs = self._read_verified(version)
+            except (OSError, ValueError, KeyError, MXNetError) as e:
+                raise MXNetError(
+                    f"checkpoint {self.params_path(version)!r} failed "
+                    "verification (truncated or corrupt payload); "
+                    "CheckpointManager.load() falls back to the last "
+                    "good version") from e
+            pname = os.path.basename(self.params_path(version))
+            if pname in blobs:
+                return nd.load_buffer(blobs[pname], ctx=ctx)
+        return nd.load(self.params_path(version), ctx=ctx)

@@ -1,0 +1,189 @@
+"""Deterministic fault injection (``MXNET_FAULT_SPEC``).
+
+The reference has no fault-injection harness anywhere (SURVEY.md §5.3:
+elasticity is a flat NO; ps-lite offers dead-node *detection* only).
+This registry gives every resilience-critical code path a NAMED
+injection point that production traffic pays one dict lookup for, and
+tests arm deterministically by hit-count — "crash during the 3rd
+checkpoint write" becomes a reproducible scenario instead of a
+``kill -9`` race.
+
+Points wired in-tree:
+
+==============  =======================================================
+``feed.h2d``    io/device_feed.py producer, before each H2D transfer
+``ps.push``     _ps.py client, inside every push/spush attempt
+``ps.pull``     _ps.py client, inside every pull/spull attempt
+``ckpt.write``  resilience/checkpoint.py, MID-payload in atomic_write
+``step.loss_nan``  make_train_step host wrapper + Module.fit step guard
+==============  =======================================================
+
+Spec grammar (env ``MXNET_FAULT_SPEC`` or ``faultsim.reset(spec)``)::
+
+    spec   := clause (';' clause)*
+    clause := point ':' action ['=' value] '@' hits
+    action := crash | raise | delay | nan
+    hits   := N | N-M | N+          (1-based per-point hit count)
+
+Actions:
+
+* ``crash``   — ``os._exit(87)``: a hard kill, no cleanup/atexit runs
+  (the mid-write power-loss simulation).
+* ``raise``   — raise :class:`FaultInjected` (retry paths list it as
+  transient, so backoff recovery is exercised for real).
+* ``delay=S`` — ``time.sleep(S)``.
+* ``nan``     — return ``"nan"``: the caller poisons its own value
+  (used by the step-level NaN guard paths).
+
+Example: ``MXNET_FAULT_SPEC="ckpt.write:crash@3;ps.push:delay=2.0@7"``
+crashes the process in the middle of the 3rd checkpoint payload write
+and delays the 7th PS push by 2 seconds.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..base import MXNetError
+
+__all__ = ["FaultInjected", "inject", "reset", "hits", "armed",
+           "CRASH_EXIT_CODE"]
+
+#: exit status of an armed ``crash`` action — distinguishable from a
+#: real signal kill in subprocess tests
+CRASH_EXIT_CODE = 87
+
+
+class FaultInjected(Exception):
+    """Raised by an armed ``raise`` injection point."""
+
+    def __init__(self, point, hit):
+        super().__init__(f"injected fault at {point!r} (hit {hit})")
+        self.point = point
+        self.hit = hit
+
+
+class _Rule:
+    __slots__ = ("action", "value", "lo", "hi")
+
+    def __init__(self, action, value, lo, hi):
+        self.action = action
+        self.value = value
+        self.lo = lo
+        self.hi = hi  # None = open-ended (the "N+" form)
+
+    def matches(self, n):
+        return self.lo <= n and (self.hi is None or n <= self.hi)
+
+
+_LOCK = threading.Lock()
+# spec None = not yet armed (first inject() reads MXNET_FAULT_SPEC)
+_STATE = {"spec": None, "rules": {}, "hits": {}}
+
+
+def _parse(spec):
+    rules = {}
+    for clause in filter(None, (c.strip() for c in spec.split(";"))):
+        try:
+            point, rest = clause.split(":", 1)
+            action, hitpart = rest.split("@", 1)
+        except ValueError:
+            raise MXNetError(
+                f"bad fault spec clause {clause!r} "
+                "(want point:action[=value]@hits)") from None
+        value = None
+        if "=" in action:
+            action, raw = action.split("=", 1)
+            try:
+                value = float(raw)
+            except ValueError:
+                raise MXNetError(
+                    f"bad fault value {raw!r} in {clause!r}") from None
+        action = action.strip()
+        if action not in ("crash", "raise", "delay", "nan"):
+            raise MXNetError(f"unknown fault action {action!r} in "
+                             f"{clause!r}")
+        hitpart = hitpart.strip()
+        try:
+            if hitpart.endswith("+"):
+                lo, hi = int(hitpart[:-1]), None
+            elif "-" in hitpart:
+                a, b = hitpart.split("-", 1)
+                lo, hi = int(a), int(b)
+            else:
+                lo = hi = int(hitpart)
+        except ValueError:
+            raise MXNetError(
+                f"bad hit range {hitpart!r} in {clause!r}") from None
+        rules.setdefault(point.strip(), []).append(
+            _Rule(action, value, lo, hi))
+    return rules
+
+
+def reset(spec=None):
+    """(Re)arm from ``spec`` and clear all hit counters.
+
+    ``spec=None`` re-reads ``MXNET_FAULT_SPEC``; tests usually pass the
+    spec explicitly so arming happens at a precise program point rather
+    than at process start.
+    """
+    if spec is None:
+        spec = os.environ.get("MXNET_FAULT_SPEC", "")
+    rules = _parse(spec)
+    with _LOCK:
+        _STATE["spec"] = spec
+        _STATE["rules"] = rules
+        _STATE["hits"] = {}
+
+
+def _ensure_locked():
+    if _STATE["spec"] is None:
+        spec = os.environ.get("MXNET_FAULT_SPEC", "")
+        _STATE["spec"] = spec
+        _STATE["rules"] = _parse(spec)
+        _STATE["hits"] = {}
+
+
+def hits(point):
+    """How many times ``point`` has fired since the last reset()."""
+    with _LOCK:
+        _ensure_locked()
+        return _STATE["hits"].get(point, 0)
+
+
+def armed(point):
+    """True when any clause names ``point`` — the cheap pre-check that
+    keeps optional wrappers (the make_train_step NaN poisoner) off the
+    fast path entirely when the harness is disarmed."""
+    with _LOCK:
+        _ensure_locked()
+        return point in _STATE["rules"]
+
+
+def inject(point):
+    """Count a hit at ``point`` and fire the armed action, if any.
+
+    Returns ``"nan"`` when the caller must poison its value, else
+    ``None``.  Thread-safe: producer threads and PS serve threads share
+    one counter per point, so hit numbering is global per process.
+    """
+    with _LOCK:
+        _ensure_locked()
+        n = _STATE["hits"].get(point, 0) + 1
+        _STATE["hits"][point] = n
+        rule = None
+        for r in _STATE["rules"].get(point, ()):
+            if r.matches(n):
+                rule = r
+                break
+    if rule is None:
+        return None
+    if rule.action == "crash":
+        os._exit(CRASH_EXIT_CODE)
+    if rule.action == "raise":
+        raise FaultInjected(point, n)
+    if rule.action == "delay":
+        time.sleep(rule.value or 0.0)
+        return None
+    return "nan"

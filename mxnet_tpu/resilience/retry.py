@@ -1,0 +1,48 @@
+"""Bounded exponential-backoff-with-jitter retry.
+
+One shared helper for every transient-failure path (the device-feed
+producer's H2D attempts and the PS client ops), replacing ad-hoc
+try-once-redial-once chains: attempts are bounded, the delay doubles up
+to a cap, and jitter decorrelates the retries of W workers hammering
+the same recovering shard.
+"""
+from __future__ import annotations
+
+import random
+import time
+
+__all__ = ["retry_call"]
+
+
+def retry_call(fn, retry_on=(ConnectionError, EOFError, OSError),
+               attempts=4, base_delay=0.05, max_delay=2.0, jitter=0.5,
+               deadline=None, on_retry=None):
+    """Call ``fn()`` until it succeeds, raising the last error after
+    ``attempts`` tries or once ``deadline`` (absolute ``time.monotonic``
+    value) passes.
+
+    ``on_retry(attempt_no, exc)`` runs between attempts — the PS client
+    drops its dead connection there so the next attempt redials.
+    Backoff: ``base_delay * 2**k`` capped at ``max_delay``, then
+    stretched by up to ``jitter`` (fraction) of itself at random.
+    """
+    delay = float(base_delay)
+    attempts = max(1, int(attempts))
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except retry_on as exc:
+            timed_out = deadline is not None \
+                and time.monotonic() >= deadline
+            if attempt >= attempts or timed_out:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, exc)
+            sleep = min(delay, float(max_delay))
+            sleep *= 1.0 + jitter * random.random()
+            if deadline is not None:
+                sleep = min(sleep, max(0.0,
+                                       deadline - time.monotonic()))
+            time.sleep(sleep)
+            delay *= 2.0
+    raise AssertionError("unreachable")  # pragma: no cover

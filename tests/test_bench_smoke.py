@@ -23,13 +23,13 @@ _BENCH = os.path.join(os.path.dirname(os.path.dirname(
 _CACHE_DIR = "/tmp/mxnet_tpu_xla_cache_ci"
 
 
-def _run(extra_env=None, timeout=240):
+def _run(extra_env=None, timeout=240, extra_args=()):
     env = dict(os.environ)
     env["JAX_COMPILATION_CACHE_DIR"] = _CACHE_DIR
     env.update(extra_env or {})
     return subprocess.run(
-        [sys.executable, _BENCH, "--smoke"], capture_output=True,
-        text=True, timeout=timeout, env=env)
+        [sys.executable, _BENCH, "--smoke", *extra_args],
+        capture_output=True, text=True, timeout=timeout, env=env)
 
 
 def test_smoke_emits_valid_json_with_heartbeats():
@@ -62,11 +62,34 @@ def test_smoke_emits_valid_json_with_heartbeats():
     assert feed["feed_ms_per_step"] > 0
     assert "feed_wait_ms_per_step" in feed
     assert "overlap_frac" in feed
+    # the per-phase atomic checkpoint writes ran and verified
+    ck = out["checkpoint"]
+    assert ck["verified"] is True
+    assert ck["write_s"]["measure"] > 0
+    assert ck["write_s"]["feed"] > 0
+    assert out["resumed"] is False
     # a heartbeat per phase, so a hang is attributable
     for phase in ("import", "device_init", "build", "autotune",
-                  "compile", "K1", "K2", "trials", "feed", "conv_ab",
-                  "done"):
+                  "compile", "K1", "K2", "trials", "feed",
+                  "checkpoint", "conv_ab", "done"):
         assert f"phase={phase}" in r.stderr, f"missing phase {phase}"
+
+
+def test_smoke_checkpoint_resume_roundtrip(tmp_path):
+    """--checkpoint then --resume-from: the second run restores the
+    first run's trained params/opt state and says so in its JSON."""
+    prefix = str(tmp_path / "bench_ck")
+    r1 = _run(extra_args=("--checkpoint", prefix, "--no-autotune"))
+    assert r1.returncode == 0, r1.stderr[-2000:]
+    out1 = json.loads(r1.stdout.splitlines()[-1])
+    assert out1["checkpoint"]["prefix"] == prefix
+    assert out1["checkpoint"]["verified"] is True
+    r2 = _run(extra_args=("--resume-from", prefix, "--no-autotune"))
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    out2 = json.loads(r2.stdout.splitlines()[-1])
+    assert out2["resumed"] is True
+    assert out2["resumed_from_epoch"] == 2
+    assert "phase=resume" in r2.stderr
 
 
 def test_smoke_deadline_degrades_not_dies():

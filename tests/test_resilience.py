@@ -1,0 +1,578 @@
+"""Elastic training runtime tests (resilience subsystem).
+
+The harness under test is the deterministic fault-injection registry
+(MXNET_FAULT_SPEC / resilience.faultsim), so every crash/drain
+scenario here is a reproducible program point, not a kill -9 race:
+
+* atomic checkpoint writes survive an injected mid-file crash (the
+  ``latest`` pointer never names a torn version);
+* a ``Module.fit`` killed by SIGTERM mid-epoch and relaunched with
+  ``resume_from=`` reproduces the uninterrupted run's final params
+  BIT-exactly (params, optimizer state, RNG, batch cursor);
+* the step-level NaN/Inf guard skips bad steps and aborts at
+  MXNET_BAD_STEP_LIMIT with a last-good restore;
+* the PS client retries injected faults with bounded backoff, and the
+  former hard-coded 600 s server waits follow MXNET_PS_DEADLINE_SEC;
+* DeviceFeedIter.close() is idempotent with a bounded producer join
+  (no thread leak), and its producer retries injected H2D faults.
+"""
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.resilience import faultsim, retry_call
+from mxnet_tpu.resilience.checkpoint import (CheckpointManager,
+                                             atomic_write_bytes,
+                                             capture_rng, restore_rng)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faultsim.reset("")
+    yield
+    faultsim.reset("")
+
+
+def _run_script(body, timeout=180):
+    """Run an inline python script in a fresh interpreter (the crash /
+    SIGTERM scenarios must take down a real process, not this one)."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    prelude = textwrap.dedent(f"""\
+        import sys
+        sys.path.insert(0, {_REPO!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        """)
+    return subprocess.run(
+        [sys.executable, "-c", prelude + textwrap.dedent(body)],
+        capture_output=True, text=True, timeout=timeout, env=env)
+
+
+# ---------------------------------------------------------------- faultsim
+def test_fault_spec_parsing_and_actions():
+    faultsim.reset("p.a:delay=0.05@2;p.b:raise@1-2;p.c:nan@3+")
+    assert faultsim.armed("p.a") and not faultsim.armed("p.zzz")
+    assert faultsim.inject("p.a") is None  # hit 1: disarmed
+    t0 = time.monotonic()
+    assert faultsim.inject("p.a") is None  # hit 2: delay
+    assert time.monotonic() - t0 >= 0.05
+    with pytest.raises(faultsim.FaultInjected):
+        faultsim.inject("p.b")
+    with pytest.raises(faultsim.FaultInjected):
+        faultsim.inject("p.b")
+    assert faultsim.inject("p.b") is None  # hit 3: past the range
+    assert faultsim.inject("p.c") is None
+    assert faultsim.inject("p.c") is None
+    assert faultsim.inject("p.c") == "nan"  # 3+ is open-ended
+    assert faultsim.inject("p.c") == "nan"
+    assert faultsim.hits("p.c") == 4
+
+
+def test_fault_spec_rejects_garbage():
+    with pytest.raises(mx.MXNetError):
+        faultsim.reset("nonsense")
+    with pytest.raises(mx.MXNetError):
+        faultsim.reset("p:explode@1")
+    with pytest.raises(mx.MXNetError):
+        faultsim.reset("p:raise@x")
+
+
+def test_retry_call_backoff_and_bounds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("transient")
+        return 42
+
+    assert retry_call(flaky, attempts=4, base_delay=0.001) == 42
+    assert len(calls) == 3
+    # bounded: the last error propagates once attempts are exhausted
+    with pytest.raises(ConnectionError):
+        retry_call(lambda: (_ for _ in ()).throw(ConnectionError("x")),
+                   attempts=2, base_delay=0.001)
+    # non-listed exceptions pass straight through
+    with pytest.raises(ValueError):
+        retry_call(lambda: (_ for _ in ()).throw(ValueError("x")),
+                   attempts=3, base_delay=0.001)
+
+
+# ------------------------------------------------------- atomic checkpoints
+def test_atomic_write_is_all_or_nothing(tmp_path):
+    p = str(tmp_path / "blob.bin")
+    atomic_write_bytes(p, b"A" * 100)
+    faultsim.reset("ckpt.write:raise@1")
+    with pytest.raises(faultsim.FaultInjected):
+        atomic_write_bytes(p, b"B" * 100)
+    with open(p, "rb") as f:
+        assert f.read() == b"A" * 100  # old content intact, not torn
+    assert [n for n in os.listdir(tmp_path)] == ["blob.bin"]  # no temp
+
+
+def test_checkpoint_crash_mid_write_preserves_latest(tmp_path):
+    """Injected ``ckpt.write:crash`` during version 2's params write
+    takes the process down mid-file; version 1 and the ``latest``
+    pointer survive untouched."""
+    prefix = str(tmp_path / "ck")
+    r = _run_script(f"""
+        import numpy as onp
+        import mxnet_tpu as mx
+        from mxnet_tpu.resilience import faultsim
+        from mxnet_tpu.resilience.checkpoint import CheckpointManager
+        mgr = CheckpointManager({prefix!r})
+        mgr.save(1, arg_params={{"w": mx.nd.ones((4, 4))}})
+        faultsim.reset("ckpt.write:crash@1")
+        mgr.save(2, arg_params={{"w": mx.nd.zeros((4, 4))}})
+        print("UNREACHABLE")
+        """)
+    assert r.returncode == faultsim.CRASH_EXIT_CODE, r.stderr[-2000:]
+    assert "UNREACHABLE" not in r.stdout
+    mgr = CheckpointManager(prefix)
+    assert mgr.verify(1)
+    assert mgr.latest_epoch() == 1
+    assert not os.path.exists(mgr.params_path(2))  # temp never landed
+    st = mgr.load()
+    assert st["epoch"] == 1
+    onp.testing.assert_array_equal(st["arg_params"]["w"].asnumpy(),
+                                   onp.ones((4, 4)))
+
+
+def test_model_save_checkpoint_kill_mid_file_regression(tmp_path):
+    """The satellite regression: ``model.save_checkpoint`` used to
+    ``nd.save`` straight onto ``prefix-NNNN.params``, so a crash
+    mid-write left a torn file ``load_checkpoint`` loaded blindly.
+    Now the crash leaves no final file at all and epoch 1 still
+    loads."""
+    prefix = str(tmp_path / "model")
+    r = _run_script(f"""
+        import numpy as onp
+        import mxnet_tpu as mx
+        from mxnet_tpu import sym
+        from mxnet_tpu.resilience import faultsim
+        d = sym.Variable("data")
+        net = sym.FullyConnected(d, num_hidden=2, name="fc")
+        arg = {{"fc_weight": mx.nd.ones((2, 3)),
+               "fc_bias": mx.nd.zeros((2,))}}
+        mx.model.save_checkpoint({prefix!r}, 1, net, arg, {{}})
+        faultsim.reset("ckpt.write:crash@1")
+        mx.model.save_checkpoint({prefix!r}, 2, net, arg, {{}})
+        print("UNREACHABLE")
+        """)
+    assert r.returncode == faultsim.CRASH_EXIT_CODE, r.stderr[-2000:]
+    symbol, arg_params, aux_params = mx.model.load_checkpoint(prefix, 1)
+    onp.testing.assert_array_equal(arg_params["fc_weight"].asnumpy(),
+                                   onp.ones((2, 3)))
+    assert not os.path.exists(f"{prefix}-0002.params")
+
+
+def test_load_params_detects_corruption(tmp_path):
+    prefix = str(tmp_path / "model")
+    d = sym.Variable("data")
+    net = sym.FullyConnected(d, num_hidden=2, name="fc")
+    mx.model.save_checkpoint(prefix, 1, net,
+                             {"fc_weight": mx.nd.ones((2, 3))}, {})
+    with open(f"{prefix}-0001.params", "r+b") as f:
+        f.truncate(16)  # a torn write from a foreign tool
+    with pytest.raises(mx.MXNetError, match="verification"):
+        mx.model.load_params(prefix, 1)
+
+
+def test_checkpoint_retention_verify_and_fallback(tmp_path):
+    prefix = str(tmp_path / "ck")
+    mgr = CheckpointManager(prefix, keep_n=2)
+    for e in (1, 2, 3):
+        mgr.save(e, arg_params={"w": mx.nd.full((3,), float(e))},
+                 optimizer_states=f"state{e}".encode())
+    assert mgr.epochs() == [2, 3]  # keep_n pruned version 1
+    assert not os.path.exists(mgr.params_path(1))
+    assert mgr.latest_epoch() == 3
+    # corrupt the newest: fallback to the previous good version
+    with open(mgr.params_path(3), "r+b") as f:
+        f.truncate(10)
+    assert not mgr.verify(3)
+    assert mgr.latest_epoch() == 2
+    st = mgr.load()
+    assert st["epoch"] == 2
+    assert st["optimizer_states"] == b"state2"
+    onp.testing.assert_array_equal(st["arg_params"]["w"].asnumpy(),
+                                   onp.full((3,), 2.0))
+    # a pinned corrupt epoch is detection, not substitution
+    with pytest.raises(mx.MXNetError, match="verification"):
+        mgr.load(3)
+
+
+def test_rng_capture_restore_roundtrip():
+    mx.random.seed(13)
+    snap = capture_rng()
+    host_a = onp.random.rand(4)
+    dev_a = mx.nd.random_uniform(shape=(4,)).asnumpy()
+    restore_rng(snap)
+    host_b = onp.random.rand(4)
+    dev_b = mx.nd.random_uniform(shape=(4,)).asnumpy()
+    onp.testing.assert_array_equal(host_a, host_b)
+    onp.testing.assert_array_equal(dev_a, dev_b)
+
+
+# --------------------------------------------------- fit: resume + drain
+def _mlp():
+    d = sym.Variable("data")
+    fc1 = sym.FullyConnected(d, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(fc2, sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def _toy_data():
+    rng = onp.random.RandomState(7)
+    X = rng.randn(64, 10).astype("float32")
+    y = (X @ rng.randn(10, 4)).argmax(axis=1).astype("float32")
+    return X, y
+
+
+def _fit(num_epoch, resume_from=None, checkpoint=None,
+         batch_end_callback=None, seed=11):
+    mx.random.seed(seed)
+    onp.random.seed(seed)
+    X, y = _toy_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=False)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=num_epoch, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1),
+                              ("momentum", 0.9)),
+            initializer=mx.init.Xavier(), resume_from=resume_from,
+            checkpoint=checkpoint, batch_end_callback=batch_end_callback)
+    return mod
+
+
+_FIT_SCRIPT = """
+    import os, signal
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+
+    def _mlp():
+        d = sym.Variable("data")
+        fc1 = sym.FullyConnected(d, num_hidden=16, name="fc1")
+        act = sym.Activation(fc1, act_type="relu", name="relu1")
+        fc2 = sym.FullyConnected(act, num_hidden=4, name="fc2")
+        return sym.SoftmaxOutput(fc2, sym.Variable("softmax_label"),
+                                 name="softmax")
+
+    rng = onp.random.RandomState(7)
+    X = rng.randn(64, 10).astype("float32")
+    y = (X @ rng.randn(10, 4)).argmax(axis=1).astype("float32")
+    mx.random.seed(11)
+    onp.random.seed(11)
+    it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=False)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+
+    def killer(param):
+        # simulated preemption: SIGTERM lands after epoch 1, batch 2
+        if param.epoch == 1 and param.nbatch == 2:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    mod.fit(it, num_epoch=3, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1),
+                              ("momentum", 0.9)),
+            initializer=mx.init.Xavier(), checkpoint=PREFIX,
+            batch_end_callback=killer)
+    print("COMPLETED")
+"""
+
+
+def test_sigterm_drain_then_resume_is_bit_exact(tmp_path):
+    """THE acceptance scenario: kill fit with SIGTERM mid-epoch, see
+    the drain flush a cursor-bearing checkpoint and the process exit
+    with the signal's disposition, then relaunch with resume_from= and
+    get the uninterrupted run's final params bit-exactly."""
+    prefix = str(tmp_path / "elastic")
+    # run A: uninterrupted reference (in-process)
+    mod_a = _fit(3)
+    arg_a, aux_a = mod_a.get_params()
+
+    # run B1: killed by SIGTERM at epoch 1 batch 2 (subprocess)
+    r = _run_script(
+        _FIT_SCRIPT.replace("PREFIX", repr(prefix)))
+    assert r.returncode == -signal.SIGTERM, (r.returncode,
+                                             r.stderr[-2000:])
+    assert "COMPLETED" not in r.stdout  # drained, not completed
+    mgr = CheckpointManager(prefix)
+    ep = mgr.latest_epoch()
+    assert ep is not None
+    drained = mgr.load(ep)
+    # the drain checkpoint carries the mid-epoch cursor (3 batches of
+    # epoch 1 done when the handler fired)
+    assert drained["epoch"] == 1
+    assert drained["batch_cursor"] == 3
+    assert drained["optimizer_states"]  # momentum came along
+
+    # run B2: relaunch with resume_from= (in-process)
+    mod_b = _fit(3, resume_from=prefix)
+    arg_b, aux_b = mod_b.get_params()
+    assert set(arg_a) == set(arg_b)
+    for k in arg_a:
+        onp.testing.assert_array_equal(arg_a[k].asnumpy(),
+                                       arg_b[k].asnumpy(), err_msg=k)
+    for k in aux_a:
+        onp.testing.assert_array_equal(aux_a[k].asnumpy(),
+                                       aux_b[k].asnumpy(), err_msg=k)
+    # teardown hygiene: fit closed its device-feed producers
+    assert not [t for t in threading.enumerate()
+                if t.name == "DeviceFeedIter" and t.is_alive()]
+
+
+def test_resume_from_epoch_boundary_is_bit_exact(tmp_path):
+    """Epoch-boundary resume (cursor 0): stop a checkpointed run after
+    2 of 3 epochs, resume, and match the uninterrupted run."""
+    prefix = str(tmp_path / "bnd")
+    mod_a = _fit(3)
+    arg_a, _ = mod_a.get_params()
+    _fit(2, checkpoint=prefix)  # leaves a clean epoch-2 checkpoint
+    mgr = CheckpointManager(prefix)
+    assert mgr.latest_epoch() == 2
+    assert mgr.load()["batch_cursor"] == 0
+    mod_b = _fit(3, resume_from=prefix)
+    arg_b, _ = mod_b.get_params()
+    for k in arg_a:
+        onp.testing.assert_array_equal(arg_a[k].asnumpy(),
+                                       arg_b[k].asnumpy(), err_msg=k)
+
+
+# --------------------------------------------------------- NaN/Inf guard
+def test_nan_guard_skips_bad_step_and_recovers(monkeypatch):
+    monkeypatch.setenv("MXNET_BAD_STEP_LIMIT", "3")
+    faultsim.reset("step.loss_nan:nan@2")  # exactly one bad step
+    snaps = []
+    mod_holder = {}
+
+    def snap_cb(param):
+        arg, _ = mod_holder["mod"].get_params()
+        snaps.append({k: v.asnumpy() for k, v in arg.items()})
+
+    mx.random.seed(11)
+    onp.random.seed(11)
+    X, y = _toy_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=False)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod_holder["mod"] = mod
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1),),
+            initializer=mx.init.Xavier(), batch_end_callback=snap_cb)
+    # the armed hit was step 2 (0-indexed batch 1): its update was
+    # withheld, so the params after batch 1 equal those after batch 0
+    assert len(snaps) == 8
+    for k in snaps[0]:
+        onp.testing.assert_array_equal(snaps[0][k], snaps[1][k],
+                                       err_msg=k)
+    # training resumed after the skip: batch 2 moved the params again
+    assert any(not onp.array_equal(snaps[1][k], snaps[2][k])
+               for k in snaps[1])
+
+
+def test_nan_guard_aborts_at_limit_and_restores(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_BAD_STEP_LIMIT", "2")
+    prefix = str(tmp_path / "guard")
+    # every step of epoch 1 is bad (epoch 0's 8 steps complete and
+    # leave a clean checkpoint to restore)
+    faultsim.reset("step.loss_nan:nan@9+")
+    mx.random.seed(11)
+    onp.random.seed(11)
+    X, y = _toy_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=False)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    with pytest.raises(mx.MXNetError, match="consecutive non-finite"):
+        mod.fit(it, num_epoch=2, optimizer="sgd",
+                optimizer_params=(("learning_rate", 0.1),),
+                initializer=mx.init.Xavier(), checkpoint=prefix)
+    # params came back as the last-good checkpoint (end of epoch 0)
+    ck = CheckpointManager(prefix).load()
+    arg, _ = mod.get_params()
+    for k, v in ck["arg_params"].items():
+        onp.testing.assert_array_equal(arg[k].asnumpy(), v.asnumpy(),
+                                       err_msg=k)
+
+
+def test_make_train_step_in_graph_guard():
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import make_train_step
+
+    net = nn.Dense(2, in_units=3)
+    net.initialize(init=mx.init.Constant(0.5))
+    step_fn, params, opt_state = make_train_step(
+        net, gluon.loss.L2Loss(), optimizer="sgd", learning_rate=0.1,
+        donate=False, nan_guard=True)
+    x = jnp.ones((4, 3), jnp.float32)
+    y = jnp.zeros((4, 2), jnp.float32)
+    key = jax.random.key(0)
+    _, p1, s1 = step_fn(params, opt_state, x, y, key, 1.0)
+    assert int(s1["_bad_steps"]) == 0
+    # a NaN batch: update skipped, consecutive counter bumps
+    _, p2, s2 = step_fn(p1, s1, x * jnp.nan, y, key, 2.0)
+    assert int(s2["_bad_steps"]) == 1
+    for k in p1:
+        onp.testing.assert_array_equal(onp.asarray(p1[k]),
+                                       onp.asarray(p2[k]), err_msg=k)
+    _, p3, s3 = step_fn(p2, s2, x * jnp.inf, y, key, 3.0)
+    assert int(s3["_bad_steps"]) == 2  # consecutive
+    # a finite step updates again and resets the counter
+    _, p4, s4 = step_fn(p3, s3, x, y, key, 4.0)
+    assert int(s4["_bad_steps"]) == 0
+    assert any(not onp.array_equal(onp.asarray(p3[k]),
+                                   onp.asarray(p4[k])) for k in p3)
+
+
+def test_make_train_step_loss_nan_injection():
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import make_train_step
+
+    faultsim.reset("step.loss_nan:nan@1")
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    step_fn, params, opt_state = make_train_step(
+        net, gluon.loss.L2Loss(), donate=False, nan_guard=True)
+    x = jnp.ones((4, 3), jnp.float32)
+    y = jnp.zeros((4, 2), jnp.float32)
+    key = jax.random.key(0)
+    # hit 1 is armed: the wrapper poisons the batch, the in-graph
+    # guard withholds the update
+    _, p1, s1 = step_fn(params, opt_state, x, y, key, 1.0)
+    assert int(s1["_bad_steps"]) == 1
+    _, p2, s2 = step_fn(p1, s1, x, y, key, 2.0)
+    assert int(s2["_bad_steps"]) == 0
+
+
+# ------------------------------------------------------------ PS client
+def test_ps_deadline_env_replaces_600s(monkeypatch):
+    """The former hard-coded 600 s readiness wait now follows
+    MXNET_PS_DEADLINE_SEC: a pull that can never become ready times
+    out in well under 600 s."""
+    monkeypatch.setenv("MXNET_PS_DEADLINE_SEC", "0.3")
+    from mxnet_tpu._ps import _ServerShard, _recv_msg, _send_msg
+
+    shard = _ServerShard(0, 2)
+    shard.start()
+    try:
+        s = socket.create_connection(("127.0.0.1", shard.port),
+                                     timeout=5)
+        t0 = time.monotonic()
+        _send_msg(s, ("pull", "never-initialized", 0))
+        resp = _recv_msg(s)
+        dt = time.monotonic() - t0
+        assert resp[0] == "err" and "timeout" in resp[1]
+        assert dt < 10.0, dt  # seconds, not the old 600
+        s.close()
+    finally:
+        shard.stop()
+
+
+def test_ps_client_retries_injected_faults(monkeypatch):
+    """The PS client's bounded-backoff retry recovers from injected
+    ps.push faults (raise => retried like a transport error;
+    delay => the op just takes longer) without losing the update."""
+    monkeypatch.setenv("MXNET_PS_NATIVE", "0")
+    from mxnet_tpu._ps import PSBackend
+
+    be = PSBackend(0, 1)  # direct ctor: the singleton is shared state
+    try:
+        be.init("k", onp.zeros((4,), onp.float32))
+        faultsim.reset("ps.push:raise@1")
+        be.push("k", onp.ones((4,), onp.float32), "sync")
+        assert faultsim.hits("ps.push") == 2  # first raised, retry won
+        out = onp.asarray(be.pull("k")).reshape(4)
+        onp.testing.assert_array_equal(out, onp.ones(4))
+
+        faultsim.reset("ps.push:delay=0.2@1")
+        t0 = time.monotonic()
+        be.push("k", onp.full((4,), 2.0, onp.float32), "sync")
+        assert time.monotonic() - t0 >= 0.2
+        out = onp.asarray(be.pull("k")).reshape(4)
+        onp.testing.assert_array_equal(out, onp.full(4, 2.0))
+
+        # exhausted attempts surface the injected fault, not silence
+        faultsim.reset("ps.pull:raise@1+")
+        with pytest.raises(faultsim.FaultInjected):
+            be.pull("k")
+    finally:
+        faultsim.reset("")
+        be.stop_heartbeat()
+        if be.server is not None:
+            be.server.stop()
+
+
+# -------------------------------------------------------- device feed
+def _batches(n=4):
+    for i in range(n):
+        yield (onp.full((2, 2), float(i), "float32"),
+               onp.zeros((2,), "float32"))
+
+
+def test_device_feed_close_idempotent_bounded_no_leak():
+    from mxnet_tpu.io.device_feed import DeviceFeedIter
+
+    it = DeviceFeedIter(_batches(8), depth=2)
+    first = it.next()
+    assert onp.asarray(first[0].asnumpy()).shape == (2, 2)
+    t0 = time.monotonic()
+    it.close()
+    it.close()  # idempotent
+    assert time.monotonic() - t0 < 15.0  # bounded join
+    assert it._thread is None
+    with pytest.raises(StopIteration):
+        it.next()  # closed: no blocking on a dead producer
+    assert not [t for t in threading.enumerate()
+                if t.name == "DeviceFeedIter" and t.is_alive()]
+    # reset() revives a closed wrapper (fit epoch-loop contract) —
+    # a resettable source replays from the top
+    base = mx.io.NDArrayIter(onp.zeros((8, 2), "float32"),
+                             onp.zeros((8,), "float32"), batch_size=4)
+    it2 = DeviceFeedIter(base, depth=1)
+    it2.close()
+    it2.reset()
+    assert len(list(it2)) == 2
+    it2.close()
+
+
+def test_device_feed_h2d_injection_retried():
+    from mxnet_tpu.io.device_feed import DeviceFeedIter
+
+    faultsim.reset("feed.h2d:raise@1")
+    it = DeviceFeedIter(_batches(3), depth=1)
+    got = list(it)
+    assert len(got) == 3  # producer retried the injected fault
+    assert faultsim.hits("feed.h2d") == 4  # 3 batches + 1 retry
+    it.close()
+
+
+def test_device_feed_persistent_fault_surfaces():
+    from mxnet_tpu.io.device_feed import DeviceFeedIter
+
+    faultsim.reset("feed.h2d:raise@1+")  # beyond any retry budget
+    it = DeviceFeedIter(_batches(3), depth=1)
+    with pytest.raises(faultsim.FaultInjected):
+        list(it)
+    it.close()
+    assert not [t for t in threading.enumerate()
+                if t.name == "DeviceFeedIter" and t.is_alive()]
