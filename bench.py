@@ -53,6 +53,12 @@ the round its headline artifact):
 * the async device feed A/B (``"device_feed"`` in the JSON) runs real
   steps fed blocking vs through io.DeviceFeedIter and reports the
   per-phase feed/compute overlap;
+* the ``collectives`` phase compiles the dp step over a forced
+  8-device CPU mesh in a subprocess, sharded
+  (``optimizer_sharding="ps"``, the flat-bucketed reduce-scatter +
+  shard-owned optimizer of parallel.zero) vs replicated, and reports
+  each program's HLO collective counts/bytes under ``"collectives"``
+  in the JSON — the launch-count win is measurable without TPUs;
 * ``--checkpoint PREFIX`` writes timed atomic checkpoints
   (resilience.checkpoint) after the measure and feed phases — write
   cost lands under ``"checkpoint": {"write_s": ...}`` in the JSON
@@ -402,6 +408,90 @@ def _ckpt_resume(prefix, params, opt_state):
     return params, opt_state, st["epoch"]
 
 
+def _collectives_probe(n_devices):
+    """Child mode (``--collectives-probe N``): compile the smoke-net dp
+    train step over an N-device CPU mesh twice — replicated vs
+    ``optimizer_sharding="ps"`` — and print ONE JSON line with each
+    program's HLO collective counts/bytes.  Runs in a subprocess
+    because the device count must be forced before JAX initializes."""
+    # the probe DEFINES its two arms: a caller-level
+    # MXNET_OPTIMIZER_SHARDING (force-on or force-off) would make both
+    # arms compile the same program and the A/B silently lie
+    os.environ.pop("MXNET_OPTIMIZER_SHARDING", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu  # noqa: F401
+    import jax.numpy as jnp
+    import numpy as onp
+
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import get_mesh, make_train_step
+    from mxnet_tpu.parallel.zero import collective_bytes
+
+    net, classes = _build_net(True, "NCHW")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = get_mesh((n_devices,), ("data",),
+                    devices=jax.devices()[:n_devices])
+    batch = n_devices * 2
+    x = jnp.asarray(onp.random.rand(batch, 3, 16, 16).astype("float32"))
+    y = jnp.asarray(
+        onp.random.randint(0, classes, (batch,)).astype("float32"))
+    key = jax.random.key(0)
+    out = {"n": n_devices,
+           "net": "smoke-conv (structural metric; counts do not depend "
+                  "on the net's scale, only its tensor list)"}
+    for label, kw in (("replicated", {}),
+                      ("sharded", {"optimizer_sharding": "ps"})):
+        step, p, s = make_train_step(
+            net, loss_fn, optimizer="sgd", learning_rate=0.1,
+            momentum=0.9, mesh=mesh, donate=False, autotune=False, **kw)
+        acc = collective_bytes(
+            step.lower(p, s, x, y, key, 1.0).compile().as_text())
+        out[label] = acc
+    rep = out["replicated"]["counts"]
+    shd = out["sharded"]["counts"]
+    out["launches_replicated"] = sum(rep.values())
+    out["launches_sharded"] = sum(shd.values())
+    print(json.dumps(out), flush=True)
+
+
+def _measure_collectives(deadline):
+    """The ``collectives`` phase: per-step collective launch counts and
+    bytes of the compiled dp step, sharded vs replicated, measured
+    WITHOUT TPUs on a forced 8-device CPU mesh (the
+    ``_collective_bytes`` methodology the multichip dryrun anchors
+    on).  Subprocess because the device count is a pre-init flag."""
+    import subprocess
+    import sys as _sys
+
+    n = 8
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
+                     if "host_platform_device_count" not in f)
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n}").strip()
+    # the budget must NEVER exceed the remaining internal deadline —
+    # granting a slow box a fixed minimum here would let the subprocess
+    # push the run past the external watchdog the deadline pre-empts
+    budget = min(600.0, deadline.remaining())
+    if budget < 10.0:
+        raise RuntimeError(
+            "deadline: insufficient budget left for the collectives "
+            "probe subprocess")
+    proc = subprocess.run(
+        [_sys.executable, os.path.abspath(__file__),
+         "--collectives-probe", str(n)],
+        env=env, capture_output=True, text=True, timeout=budget)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"collectives probe rc={proc.returncode}: "
+            f"{proc.stderr[-500:]}")
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    return json.loads(lines[-1])
+
+
 def _conv_ab(batch, smoke, deadline):
     """Step-level MXNET_CONV_1X1_DOT A/B in NHWC (the flag only lowers
     CHANNEL-LAST 1x1 convs to dot_general — ops/conv.py:60-83).
@@ -460,7 +550,14 @@ def main(argv=None):
                     help="restore params/opt state from a checkpoint "
                          "prefix before measuring; the JSON records "
                          "resumed: true")
+    ap.add_argument("--collectives-probe", dest="collectives_probe",
+                    type=int, default=None, help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+
+    if args.collectives_probe:
+        # child mode for the collectives phase: the parent forced the
+        # CPU platform + device count in our env before exec
+        return _collectives_probe(args.collectives_probe)
 
     default_deadline = 240.0 if args.smoke else 1500.0
     deadline_s = args.deadline if args.deadline is not None else float(
@@ -657,6 +754,23 @@ def main(argv=None):
             import shutil
 
             shutil.rmtree(ckpt_tmpdir, ignore_errors=True)
+
+    # collective launch accounting (sharded-server vs replicated dp
+    # step on the virtual CPU mesh) — the round-9 structural metric:
+    # counts/bytes land in the JSON so a per-tensor-collective
+    # regression is visible in the headline artifact, not just in CI
+    if deadline.exceeded(margin=0.0 if args.smoke else 60.0):
+        out["collectives"] = "skipped (deadline)"
+        out["degraded"] = True
+        reasons.append("deadline: skipped collectives phase")
+    else:
+        _heartbeat("collectives")
+        try:
+            out["collectives"] = _measure_collectives(deadline)
+        except Exception as exc:  # auxiliary metric: never kill the run
+            out["collectives"] = {"error": repr(exc)}
+            out["degraded"] = True
+            reasons.append(f"collectives phase failed: {exc!r}")
 
     if args.conv_ab or args.smoke:
         # the A/B costs roughly two more build+compile+measure passes

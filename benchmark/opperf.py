@@ -400,6 +400,24 @@ def _bench_extra_inputs():
         "multi_lars": ([v, v, v, v], dict(eta=0.001, eps=1e-8)),
         # _sparse_adagrad_update is an alias of adagrad_update (timed)
     }
+    # bucketed flat-tensor rows (round 9): one launch over a 1M-element
+    # flat bucket — the sharded-server exchange's inner update as
+    # benchmarked ops (the multi_mp_sgd/multi_lars analog); seg_ids
+    # partitions the bucket into 16 "parameters" for the LARS trust
+    # ratios (int input: the chain perturbation adds an integer 0)
+    flat = onp.random.rand(n * n).astype("float32")
+    seg = onp.repeat(onp.arange(16, dtype="int32"), (n * n) // 16)
+    opt.update({
+        "_fused_bucket_sgd_mom_update": (
+            [flat, flat.copy(), flat.copy()],
+            dict(lr=0.1, momentum=0.9)),
+        "_fused_bucket_adam_update": (
+            [flat, flat.copy(), flat.copy(), flat.copy()],
+            dict(lr=0.1)),
+        "_fused_bucket_lars_update": (
+            [flat, flat.copy(), flat.copy(), seg],
+            dict(lr=0.1, momentum=0.9, num_segments=16)),
+    })
     scalar_cmp = {
         name: ([a], dict(scalar=0.5))
         for name in ("_equal_scalar", "_not_equal_scalar",

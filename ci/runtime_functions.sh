@@ -53,14 +53,31 @@ opperf_smoke() {
     # per-op benchmark smoke on CPU: a representative slice of the
     # curated tables — including the r05 per-op input registries
     # (optimizer updates, zero-input samplers, npi tail, quantized,
-    # detection) — so expanded op coverage keeps producing a committed
-    # OPPERF_*.jsonl artifact instead of silently lapsing.  One JSON
-    # line per op lands in OPPERF_smoke.jsonl (diffable across PRs).
+    # detection) and the round-9 bucketed flat-tensor optimizer rows
+    # (_fused_bucket_*, the multi_mp_sgd/multi_lars analog the
+    # sharded-server exchange runs per step) — so expanded op coverage
+    # keeps producing a committed OPPERF_*.jsonl artifact instead of
+    # silently lapsing.  One JSON line per op lands in
+    # OPPERF_smoke.jsonl (diffable across PRs).
     JAX_PLATFORMS=cpu python benchmark/opperf.py --runs 8 --ops \
 dot,Convolution,BatchNorm,FullyConnected,softmax,SyncBatchNorm,\
-_contrib_BNReluConv,sgd_update,adam_update,multi_lars,_random_uniform,\
+_contrib_BNReluConv,sgd_update,adam_update,multi_lars,\
+_fused_bucket_sgd_mom_update,_fused_bucket_adam_update,\
+_fused_bucket_lars_update,_random_uniform,\
 _npi_interp,_npi_full_like,_contrib_quantize,MultiBoxPrior \
         | tee OPPERF_smoke.jsonl
+}
+
+collectives_budget() {
+    # sharded-server launch-count gate: the dp(16) dryrun runs the
+    # flat-bucketed exchange (optimizer_sharding="ps") and ASSERTS its
+    # collective budget — <= MXNET_COLLECTIVES_BUDGET (default 8)
+    # reduce-scatters and all-gathers and <= 2 all-reduces in the
+    # compiled step's HLO (vs one all-reduce per tensor replicated,
+    # 54+ launches in the r05 artifact).  A bucketing regression fails
+    # this cell on the CPU mesh before it ever reaches a pod.
+    JAX_PLATFORMS=cpu MXNET_DRYRUN_SCALING=0 MXNET_DRYRUN_CASES=dp \
+        python -c "import __graft_entry__ as g; g.dryrun_multichip(16)"
 }
 
 "$@"

@@ -86,8 +86,27 @@ register_env("MXNET_ENFORCE_DETERMINISM", False, bool,
              "Force full fp32 matmul precision on the MXU (slower, "
              "reproducible to the ulp).")
 register_env("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000, int,
-             "Reference key-sharding bound; informational under the "
-             "allreduce design (no server shards to balance).", live=False)
+             "Flat-bucket split threshold (elements) for the sharded-"
+             "server gradient exchange (optimizer_sharding='ps', "
+             "parallel.zero): a bucket closes once the next parameter "
+             "would push it past this many elements — the authentic "
+             "ps-lite bound above which arrays are sliced across "
+             "servers.  Fewer, larger buckets mean fewer collective "
+             "launches; the collectives-budget CI gate runs at 4e6.")
+register_env("MXNET_OPTIMIZER_SHARDING", "", str,
+             "Sharded-server optimizer (ZeRO-1 as the TPU-native "
+             "parameter server): 'ps'/'1' forces it on for every "
+             "make_train_step/Module mesh, '0'/'off' forces it off "
+             "(overriding the kvstore='dist_sync' mapping and explicit "
+             "opt-ins), empty defers to the caller.  Gradients "
+             "reduce-scatter in flat buckets, the optimizer updates "
+             "only the locally-owned shard (state lives sharded), and "
+             "the params all-gather back.")
+register_env("MXNET_COLLECTIVES_BUDGET", 8, int,
+             "Per-step collective-launch budget the dp dryrun verdict "
+             "gates against under optimizer_sharding='ps': at most "
+             "this many reduce-scatters and all-gathers (and <=2 "
+             "stray all-reduces) in the compiled step's HLO.")
 register_env("MXNET_ENGINE_TYPE", "XLA", str,
              "Reference engine selector; the XLA async runtime is the "
              "only engine.", live=False)

@@ -28,7 +28,8 @@ from . import ndarray as nd
 from .base import MXNetError
 from . import optimizer as opt
 
-__all__ = ["KVStore", "DistKVStore", "create", "init_distributed"]
+__all__ = ["KVStore", "DistKVStore", "create", "init_distributed",
+           "quantize_2bit", "GradientCompression"]
 
 _dist_initialized = False
 
@@ -81,6 +82,22 @@ def _key_list(key):
     return ([key] if single else list(key)), single
 
 
+def quantize_2bit(acc, threshold):
+    """The 2-bit quantization rule as ONE pure traced function
+    (reference gradient_compression-inl.h quantize_2bit kernel):
+    ``acc`` (gradient + carried residual) maps to {-t, 0, +t} and the
+    new residual is what quantization dropped.  Shared by the eager
+    :class:`GradientCompression` below and the sharded-server step's
+    per-bucket compression (parallel/__init__.py), so wire semantics
+    cannot drift between the two surfaces.  Accumulation stays in
+    ``acc``'s dtype — callers feed fp32 (the narrow-accumulate
+    discipline for fp16/bf16 gradients)."""
+    t = jnp.asarray(threshold, acc.dtype)
+    q = jnp.where(acc >= t, t,
+                  jnp.where(acc <= -t, -t, jnp.zeros((), acc.dtype)))
+    return q, acc - q
+
+
 class GradientCompression:
     """2-bit gradient compression with error-feedback residual
     (reference src/kvstore/gradient_compression.h:38-121).
@@ -96,23 +113,27 @@ class GradientCompression:
         self.threshold = float(threshold)
         self._residual = {}
 
-    def _quantize(self, key, grad_v):
-        r = self._residual.get(key)
+    def _quantize(self, key, grad_v, shard=None):
+        """Residuals are keyed per (key, shard): a big array sliced
+        into bucket-shards (MXNET_KVSTORE_BIGARRAY_BOUND) quantizes
+        each slice as its own wire unit, and a shared residual would
+        cross-feed one shard's error into another's next round —
+        silently corrupting the error-feedback contract."""
+        rk = key if shard is None else (key, shard)
+        r = self._residual.get(rk)
         if r is None:
             r = jnp.zeros_like(grad_v)
-        acc = grad_v + r
-        t = self.threshold
-        q = jnp.where(acc >= t, t, jnp.where(acc <= -t, -t, 0.0))
-        self._residual[key] = acc - q
+        q, resid = quantize_2bit(grad_v + r, self.threshold)
+        self._residual[rk] = resid
         return q
 
-    def compress(self, key, grad_v):
+    def compress(self, key, grad_v, shard=None):
         """Local quantize-dequantize (single-process stores: no wire)."""
-        return self._quantize(key, grad_v)
+        return self._quantize(key, grad_v, shard=shard)
 
-    def compress_packed(self, key, grad_v):
+    def compress_packed(self, key, grad_v, shard=None):
         """Quantize and pack to the 2-bit wire payload (uint8)."""
-        q = self._quantize(key, grad_v)
+        q = self._quantize(key, grad_v, shard=shard)
         codes = jnp.where(q > 0, jnp.uint8(1),
                           jnp.where(q < 0, jnp.uint8(2), jnp.uint8(0)))
         flat = codes.reshape(-1)
@@ -258,6 +279,11 @@ class KVStore:
             raise MXNetError(f"unsupported compression {ctype}")
         self._compression = GradientCompression(
             compression_params.get("threshold", 0.5))
+        # the fresh compressor carries no residual state, so the
+        # per-key slice-step pins (fixed by the OLD residuals' layout)
+        # protect nothing anymore — let new pushes re-pin at the
+        # current MXNET_KVSTORE_BIGARRAY_BOUND
+        getattr(self, "_comp_slice_step", {}).clear()
 
     # --------------------------------------------------------- optimizer
     def set_optimizer(self, optimizer):
@@ -348,6 +374,10 @@ class DistKVStore(KVStore):
         # compression tests read these)
         self.last_wire_bytes = 0
         self.last_uncompressed_bytes = 0
+        # per-key pinned compression slice step (see
+        # _compress_packed_bigarray: residual layout must outlive
+        # mid-run MXNET_KVSTORE_BIGARRAY_BOUND changes)
+        self._comp_slice_step = {}
 
     # ------------------------------------------------ sharded PS backend
     def _ps_active(self):
@@ -478,6 +508,38 @@ class DistKVStore(KVStore):
                 self._store[k]._adopt(
                     self._broadcast0(self._store[k]._data))
 
+    def _compress_packed_bigarray(self, k, a32):
+        """Compress one push payload, slicing arrays above the live
+        ``MXNET_KVSTORE_BIGARRAY_BOUND`` into bound-sized bucket-shards
+        first — the ps-lite big-array slicing (kvstore_dist.h
+        EncodeDefaultKey) applied to the compressed wire.  Each slice
+        quantizes as its own unit with its OWN error-feedback residual
+        (keyed per (key, shard) in GradientCompression — a shared
+        residual would cross-feed one slice's dropped error into
+        another's next round).  Slice edges are 4-aligned, so the
+        concatenated payload is byte-identical to whole-array packing
+        and the server-side decompress needs no changes.
+
+        The slice step is PINNED per key at its first compressed push:
+        residual shapes/offsets are fixed by the original slicing, so
+        a mid-run MXNET_KVSTORE_BIGARRAY_BOUND change (the knob is
+        live) applies to keys first pushed after it, never to a key
+        whose residual state already exists under the old layout."""
+        from .config import get_env
+
+        flat = a32.reshape(-1)
+        step = self._comp_slice_step.get(k)
+        if step is None:
+            bound = int(get_env("MXNET_KVSTORE_BIGARRAY_BOUND"))
+            step = max(4, (bound // 4) * 4)
+            self._comp_slice_step[k] = step
+        if flat.size <= step:
+            return onp.asarray(self._compression.compress_packed(k, a32))
+        return onp.concatenate([
+            onp.asarray(self._compression.compress_packed(
+                k, flat[o:o + step], shard=i))
+            for i, o in enumerate(range(0, flat.size, step))])
+
     def _push_sparse(self, k, vlist):
         """Row-sparse push: aggregate the per-device grads, ship only
         (rows, vals) to the key's owner shard — O(nnz) wire bytes."""
@@ -532,8 +594,7 @@ class DistKVStore(KVStore):
             if self._compression is not None:
                 # quantization math is f32; the packed wire stays 2-bit
                 a32 = agg.astype(jnp.float32)
-                payload = onp.asarray(
-                    self._compression.compress_packed(k, a32))
+                payload = self._compress_packed_bigarray(k, a32)
                 self.last_wire_bytes = int(payload.nbytes)
                 self.last_uncompressed_bytes = int(agg.nbytes)
                 self._ps_op(k, lambda: ps.push(
@@ -731,7 +792,7 @@ class DistKVStore(KVStore):
             narrow = agg.dtype if agg.dtype in (jnp.float16,
                                                 jnp.bfloat16) else None
             a32 = agg.astype(jnp.float32) if narrow is not None else agg
-            payload = self._compression.compress_packed(key, a32)
+            payload = self._compress_packed_bigarray(key, a32)
             self.last_wire_bytes = int(payload.nbytes)
             self.last_uncompressed_bytes = int(agg.nbytes)
             out = self._compression.decompress(payload, a32.shape,

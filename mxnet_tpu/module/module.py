@@ -264,6 +264,46 @@ class Module(BaseModule):
         return arg, aux
 
     # -------------------------------------------------------- optimizer
+    def _update_param_names(self):
+        """Parameters the optimizer actually updates (grad_req not
+        'null' and a gradient buffer exists) — the set the sharded
+        bucket plan must cover exactly."""
+        return [n for n in self._param_names
+                if self._grad_req.get(n, "null") != "null"
+                and self._exec.grad_dict.get(n) is not None]
+
+    def _resolve_optimizer_sharding(self, kvstore, optimizer):
+        """Map ``kvstore='dist_*'`` (whose reference semantics ARE the
+        server-side optimizer on key shards, kvstore_dist_server.h:346)
+        to the sharded-server updater over this module's data mesh.
+        MXNET_OPTIMIZER_SHARDING overrides in both directions.
+        Per-param lr_mult/wd_mult ARE supported (the updater
+        partitions buckets by effective (lr, wd)); semantics the flat
+        buckets cannot reproduce — per-update lr schedules, stochastic
+        rules, multi-precision masters, fused/eager state-layout
+        mismatches — fall back to the eager per-param Updater with a
+        logged reason."""
+        from ..parallel.zero import (resolve_sharding_env,
+                                     sharding_rule_reasons)
+
+        env = resolve_sharding_env()
+        if env is False:
+            return None
+        kv_name = kvstore if isinstance(kvstore, str) else \
+            getattr(kvstore, "type", "")
+        if env != "ps" and not str(kv_name).startswith("dist"):
+            return None
+        if self._mesh is None:
+            return None  # one device: nothing to shard over
+        reasons = sharding_rule_reasons(optimizer)
+        if reasons:
+            self.logger.warning(
+                "optimizer sharding requested (kvstore=%r) but falling "
+                "back to the replicated updater: %s", kv_name,
+                "; ".join(reasons))
+            return None
+        return "ps"
+
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
@@ -283,7 +323,19 @@ class Module(BaseModule):
             optimizer = opt.create(
                 optimizer, param_idx2name=idx2name, **opt_params)
         self._optimizer = optimizer
-        self._updater = opt.get_updater(optimizer)
+        if self._resolve_optimizer_sharding(kvstore, optimizer) == "ps":
+            # ZeRO-1: optimizer state sharded over the data mesh in
+            # flat buckets, updates run on the owned shard only, params
+            # all-gather back (parallel.zero; the dist_sync
+            # server-side-optimizer analog)
+            from ..parallel.zero import ShardedBucketUpdater
+
+            upd = {n: self._exec.arg_dict[n]._data
+                   for n in self._update_param_names()}
+            self._updater = ShardedBucketUpdater(optimizer, self._mesh,
+                                                 upd)
+        else:
+            self._updater = opt.get_updater(optimizer)
         self.optimizer_initialized = True
 
     # ------------------------------------------------------------- exec
@@ -317,13 +369,18 @@ class Module(BaseModule):
     def update(self):
         self._check_binded()
         assert self.optimizer_initialized
-        for name in self._param_names:
-            if self._grad_req.get(name, "null") == "null":
-                continue
-            grad = self._exec.grad_dict.get(name)
-            if grad is None:
-                continue
-            self._updater(name, grad, self._exec.arg_dict[name])
+        from ..parallel.zero import ShardedBucketUpdater
+
+        if isinstance(self._updater, ShardedBucketUpdater):
+            # one fused sharded program over ALL params (per-name calls
+            # would defeat the flat bucketing)
+            self._updater.update_all(
+                [(n, self._exec.grad_dict[n], self._exec.arg_dict[n])
+                 for n in self._update_param_names()])
+            return
+        for name in self._update_param_names():
+            self._updater(name, self._exec.grad_dict[name],
+                          self._exec.arg_dict[name])
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
         eval_metric.update(labels, self.get_outputs())
@@ -359,11 +416,9 @@ class Module(BaseModule):
         guard's whole point is that such a step must not update."""
         if not self._outputs_finite():
             return False
-        for name in self._param_names:
-            if self._grad_req.get(name, "null") == "null":
-                continue
-            g = self._exec.grad_dict.get(name)
-            if g is not None and not onp.isfinite(g.asnumpy()).all():
+        for name in self._update_param_names():
+            g = self._exec.grad_dict[name]
+            if not onp.isfinite(g.asnumpy()).all():
                 return False
         return True
 
@@ -371,12 +426,27 @@ class Module(BaseModule):
     def _get_optimizer_states(self):
         if self._updater is None:
             raise MXNetError("optimizer not initialized")
-        return self._updater.get_states()
+        # dump_optimizer=True: the pickle carries the optimizer with
+        # its update COUNTERS (num_update/_index_update_count — and the
+        # sharded updater seeds them from its own step count), so a
+        # resumed adam/ftml run continues its bias correction at the
+        # right t in EITHER mode instead of silently restarting at 1.
+        # Both Updater.set_states and ShardedBucketUpdater.set_states
+        # accept the (states, optimizer) tuple form.
+        return self._updater.get_states(dump_optimizer=True)
 
     def _set_optimizer_states(self, states):
         if self._updater is None:
             raise MXNetError("optimizer not initialized")
         self._updater.set_states(states)
+        # a dump_optimizer pickle makes set_states install the
+        # unpickled optimizer as the updater's live one; re-point the
+        # module at it so post-resume mutations (the lr-decay callback
+        # recipe: module._optimizer.lr = ...) reach the optimizer that
+        # actually runs, not a dead pre-resume object
+        live = getattr(self._updater, "optimizer", None)
+        if live is not None:
+            self._optimizer = live
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):

@@ -12,10 +12,10 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..optimizer.optimizer import (_adagrad_step, _adam_step,
-                                   _ftrl_step, _nag_step,
-                                   _rmsprop_alex_step, _rmsprop_step,
-                                   _sgd_mom_step, _sgd_step,
-                                   _signum_step)
+                                   _ftrl_step, _lars_bucket_step,
+                                   _nag_step, _rmsprop_alex_step,
+                                   _rmsprop_step, _sgd_mom_step,
+                                   _sgd_step, _signum_step)
 from .registry import register_op
 
 
@@ -145,6 +145,53 @@ def multi_sgd_mom_update(*args, lrs, wds, momentum=0.0, num_weights=1,
         new_w.append(nw)
         new_m.append(nm)
     return tuple(new_w) + tuple(new_m)
+
+
+# ------------------------------------- bucketed flat-tensor variants
+# (round 9): ONE launch over a dtype-homogeneous FLAT bucket holding
+# many parameters — the sharded-server exchange's inner update
+# (parallel.zero / make_train_step optimizer_sharding="ps") exposed as
+# standalone ops, the multi_mp_sgd/multi_lars analog: where the
+# reference fuses N small tensors into one kernel by looping inside
+# it, the flat layout IS the fusion.
+@register_op("_fused_bucket_sgd_mom_update", num_outputs=2,
+             differentiable=False)
+def fused_bucket_sgd_mom_update(weight, grad, mom, *, lr, momentum=0.9,
+                                wd=0.0, rescale_grad=1.0,
+                                clip_gradient=-1.0):
+    """SGD+momentum over one flat bucket (reference analog:
+    multi_sgd_mom_update / multi_mp_sgd_mom_update)."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return _sgd_mom_step(weight, mom, g, lr, wd, momentum)
+
+
+@register_op("_fused_bucket_adam_update", num_outputs=3,
+             differentiable=False)
+def fused_bucket_adam_update(weight, grad, mean, var, *, lr, beta1=0.9,
+                             beta2=0.999, epsilon=1e-8, wd=0.0,
+                             rescale_grad=1.0, clip_gradient=-1.0,
+                             t=1.0):
+    """Adam over one flat bucket (both moment slots ride the same flat
+    layout — the per-chip state the ZeRO-1 shard owns)."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return _adam_step(weight, mean, var, g, lr, wd, beta1, beta2,
+                      epsilon, t)
+
+
+@register_op("_fused_bucket_lars_update", num_outputs=2,
+             differentiable=False)
+def fused_bucket_lars_update(weight, grad, mom, seg_ids, *, lr,
+                             num_segments, momentum=0.9, lars_eta=0.001,
+                             lars_epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                             clip_gradient=-1.0):
+    """LARS over one flat bucket: per-parameter trust ratios recovered
+    from segment-summed norms (``seg_ids`` maps elements to their
+    parameter — the multi_sum_sq + multi_lars pipeline in one op)."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return _lars_bucket_step(weight, mom, g,
+                             seg_ids.astype(jnp.int32), lr, wd,
+                             momentum, lars_eta, lars_epsilon,
+                             int(num_segments))
 
 
 @register_op("multi_sum_sq",

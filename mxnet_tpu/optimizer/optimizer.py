@@ -196,6 +196,30 @@ class Optimizer:
         raise MXNetError(
             f"{type(self).__name__} does not provide a fused SPMD rule")
 
+    #: True when fused_update applies the same math to every element
+    #: independently of its neighbors, so running it on an arbitrary
+    #: slice of a flat dtype-homogeneous bucket of MANY parameters is
+    #: identical to running it per-parameter — the contract the ZeRO-1
+    #: sharded-server exchange (parallel.zero) relies on.  Norm-based
+    #: rules (LARS, GroupAdaGrad) set False; LARS provides the
+    #: bucket-aware form below instead.
+    fused_elementwise = True
+
+    def fused_bucket_update(self, w, g, state, t, key=None, seg_ids=None,
+                            num_segments=None, axis_name=None):
+        """Update one flat bucket SHARD (the server-side-optimizer
+        analog, kvstore_dist_server.h:346).  ``w``/``g``/``state`` are
+        this device's slice of the flat bucket; ``seg_ids`` maps each
+        element to its parameter within the bucket and ``axis_name``
+        names the shard axis, for rules needing cross-shard
+        per-parameter reductions.  Default: delegate to the
+        elementwise ``fused_update``."""
+        if not self.fused_elementwise:
+            raise MXNetError(
+                f"{type(self).__name__} is not elementwise and provides "
+                "no bucket-aware fused rule")
+        return self.fused_update(w, g, state, t, key=key)
+
 
 def _jit(fn):
     """jit with scalar hyper-params as traced args (no recompile per lr)."""
@@ -249,7 +273,10 @@ class SGD(Optimizer):
     def fused_update(self, w, g, state, t, key=None):
         g = self._prep(g)
         if self.momentum == 0.0:
-            return _sgd_step(w, g, self.learning_rate, self.wd), ()
+            # momentum may have been zeroed LIVE: pass any existing
+            # slot through untouched (the eager rule leaves it stale
+            # too) so the traced state structure never changes
+            return _sgd_step(w, g, self.learning_rate, self.wd), state
         (mom,) = state
         new_w, new_m = _sgd_mom_step(w, mom, g, self.learning_rate,
                                      self.wd, self.momentum)
@@ -307,7 +334,8 @@ class NAG(Optimizer):
     def fused_update(self, w, g, state, t, key=None):
         g = self._prep(g)
         if self.momentum == 0.0:
-            return _sgd_step(w, g, self.learning_rate, self.wd), ()
+            # see SGD: live-zeroed momentum keeps the slot structure
+            return _sgd_step(w, g, self.learning_rate, self.wd), state
         (mom,) = state
         new_w, new_m = _nag_step(w, mom, g, self.learning_rate, self.wd,
                                  self.momentum)
@@ -356,8 +384,9 @@ class Signum(Optimizer):
         g = self._prep(g)
         lr, wd = self.learning_rate, self.wd
         if self.momentum == 0.0:
+            # see SGD: live-zeroed momentum keeps the slot structure
             return ((1 - lr * self.wd_lh) * w
-                    - lr * jnp.sign(g + wd * w)), ()
+                    - lr * jnp.sign(g + wd * w)), state
         (mom,) = state
         new_w, new_m = _signum_step(w, mom, g, lr, wd, self.momentum,
                                     self.wd_lh)
@@ -506,7 +535,12 @@ class GroupAdaGrad(Optimizer):
         weight  -= lr * grad / sqrt(history + eps)
 
     One adaptive rate per output row — the embedding-table optimizer.
-    Weight decay is not supported (reference contract)."""
+    Weight decay is not supported (reference contract).  Not
+    bucket-shardable: the per-row history couples elements and no
+    flat-bucket form exists, so ``optimizer_sharding="ps"`` rejects
+    it."""
+
+    fused_elementwise = False
 
     def __init__(self, eps=1e-5, **kwargs):
         super().__init__(**kwargs)
@@ -870,10 +904,38 @@ def _lars_step(w, mom, g, lr, wd, momentum, eta, eps):
     return w - mom, mom
 
 
+def _lars_bucket_step(w, mom, g, seg_ids, lr, wd, momentum, eta, eps,
+                      num_segments, axis_name=None):
+    """LARS over one flat bucket shard: per-PARAMETER trust ratios from
+    segment-summed squared norms, psum'd over the shard axis when a
+    parameter spans shards (the multi_lars/multi_sum_sq pipeline,
+    src/operator/contrib/multi_lars.cc, applied to the ZeRO layout)."""
+    w_ss = jax.ops.segment_sum(w * w, seg_ids,
+                               num_segments=num_segments)
+    g_ss = jax.ops.segment_sum(g * g, seg_ids,
+                               num_segments=num_segments)
+    if axis_name is not None:
+        w_ss = jax.lax.psum(w_ss, axis_name)
+        g_ss = jax.lax.psum(g_ss, axis_name)
+    w_norm = jnp.sqrt(w_ss)
+    g_norm = jnp.sqrt(g_ss)
+    trust = jnp.where((w_norm > 0) & (g_norm > 0),
+                      eta * w_norm / (g_norm + wd * w_norm + eps),
+                      jnp.ones_like(w_norm))
+    scaled_lr = (lr * trust)[seg_ids]
+    mom = momentum * mom + scaled_lr * (g + wd * w)
+    return w - mom, mom
+
+
 @register
 class LARS(Optimizer):
     """Layer-wise Adaptive Rate Scaling (reference optimizer.py:796 and
     the multi_lars fused ops, src/operator/contrib/multi_lars.cc)."""
+
+    #: the trust ratio is a per-TENSOR norm, so the generic
+    #: slice-the-bucket delegation is wrong; fused_bucket_update below
+    #: recovers exact layer norms from segment sums + psum instead
+    fused_elementwise = False
 
     def __init__(self, momentum=0.0, lars_eta=0.001, lars_epsilon=0,
                  momentum_correction=True, **kwargs):
@@ -902,6 +964,18 @@ class LARS(Optimizer):
         new_w, new_m = _lars_step(
             w, mom, self._prep(g), self.learning_rate, self.wd,
             self.momentum, self.eta, self.epsilon)
+        return new_w, (new_m,)
+
+    def fused_bucket_update(self, w, g, state, t, key=None, seg_ids=None,
+                            num_segments=None, axis_name=None):
+        if seg_ids is None:
+            # whole-tensor bucket: degenerate to the per-param rule
+            return self.fused_update(w, g, state, t, key=key)
+        (mom,) = state
+        new_w, new_m = _lars_bucket_step(
+            w, mom, self._prep(g), seg_ids, self.learning_rate, self.wd,
+            self.momentum, self.eta, self.epsilon, num_segments,
+            axis_name)
         return new_w, (new_m,)
 
 

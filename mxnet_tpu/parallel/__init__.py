@@ -35,7 +35,7 @@ from ..base import MXNetError
 __all__ = ["get_mesh", "functionalize", "make_train_step",
            "DataParallelTrainer", "Mesh", "NamedSharding", "P",
            "NORM_STAT_SUFFIXES", "amp_cast_params", "auto_tp_spec",
-           "ring", "pipeline", "moe", "compat_shard_map",
+           "ring", "pipeline", "moe", "zero", "compat_shard_map",
            "make_predict_fn", "tune_microbatch"]
 
 
@@ -195,7 +195,8 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
                     param_spec=None, donate=True, compute_dtype=None,
                     loss_scale=None, sample_data=None, autotune=None,
                     variant_ops=("conv1x1_dot",), nan_guard=None,
-                    **opt_kwargs):
+                    optimizer_sharding=None, bucket_bound=None,
+                    gradient_compression=None, **opt_kwargs):
     """Build ONE fully-fused jitted SPMD train step.
 
     Returns (step_fn, params, opt_state) where
@@ -248,6 +249,34 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
     can enforce MXNET_BAD_STEP_LIMIT without a per-step sync.  None
     follows that env var (>0 arms it); dynamic loss scaling already
     skips non-finite updates, so the guard stays off there.
+
+    optimizer_sharding="ps": the sharded-server gradient exchange
+    (ZeRO-1 ≡ the reference's key-sharded servers running the
+    server-side optimizer, kvstore_dist_server.h:346, see
+    parallel.zero).  Gradients flatten into dtype-homogeneous flat
+    buckets (split threshold: ``bucket_bound`` elements, default the
+    authentic ``MXNET_KVSTORE_BIGARRAY_BOUND``), each bucket
+    ``reduce_scatter``s over the data axis, the optimizer's fused rule
+    updates ONLY the locally-owned shard (optimizer state is created,
+    donated and persisted SHARDED — per-chip state bytes ~ params/N),
+    and the updated param buckets ``all_gather`` back — ~2·buckets
+    collectives per step instead of one all-reduce per parameter
+    tensor.  ``None`` follows MXNET_OPTIMIZER_SHARDING ('ps' arms it,
+    '0' force-disables, empty leaves it off); needs a mesh and does
+    not compose with ``param_spec`` (tp) yet.  Dynamic loss scaling
+    checks finiteness on the SCATTERED shard and psums the verdict;
+    the nan-guard and donation contracts are unchanged; under the
+    forward each device sees its local batch shard, so BatchNorm uses
+    per-shard statistics — the reference DataParallel semantics
+    (executor_group.py), vs the replicated path's SyncBatchNorm-style
+    global stats.
+
+    gradient_compression: ``{"type": "2bit", "threshold": t}`` —
+    2-bit quantization (kvstore.GradientCompression math) applied
+    per-bucket on the scattered gradient shard before the optimizer,
+    with the error-feedback residual carried SHARD-LOCAL in fp32
+    inside opt_state (``_residual<i>``) so narrow-dtype buckets keep
+    full-precision accumulation.  Requires optimizer_sharding="ps".
     """
     from .. import autotune as _at
     from ..config import setup_compilation_cache
@@ -279,8 +308,64 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
     static_scale = float(loss_scale) if (
         loss_scale is not None and not dynamic_scaling) else 1.0
 
+    # ---- sharded-server mode resolution (parallel.zero) --------------
+    from . import zero as _zero
+
+    ps_mode = optimizer_sharding
+    env_ps = _zero.resolve_sharding_env()
+    if env_ps is False:
+        ps_mode = None  # '0' force-disables even explicit opt-ins
+    elif ps_mode is None and env_ps == "ps":
+        ps_mode = "ps"
+    if ps_mode not in (None, False, "", "ps"):
+        raise MXNetError(
+            f"unknown optimizer_sharding {ps_mode!r} (only 'ps')")
+    ps_mode = "ps" if ps_mode == "ps" else None
+    if ps_mode and mesh is None:
+        import warnings
+
+        warnings.warn(
+            "optimizer_sharding='ps' needs a mesh (nothing to shard "
+            "over on one device) — step stays replicated", stacklevel=2)
+        ps_mode = None
+    if ps_mode and param_spec:
+        raise MXNetError(
+            "optimizer_sharding='ps' does not compose with param_spec "
+            "(tensor parallelism) yet")
+    if gradient_compression is not None and not ps_mode:
+        raise MXNetError(
+            "gradient_compression in make_train_step requires "
+            "optimizer_sharding='ps' (the replicated step has no "
+            "bucketed wire to compress)")
+
     names = list(params)
-    opt_state = {n: opt.fused_state(v) for n, v in params.items()}
+    comp_threshold = None
+    if ps_mode:
+        n_sh = int(mesh.shape[data_axis])
+        _zero.check_bucket_rule(opt)
+        plan = _zero.plan_buckets(params, n_sh, capacity=bucket_bound)
+        bucket_keys = [f"_bucket{i}" for i in range(len(plan))]
+        # optimizer state is created over the FLAT buckets and lives
+        # sharded for the step's whole life (the server owning its key
+        # shard's state) — per-chip state bytes ~ total/N
+        opt_state = {
+            bk: opt.fused_state(_zero.flatten_bucket(b, params))
+            for bk, b in zip(bucket_keys, plan)
+        }
+        if gradient_compression is not None:
+            ctype = gradient_compression.get("type", "2bit")
+            if ctype != "2bit":
+                raise MXNetError(f"unsupported compression {ctype}")
+            comp_threshold = float(
+                gradient_compression.get("threshold", 0.5))
+            for i, b in enumerate(plan):
+                # error-feedback residual: per bucket-SHARD, fp32 (the
+                # narrow-accumulate discipline — a bf16 residual would
+                # lose the feedback below threshold/256)
+                opt_state[f"_residual{i}"] = jnp.zeros((b.padded,),
+                                                       jnp.float32)
+    else:
+        opt_state = {n: opt.fused_state(v) for n, v in params.items()}
     if dynamic_scaling:
         opt_state["_loss_scale"] = (
             jnp.float32(2.0 ** 16),  # initial scale (reference amp)
@@ -293,6 +378,21 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
     nan_guard = bool(nan_guard) and not dynamic_scaling
     if nan_guard:
         opt_state["_bad_steps"] = jnp.zeros((), jnp.int32)
+
+    def _scale_bookkeeping(finite, scale, good):
+        """Dynamic-loss-scale update shared by the replicated and
+        sharded arms — ONE copy, because the two must stay
+        bit-identical for the sharded-vs-replicated parity contract:
+        overflow halves the scale (floor 1.0); 2000 consecutive
+        finite steps double it and reset the counter (reference amp
+        scaler)."""
+        good = jnp.where(finite, good + 1, 0)
+        new_scale = jnp.where(
+            finite,
+            jnp.where(good >= 2000, scale * 2.0, scale),
+            jnp.maximum(scale * 0.5, 1.0))
+        good = jnp.where(good >= 2000, 0, good)
+        return new_scale.astype(jnp.float32), good
 
     def _apply_updates(params_, opt_state_, grads, t, key):
         new_p, new_s = {}, {}
@@ -332,13 +432,8 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
                     up_s[n], opt_state_[n])
                 for n in names
             }
-            good = jnp.where(finite, good + 1, 0)
-            new_scale = jnp.where(
-                finite,
-                jnp.where(good >= 2000, scale * 2.0, scale),
-                jnp.maximum(scale * 0.5, 1.0))
-            good = jnp.where(good >= 2000, 0, good)
-            new_s["_loss_scale"] = (new_scale.astype(jnp.float32), good)
+            new_s["_loss_scale"] = _scale_bookkeeping(finite, scale,
+                                                      good)
             # unscale with the scale the loss was COMPUTED with, not the
             # adjusted one, or the reported loss jumps 2x on every
             # scale-change step
@@ -379,6 +474,119 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
             return loss, new_p, new_s
         new_p, new_s = _apply_updates(params_, opt_state_, grads, t, key)
         return loss, new_p, new_s
+
+    # ---- sharded-server step (optimizer_sharding="ps") ---------------
+    if ps_mode:
+        needs_seg = not getattr(opt, "fused_elementwise", True)
+        seg_info = [_zero.bucket_segments(b) for b in plan] \
+            if needs_seg else None
+        check_finite = dynamic_scaling or nan_guard
+
+        def ps_local_step(params_, opt_state_, x, y, key, t):
+            # runs PER DEVICE under shard_map: params replicated in,
+            # x/y are the local batch shard, bucket states/residuals
+            # are the locally-owned shard
+            idx = jax.lax.axis_index(data_axis)
+            fkey = jax.random.fold_in(key, idx)
+            if dynamic_scaling:
+                scale, good = opt_state_["_loss_scale"]
+            else:
+                scale = static_scale
+
+            def local_loss(p, x_, y_, k_):
+                lv = loss_of(p, x_, y_, k_)
+                if dynamic_scaling or static_scale != 1.0:
+                    lv = lv * scale
+                return lv
+
+            lval, lgrads = jax.value_and_grad(local_loss)(
+                params_, x, y, fkey)
+            # grad of the GLOBAL mean loss = psum(local-mean grads)/N;
+            # the unscale folds into the same multiply
+            inv = 1.0 / n_sh
+            if dynamic_scaling:
+                inv = inv / scale
+            elif static_scale != 1.0:
+                inv = inv / static_scale
+            # parity with the replicated arms: dynamic scaling's
+            # verdict is GRADIENT finiteness only (a scaled loss can
+            # overflow while the unscaled grads are fine); the nan
+            # guard additionally checks the loss, as replicated does
+            finite = None
+            if nan_guard:
+                finite = jnp.isfinite(lval)
+            elif dynamic_scaling:
+                finite = jnp.array(True)
+            staged = []
+            for i, (bk, b) in enumerate(zip(bucket_keys, plan)):
+                flat_g = _zero.flatten_bucket(b, lgrads)
+                # THE exchange: one reduce-scatter for the whole
+                # bucket replaces len(b.names) per-tensor all-reduces
+                g_sh = jax.lax.psum_scatter(
+                    flat_g, data_axis, scatter_dimension=0, tiled=True)
+                g32 = g_sh.astype(jnp.float32) * inv
+                if check_finite:
+                    # finiteness verdict on the SCATTERED shard (each
+                    # device sees params/N elements; psum below makes
+                    # the verdict global)
+                    finite = finite & jnp.isfinite(g32).all()
+                new_resid = None
+                if comp_threshold is not None:
+                    from ..kvstore import quantize_2bit
+
+                    acc = g32 + opt_state_[f"_residual{i}"]
+                    g32, new_resid = quantize_2bit(acc, comp_threshold)
+                gq = g32.astype(flat_g.dtype)
+                sub = jax.random.fold_in(
+                    jax.random.fold_in(key, i), idx) \
+                    if opt.needs_key else None
+                w_sh, uw, us = _zero.bucket_shard_update(
+                    b, opt, params_, gq, opt_state_[bk], t,
+                    n_shards=n_sh, idx=idx, axis=data_axis,
+                    seg=seg_info[i] if needs_seg else None, key=sub)
+                staged.append((i, bk, b, w_sh, uw, us, new_resid))
+            new_p, new_s = {}, {}
+            if check_finite:
+                bad = jax.lax.psum(1 - finite.astype(jnp.int32),
+                                   data_axis)
+                finite = bad == 0
+            for i, bk, b, w_sh, uw, us, new_resid in staged:
+                if check_finite:
+                    # skip-the-update selection (dynamic scaling / nan
+                    # guard): shard, state AND residual all hold
+                    uw = jnp.where(finite, uw, w_sh)
+                    us = jax.tree_util.tree_map(
+                        lambda u, o: jnp.where(finite, u, o), us,
+                        opt_state_[bk])
+                    if new_resid is not None:
+                        new_resid = jnp.where(
+                            finite, new_resid,
+                            opt_state_[f"_residual{i}"])
+                new_s[bk] = us
+                if new_resid is not None:
+                    new_s[f"_residual{i}"] = new_resid
+                new_p.update(_zero.gather_bucket(b, uw, data_axis))
+            loss = jax.lax.pmean(lval, data_axis)
+            if dynamic_scaling:
+                new_s["_loss_scale"] = _scale_bookkeeping(finite, scale,
+                                                          good)
+                loss = loss / scale
+            elif static_scale != 1.0:
+                loss = loss / static_scale
+            if nan_guard:
+                new_s["_bad_steps"] = jnp.where(
+                    finite, jnp.int32(0), opt_state_["_bad_steps"] + 1)
+            return loss, new_p, new_s
+
+        ps_p_specs = {n: P() for n in params}
+        ps_s_specs = jax.tree_util.tree_map(
+            lambda l: P(data_axis) if getattr(l, "ndim", 0) else P(),
+            opt_state)
+        step = compat_shard_map(
+            ps_local_step, mesh,
+            in_specs=(ps_p_specs, ps_s_specs, P(data_axis),
+                      P(data_axis), P(), P()),
+            out_specs=(P(), ps_p_specs, ps_s_specs))
 
     # ---- in-step variant autotuning (mxnet_tpu.autotune) -------------
     mesh_d = _at.mesh_desc(mesh)
@@ -426,7 +634,16 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
     if mesh is not None:
         repl = NamedSharding(mesh, P())
         batch_sharding = NamedSharding(mesh, P(data_axis))
-        if param_spec is None:
+        if ps_mode:
+            # params replicate; bucket states + residuals live SHARDED
+            # over the data axis (the ZeRO-1 memory win); scalar
+            # entries (loss-scale, bad-step counters) replicate
+            shard1 = NamedSharding(mesh, P(data_axis))
+            p_shard = jax.tree_util.tree_map(lambda _: repl, params)
+            opt_shard = jax.tree_util.tree_map(
+                lambda l: shard1 if getattr(l, "ndim", 0) else repl,
+                opt_state)
+        elif param_spec is None:
             p_shard = jax.tree_util.tree_map(lambda _: repl, params)
             opt_shard = jax.tree_util.tree_map(lambda _: repl, opt_state)
         else:
@@ -527,5 +744,6 @@ class DataParallelTrainer:
                 p.data()._adopt(v)
 
 
-from . import moe, pipeline, ring  # noqa: E402  (submodule re-exports)
+from . import moe, pipeline, ring, zero  # noqa: E402  (submodule
+#                                           re-exports)
 from .predict import make_predict_fn, tune_microbatch  # noqa: E402

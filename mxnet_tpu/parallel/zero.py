@@ -1,0 +1,709 @@
+"""ZeRO-1 sharded-server gradient exchange (the TPU-native parameter
+server).
+
+Reference parity: ps-lite slices every big array across servers
+(``MXNET_KVSTORE_BIGARRAY_BOUND``, kvstore_dist.h EncodeDefaultKey),
+each server owns a key shard and runs the SERVER-SIDE optimizer on it
+(kvstore_dist_server.h:346), and workers pull back only the updated
+slices — the partitioning Rajbhandari et al. rediscovered as ZeRO-1
+(SC'20) with the bucketed-collective overlap of PyTorch DDP (Li et
+al., VLDB'20; both in PAPERS.md).
+
+TPU-native redesign: instead of one XLA all-reduce per parameter
+tensor (54 launches for the r05 dp(16) ResNet-18 dryrun — pure launch
+overhead on small tensors) the gradients flatten into a few
+dtype-homogeneous FLAT BUCKETS, each bucket ``reduce_scatter``s over
+the data axis, the registry optimizer's fused rule runs ONLY on the
+locally-owned shard (optimizer state lives sharded — memory and FLOPs
+scale with params/N), and the updated param buckets ``all_gather``
+back: ~2·buckets collectives of the same total bytes.
+
+This module owns the pieces shared by ``make_train_step``'s
+``optimizer_sharding="ps"`` path and the Module-side
+:class:`ShardedBucketUpdater` (the ``kvstore='dist_sync'`` mapping):
+
+* :func:`plan_buckets` — greedy dtype-homogeneous packing honoring the
+  authentic ``MXNET_KVSTORE_BIGARRAY_BOUND`` split threshold, padded
+  so every bucket divides the shard count;
+* :func:`flatten_bucket` / :func:`unflatten_bucket` /
+  :func:`shard_slice` — the flat layout;
+* :func:`collective_bytes` — the HLO collective counter (moved here
+  from ``__graft_entry__`` so bench.py and tests share it);
+* :class:`ShardedBucketUpdater` — Module's drop-in Updater with
+  bucket-sharded optimizer state (gathers to the LEGACY per-param
+  ``.states`` layout on save, re-shards on load, so checkpoint files
+  stay interchangeable with replicated runs).
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["Bucket", "plan_buckets", "flatten_bucket", "unflatten_bucket",
+           "bucket_segments", "shard_slice", "collective_bytes",
+           "resolve_sharding_env", "ShardedBucketUpdater"]
+
+
+# ------------------------------------------------------------ bucket plan
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    """One dtype-homogeneous flat bucket of whole parameters."""
+
+    dtype: str
+    names: tuple          # parameter names, in packing order
+    shapes: tuple         # per-name shapes
+    offsets: tuple        # per-name start offset in the flat layout
+    size: int             # total elements (unpadded)
+    padded: int           # size rounded up to a multiple of n_shards
+    #: opaque partition key (e.g. effective (lr, wd) of the bucket's
+    #: params); params with different groups never share a bucket
+    group: object = None
+
+    @property
+    def pad(self):
+        return self.padded - self.size
+
+
+def _capacity(capacity=None):
+    if capacity is not None:
+        return max(1, int(capacity))
+    from ..config import get_env
+
+    return max(1, int(get_env("MXNET_KVSTORE_BIGARRAY_BOUND")))
+
+
+def plan_buckets(params, n_shards, capacity=None, group_key=None):
+    """Pack ``{name: array}`` into dtype-homogeneous flat buckets.
+
+    The split threshold is the authentic reference knob: a bucket is
+    closed once adding the next parameter would push it past
+    ``MXNET_KVSTORE_BIGARRAY_BOUND`` elements (``capacity`` overrides
+    the env) — the ps-lite bound above which arrays are sliced across
+    servers.  Whole parameters are never split across buckets; a
+    single parameter larger than the bound gets a bucket of its own.
+    Each bucket is padded to a multiple of ``n_shards`` so
+    reduce-scatter/all-gather tile evenly.
+
+    ``group_key`` ({name: hashable}, optional) further partitions
+    buckets: params with different keys never share one.  The Module
+    updater uses it for effective (lr, wd) hyper-parameter groups so
+    per-param ``lr_mult``/``wd_mult`` stay exact under sharding.
+    """
+    cap = _capacity(capacity)
+    n_shards = max(1, int(n_shards))
+    per_part = {}
+    order = []
+    for name, v in params.items():
+        dt = str(onp.dtype(getattr(v, "dtype", onp.float32)))
+        part = (dt, None if group_key is None else group_key.get(name))
+        if part not in per_part:
+            per_part[part] = []
+            order.append(part)
+        per_part[part].append((name, tuple(v.shape)))
+    buckets = []
+    for part in order:
+        dt, grp = part
+        cur_names, cur_shapes, cur_offsets, cur_size = [], [], [], 0
+
+        def close():
+            nonlocal cur_names, cur_shapes, cur_offsets, cur_size
+            if not cur_names:
+                return
+            padded = -(-cur_size // n_shards) * n_shards
+            buckets.append(Bucket(dt, tuple(cur_names), tuple(cur_shapes),
+                                  tuple(cur_offsets), cur_size, padded,
+                                  grp))
+            cur_names, cur_shapes, cur_offsets, cur_size = [], [], [], 0
+
+        for name, shape in per_part[part]:
+            n = 1
+            for d in shape:
+                n *= int(d)
+            if cur_names and cur_size + n > cap:
+                close()
+            cur_names.append(name)
+            cur_shapes.append(shape)
+            cur_offsets.append(cur_size)
+            cur_size += n
+        close()
+    return buckets
+
+
+def flatten_bucket(bucket, tree):
+    """Concatenate the bucket's parameters (in plan order) from a
+    ``{name: array}`` tree into one flat padded array."""
+    import jax.numpy as jnp
+
+    parts = [jnp.reshape(tree[n], (-1,)) for n in bucket.names]
+    if bucket.pad:
+        parts.append(jnp.zeros((bucket.pad,), dtype=parts[0].dtype))
+    return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+
+def unflatten_bucket(bucket, flat):
+    """Inverse of :func:`flatten_bucket` (padding dropped)."""
+    out = {}
+    for name, shape, off in zip(bucket.names, bucket.shapes,
+                                bucket.offsets):
+        n = 1
+        for d in shape:
+            n *= int(d)
+        out[name] = flat[off:off + n].reshape(shape)
+    return out
+
+
+def bucket_segments(bucket):
+    """Static per-element segment ids (param index within the bucket;
+    padding gets an inert extra segment) for norm-based rules (LARS)
+    that need per-parameter reductions over the flat layout.
+
+    Returns (ids int32 ndarray of length ``padded``, num_segments).
+    """
+    ids = onp.empty((bucket.padded,), onp.int32)
+    for i, (shape, off) in enumerate(zip(bucket.shapes, bucket.offsets)):
+        n = 1
+        for d in shape:
+            n *= int(d)
+        ids[off:off + n] = i
+    ids[bucket.size:] = len(bucket.names)
+    return ids, len(bucket.names) + 1
+
+
+def shard_slice(flat, n_shards, idx):
+    """This shard's slice of a flat padded bucket (inside shard_map:
+    ``idx`` is the traced ``lax.axis_index``)."""
+    return flat.reshape(n_shards, -1)[idx]
+
+
+def bucket_shard_update(bucket, opt, params, g_sh, state, t, *, n_shards,
+                        idx, axis, seg=None, key=None):
+    """The per-bucket owned-shard update core, shared by
+    :meth:`ShardedBucketUpdater._build` and ``make_train_step``'s ps
+    step — ONE copy, so the two arms' seg-id slicing and shard layout
+    cannot drift apart (their parity IS the checkpoint-interchange
+    contract).  Slices this device's shard of the flat param bucket
+    and runs the fused rule on it against the already-scattered
+    gradient shard ``g_sh``.  Returns ``(w_sh, new_w_sh, new_state)``
+    un-gathered, so the caller can finite-gate the update before
+    :func:`gather_bucket`."""
+    import jax.numpy as jnp
+
+    w_sh = shard_slice(flatten_bucket(bucket, params), n_shards, idx)
+    kwargs = {}
+    if seg is not None:
+        ids, nseg = seg
+        kwargs = dict(
+            seg_ids=shard_slice(jnp.asarray(ids), n_shards, idx),
+            num_segments=nseg, axis_name=axis)
+    uw, us = opt.fused_bucket_update(w_sh, g_sh, state, t, key=key,
+                                     **kwargs)
+    return w_sh, uw, us
+
+
+def gather_bucket(bucket, w_sh, axis):
+    """All-gather an updated shard back to the replicated flat bucket
+    and split it per param (tiled, matching :func:`shard_slice`'s
+    row-major layout)."""
+    import jax
+
+    return unflatten_bucket(
+        bucket, jax.lax.all_gather(w_sh, axis, tiled=True))
+
+
+def resolve_sharding_env():
+    """The MXNET_OPTIMIZER_SHARDING tri-state: "ps" forced on, False
+    forced OFF (overriding kvstore mapping / explicit opt-in), None
+    unset (caller decides).  Unknown values raise — a typo'd force-on
+    silently training replicated is the silent-green failure mode the
+    dryrun case filter also rejects."""
+    from ..config import get_env
+
+    raw = str(get_env("MXNET_OPTIMIZER_SHARDING")).strip().lower()
+    if raw in ("ps", "1", "on", "true", "yes"):
+        return "ps"
+    if raw in ("0", "off", "false", "no"):
+        return False
+    if raw:
+        raise MXNetError(
+            f"MXNET_OPTIMIZER_SHARDING={raw!r} is not a recognized "
+            "value (use 'ps' to force sharding on, '0' to force it "
+            "off, or unset)")
+    return None
+
+
+def check_bucket_rule(optimizer):
+    """A bucket shard slices through many parameters, so the rule must
+    either be elementwise or provide its own bucket-aware form."""
+    from ..optimizer.optimizer import Optimizer
+
+    if getattr(optimizer, "fused_elementwise", True):
+        return
+    if type(optimizer).fused_bucket_update is Optimizer.fused_bucket_update:
+        raise MXNetError(
+            f"optimizer {type(optimizer).__name__} is not elementwise and "
+            "provides no fused_bucket_update — it cannot run on flat "
+            "bucket shards (optimizer_sharding='ps')")
+
+
+def sharding_rule_reasons(optimizer):
+    """Semantics the flat-bucket sharded updater cannot reproduce, as
+    human-readable reasons (empty list = eligible).  Module uses this
+    at init_optimizer time to fall back to the eager updater with a
+    logged reason; :meth:`ShardedBucketUpdater.set_states` uses it to
+    REFUSE a resumed pickle that smuggles in such an optimizer (e.g.
+    an eager dump carrying an lr_scheduler) instead of silently
+    running different math."""
+    reasons = []
+    try:
+        check_bucket_rule(optimizer)
+    except MXNetError as e:
+        reasons.append(str(e))
+    if getattr(optimizer, "needs_key", False):
+        reasons.append("stochastic rule (needs per-step PRNG keys)")
+    if getattr(optimizer, "multi_precision", False):
+        reasons.append("multi_precision master weights")
+    if getattr(optimizer, "lr_scheduler", None) is not None:
+        reasons.append("lr_scheduler (evaluated per update only in "
+                       "the eager path)")
+    if not reasons:
+        # legacy .states interchange needs identical fused/eager state
+        # layouts (Nadam's fused rule carries an extra schedule
+        # scalar) — probed HERE so set_states' resume gate refuses the
+        # same optimizers Module's init gate does
+        import jax.numpy as jnp
+
+        from .. import ndarray as nd
+
+        probe = jnp.zeros((2,), jnp.float32)
+        try:
+            if len(optimizer.fused_state(probe)) != \
+                    len(optimizer.create_state(0, nd.NDArray(probe))):
+                reasons.append("fused/eager state layouts differ")
+        except Exception as e:
+            reasons.append(f"state probe failed: {e!r}")
+    return reasons
+
+
+# ------------------------------------------------- HLO collective counter
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                "collective-permute", "all-to-all")
+
+_DT_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
+             "f64": 8, "s64": 8, "s8": 1, "u8": 1, "pred": 1}
+
+
+def collective_bytes(hlo_text):
+    """Per-collective output bytes + launch counts in a compiled HLO —
+    the per-step cross-chip traffic the sharded program will put on
+    ICI/DCN.  (Moved from ``__graft_entry__._collective_bytes`` so
+    bench.py's collectives phase and the tier-1 budget tests share
+    one parser.)"""
+    out = {k: 0 for k in _COLLECTIVES}
+    counts = {k: 0 for k in _COLLECTIVES}
+    pat = re.compile(
+        r"= (\(?[\w\[\],{}: /]*\)?) ("
+        + "|".join(_COLLECTIVES) + r")(?:-start)?[.\d]*\(")
+    shape_pat = re.compile(
+        r"(f32|bf16|f16|s32|u32|f64|s64|s8|u8|pred)\[([\d,]*)\]")
+    for line in hlo_text.splitlines():
+        # async collectives lower to -start/-done pairs: count starts
+        m = pat.search(line)
+        if not m:
+            continue
+        shapes, kind = m.groups()
+        total = 0
+        for sm in shape_pat.finditer(shapes):
+            n = 1
+            for d in sm.group(2).split(","):
+                if d:
+                    n *= int(d)
+            total += n * _DT_BYTES[sm.group(1)]
+        if "-start" in line[m.start():m.end()]:
+            # async -start results carry (operand..., result...) pairs
+            # (plus tiny u32 contexts): halve to approximate the real
+            # wire bytes instead of double-counting
+            total //= 2
+        out[kind] += total
+        counts[kind] += 1
+    return {"bytes": out, "counts": counts,
+            "total_bytes": sum(out.values())}
+
+
+# hyper-params NOT fingerprinted for live-mutation re-trace: lr/wd ride
+# the bucket group key, multipliers/param_dict feed _get_lr/_get_wd,
+# schedulers force the eager fallback, and the counters advance
+# mechanically without changing the update rule
+_HYPER_SIG_SKIP = frozenset((
+    "lr", "wd", "lr_mult", "wd_mult", "param_dict", "idx2name",
+    "lr_scheduler", "num_update", "begin_num_update",
+    "_index_update_count", "_all_index_update_counts",
+))
+
+
+# --------------------------------------------- Module-side sharded updater
+class ShardedBucketUpdater:
+    """Module's ZeRO-1 updater: the optimizer state of every trainable
+    parameter lives SHARDED over the data mesh in flat buckets; each
+    device runs the fused rule only on its shard (the server-side
+    optimizer, kvstore_dist_server.h:346) and the updated param buckets
+    all-gather back to the replicated executor weights.
+
+    Gradients arriving here are already fully reduced (the executor's
+    backward all-reduces under the data mesh), so the win is optimizer
+    MEMORY and update FLOPs at params/N per chip — plus one all-gather
+    per bucket instead of nothing, which is the ZeRO-1 trade.
+
+    Checkpoint contract (``get_states``/``set_states``): shards GATHER
+    into the legacy per-param ``{name: state-tuple}`` pickle on save
+    and RE-SHARD on load, so ``.states`` files are bit-interchangeable
+    with the replicated :class:`~mxnet_tpu.optimizer.Updater`.
+    """
+
+    def __init__(self, optimizer, mesh, params, data_axis="data",
+                 capacity=None):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        check_bucket_rule(optimizer)
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.axis = data_axis
+        self.n_shards = int(mesh.shape[data_axis])
+        self._capacity = capacity
+        self._shapes = {n: tuple(v.shape) for n, v in params.items()}
+        self._dtypes = {n: onp.dtype(getattr(v, "dtype", onp.float32))
+                        for n, v in params.items()}
+        # effective (lr, wd) per param — lr_mult/wd_mult applied the
+        # way the eager Updater would — partition the buckets, so each
+        # bucket carries ONE hyper-parameter setting and per-param
+        # multipliers survive sharding exactly
+        self._groups = self._current_groups(params)
+        self.plan = plan_buckets(params, self.n_shards, capacity=capacity,
+                                 group_key=self._groups)
+        self._rebuild_bucket_opts()
+        self._hyper_sig = self._current_hyper_sig()
+        self._repl = NamedSharding(mesh, P())
+        self._state_sh = NamedSharding(mesh, P(data_axis))
+        # the step clock continues the optimizer's (begin_num_update
+        # seeds resumed runs; adam/ftml bias correction uses t = _t+1
+        # exactly as eager's _update_count would produce)
+        self._t = int(getattr(optimizer, "num_update", 0) or 0)
+        self._fn = None
+        states = []
+        for b in self.plan:
+            st = optimizer.fused_state(flatten_bucket(
+                b, {n: params[n] for n in b.names}))
+            states.append(self._place_state(st))
+        self._states = states
+
+    def _current_groups(self, names):
+        return {n: (float(self.optimizer._get_lr(n)),
+                    float(self.optimizer._get_wd(n))) for n in names}
+
+    def _current_hyper_sig(self):
+        """Every scalar hyper-param the fused rules bake in at trace
+        time besides lr/wd (momentum, beta1/beta2, rescale_grad,
+        clip_gradient, ...).  The eager updater reads these live on
+        every update, so a mid-run mutation must re-bake + re-trace
+        here too, not silently keep the stale traced values."""
+        return tuple(sorted(
+            (k, v) for k, v in vars(self.optimizer).items()
+            if k not in _HYPER_SIG_SKIP
+            and isinstance(v, (int, float, bool, str, bytes, type(None)))
+        ))
+
+    def _rebuild_bucket_opts(self):
+        """One shallow optimizer copy per bucket with that bucket's
+        effective lr/wd baked in (the fused rules read self.lr/self.wd
+        at trace time; multipliers live in the group key)."""
+        import copy
+
+        self._bucket_opts = []
+        for b in self.plan:
+            o = copy.copy(self.optimizer)
+            o.lr_mult, o.wd_mult, o.param_dict = {}, {}, {}
+            o.lr_scheduler = None
+            if b.group is not None:
+                o.lr, o.wd = b.group
+            self._bucket_opts.append(o)
+
+    def _sync_hyper_params(self):
+        """The eager updater reads lr/wd on EVERY update; the fused
+        path bakes them in at trace time.  Re-deriving the effective
+        groups per call keeps the two in sync when the caller mutates
+        ``optimizer.lr``/``wd`` mid-training (the epoch-decay recipe):
+        a value change re-traces the jitted update, and a change that
+        re-partitions the params gathers the states, replans the
+        buckets and re-shards.  Non-(lr, wd) scalars (momentum,
+        beta1/beta2, rescale_grad, clip_gradient, ...) never affect
+        the partition, so a mutation there only re-bakes + re-traces."""
+        sig = self._current_hyper_sig()
+        if sig != self._hyper_sig:
+            self._hyper_sig = sig
+            self._rebuild_bucket_opts()
+            self._fn = None
+        groups = self._current_groups(self._shapes)
+        if groups == self._groups:
+            return
+        if all(len({groups[n] for n in b.names}) == 1
+               for b in self.plan):
+            # same partition, new values: swap the baked hyper-params
+            self._groups = groups
+            self.plan = [dataclasses.replace(b, group=groups[b.names[0]])
+                         for b in self.plan]
+            self._rebuild_bucket_opts()
+            self._fn = None
+            return
+        per_param = self._gather_per_param()
+        self._groups = groups
+
+        class _Spec:
+            def __init__(self, shape, dtype):
+                self.shape, self.dtype = shape, dtype
+
+        self.plan = plan_buckets(
+            {n: _Spec(self._shapes[n], self._dtypes[n])
+             for n in self._shapes},
+            self.n_shards, capacity=self._capacity, group_key=groups)
+        self._rebuild_bucket_opts()
+        self._states = self._flatten_to_plan(per_param)
+        self._fn = None
+
+    def _place_state(self, st):
+        import jax
+
+        return tuple(
+            jax.device_put(s, self._state_sh if getattr(s, "ndim", 0)
+                           else self._repl) for s in st)
+
+    # ----------------------------------------------------------- update
+    def _build(self):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from . import compat_shard_map
+
+        plan = self.plan
+        opts = self._bucket_opts
+        n_sh = self.n_shards
+        axis = self.axis
+        needs_seg = not getattr(self.optimizer, "fused_elementwise",
+                                True)
+        segs = [bucket_segments(b) for b in plan] if needs_seg else None
+
+        def local_update(params_, grads_, states_, t):
+            idx = jax.lax.axis_index(axis)
+            new_p, new_states = {}, []
+            for i, b in enumerate(plan):
+                # grads arrive fully reduced from the executor's
+                # backward; the owned shard is just a slice
+                g_sh = shard_slice(flatten_bucket(b, grads_), n_sh, idx)
+                _, uw, us = bucket_shard_update(
+                    b, opts[i], params_, g_sh, states_[i], t,
+                    n_shards=n_sh, idx=idx, axis=axis,
+                    seg=segs[i] if needs_seg else None)
+                new_p.update(gather_bucket(b, uw, axis))
+                new_states.append(us)
+            return new_p, new_states
+
+        p_specs = {n: P() for b in plan for n in b.names}
+        s_specs = [tuple(P(axis) if getattr(s, "ndim", 0) else P()
+                         for s in st) for st in self._states]
+        mapped = compat_shard_map(
+            local_update, self.mesh,
+            in_specs=(p_specs, p_specs, s_specs, P()),
+            out_specs=(p_specs, s_specs))
+        p_shardings = {n: self._repl for n in p_specs}
+        s_shardings = [tuple(self._state_sh if getattr(s, "ndim", 0)
+                             else self._repl for s in st)
+                       for st in self._states]
+        # donate only the states (we own them between calls); the
+        # params/grads buffers stay live in the executor's NDArrays
+        self._fn = jax.jit(
+            mapped,
+            in_shardings=(p_shardings, p_shardings, s_shardings, None),
+            out_shardings=(p_shardings, s_shardings),
+            donate_argnums=(2,))
+
+    def update_all(self, triplets):
+        """Apply one step to every ``(name, grad, weight)`` NDArray
+        triplet at once (Module.update collects them; per-name calls
+        would defeat the bucketing)."""
+        import jax.numpy as jnp
+
+        if self._states is None:
+            self._gather_per_param()  # raises the state-lost error
+        self._sync_hyper_params()
+        if self._fn is None:
+            self._build()
+        trip = {n: (g, w) for n, g, w in triplets}
+        plan_names = [n for b in self.plan for n in b.names]
+        planned = set(plan_names)
+        missing = [n for n in plan_names if n not in trip]
+        extra = [n for n in trip if n not in planned]
+        if missing or extra:
+            raise MXNetError(
+                "sharded update param set diverged from the bucket plan "
+                f"(missing {missing[:4]}, unplanned {extra[:4]})")
+        grads = {n: trip[n][0]._data for n in plan_names}
+        weights = {n: trip[n][1] for n in plan_names}
+        params = {n: weights[n]._data for n in plan_names}
+        try:
+            new_p, self._states = self._fn(params, grads,
+                                           self._states,
+                                           jnp.float32(self._t + 1))
+        except Exception:
+            # the jitted call donates the state buffers; if it died
+            # mid-execution they are gone and any later get_states
+            # (e.g. the preemption drain's final checkpoint) would
+            # crash on deleted arrays — mark the loss so it raises a
+            # clear error instead.  _t is untouched: the step did not
+            # happen.
+            if any(getattr(s, "is_deleted", lambda: False)()
+                   for st in self._states for s in st):
+                self._states = None
+            raise
+        self._t += 1
+        # the eager Updater advances optimizer.num_update on every call
+        # (_update_count); callbacks reading module._optimizer.num_update
+        # — the classic decay-every-K-updates recipe — must see the same
+        # clock here (num_update is in _HYPER_SIG_SKIP, so this never
+        # triggers a re-trace)
+        self.optimizer.num_update = max(
+            self._t, int(getattr(self.optimizer, "num_update", 0)))
+        for n, w in weights.items():
+            w._adopt(new_p[n])
+
+    # --------------------------------------- checkpoint (legacy layout)
+    def _gather_per_param(self):
+        """Gather the sharded bucket states to host, re-split per
+        param: ``{name: tuple of onp leaves}``."""
+        if self._states is None:
+            raise MXNetError(
+                "sharded optimizer state was lost when a step failed "
+                "mid-execution (the buffers are donated to the jitted "
+                "update); restore from the last checkpoint via "
+                "set_states before saving or updating again")
+        per_param = {}
+        for b, st in zip(self.plan, self._states):
+            per_leaf = [onp.asarray(s) for s in st]
+            for name, shape, off in zip(b.names, b.shapes, b.offsets):
+                n = 1
+                for d in shape:
+                    n *= int(d)
+                per_param[name] = tuple(
+                    s[off:off + n].reshape(shape)
+                    if getattr(s, "ndim", 0) else s for s in per_leaf)
+        return per_param
+
+    def _flatten_to_plan(self, per_param):
+        """Inverse of :meth:`_gather_per_param`: flatten per-param
+        leaf tuples into the current plan's buckets and re-shard."""
+        import jax.numpy as jnp
+
+        new_states = []
+        for b in self.plan:
+            ref = per_param[b.names[0]]
+            flat = []
+            for li in range(len(ref)):
+                if getattr(ref[li], "ndim", 0):
+                    tree = {n: jnp.asarray(per_param[n][li])
+                            for n in b.names}
+                    flat.append(flatten_bucket(b, tree))
+                else:
+                    # replicated scalar state: identical across params
+                    # by construction
+                    flat.append(jnp.asarray(ref[li]))
+            new_states.append(self._place_state(tuple(flat)))
+        return new_states
+
+    def get_states(self, dump_optimizer=False):
+        """Gather the bucket shards back into the legacy per-param
+        ``{name: state-tuple-of-NDArrays}`` pickle (the replicated
+        Updater's exact on-disk layout, so sharded and replicated runs
+        exchange ``.states`` files freely)."""
+        import copy
+        import pickle
+
+        from .. import ndarray as nd
+
+        states = {
+            name: tuple(nd.array(leaf) for leaf in leaves)
+            for name, leaves in self._gather_per_param().items()
+        }
+        # the fused rules take the step count t explicitly (bias
+        # correction: adam/ftml/...), so it must ride the pickle — as a
+        # reserved entry the eager Updater carries through untouched
+        # (it only ever looks states up by param name)
+        states["__step"] = (nd.array(onp.asarray([self._t],
+                                                 onp.int64)),)
+        if dump_optimizer:
+            opt = copy.copy(self.optimizer)
+            opt.param_dict = {}
+            # the sharded path never ran opt._update_count, so the
+            # copy's begin_num_update/_index_update_count are stale
+            # (num_update is kept live by update_all): seed all three
+            # coherently with our step count so an EAGER resume of this
+            # file continues its adam/ftml bias correction instead of
+            # restarting at t=1
+            opt.num_update = opt.begin_num_update = self._t
+            opt._index_update_count = {}
+            return pickle.dumps((states, opt))
+        return pickle.dumps(states)
+
+    def set_states(self, states):
+        """Re-shard a legacy per-param states pickle onto the mesh
+        (the inverse of :meth:`get_states`; a replicated run's file
+        loads the same way)."""
+        import pickle
+
+        import jax.numpy as jnp
+
+        loaded = pickle.loads(states)
+        have_opt = isinstance(loaded, tuple) and len(loaded) == 2
+        if have_opt:
+            loaded, new_opt = loaded
+            # init_optimizer's eligibility gate ran against the
+            # init-time optimizer only; a cross-mode resume can smuggle
+            # in semantics the flat buckets cannot reproduce (an eager
+            # dump's lr_scheduler would silently pin the lr at the
+            # resume-point value).  Refuse loudly, keeping our own
+            # optimizer untouched.
+            bad = sharding_rule_reasons(new_opt)
+            if bad:
+                raise MXNetError(
+                    "resumed optimizer states carry an optimizer the "
+                    "sharded updater cannot run ({}); resume this "
+                    "checkpoint with kvstore='local' (the eager "
+                    "updater) instead".format("; ".join(bad)))
+            new_opt.param_dict = getattr(self.optimizer, "param_dict", {})
+            self.optimizer = new_opt
+            self._rebuild_bucket_opts()
+            self._hyper_sig = self._current_hyper_sig()
+            self._fn = None  # hyper-params may have changed: re-trace
+            # dumps carry the count on the optimizer itself — and it is
+            # FRESHER than any "__step" states entry: an eager run that
+            # resumed a sharded file carries the old "__step" inert
+            # while its own counters kept advancing
+            self._t = int(getattr(new_opt, "num_update", self._t))
+        loaded = dict(loaded)
+        stp = loaded.pop("__step", None)
+        if stp is not None and not have_opt:
+            v = stp[0]
+            self._t = int(onp.asarray(
+                v.asnumpy() if hasattr(v, "asnumpy") else v
+            ).reshape(-1)[0])
+        per_param = {}
+        for b in self.plan:
+            for name in b.names:
+                st = loaded.get(name)
+                if st is None:
+                    raise MXNetError(
+                        f"optimizer states missing parameter {name!r}")
+                per_param[name] = tuple(
+                    s._data if hasattr(s, "_data") else jnp.asarray(s)
+                    for s in st)
+        self._states = self._flatten_to_plan(per_param)

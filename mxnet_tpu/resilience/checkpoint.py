@@ -152,6 +152,15 @@ def _crc(blob):
 def _as_nd(v):
     from .. import ndarray as nd
 
+    data = v._data if isinstance(v, nd.NDArray) else v
+    if hasattr(data, "sharding") and hasattr(data, "devices") \
+            and len(data.devices()) > 1:
+        # mesh-backed array (replicated module weights, or a ZeRO
+        # bucket shard under optimizer_sharding="ps"): GATHER to one
+        # host copy here, so what lands on disk is the legacy
+        # single-array layout and never aliases a device buffer a
+        # donating step may consume mid-save
+        return nd.array(onp.asarray(data))
     return v if isinstance(v, nd.NDArray) else nd.array(onp.asarray(v))
 
 
@@ -181,6 +190,14 @@ class CheckpointManager:
       size+CRC32, RNG snapshot, autotune winners hash
     * ``prefix-symbol.json``        — the network (shared across versions)
     * ``prefix-latest.json``        — pointer to the newest version
+
+    Sharded-optimizer runs (``optimizer_sharding="ps"``): the save
+    path GATHERS — mesh-backed params gather here in ``_as_nd`` and
+    the ``ShardedBucketUpdater`` gathers its bucket shards into the
+    legacy per-param states pickle before it reaches ``save`` — so the
+    on-disk layout is identical to a replicated run's; loading into a
+    sharded run RE-SHARDS (``ShardedBucketUpdater.set_states``), which
+    is why ``.states`` files move freely between the two modes.
     """
 
     MANIFEST_FORMAT = 1

@@ -68,10 +68,22 @@ def test_smoke_emits_valid_json_with_heartbeats():
     assert ck["write_s"]["measure"] > 0
     assert ck["write_s"]["feed"] > 0
     assert out["resumed"] is False
+    # the collectives phase compiled the dp step sharded vs replicated
+    # on the CPU mesh and the sharded-server exchange kept its launch
+    # budget: bucketed reduce-scatter/all-gather instead of one
+    # all-reduce per tensor (round 9)
+    col = out["collectives"]
+    assert col["n"] == 8
+    rep, shd = col["replicated"]["counts"], col["sharded"]["counts"]
+    assert rep["all-reduce"] >= 5  # one per grad tensor
+    assert 1 <= shd["reduce-scatter"] <= 8
+    assert 1 <= shd["all-gather"] <= 8
+    assert shd["all-reduce"] <= 2
+    assert col["launches_sharded"] < col["launches_replicated"]
     # a heartbeat per phase, so a hang is attributable
     for phase in ("import", "device_init", "build", "autotune",
                   "compile", "K1", "K2", "trials", "feed",
-                  "checkpoint", "conv_ab", "done"):
+                  "checkpoint", "collectives", "conv_ab", "done"):
         assert f"phase={phase}" in r.stderr, f"missing phase {phase}"
 
 

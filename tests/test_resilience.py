@@ -336,6 +336,112 @@ def test_sigterm_drain_then_resume_is_bit_exact(tmp_path):
                 if t.name == "DeviceFeedIter" and t.is_alive()]
 
 
+def _fit_ps(num_epoch, resume_from=None, checkpoint=None,
+            batch_end_callback=None, seed=11):
+    """Like _fit but data-parallel over the 8-device mesh with the
+    kvstore='dist_sync' mapping: optimizer state lives SHARDED in flat
+    buckets (parallel.zero.ShardedBucketUpdater)."""
+    mx.random.seed(seed)
+    onp.random.seed(seed)
+    X, y = _toy_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=False)
+    mod = mx.mod.Module(_mlp(), context=[mx.gpu(i) for i in range(8)])
+    mod.fit(it, num_epoch=num_epoch, kvstore="dist_sync",
+            optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1),
+                              ("momentum", 0.9)),
+            initializer=mx.init.Xavier(), resume_from=resume_from,
+            checkpoint=checkpoint, batch_end_callback=batch_end_callback)
+    return mod
+
+
+_FIT_PS_SCRIPT = """
+    import os, signal
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+
+    def _mlp():
+        d = sym.Variable("data")
+        fc1 = sym.FullyConnected(d, num_hidden=16, name="fc1")
+        act = sym.Activation(fc1, act_type="relu", name="relu1")
+        fc2 = sym.FullyConnected(act, num_hidden=4, name="fc2")
+        return sym.SoftmaxOutput(fc2, sym.Variable("softmax_label"),
+                                 name="softmax")
+
+    rng = onp.random.RandomState(7)
+    X = rng.randn(64, 10).astype("float32")
+    y = (X @ rng.randn(10, 4)).argmax(axis=1).astype("float32")
+    mx.random.seed(11)
+    onp.random.seed(11)
+    it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=False)
+    mod = mx.mod.Module(_mlp(),
+                        context=[mx.gpu(i) for i in range(8)])
+
+    def killer(param):
+        if param.epoch == 1 and param.nbatch == 2:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    mod.fit(it, num_epoch=3, kvstore="dist_sync", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1),
+                              ("momentum", 0.9)),
+            initializer=mx.init.Xavier(), checkpoint=PREFIX,
+            batch_end_callback=killer)
+    print("COMPLETED")
+"""
+
+
+def test_sigterm_drain_then_resume_is_bit_exact_sharded(tmp_path):
+    """The round-9 acceptance scenario: the SIGTERM-drain + resume
+    contract holds under optimizer_sharding='ps' (kvstore='dist_sync'
+    on the 8-device mesh) — the drain checkpoint GATHERS the bucket
+    shards into the legacy .states layout, resume RE-SHARDS them, and
+    the relaunched run reproduces the uninterrupted run's params
+    bit-exactly."""
+    import pickle
+
+    from mxnet_tpu.parallel.zero import ShardedBucketUpdater
+
+    prefix = str(tmp_path / "elastic_ps")
+    # run A: uninterrupted sharded reference (in-process)
+    mod_a = _fit_ps(3)
+    assert isinstance(mod_a._updater, ShardedBucketUpdater)
+    arg_a, aux_a = mod_a.get_params()
+
+    # run B1: killed by SIGTERM at epoch 1 batch 2 (subprocess)
+    r = _run_script(_FIT_PS_SCRIPT.replace("PREFIX", repr(prefix)))
+    assert r.returncode == -signal.SIGTERM, (r.returncode,
+                                             r.stderr[-2000:])
+    assert "COMPLETED" not in r.stdout
+    mgr = CheckpointManager(prefix)
+    ep = mgr.latest_epoch()
+    assert ep is not None
+    drained = mgr.load(ep)
+    assert drained["epoch"] == 1
+    assert drained["batch_cursor"] == 3
+    # the drained optimizer state is the LEGACY per-param layout (a
+    # replicated run could load this file directly)
+    legacy, opt_copy = pickle.loads(drained["optimizer_states"])
+    assert set(legacy) == {"fc1_weight", "fc1_bias", "fc2_weight",
+                           "fc2_bias", "__step"}
+    assert all(isinstance(st, tuple) for st in legacy.values())
+    # counters seeded: an EAGER resume of this sharded drain file
+    # continues t where the killed run stopped (epoch 1 batch 3)
+    assert opt_copy.num_update == 11
+
+    # run B2: relaunch with resume_from= (in-process, re-shards)
+    mod_b = _fit_ps(3, resume_from=prefix)
+    assert isinstance(mod_b._updater, ShardedBucketUpdater)
+    arg_b, aux_b = mod_b.get_params()
+    assert set(arg_a) == set(arg_b)
+    for k in arg_a:
+        onp.testing.assert_array_equal(arg_a[k].asnumpy(),
+                                       arg_b[k].asnumpy(), err_msg=k)
+    for k in aux_a:
+        onp.testing.assert_array_equal(aux_a[k].asnumpy(),
+                                       aux_b[k].asnumpy(), err_msg=k)
+
+
 def test_resume_from_epoch_boundary_is_bit_exact(tmp_path):
     """Epoch-boundary resume (cursor 0): stop a checkpointed run after
     2 of 3 epochs, resume, and match the uninterrupted run."""

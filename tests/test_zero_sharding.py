@@ -1,0 +1,780 @@
+"""Sharded-server step (round 9): flat-bucketed reduce-scatter
+gradients + shard-owned optimizer — ZeRO-1 as the TPU-native parameter
+server (parallel.zero, make_train_step optimizer_sharding="ps", the
+Module kvstore='dist_sync' mapping).
+
+The acceptance invariants from the issue:
+
+* parity: the sharded step's params/opt state match the replicated
+  step bit-exactly for fp32 SGD over >= 10 steps (adam/lars allclose),
+  including under dynamic loss scaling;
+* collectives: dp(16) emits <= 8 reduce-scatters + <= 8 all-gathers
+  (vs one all-reduce per tensor replicated), read from the compiled
+  HLO via the same ``collective_bytes`` counter the dryrun/CI gate
+  uses;
+* memory: per-chip optimizer-state bytes under sharding ~ total/N;
+* checkpoints: sharded optimizer state gathers to the LEGACY .states
+  layout and re-shards on load (files interchangeable with replicated
+  runs).
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, sym
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel import get_mesh, make_train_step
+from mxnet_tpu.parallel import zero
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ bucket plan
+def test_plan_buckets_dtype_homogeneous_and_bounded():
+    params = {
+        "a": jnp.zeros((300,), jnp.float32),
+        "b": jnp.zeros((300,), jnp.float32),
+        "c": jnp.zeros((10, 10), jnp.bfloat16),
+        "d": jnp.zeros((700,), jnp.float32),
+        "e": jnp.zeros((50,), jnp.bfloat16),
+    }
+    plan = zero.plan_buckets(params, n_shards=8, capacity=512)
+    # capacity 512: [a,b] close before d (300+300 <= 512? no: 600 > 512
+    # -> a alone? greedy closes when ADDING would exceed: a(300) then
+    # b would make 600 > 512 -> close [a]; b(300) + d(700) > 512 ->
+    # close [b]; [d] alone; bf16 group: [c(100), e(50)] fits
+    assert [b.names for b in plan] == [("a",), ("b",), ("d",),
+                                       ("c", "e")]
+    for b in plan:
+        assert b.padded % 8 == 0
+        assert b.padded >= b.size
+    assert plan[-1].dtype == "bfloat16"
+    # env knob is the default capacity (the authentic reference bound)
+    os.environ["MXNET_KVSTORE_BIGARRAY_BOUND"] = "512"
+    try:
+        assert [b.names for b in zero.plan_buckets(params, 8)] == \
+            [b.names for b in plan]
+    finally:
+        del os.environ["MXNET_KVSTORE_BIGARRAY_BOUND"]
+    # a single param larger than the bound still gets a whole bucket
+    assert ("d",) in [b.names for b in plan]
+
+
+def test_flatten_unflatten_roundtrip_and_segments():
+    params = {"w": jnp.arange(12.0).reshape(3, 4),
+              "b": jnp.arange(5.0) + 100}
+    (bucket,) = zero.plan_buckets(params, n_shards=8, capacity=1 << 20)
+    flat = zero.flatten_bucket(bucket, params)
+    assert flat.shape == (bucket.padded,) and bucket.padded % 8 == 0
+    back = zero.unflatten_bucket(bucket, flat)
+    for k in params:
+        onp.testing.assert_array_equal(onp.asarray(back[k]),
+                                       onp.asarray(params[k]))
+    ids, nseg = zero.bucket_segments(bucket)
+    assert nseg == 3  # 2 params + the inert padding segment
+    assert (ids[:12] == 0).all() and (ids[12:17] == 1).all()
+    assert (ids[17:] == 2).all()
+
+
+# ----------------------------------------------------------------- parity
+def _mlp_net():
+    mx.random.seed(0)
+    onp.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"),
+                nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(init=mx.init.Xavier())
+    net(mx.nd.zeros((1, 8)))
+    return net
+
+
+def _run_steps(optimizer, n_steps=10, **kw):
+    mesh = get_mesh((8,), ("data",))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step, p, s = make_train_step(
+        _mlp_net(), loss_fn, optimizer=optimizer, learning_rate=0.1,
+        momentum=0.9, mesh=mesh, donate=False, **kw)
+    rng = onp.random.RandomState(0)
+    X = jnp.asarray(rng.rand(32, 8).astype("float32"))
+    y = jnp.asarray(rng.randint(0, 4, (32,)).astype("float32"))
+    key = jax.random.key(0)
+    loss = None
+    for i in range(n_steps):
+        loss, p, s = step(p, s, X, y, key, float(i + 1))
+    # block auto-prefix differs between builds; align by suffix
+    p = {k.split("_", 1)[-1]: onp.asarray(v) for k, v in p.items()}
+    return float(loss), p, s
+
+
+@pytest.mark.parametrize("optimizer,exact", [
+    ("sgd", True),      # acceptance: bit-exact fp32
+    ("adam", False),    # allclose (carries 2 slots)
+    ("lars", False),    # allclose (trust ratios via segment psum)
+])
+def test_sharded_step_parity_with_replicated(optimizer, exact):
+    l_r, p_r, _ = _run_steps(optimizer)
+    l_s, p_s, s_s = _run_steps(optimizer, optimizer_sharding="ps",
+                               bucket_bound=300)
+    assert set(p_r) == set(p_s)
+    if exact:
+        assert l_r == l_s
+        for k in p_r:
+            onp.testing.assert_array_equal(p_r[k], p_s[k], err_msg=k)
+    else:
+        assert onp.isclose(l_r, l_s, rtol=1e-6)
+        for k in p_r:
+            onp.testing.assert_allclose(p_r[k], p_s[k], rtol=1e-5,
+                                        atol=1e-7, err_msg=k)
+    # the optimizer state really lives in buckets, sharded over 'data'
+    bkeys = [k for k in s_s if k.startswith("_bucket")]
+    assert bkeys
+    for bk in bkeys:
+        for leaf in s_s[bk]:
+            if getattr(leaf, "ndim", 0):
+                assert leaf.sharding.spec == jax.sharding.PartitionSpec(
+                    "data")
+
+
+def test_sharded_step_parity_under_dynamic_loss_scaling():
+    l_r, p_r, s_r = _run_steps("sgd", loss_scale="dynamic")
+    l_s, p_s, s_s = _run_steps("sgd", loss_scale="dynamic",
+                               optimizer_sharding="ps", bucket_bound=300)
+    assert l_r == l_s
+    for k in p_r:
+        onp.testing.assert_array_equal(p_r[k], p_s[k], err_msg=k)
+    # the scale/finite-counter bookkeeping matches too
+    for a, b in zip(s_r["_loss_scale"], s_s["_loss_scale"]):
+        assert float(onp.asarray(a)) == float(onp.asarray(b))
+
+
+def test_sharded_step_env_knob_and_guards():
+    mesh = get_mesh((8,), ("data",))
+    loss_fn = gluon.loss.L2Loss()
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    # env force-ON (the MXNET_OPTIMIZER_SHARDING knob)
+    os.environ["MXNET_OPTIMIZER_SHARDING"] = "ps"
+    try:
+        _, _, s = make_train_step(net, loss_fn, mesh=mesh, donate=False)
+        assert any(k.startswith("_bucket") for k in s)
+    finally:
+        del os.environ["MXNET_OPTIMIZER_SHARDING"]
+    # env force-OFF beats the explicit opt-in
+    os.environ["MXNET_OPTIMIZER_SHARDING"] = "0"
+    try:
+        _, _, s = make_train_step(net, loss_fn, mesh=mesh, donate=False,
+                                  optimizer_sharding="ps")
+        assert not any(k.startswith("_bucket") for k in s)
+    finally:
+        del os.environ["MXNET_OPTIMIZER_SHARDING"]
+    # tp param_spec does not compose
+    from mxnet_tpu.parallel import P
+
+    with pytest.raises(MXNetError, match="param_spec"):
+        make_train_step(net, loss_fn, mesh=mesh, donate=False,
+                        optimizer_sharding="ps",
+                        param_spec={"dense0_weight": P("data", None)})
+    # compression demands the sharded wire
+    with pytest.raises(MXNetError, match="optimizer_sharding"):
+        make_train_step(net, loss_fn, mesh=mesh, donate=False,
+                        gradient_compression={"type": "2bit"})
+    # non-elementwise rule without a bucket form is rejected loudly
+    with pytest.raises(MXNetError, match="bucket"):
+        make_train_step(net, loss_fn, optimizer="groupadagrad",
+                        mesh=mesh, donate=False,
+                        optimizer_sharding="ps")
+    # meshless: warns and stays replicated rather than failing
+    with pytest.warns(UserWarning, match="mesh"):
+        _, _, s = make_train_step(net, loss_fn, donate=False,
+                                  optimizer_sharding="ps")
+    assert not any(k.startswith("_bucket") for k in s)
+
+
+# ------------------------------------------------------------ collectives
+def _collective_counts(**kw):
+    mesh = get_mesh((8,), ("data",))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step, p, s = make_train_step(
+        _mlp_net(), loss_fn, optimizer="sgd", learning_rate=0.1,
+        momentum=0.9, mesh=mesh, donate=False, **kw)
+    rng = onp.random.RandomState(0)
+    X = jnp.asarray(rng.rand(32, 8).astype("float32"))
+    y = jnp.asarray(rng.randint(0, 4, (32,)).astype("float32"))
+    hlo = step.lower(p, s, X, y, jax.random.key(0), 1.0) \
+        .compile().as_text()
+    return zero.collective_bytes(hlo)
+
+
+def test_collective_structure_on_8dev_mesh():
+    rep = _collective_counts()
+    shd = _collective_counts(optimizer_sharding="ps", bucket_bound=300)
+    # replicated: one all-reduce per gradient tensor (6 params + loss)
+    assert rep["counts"]["all-reduce"] >= 6
+    assert rep["counts"]["reduce-scatter"] == 0
+    # sharded: exactly one reduce-scatter + one all-gather per bucket
+    # (3 at bound=300 for this MLP) and only the loss pmean all-reduce
+    c = shd["counts"]
+    assert c["reduce-scatter"] == 3
+    assert c["all-gather"] == 3
+    assert c["all-reduce"] <= 2
+    # same total gradient bytes, just batched (RS+AG ~ 2x params; the
+    # replicated AR carries params once but per-tensor)
+    assert shd["bytes"]["reduce-scatter"] > 0
+    one = _collective_counts(optimizer_sharding="ps")  # default bound:
+    assert one["counts"]["reduce-scatter"] == 1        # one flat bucket
+
+
+def test_dp16_resnet_collective_budget_acceptance():
+    """THE acceptance bar: ResNet-18 dp(16) under
+    optimizer_sharding="ps" compiles to <= 8 reduce-scatters + <= 8
+    all-gathers (vs one all-reduce per tensor replicated), counted
+    from the compiled HLO in a 16-device CPU-mesh subprocess — the
+    same program/bound the ci ``collectives_budget`` cell gates."""
+    body = textwrap.dedent("""\
+        import os, json, sys
+        sys.path.insert(0, %r)
+        os.environ["MXNET_KVSTORE_BIGARRAY_BOUND"] = "4000000"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import numpy as onp
+        import mxnet_tpu as mx
+        from mxnet_tpu import gluon
+        from mxnet_tpu.parallel import get_mesh, make_train_step
+        from mxnet_tpu.parallel.zero import collective_bytes
+
+        def build():
+            net = gluon.model_zoo.vision.get_resnet(1, 18, classes=10)
+            net.initialize(init=mx.init.Xavier())
+            net(mx.nd.zeros((1, 3, 32, 32)))
+            return net
+
+        mesh = get_mesh((16,), ("data",))
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        x = jnp.asarray(onp.random.rand(32, 3, 32, 32).astype("float32"))
+        y = jnp.asarray(onp.random.randint(0, 10, (32,)).astype("float32"))
+        key = jax.random.key(0)
+        out = {}
+        for label, kw in (("replicated", {}),
+                          ("sharded", {"optimizer_sharding": "ps"})):
+            step, p, s = make_train_step(
+                build(), loss_fn, optimizer="sgd", learning_rate=0.1,
+                mesh=mesh, donate=False, autotune=False, **kw)
+            out[label] = collective_bytes(
+                step.lower(p, s, x, y, key, 1.0).compile().as_text())
+        print(json.dumps(out))
+        """) % (_REPO,)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
+                     if "host_platform_device_count" not in f)
+    env["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=16").strip()
+    r = subprocess.run([sys.executable, "-c", body], env=env,
+                       capture_output=True, text=True, timeout=420)
+    assert r.returncode == 0, r.stderr[-3000:]
+    out = json.loads(r.stdout.splitlines()[-1])
+    rep, shd = out["replicated"]["counts"], out["sharded"]["counts"]
+    # replicated: one all-reduce per gradient tensor (the r05 artifact
+    # counted 54; jax versions shift the exact figure, the per-tensor
+    # structure doesn't)
+    assert rep["all-reduce"] >= 20, rep
+    # sharded: the budget the CI gate enforces
+    assert shd["reduce-scatter"] <= 8, shd
+    assert 1 <= shd["all-gather"] <= 8, shd
+    assert shd["all-reduce"] <= 2, shd
+
+
+# ----------------------------------------------------------------- memory
+def test_optimizer_state_bytes_shard_as_params_over_n():
+    """Adam carries 2 slots: per-chip opt-state bytes under sharding
+    must be ~ 2*params/8 on the 8-device mesh (vs 2*params replicated
+    on every chip)."""
+    mesh = get_mesh((8,), ("data",))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    net = _mlp_net()
+    step, p, s = make_train_step(
+        net, loss_fn, optimizer="adam", mesh=mesh, donate=False,
+        optimizer_sharding="ps")
+    param_bytes = sum(v.nbytes for v in p.values())
+    local = 0
+    for k, st in s.items():
+        if not k.startswith("_bucket"):
+            continue
+        for leaf in st:
+            if getattr(leaf, "ndim", 0):
+                local += leaf.addressable_shards[0].data.nbytes
+    expect = 2 * param_bytes / 8
+    # padding rounds each bucket up to a multiple of 8 elements
+    assert expect <= local <= expect * 1.1 + 2 * 8 * 4, (local, expect)
+
+
+# ----------------------------------------------- Module dist_sync mapping
+def _mlp_symbol():
+    d = sym.Variable("data")
+    fc1 = sym.FullyConnected(d, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(fc2, sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def _fit_module(kvstore, optimizer="sgd", epochs=2, extra_params=()):
+    rng = onp.random.RandomState(7)
+    X = rng.randn(64, 10).astype("float32")
+    y = (X @ rng.randn(10, 4)).argmax(axis=1).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=False)
+    mod = mx.mod.Module(_mlp_symbol(),
+                        context=[mx.gpu(i) for i in range(8)])
+    mod.bind(data_shapes=it.provide_data,
+             label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    arg, aux = mod.get_params()
+    r = onp.random.RandomState(3)
+    det = {n: mx.nd.array((r.randn(*v.shape) * 0.3).astype("float32"))
+           for n, v in arg.items()}
+    mod.set_params(det, aux)
+    opt_params = [("learning_rate", 0.1)]
+    if optimizer in ("sgd", "lars"):
+        opt_params.append(("momentum", 0.9))
+    opt_params.extend(extra_params)
+    mod.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                       optimizer_params=tuple(opt_params))
+    for _ in range(epochs):
+        it.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+    arg, _ = mod.get_params()
+    return mod, {n: v.asnumpy() for n, v in arg.items()}
+
+
+def test_module_dist_sync_maps_to_sharded_updater():
+    """Module.fit(kvstore='dist_sync') on a data mesh runs the
+    server-side-optimizer analog: state sharded in flat buckets,
+    updates on the owned shard only — and trains the same model as
+    the replicated updater."""
+    mod_s, p_s = _fit_module("dist_sync")
+    assert isinstance(mod_s._updater, zero.ShardedBucketUpdater)
+    mod_l, p_l = _fit_module("local")
+    assert not isinstance(mod_l._updater, zero.ShardedBucketUpdater)
+    for n in p_l:
+        onp.testing.assert_allclose(p_s[n], p_l[n], rtol=1e-5,
+                                    atol=1e-6, err_msg=n)
+    # .states files are interchangeable: sharded gathers to the legacy
+    # layout, the eager updater loads it, and re-sharding round-trips
+    # bit-exactly
+    blob = mod_s._get_optimizer_states()
+    import pickle
+
+    # fit checkpoints ride dump_optimizer=True: (states, optimizer)
+    # with the optimizer's counters seeded so cross-mode resumes keep
+    # their bias-correction step
+    legacy, opt_copy = pickle.loads(blob)
+    assert opt_copy.num_update == mod_s._updater._t == 16
+    # per-param legacy layout + the reserved "__step" counter (the
+    # fused rules take t explicitly; eager carries it through inert)
+    assert set(legacy) == {"fc1_weight", "fc1_bias", "fc2_weight",
+                           "fc2_bias", "__step"}
+    mod_l._set_optimizer_states(blob)
+    mod_s._set_optimizer_states(mod_l._get_optimizer_states())
+    a = legacy
+    b, _ = pickle.loads(mod_s._get_optimizer_states())
+    for k in a:
+        for x, yv in zip(a[k], b[k]):
+            onp.testing.assert_array_equal(x.asnumpy(), yv.asnumpy())
+
+
+def test_module_sharded_engages_with_weight_decay():
+    """wd>0 auto-seeds wd_mult=0 on every bias (set_wd_mult): the
+    sharded updater must still ENGAGE — buckets partition by effective
+    (lr, wd) so per-param multipliers stay exact — and match the eager
+    updater's math."""
+    mod_s, p_s = _fit_module("dist_sync",
+                             extra_params=(("wd", 1e-2),))
+    assert isinstance(mod_s._updater, zero.ShardedBucketUpdater)
+    # biases (wd_mult 0) and weights (wd) landed in separate buckets
+    groups = {b.group for b in mod_s._updater.plan}
+    assert len(groups) == 2, groups
+    mod_l, p_l = _fit_module("local", extra_params=(("wd", 1e-2),))
+    for n in p_l:
+        onp.testing.assert_allclose(p_s[n], p_l[n], rtol=1e-5,
+                                    atol=1e-6, err_msg=n)
+
+
+def test_sharded_live_num_update_clock_matches_eager():
+    """Callbacks reading module._optimizer.num_update (the classic
+    decay-every-K-updates recipe) must see the same live clock under
+    kvstore='dist_sync' as under 'local' — including a nonzero
+    begin_num_update, which also seeds adam's bias-correction t so the
+    two updaters stay in parity on resumed-style counters."""
+    seed = (("begin_num_update", 5),)
+    mod_s, p_s = _fit_module("dist_sync", optimizer="adam",
+                             extra_params=seed)
+    mod_l, p_l = _fit_module("local", optimizer="adam",
+                             extra_params=seed)
+    assert isinstance(mod_s._updater, zero.ShardedBucketUpdater)
+    # 2 epochs x 8 batches on top of begin_num_update=5
+    assert mod_l._optimizer.num_update == 21
+    assert mod_s._optimizer.num_update == 21
+    assert mod_s._updater._t == 21
+    for n in p_l:
+        onp.testing.assert_allclose(p_s[n], p_l[n], rtol=1e-5,
+                                    atol=1e-6, err_msg=n)
+
+
+def test_sharding_env_rejects_unknown_values(monkeypatch):
+    monkeypatch.setenv("MXNET_OPTIMIZER_SHARDING", "sharded")
+    with pytest.raises(MXNetError, match="not a recognized"):
+        zero.resolve_sharding_env()
+
+
+def test_stale_step_entry_loses_to_fresh_optimizer_counters():
+    """sharded -> eager -> sharded resume chain: the eager leg carries
+    the original "__step" inert while its own counters advance, so a
+    later sharded set_states must trust the dump's num_update, not the
+    stale states entry."""
+    import pickle
+
+    mod, _ = _fit_module("dist_sync", optimizer="adam")
+    upd = mod._updater
+    blob = upd.get_states(dump_optimizer=True)
+    states, opt_copy = pickle.loads(blob)
+    # simulate the eager leg: 8 more updates advanced the optimizer's
+    # counters but left the inherited "__step" untouched
+    opt_copy.num_update = upd._t + 8
+    upd.set_states(pickle.dumps((states, opt_copy)))
+    assert upd._t == 24  # num_update won; stale __step=16 ignored
+
+
+def test_sharded_updater_step_counter_survives_states_roundtrip():
+    """Bias-corrected rules (adam) need the step count across a
+    save/load: the reserved "__step" entry restores _t, so a resumed
+    adam run does not restart its bias correction at t=1."""
+    mod, _ = _fit_module("dist_sync", optimizer="adam")
+    upd = mod._updater
+    assert isinstance(upd, zero.ShardedBucketUpdater)
+    t_before = upd._t
+    assert t_before == 16  # 8 batches/epoch x 2 epochs
+    blob = upd.get_states()
+    upd._t = 0
+    upd.set_states(blob)
+    assert upd._t == t_before
+
+
+def test_sharded_updater_tracks_live_lr_and_wd_mutation():
+    """The eager updater reads lr/wd on every update; the sharded one
+    bakes them at trace — so it must RE-SYNC when the caller mutates
+    them mid-training (the epoch-decay recipe).  The wd 0 -> 1e-2 flip
+    also re-partitions the params (bias wd_mult=0), exercising the
+    gather -> replan -> re-shard path."""
+    rng = onp.random.RandomState(7)
+    X = rng.randn(64, 10).astype("float32")
+    y = (X @ rng.randn(10, 4)).argmax(axis=1).astype("float32")
+
+    def run(kvstore):
+        it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=False)
+        mod = mx.mod.Module(_mlp_symbol(),
+                            context=[mx.gpu(i) for i in range(8)])
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(initializer=mx.init.Xavier())
+        arg, aux = mod.get_params()
+        r = onp.random.RandomState(3)
+        mod.set_params(
+            {n: mx.nd.array((r.randn(*v.shape) * 0.3).astype("float32"))
+             for n, v in arg.items()}, aux)
+        mod.init_optimizer(kvstore=kvstore, optimizer="sgd",
+                           optimizer_params=(("learning_rate", 0.1),
+                                             ("momentum", 0.9)))
+        for epoch in range(4):
+            if epoch == 2:
+                # mid-training decay + late weight decay
+                mod._optimizer.set_learning_rate(0.01)
+                mod._optimizer.wd = 1e-2
+            it.reset()
+            for batch in it:
+                mod.forward(batch, is_train=True)
+                mod.backward()
+                mod.update()
+        arg, _ = mod.get_params()
+        return mod, {n: v.asnumpy() for n, v in arg.items()}
+
+    mod_s, p_s = run("dist_sync")
+    assert isinstance(mod_s._updater, zero.ShardedBucketUpdater)
+    # the wd flip split biases (wd_mult 0) from weights: re-bucketed
+    assert len({b.group for b in mod_s._updater.plan}) == 2
+    mod_l, p_l = run("local")
+    for n in p_l:
+        onp.testing.assert_allclose(p_s[n], p_l[n], rtol=1e-5,
+                                    atol=1e-6, err_msg=n)
+
+
+def test_sharded_updater_tracks_live_momentum_mutation():
+    """lr/wd are not the only live hyper-params: the eager updater
+    reads momentum/clip_gradient/... on every update too, so mutating
+    them mid-training must re-bake + re-trace the sharded step — not
+    silently keep the values traced at init."""
+    rng = onp.random.RandomState(7)
+    X = rng.randn(64, 10).astype("float32")
+    y = (X @ rng.randn(10, 4)).argmax(axis=1).astype("float32")
+
+    def run(kvstore):
+        it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=False)
+        mod = mx.mod.Module(_mlp_symbol(),
+                            context=[mx.gpu(i) for i in range(8)])
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(initializer=mx.init.Xavier())
+        arg, aux = mod.get_params()
+        r = onp.random.RandomState(3)
+        mod.set_params(
+            {n: mx.nd.array((r.randn(*v.shape) * 0.3).astype("float32"))
+             for n, v in arg.items()}, aux)
+        mod.init_optimizer(kvstore=kvstore, optimizer="sgd",
+                           optimizer_params=(("learning_rate", 0.1),
+                                             ("momentum", 0.9)))
+        for epoch in range(4):
+            if epoch == 2:
+                # kill momentum + start clipping mid-run
+                mod._optimizer.momentum = 0.0
+                mod._optimizer.clip_gradient = 0.05
+            it.reset()
+            for batch in it:
+                mod.forward(batch, is_train=True)
+                mod.backward()
+                mod.update()
+        arg, _ = mod.get_params()
+        return mod, {n: v.asnumpy() for n, v in arg.items()}
+
+    mod_s, p_s = run("dist_sync")
+    assert isinstance(mod_s._updater, zero.ShardedBucketUpdater)
+    mod_l, p_l = run("local")
+    for n in p_l:
+        onp.testing.assert_allclose(p_s[n], p_l[n], rtol=1e-5,
+                                    atol=1e-6, err_msg=n)
+
+
+@pytest.mark.parametrize("kvstore", ["local", "dist_sync"])
+def test_resume_repoints_module_optimizer_at_live_one(kvstore):
+    """set_states installs the UNPICKLED optimizer as the updater's
+    live one; the module must re-point at it, or post-resume
+    mutations (module._optimizer.lr = ...) hit a dead object and are
+    silently ignored for the rest of training."""
+    mod, _ = _fit_module(kvstore)
+    blob = mod._get_optimizer_states()
+    pre_resume_opt = mod._optimizer
+    mod._set_optimizer_states(blob)
+    assert mod._optimizer is mod._updater.optimizer
+    assert mod._optimizer is not pre_resume_opt
+    # and the mutation actually lands in the running update
+    mod._optimizer.lr = 0.123
+    if kvstore == "dist_sync":
+        mod._updater._sync_hyper_params()
+        assert all(b.group[0] == pytest.approx(0.123)
+                   for b in mod._updater.plan)
+    else:
+        assert mod._updater.optimizer._get_lr("fc1_weight") \
+            == pytest.approx(0.123)
+
+
+def test_sharded_set_states_refuses_ineligible_optimizer():
+    """init_optimizer's eligibility gate runs against the init-time
+    optimizer only; a cross-mode resume pickle can smuggle in
+    semantics the flat buckets cannot reproduce (an eager dump's
+    lr_scheduler, multi-precision masters).  set_states must refuse
+    loudly — silently pinning the lr at the resume-point value is the
+    silent-math-change failure mode."""
+    import pickle
+
+    mod, _ = _fit_module("dist_sync")
+    upd = mod._updater
+    blob = upd.get_states(dump_optimizer=True)
+    states, opt_copy = pickle.loads(blob)
+    opt_copy.multi_precision = True
+    with pytest.raises(MXNetError, match="multi_precision"):
+        upd.set_states(pickle.dumps((states, opt_copy)))
+    # the updater kept its own optimizer and stays usable
+    assert upd.optimizer is not opt_copy
+    assert not upd.optimizer.multi_precision
+    # a layout-mismatched rule (Nadam's fused state carries an extra
+    # schedule scalar) is refused by the same shared predicate Module's
+    # init gate uses — not crashed on later inside the jitted update
+    from mxnet_tpu import optimizer as opt_mod
+
+    with pytest.raises(MXNetError, match="layouts differ"):
+        upd.set_states(pickle.dumps((states, opt_mod.create("nadam"))))
+    upd.set_states(blob)
+
+
+def test_sharded_updater_state_lost_raises_clear_error():
+    """A step failing mid-execution consumes the DONATED state
+    buffers; get_states (the preemption drain's final checkpoint) must
+    raise a clear restore-from-checkpoint error, not crash on deleted
+    arrays — and a set_states restore recovers."""
+    mod, _ = _fit_module("dist_sync")
+    upd = mod._updater
+    blob = upd.get_states()
+    upd._states = None  # what the failed-step handler records
+    with pytest.raises(MXNetError, match="last checkpoint"):
+        upd.get_states()
+    with pytest.raises(MXNetError, match="last checkpoint"):
+        upd.update_all([])
+    upd.set_states(blob)
+    assert upd._states is not None
+
+
+def test_sharded_dump_optimizer_seeds_eager_counters():
+    """Sharded -> EAGER resume of a bias-corrected rule: the
+    dump_optimizer pickle's counters are seeded with the sharded step
+    count, so the eager Updater continues adam's bias correction
+    instead of restarting at t=1."""
+    from mxnet_tpu import optimizer as opt_mod
+
+    mod, _ = _fit_module("dist_sync", optimizer="adam")
+    upd = mod._updater
+    assert isinstance(upd, zero.ShardedBucketUpdater)
+    blob = upd.get_states(dump_optimizer=True)
+    eager = opt_mod.get_updater(opt_mod.create("adam"))
+    eager.set_states(blob)
+    assert eager.optimizer.begin_num_update == upd._t
+    w, g = mx.nd.ones((16,)), mx.nd.ones((16,))
+    eager("fc1_bias", g, w)
+    assert eager.optimizer._index_update_count["fc1_bias"] == upd._t + 1
+
+
+def test_module_sharding_env_force_off_and_fallbacks():
+    os.environ["MXNET_OPTIMIZER_SHARDING"] = "0"
+    try:
+        mod, _ = _fit_module("dist_sync", epochs=1)
+        assert not isinstance(mod._updater, zero.ShardedBucketUpdater)
+    finally:
+        del os.environ["MXNET_OPTIMIZER_SHARDING"]
+    # semantics the flat buckets cannot reproduce fall back LOUDLY to
+    # the eager updater instead of silently changing the math
+    mod, _ = _fit_module("dist_sync", optimizer="nadam", epochs=1)
+    assert not isinstance(mod._updater, zero.ShardedBucketUpdater)
+
+
+# --------------------------------------------- kvstore satellite fixes
+def test_compression_residuals_keyed_per_bucket_shard():
+    from mxnet_tpu.kvstore import GradientCompression
+
+    gc = GradientCompression(threshold=0.5)
+    g = jnp.asarray([0.3, -0.3])
+    # same key, different shards: residuals must NOT cross-feed
+    q0 = gc.compress("k", g, shard=0)
+    q1 = gc.compress("k", g, shard=1)
+    assert (onp.asarray(q0) == 0).all() and (onp.asarray(q1) == 0).all()
+    # second round: each shard's own residual pushes it over threshold
+    q0b = gc.compress("k", g, shard=0)
+    onp.testing.assert_allclose(onp.asarray(q0b), [0.5, -0.5])
+    assert ("k", 0) in gc._residual and ("k", 1) in gc._residual
+    # a shared-residual implementation would have fired on q1 already
+    q1b = gc.compress("k", g, shard=1)
+    onp.testing.assert_allclose(onp.asarray(q1b), [0.5, -0.5])
+
+
+def test_dist_push_slices_bigarrays_per_shard_residual(monkeypatch):
+    """The production caller of the (key, shard) keying: a compressed
+    dist push of an array above MXNET_KVSTORE_BIGARRAY_BOUND slices
+    it into bound-sized bucket-shards, each with its own residual —
+    and the concatenated wire payload is byte-identical to whole-array
+    packing (4-aligned slice edges)."""
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "8")
+    kv = mx.kv.create("dist_sync")  # 1-worker group
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    g = onp.linspace(-1.0, 1.0, 20).astype("float32")
+    kv.init("big", mx.nd.zeros((20,)))
+    kv.push("big", mx.nd.array(g))
+    # 20 elements / 8-bound -> 3 slices, each with its own residual
+    keys = sorted(k for k in kv._compression._residual
+                  if isinstance(k, tuple) and k[0] == "big")
+    assert keys == [("big", 0), ("big", 1), ("big", 2)]
+    # wire payload identical to whole-array packing (fresh compressor)
+    from mxnet_tpu.kvstore import GradientCompression
+
+    ref = GradientCompression(0.5)
+    expect = onp.asarray(ref.compress_packed("big", g))
+    got = kv._compress_packed_bigarray("big2", jnp.asarray(g))
+    onp.testing.assert_array_equal(got, expect)
+    # and the pulled value decodes the sliced payload correctly
+    out = mx.nd.zeros((20,))
+    kv.pull("big", out=out)
+    q, _ = __import__("mxnet_tpu").kvstore.quantize_2bit(
+        jnp.asarray(g), 0.5)
+    onp.testing.assert_allclose(out.asnumpy(), onp.asarray(q))
+    # the slice step is PINNED per key: a mid-run bound change must
+    # not re-slice a key whose residual layout already exists
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "1000000")
+    kv.push("big", mx.nd.array(g))  # would crash on shape mismatch
+    assert kv._comp_slice_step["big"] == 8
+    # re-configuring compression discards every residual, so the pins
+    # protect nothing: keys re-pin at the CURRENT bound
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    assert kv._comp_slice_step == {}
+    kv.push("big", mx.nd.array(g))
+    assert kv._comp_slice_step["big"] == 1000000
+
+
+@pytest.mark.parametrize("dtype", ["float16", "bfloat16"])
+def test_narrow_accumulate_survives_bucket_roundtrip(dtype):
+    """kvstore narrow-dtype path: fp16/bf16 gradients quantize through
+    an fp32 accumulator (kvstore.py _reduce/_widen), so K sub-threshold
+    pushes accumulate to EXACTLY K * fp32(narrow(g)) — a narrow-dtype
+    accumulator would have rounded the running sum."""
+    kv = mx.kv.create("dist_sync")  # 1-worker group: local quantize
+    kv.set_gradient_compression({"type": "2bit", "threshold": 1.0})
+    g = onp.full((8,), 0.1, dtype)
+    kv.init("w", mx.nd.zeros((8,), dtype=dtype))
+    for k in range(3):
+        kv.push("w", mx.nd.array(g, dtype=dtype))
+    (resid,) = [v for v in kv._compression._residual.values()]
+    assert resid.dtype == jnp.float32
+    expect = 3 * onp.float32(onp.asarray(g.astype(dtype))[0])
+    onp.testing.assert_allclose(onp.asarray(resid),
+                                onp.full((8,), expect), rtol=1e-7)
+    # store value keeps the narrow dtype (the widen round-trip)
+    out = mx.nd.zeros((8,), dtype=dtype)
+    kv.pull("w", out=out)
+    assert str(out.dtype) in (dtype, f"<class 'jax.numpy.{dtype}'>") or \
+        onp.dtype(out.asnumpy().dtype).itemsize <= 4
+
+
+def test_sharded_step_compression_residual_shard_local():
+    """In-step 2-bit compression: residual carried as fp32 shard-local
+    state, error feedback converges training."""
+    mesh = get_mesh((8,), ("data",))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step, p, s = make_train_step(
+        _mlp_net(), loss_fn, optimizer="sgd", learning_rate=0.05,
+        momentum=0.9, mesh=mesh, donate=False, optimizer_sharding="ps",
+        bucket_bound=300,
+        gradient_compression={"type": "2bit", "threshold": 0.05})
+    rkeys = [k for k in s if k.startswith("_residual")]
+    assert len(rkeys) == 3  # one per bucket
+    for rk in rkeys:
+        assert s[rk].dtype == jnp.float32
+        assert s[rk].sharding.spec == jax.sharding.PartitionSpec("data")
+    rng = onp.random.RandomState(0)
+    X = jnp.asarray(rng.rand(32, 8).astype("float32"))
+    y = jnp.asarray(rng.randint(0, 4, (32,)).astype("float32"))
+    key = jax.random.key(0)
+    losses = []
+    for i in range(12):
+        loss, p, s = step(p, s, X, y, key, float(i + 1))
+        losses.append(float(loss))
+    assert all(onp.isfinite(v) for v in losses)
+    assert losses[-1] < losses[0]  # error feedback actually trains
+    assert float(onp.abs(onp.asarray(s[rkeys[0]])).max()) > 0
