@@ -59,6 +59,11 @@ the round its headline artifact):
   shard-owned optimizer of parallel.zero) vs replicated, and reports
   each program's HLO collective counts/bytes under ``"collectives"``
   in the JSON — the launch-count win is measurable without TPUs;
+* the ``telemetry`` phase arms a run log (telemetry.RunLog), reports
+  real steps + program introspection into it, then RE-READS its own
+  JSONL — schema verdict, record counts and the step's
+  memory/flop/collective report land under ``"telemetry"`` in the
+  JSON (the observability layer validating itself every bench run);
 * ``--checkpoint PREFIX`` writes timed atomic checkpoints
   (resilience.checkpoint) after the measure and feed phases — write
   cost lands under ``"checkpoint": {"write_s": ...}`` in the JSON
@@ -359,6 +364,75 @@ def _measure_feed(step_fn, params, opt_state, x, y, key, smoke,
         if t_block > 0 else None,
     }
     return report, params, opt_state
+
+
+def _measure_telemetry(step_fn, params, opt_state, x, y, key, smoke,
+                       deadline):
+    """Telemetry phase: arm a run log, run REAL steps reporting into
+    it (program introspection + per-step records on the default
+    sampling), then RE-READ the JSONL — the dogfood check: the bench
+    validates its own run log against the schema and folds the result
+    into the headline JSON.  Returns (report, params, opt_state) —
+    threaded because the step donates its inputs."""
+    import shutil
+    import tempfile
+
+    from mxnet_tpu import telemetry as tm
+    from mxnet_tpu.config import get_env
+    from mxnet_tpu.telemetry import schema as tm_schema
+
+    n = 4 if smoke else 8
+    batch = int(x.shape[0])
+    tmpdir = tempfile.mkdtemp(prefix="mxnet_tpu_bench_tm_")
+    path = os.path.join(tmpdir, "run.jsonl")
+    rl = tm.reset(path)
+    p, o = params, opt_state
+    try:
+        try:
+            # compile/memory introspection of the measured step
+            # program (a persistent-cache disk hit: the program is
+            # already built)
+            tm.describe_program(step_fn, p, o, x, y, key, 1.0,
+                                program="train_step")
+            for i in range(n):
+                if deadline.exceeded(margin=0.0):
+                    # the un-killable contract beats completeness:
+                    # report however many steps landed before the
+                    # budget ran out
+                    break
+                t0 = time.perf_counter()
+                loss, p, o = step_fn(p, o, x, y, key, 1.0)
+                synced = rl.should_sync(i)
+                # sampled sync only: the loss readback (one device
+                # sync) happens on sampled steps, like the fit loop
+                lv = float(loss) if synced else None
+                rl.step(0, i, time.perf_counter() - t0, batch,
+                        loss=lv, synced=synced)
+        finally:
+            tm.close()  # next telemetry.current() re-resolves env
+        with open(path) as f:
+            recs, problems = tm_schema.validate_lines(f)
+        by_type = {}
+        for r in recs:
+            by_type[r["type"]] = by_type.get(r["type"], 0) + 1
+        prog = next((r for r in recs if r["type"] == "program_report"),
+                    None)
+        steps = [r for r in recs if r["type"] == "step"]
+        return {
+            "steps": len(steps),
+            "records": by_type,
+            "schema_valid": not problems,
+            "schema_problems": problems[:5],
+            "sample_period": int(get_env("MXNET_TELEMETRY_SAMPLE")),
+            "synced_steps": sum(1 for r in steps if r["synced"]),
+            "program_report": {k: prog.get(k) for k in
+                               ("memory", "flops", "collectives")}
+            if prog else None,
+        }, p, o
+    finally:
+        # a phase failure lands in main()'s degraded handler — the
+        # temp run-log dir must not accumulate across CI runs
+        shutil.rmtree(tmpdir, ignore_errors=True)
 
 
 def _ckpt_save(prefix, epoch, params, opt_state):
@@ -771,6 +845,25 @@ def main(argv=None):
             out["collectives"] = {"error": repr(exc)}
             out["degraded"] = True
             reasons.append(f"collectives phase failed: {exc!r}")
+
+    # run-telemetry dogfood (round 10): the bench arms a run log,
+    # reports its own steps into it, re-reads the JSONL and folds the
+    # schema verdict + program introspection into the headline JSON
+    if deadline.exceeded(margin=0.0 if args.smoke else 30.0):
+        out["telemetry"] = "skipped (deadline)"
+        out["degraded"] = True
+        reasons.append("deadline: skipped telemetry phase")
+    else:
+        _heartbeat("telemetry")
+        try:
+            tm_report, params, opt_state = _measure_telemetry(
+                step_fn, params, opt_state, x, y, key, args.smoke,
+                deadline)
+            out["telemetry"] = tm_report
+        except Exception as exc:  # auxiliary metric: never kill the run
+            out["telemetry"] = {"error": repr(exc)}
+            out["degraded"] = True
+            reasons.append(f"telemetry phase failed: {exc!r}")
 
     if args.conv_ab or args.smoke:
         # the A/B costs roughly two more build+compile+measure passes

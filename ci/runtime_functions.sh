@@ -68,6 +68,17 @@ _npi_interp,_npi_full_like,_contrib_quantize,MultiBoxPrior \
         | tee OPPERF_smoke.jsonl
 }
 
+telemetry_smoke() {
+    # observability gate on CPU in seconds: a smoke fit with
+    # MXNET_RUNLOG armed must emit schema-valid JSONL (step records
+    # with feed-wait/collective fields, compile events with concrete
+    # retrace causes), a SIGTERM-killed fit must leave an untorn
+    # flight-recorder dump, and telemetry-off must take the no-op
+    # fast exit.  Also collected by tier-1 (tests/test_telemetry.py),
+    # so a regression turns the unit suite red between CI runs.
+    JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py -q
+}
+
 collectives_budget() {
     # sharded-server launch-count gate: the dp(16) dryrun runs the
     # flat-bucketed exchange (optimizer_sharding="ps") and ASSERTS its

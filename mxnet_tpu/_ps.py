@@ -774,6 +774,17 @@ class PSBackend:
             # address (a dropped TCP conn on a healthy shard must not
             # stall in the epoch wait below); injected faults keep
             # their connection.
+            try:
+                # retries are rare: telemetry cost lands only on the
+                # failure path, never on a healthy roundtrip
+                from . import telemetry
+
+                telemetry.count("ps_retries")
+                telemetry.event("ps_retry", op=msg[0], rank=r,
+                                attempt=attempt,
+                                error=type(exc).__name__)
+            except Exception:
+                pass
             if not isinstance(exc, faultsim.FaultInjected):
                 self._drop_conn(r)
 

@@ -327,6 +327,8 @@ def tune(op, shape, dtype, variants, measure, platform=None, mesh=None,
         entry = lookup_entry(op, shape, dtype, platform=platform,
                              mesh=mesh)
         if entry is not None and entry.get("winner") in variants:
+            _telemetry_winner(op, shape, dtype, entry["winner"],
+                              cached=True)
             return entry["winner"], {"cached": True,
                                      "timings": entry.get("timings", {})}
     timings = {}
@@ -336,7 +338,26 @@ def tune(op, shape, dtype, variants, measure, platform=None, mesh=None,
     winner = min(timings, key=timings.get)
     record(op, shape, dtype, winner, timings=timings, platform=platform,
            mesh=mesh)
+    _telemetry_winner(op, shape, dtype, winner, cached=False,
+                      timings=timings)
     return winner, {"cached": False, "timings": timings}
+
+
+def _telemetry_winner(op, shape, dtype, winner, cached, timings=None):
+    """One run-log event per tuning decision: which variant won, for
+    which signature, and whether the registry answered from cache —
+    the record the compile events' ``autotune_winner`` retrace cause
+    cross-references."""
+    try:
+        from . import telemetry
+
+        telemetry.event(
+            "autotune", op=op, shape=str(tuple(shape)),
+            dtype=str(dtype), winner=winner, cached=bool(cached),
+            timings={k: round(float(v), 6)
+                     for k, v in (timings or {}).items()})
+    except Exception:
+        pass  # telemetry must never kill a tuning session
 
 
 def tune_train_step(step, params, opt_state, x, y, key,

@@ -58,7 +58,15 @@ def log_train_metric(period, auto_reset=False):
 
 class Speedometer:
     """Log training speed + metrics every `frequent` batches (reference
-    callback.py:120)."""
+    callback.py:120).
+
+    Timing uses ``time.perf_counter()`` — a monotonic clock — so an
+    NTP step or wall-clock jump during training cannot produce
+    negative or absurd samples/sec (``time.time()`` could).  When run
+    telemetry is active (``MXNET_RUNLOG``), the reported rate is the
+    RunLog's authoritative recent-step-window throughput — the same
+    number the run log and metrics textfile carry — instead of a
+    second independent measurement."""
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
@@ -68,6 +76,28 @@ class Speedometer:
         self.last_count = 0
         self.auto_reset = auto_reset
 
+    def _speed(self):
+        try:
+            from . import telemetry
+
+            rl = telemetry.current()
+            if rl is not None:
+                # only over the steps of THIS reporting interval
+                # (since=tic): an eval loop records no steps so this
+                # returns None and we fall back to our own clock, and
+                # a window that opened mid-run is not diluted by an
+                # eval gap or the previous epoch's steps
+                authoritative = rl.recent_throughput(since=self.tic)
+                if authoritative is not None:
+                    return authoritative
+        except Exception:
+            pass  # telemetry broken must not silence the log line
+        try:
+            return (self.frequent * self.batch_size
+                    / (time.perf_counter() - self.tic))
+        except ZeroDivisionError:
+            return float("inf")
+
     def __call__(self, param):
         count = param.nbatch
         if self.last_count > count:
@@ -76,11 +106,7 @@ class Speedometer:
 
         if self.init:
             if count % self.frequent == 0:
-                try:
-                    speed = (self.frequent * self.batch_size
-                             / (time.time() - self.tic))
-                except ZeroDivisionError:
-                    speed = float("inf")
+                speed = self._speed()
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:
@@ -94,10 +120,10 @@ class Speedometer:
                     logging.info(
                         "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
                         param.epoch, count, speed)
-                self.tic = time.time()
+                self.tic = time.perf_counter()
         else:
             self.init = True
-            self.tic = time.time()
+            self.tic = time.perf_counter()
 
 
 class ProgressBar:

@@ -182,6 +182,31 @@ register_env("MXNET_FEED_JOIN_TIMEOUT_SEC", 10.0, float,
              "a wedged producer is abandoned (daemon) after this many "
              "seconds so a preemption drain can never hang fit "
              "teardown.")
+register_env("MXNET_RUNLOG", "", str,
+             "Path of the per-step JSONL run log (telemetry.RunLog). "
+             "Empty = telemetry off entirely: every wire point takes "
+             "the no-op fast exit and the fit loop performs no "
+             "per-step device syncs.  Set it and every subsystem "
+             "(step timing, device feed, compile/retrace causes, "
+             "checkpoints, PS retries, NaN guard, fault injections) "
+             "reports into one line-buffered JSONL file, plus a crash "
+             "flight recorder at <path>.flight.json.")
+register_env("MXNET_TELEMETRY_SAMPLE", 25, int,
+             "Device-sync sampling period for telemetry: the fit loop "
+             "reads the loss/metric (one device sync) only every this "
+             "many steps; unsampled step records keep wall timing but "
+             "loss=null so the hot path stays async.")
+register_env("MXNET_FLIGHTREC_DEPTH", 64, int,
+             "Crash flight recorder ring depth: the last N step "
+             "records (plus config/env/compile fingerprints) dumped "
+             "atomically on SIGTERM drain, NaN-abort, fault-injection "
+             "crash or an unhandled exception inside Module.fit.  "
+             "0 disables the recorder (run log still written).")
+register_env("MXNET_METRICS_TEXTFILE", "", str,
+             "Prometheus-textfile export path (node_exporter textfile "
+             "collector convention): telemetry counters + last "
+             "throughput/loss, atomically rewritten on every sampled "
+             "step.  Empty = off.")
 register_env("DMLC_NUM_WORKER", 1, int,
              "Distributed worker count (tools/launch.py contract).")
 register_env("DMLC_WORKER_ID", 0, int, "This worker's rank.")

@@ -501,6 +501,33 @@ class HybridBlock(Block):
             entry["fn_d"] = jax.jit(_run_d, donate_argnums=(1,))
         return entry["fn_d"]
 
+    def _telemetry_trace(self, sig, training, plat, probe, _at):
+        """One compile record per new CachedOp jit entry.  No-op when
+        MXNET_RUNLOG is unset (one call + dict lookup); the RunLog
+        diffs this fingerprint against the program's previous one to
+        name the retrace cause (shape/dtype/train_mode/
+        autotune_winner)."""
+        from .. import telemetry
+
+        rl = telemetry.current()
+        if rl is None:
+            return
+        shapes, train = sig
+        try:
+            winners = {}
+            if probe is not None and _at.enabled():
+                winners = {op: _at.lookup(op, probe.shape, probe.dtype,
+                                          platform=plat)
+                           for op in _at.VARIANT_OPS}
+            rl.compile_event(
+                f"cachedop:{self.name}",
+                telemetry.compile_fingerprint(
+                    [s[0] for s in shapes if s[0] != "#py"],
+                    [s[1] for s in shapes if s[0] != "#py"],
+                    train, winners=winners))
+        except Exception:
+            pass  # telemetry must never kill a forward
+
     def _call_cached(self, *args):
         """jit path: one compiled program, one autograd tape node.
 
@@ -527,6 +554,7 @@ class HybridBlock(Block):
             training,
         )
         entry = self._jit_cache.get(sig)
+        new_entry = entry is None
         if entry is None:
             entry = {"meta": None}
             # capture only non-array (python) inputs; array slots are fed
@@ -598,6 +626,11 @@ class HybridBlock(Block):
             _probe.dtype if _probe is not None else "none",
             platform=plat)
         _scope.__enter__()
+        if new_entry:
+            # one compile record per new CachedOp program (the gluon
+            # jit path's retrace observer, mirroring Executor's) —
+            # the RunLog diffs the fingerprint to name the cause
+            self._telemetry_trace(sig, training, plat, _probe, _at)
         try:
             nd_params = [p.data() for p in all_params]
             recording = autograd.is_recording() and (

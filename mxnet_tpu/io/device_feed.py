@@ -27,7 +27,8 @@ from .. import ndarray as nd
 from ..base import MXNetError
 from .io import DataBatch, DataIter
 
-__all__ = ["DeviceFeedIter", "as_device_batch", "device_feed_enabled"]
+__all__ = ["DeviceFeedIter", "as_device_batch", "batch_nbytes",
+           "device_feed_enabled"]
 
 _END = object()
 
@@ -76,6 +77,7 @@ def _produce(base, q, stop, stats, sharding, device, n_shards):
                 retry_on=(faultsim.FaultInjected, OSError),
                 attempts=3, base_delay=0.02, max_delay=0.5)
             stats["producer_busy_s"] += time.perf_counter() - t0
+            stats["h2d_bytes"] += batch_nbytes(out)
             if not _q_put(q, stop, out):
                 return
     except BaseException as e:  # noqa: BLE001 — surfaced on next()
@@ -139,6 +141,21 @@ def as_device_batch(item, sharding=None, device=None, n_shards=1):
     return item
 
 
+def batch_nbytes(item):
+    """Total array bytes in a (device) batch — the per-batch H2D
+    transfer volume ``stats()['h2d_bytes']`` accumulates and telemetry
+    step records report as deltas."""
+    if item is None:
+        return 0
+    if isinstance(item, DataBatch):
+        return batch_nbytes(item.data) + batch_nbytes(item.label)
+    if isinstance(item, (list, tuple)):
+        return sum(batch_nbytes(x) for x in item)
+    data = item._data if isinstance(item, nd.NDArray) else item
+    nbytes = getattr(data, "nbytes", None)
+    return int(nbytes) if nbytes is not None else 0
+
+
 class DeviceFeedIter(DataIter):
     """Wrap any batch iterator; keep ``depth`` batches device-resident
     ahead of the consumer (mesh-sharded over ``data_axis`` when a mesh
@@ -163,7 +180,8 @@ class DeviceFeedIter(DataIter):
         self._n_shards = int(mesh.devices.size) if mesh is not None else 1
         self._device = device
         self._stats = {"batches": 0, "epochs": 0,
-                       "consumer_wait_s": 0.0, "producer_busy_s": 0.0}
+                       "consumer_wait_s": 0.0, "producer_busy_s": 0.0,
+                       "h2d_bytes": 0}
         self._thread = None
         self._done = False
         self._closed = False

@@ -220,6 +220,24 @@ class BaseModule:
                 not isinstance(train_data, DeviceFeedIter):
             train_data = owned_feed = DeviceFeedIter(
                 train_data, mesh=getattr(self, "_mesh", None))
+        # telemetry session (telemetry.fit_session is a no-op shell
+        # when MXNET_RUNLOG is unset — the per-step fast exit): step
+        # records, sampled loss syncs, and the crash flight dumps for
+        # the in-fit death paths all hang off it
+        from .. import telemetry as _tm
+
+        batch_size = 0
+        try:
+            batch_size = int(train_data.provide_data[0][1][0])
+        except Exception:
+            pass
+        # feed-wait/H2D deltas come from whichever DeviceFeedIter is
+        # actually feeding the loop — fit's own wrapper or one the
+        # caller wrapped themselves
+        feed = owned_feed if owned_feed is not None else (
+            train_data if isinstance(train_data, DeviceFeedIter)
+            else None)
+        session = _tm.fit_session(batch_size=batch_size, feed=feed)
         drain = PreemptionDrain()
         try:
             with drain:
@@ -230,7 +248,15 @@ class BaseModule:
                     eval_end_callback, eval_batch_end_callback,
                     drain=drain, ckpt_mgr=ckpt_mgr,
                     checkpoint_period=checkpoint_period,
-                    resume_cursor=resume_cursor)
+                    resume_cursor=resume_cursor, session=session)
+            session.finish("preempted" if drain.requested is not None
+                           else "ok")
+        except BaseException as exc:  # noqa: BLE001 — flight-record
+            # EVERY in-fit death (NaN-abort already dumped at its raise
+            # site; re-dumping there is suppressed by reason tracking)
+            session.flight(f"exception:{type(exc).__name__}")
+            session.finish("error")
+            raise
         finally:
             if owned_feed is not None:
                 owned_feed.close()
@@ -294,9 +320,14 @@ class BaseModule:
                     batch_end_callback, epoch_end_callback,
                     eval_end_callback, eval_batch_end_callback,
                     drain=None, ckpt_mgr=None, checkpoint_period=1,
-                    resume_cursor=0):
+                    resume_cursor=0, session=None):
         from ..config import get_env
         from ..resilience import faultsim
+
+        if session is None:  # direct callers (tests) get the shell
+            from ..telemetry.session import FitSession
+
+            session = FitSession(None)
 
         bad_limit = int(get_env("MXNET_BAD_STEP_LIMIT"))
         bad_run = 0
@@ -332,6 +363,7 @@ class BaseModule:
                 data_batch = next_data_batch
                 if monitor is not None:
                     monitor.tic()
+                session.step_begin()
                 self.forward_backward(data_batch)
                 bad_step = False
                 if bad_limit > 0:
@@ -369,6 +401,7 @@ class BaseModule:
                                     set_states(
                                         state["optimizer_states"])
                                 _restore_rng(state.get("rng"))
+                        session.flight("nan_abort")
                         raise MXNetError(
                             f"aborting fit: {bad_run} consecutive "
                             f"non-finite steps (MXNET_BAD_STEP_LIMIT="
@@ -386,6 +419,20 @@ class BaseModule:
                 except StopIteration:
                     end_of_batch = True
                 self.update_metric(eval_metric, data_batch.label)
+                if session:
+                    # sampled device sync only: unsampled steps keep
+                    # wall timing but read no metric value
+                    synced = session.should_sync()
+                    loss_val = None
+                    if synced:
+                        try:
+                            nv = eval_metric.get_name_value()
+                            if nv and nv[0][1] == nv[0][1]:  # not NaN
+                                loss_val = float(nv[0][1])
+                        except Exception:
+                            pass
+                    session.step_end(epoch, nbatch, loss=loss_val,
+                                     synced=synced, bad_step=bad_step)
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:
@@ -403,6 +450,9 @@ class BaseModule:
                         "Preemption drain (signal %s): checkpoint at "
                         "epoch %d batch %d", drain.requested, epoch,
                         nbatch)
+                    # post-mortem of the preempted run: the last N
+                    # step records land beside the drain checkpoint
+                    session.flight("preempt_drain")
                     drained = True
                     break
             if drained:

@@ -336,6 +336,15 @@ class Module(BaseModule):
                                                  upd)
         else:
             self._updater = opt.get_updater(optimizer)
+        from .. import telemetry
+        from ..parallel.zero import ShardedBucketUpdater as _SBU
+
+        rl = telemetry.current()
+        if rl is not None:
+            # sticky context: every later step record carries the
+            # optimizer-sharding mode actually in effect
+            rl.set_context(sharding="ps" if isinstance(
+                self._updater, _SBU) else "none")
         self.optimizer_initialized = True
 
     # ------------------------------------------------------------- exec

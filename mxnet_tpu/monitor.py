@@ -71,20 +71,31 @@ class Monitor:
         self.queue.append((self.step, name, self.stat_func(array)))
 
     def install(self, exe):
-        """Attach to an Executor or a Gluon Block."""
+        """Attach to an Executor, a Gluon Block, or a Module.
+
+        A Module delegates to its ``install_monitor``: the monitor
+        wraps the bound executor (group) immediately when bound, or at
+        ``bind`` time otherwise — the legacy ``fit(monitor=...)``
+        path from the reference, driveable from either end."""
         from .gluon.block import Block
+        from .module.base_module import BaseModule
         from .symbol.executor import Executor
 
         if any(e is exe for e in self.exes):
             return  # idempotent: don't stack hooks/wrappers
+        if isinstance(exe, BaseModule):
+            self.exes.append(exe)
+            exe.install_monitor(self)  # wraps exe's executor via this
+            #                            install (Executor branch)
+            return
         if isinstance(exe, Block):
             self._install_block(exe)
         elif isinstance(exe, Executor):
             self._install_executor(exe)
         else:
             raise MXNetError(
-                f"Monitor.install expects an Executor or Block, got "
-                f"{type(exe)}")
+                f"Monitor.install expects an Executor, Block or "
+                f"Module, got {type(exe)}")
         self.exes.append(exe)
 
     def _install_block(self, block):

@@ -671,6 +671,56 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
     else:
         step_fn = jax.jit(_scoped_step, donate_argnums=donate_argnums,
                           static_argnums=())
+
+    # ---- telemetry: compile events + program introspection -----------
+    # One host-side record per (re)trace of the fused step: the RunLog
+    # diffs the fingerprint against the previous one for this program
+    # to name the retrace cause (shape / dtype / autotune_winner /
+    # hyper_params / sharding).  A signature seen before that recurs
+    # after a change is a cache "hit" (XLA's jit cache still holds it).
+    # MXNET_RUNLOG unset => current() is None => zero per-step work
+    # beyond one call + dict lookup.
+    from .. import telemetry as _tm
+
+    _jitted_step = step_fn
+    _tm_hyper = {k: v for k, v in sorted(vars(opt).items())
+                 if not k.startswith("_")
+                 and isinstance(v, (int, float, bool, str, type(None)))}
+    _tm_sharding = "ps" if ps_mode else "none"
+    _tm_seen = set()
+    _tm_last = [None]
+
+    def step_fn(p, o, x, y, key, t, _inner=_jitted_step):
+        rl = _tm.current()
+        if rl is not None:
+            sig = (tuple(x.shape), str(x.dtype))
+            if sig not in _tm_seen or sig != _tm_last[0]:
+                cache = "hit" if sig in _tm_seen else "miss"
+                winners = {}
+                if _at.enabled(_tune_level):
+                    winners = {
+                        op: _at.lookup(op, x.shape, x.dtype,
+                                       platform=plat, mesh=mesh_d)
+                        for op in variant_ops}
+                try:
+                    rl.compile_event(
+                        "train_step",
+                        _tm.compile_fingerprint(
+                            sig[0], sig[1], True, winners=winners,
+                            hyper=_tm_hyper, sharding=_tm_sharding),
+                        cache=cache)
+                    if cache == "miss":
+                        # memory/flop/collective introspection of the
+                        # program about to run — a persistent-cache
+                        # disk hit when the XLA cache is enabled
+                        _tm.describe_program(_inner, p, o, x, y, key,
+                                             t, program="train_step")
+                except Exception:
+                    pass  # telemetry must never kill the step
+                _tm_seen.add(sig)
+                _tm_last[0] = sig
+        return _inner(p, o, x, y, key, t)
+
     from ..resilience import faultsim
 
     if faultsim.armed("step.loss_nan"):
@@ -698,6 +748,12 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
                         "nor y has an inexact dtype to poison",
                         stacklevel=2)
             return _inner(p, o, x, y, key, t)
+
+    if step_fn is not _jitted_step:
+        # the telemetry/fault wrappers are plain functions; callers
+        # introspecting the program (bench.py, the multichip dryrun)
+        # still need jit's lower() — same XLA program either way
+        step_fn.lower = _jitted_step.lower
 
     return step_fn, params, opt_state
 

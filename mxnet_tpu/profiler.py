@@ -37,6 +37,8 @@ from .base import MXNetError
 __all__ = [
     "set_config", "profiler_set_config", "set_state", "profiler_set_state",
     "dump", "dump_profile", "dumps", "pause", "resume", "op_scope",
+    "now_us", "run_generation", "record_span", "record_counter",
+    "record_instant", "record_meta",
     "Domain", "Task", "Frame", "Event", "Counter", "Marker",
 ]
 
@@ -59,11 +61,26 @@ _paused = False
 _events = []  # chrome trace event dicts
 _agg = {}  # name -> [count, total_us, min_us, max_us]
 _jax_trace_active = False
+_run_gen = 0  # run-window starts; external lanes key metadata off it
 _t0 = time.perf_counter()
 
 
 def _now_us():
     return (time.perf_counter() - _t0) * 1e6
+
+
+def now_us():
+    """Microseconds on the profiler's trace clock — external lanes
+    (telemetry.RunLog) must timestamp on THIS clock so their spans line
+    up with the op events in one Perfetto timeline."""
+    return _now_us()
+
+
+def run_generation():
+    """Counts run-window starts.  Lane owners (telemetry) key their
+    per-trace metadata ('thread_name') off this so a second run window
+    after a finished dump gets its lane named again, not skipped."""
+    return _run_gen
 
 
 def is_running():
@@ -78,6 +95,13 @@ def set_config(**kwargs):
     aggregate_stats, continuous_dump, dump_period) plus the TPU
     extensions profile_device / tensorboard_logdir.
     """
+    if _state == "run":
+        # reference parity (profiler.py:33 backed by the C++ check):
+        # reconfiguring mid-collection (e.g. switching `filename`)
+        # would silently split/lose events — refuse, like the C side
+        raise MXNetError(
+            "profiler.set_config cannot be called while the profiler "
+            "is running; set_state('stop') first")
     unknown = set(kwargs) - set(_config)
     if unknown:
         raise MXNetError(f"unknown profiler config keys: {sorted(unknown)}")
@@ -100,13 +124,14 @@ def set_state(state="stop", profile_process="worker"):
     Stopping with continuous_dump set dumps automatically (the reference
     dumps from the C++ side on WorkerProfile teardown).
     """
-    global _state, _paused, _jax_trace_active
+    global _state, _paused, _jax_trace_active, _run_gen
     if state not in ("run", "stop"):
         raise MXNetError(f"invalid profiler state {state!r}")
     prev = _state
     _state = state
     _paused = False
     if state == "run" and prev != "run":
+        _run_gen += 1
         _record_instant("profiler_start", "profiler")
         if _config["profile_device"] and not _jax_trace_active:
             import jax
@@ -172,6 +197,34 @@ def record_op(name, dur_us, cat="operator", args=None):
             ent[3] = max(ent[3], dur_us)
 
 
+def record_span(name, cat, start_us, dur_us, args=None, tid=None):
+    """Public lane hook: one complete 'X' span on the trace clock
+    (``now_us``).  Used by telemetry.RunLog to put step/feed-wait/
+    checkpoint spans on the same Perfetto timeline as the op events.
+    Respects the run/pause window like every other event."""
+    if is_running():
+        _record(name, cat, "X", start_us, dur_us, args=args, tid=tid)
+
+
+def record_counter(name, value, cat="counter", tid=None):
+    """Public lane hook: one 'C' counter sample (throughput, loss)."""
+    if is_running():
+        _record(name, cat, "C", _now_us(), args={name: value}, tid=tid)
+
+
+def record_instant(name, cat, args=None, tid=None):
+    """Public lane hook: one instant event."""
+    if is_running():
+        _record(name, cat, "i", _now_us(), args=args, tid=tid)
+
+
+def record_meta(name, args, tid=None):
+    """Metadata event ('M') — names a tid lane in Perfetto.  Not gated
+    on is_running: lane names must land even when emitted just before
+    the run window opens."""
+    _record(name, "__metadata", "M", 0, args=args, tid=tid)
+
+
 def op_scope(name):
     """Public dispatcher hook: a context manager timing one op dispatch,
     or None when op profiling is off (the hot-path fast exit)."""
@@ -198,12 +251,29 @@ class _OpScope:
 
 
 def dump(finished=True, profile_process="worker"):
-    """Reference: profiler.py:122 — write the Chrome trace JSON file."""
+    """Reference: profiler.py:122 — write the Chrome trace JSON file.
+
+    ``finished=True`` means profiling is COMPLETE: the buffer is
+    flushed and collection stops (reference semantics — the C++ side
+    tears down WorkerProfile).  ``finished=False`` writes a snapshot
+    of everything collected so far and KEEPS collecting — the buffer
+    is retained so the next dump carries the full timeline (periodic
+    mid-run dumps watch a live training job without truncating it)."""
+    global _state, _paused
     path = _config["filename"]
     with _lock:
         events = list(_events)
         if finished:
             _events.clear()
+    if finished and _state == "run":
+        global _jax_trace_active
+        _state = "stop"
+        _paused = False
+        if _jax_trace_active:
+            import jax
+
+            jax.profiler.stop_trace()
+            _jax_trace_active = False
     with open(path, "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
     return path

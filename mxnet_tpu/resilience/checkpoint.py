@@ -240,6 +240,7 @@ class CheckpointManager:
         already consumed (0 = a clean epoch boundary) — the resume
         cursor for mid-epoch preemption drains.
         """
+        t_save0 = time.perf_counter()
         version = int(version)
         epoch = version if epoch is None else int(epoch)
         arg_params = arg_params or {}
@@ -287,6 +288,11 @@ class CheckpointManager:
                         "manifest": os.path.basename(
                             self.manifest_path(version))}).encode())
         self._apply_retention()
+        from .. import telemetry
+
+        telemetry.checkpoint_event(
+            self.prefix, version, time.perf_counter() - t_save0,
+            sum(f["bytes"] for f in files.values()))
         return manifest
 
     def _apply_retention(self):

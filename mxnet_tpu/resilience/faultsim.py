@@ -179,6 +179,20 @@ def inject(point):
                 break
     if rule is None:
         return None
+    try:
+        # armed hits are rare: telemetry cost only ever lands on the
+        # fault path, never on the per-call fast path above
+        from .. import telemetry
+
+        telemetry.count("faults")
+        telemetry.event("fault", point=point, action=rule.action,
+                        hit=n)
+        if rule.action == "crash":
+            # os._exit skips atexit: the flight recorder is the ONLY
+            # record the simulated power loss leaves behind
+            telemetry.flight_dump(f"fault_crash:{point}")
+    except Exception:
+        pass  # the harness must fire even if telemetry is broken
     if rule.action == "crash":
         os._exit(CRASH_EXIT_CODE)
     if rule.action == "raise":

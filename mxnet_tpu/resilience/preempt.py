@@ -53,6 +53,16 @@ class PreemptionDrain:
         # drain, don't die: the loop checks `requested` after the
         # in-flight step completes
         self._requested = signum
+        try:
+            # counter bump only (RunLog._lock is an RLock and handlers
+            # run in the main thread, so this cannot deadlock); the
+            # actual drain record + flight dump happen at the step
+            # boundary in fit, not in signal context
+            from .. import telemetry
+
+            telemetry.count("preempt_signals")
+        except Exception:
+            pass
 
     def _restore(self):
         # keyed off _prev, not _installed: a PARTIAL install failure

@@ -264,6 +264,7 @@ class Executor:
 
         sig = self._fwd_key(is_train)
         entry = self._fwd_jit.get(sig)
+        new_entry = entry is None
         if entry is None:
             sym = self._symbol
             don_names = self._updated_aux(is_train)
@@ -321,6 +322,9 @@ class Executor:
             tuple(arg_vals[0].shape) if arg_vals else (),
             arg_vals[0].dtype if arg_vals else "none", platform=plat)
         _scope.__enter__()
+        if new_entry:
+            self._telemetry_trace(sig, is_train, entry, arg_vals,
+                                  don_vals, rest_vals, key, _at, plat)
         try:
             if is_train and any(r != "null"
                                 for r in self._grad_req.values()):
@@ -369,6 +373,41 @@ class Executor:
             self.aux_dict[name]._adopt(val)
         self.outputs = [nd.NDArray(o) for o in outs[:n_out]]
         return self.outputs
+
+    def _telemetry_trace(self, sig, is_train, entry, arg_vals, don_vals,
+                         rest_vals, key, _at, plat):
+        """One compile record + program introspection per new jit
+        entry — the Module path's retrace observer.  The RunLog diffs
+        this fingerprint against the program's previous one to name
+        the retrace cause (shape/dtype/train_mode/autotune_winner).
+        No-op when MXNET_RUNLOG is unset; the introspection compile is
+        a persistent-cache disk hit when the XLA cache is enabled."""
+        from .. import telemetry
+
+        rl = telemetry.current()
+        if rl is None:
+            return
+        shapes, train = sig
+        program = f"executor:{getattr(self._symbol, 'name', None) or 'sym'}"
+        try:
+            probe = tuple(arg_vals[0].shape) if arg_vals else ()
+            pdt = arg_vals[0].dtype if arg_vals else "none"
+            winners = {}
+            if _at.enabled():
+                winners = {op: _at.lookup(op, probe, pdt, platform=plat)
+                           for op in _at.VARIANT_OPS}
+            rl.compile_event(program, telemetry.compile_fingerprint(
+                [s for _, s, _ in shapes], [d for _, _, d in shapes],
+                train, winners=winners))
+            if self._placement is None:
+                # memory_analysis/cost_analysis + HLO collective counts
+                # of the forward program (grouped executors run eager
+                # per-op: nothing to lower)
+                telemetry.describe_program(
+                    entry["fn"], arg_vals, don_vals, rest_vals, key,
+                    program=program)
+        except Exception:
+            pass  # telemetry must never kill a forward
 
     def backward(self, out_grads=None, is_train=True):
         """Accumulate into grad arrays per grad_req (reference

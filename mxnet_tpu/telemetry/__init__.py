@@ -1,0 +1,43 @@
+"""Unified run telemetry (observability round).
+
+Public surface:
+
+* :func:`current` — the active :class:`RunLog` or None (``MXNET_RUNLOG``
+  env arms it; the unset fast path is two dict lookups).
+* :func:`reset` / :func:`close` — (re)arm at a precise program point.
+* no-op-safe wire points every subsystem calls: :func:`compile_event`,
+  :func:`event`, :func:`count`, :func:`checkpoint_event`,
+  :func:`program_report`, :func:`flight_dump`.
+* :func:`describe_program` — XLA memory/flop/collective introspection
+  of a compiled step.
+* :func:`fit_session` — the per-``Module.fit`` session wrapper.
+* :mod:`.schema` — the JSONL record contract tests and CI validate.
+
+Env knobs (registered in :mod:`mxnet_tpu.config`): ``MXNET_RUNLOG``,
+``MXNET_TELEMETRY_SAMPLE``, ``MXNET_FLIGHTREC_DEPTH``,
+``MXNET_METRICS_TEXTFILE``.
+"""
+from . import schema  # noqa: F401
+from .runlog import (  # noqa: F401
+    RunLog,
+    checkpoint_event,
+    close,
+    compile_event,
+    compile_fingerprint,
+    count,
+    current,
+    describe_program,
+    event,
+    flight_dump,
+    flight_path_for,
+    program_report,
+    reset,
+)
+from .session import FitSession, fit_session  # noqa: F401
+
+__all__ = [
+    "RunLog", "current", "reset", "close", "compile_event",
+    "compile_fingerprint", "event", "count", "checkpoint_event",
+    "program_report", "flight_dump", "flight_path_for",
+    "describe_program", "FitSession", "fit_session", "schema",
+]

@@ -1,0 +1,626 @@
+"""Process-wide run telemetry: the ``RunLog`` every subsystem reports
+into (the tentpole of the observability round).
+
+The reference treats observability as a first-class subsystem — a C++
+``Profiler`` with lock-free per-thread stat buffers wired into every
+engine ``OprBlock``, dumped as one Chrome-trace timeline plus an
+aggregate table (src/profiler/profiler.h:251, aggregate_stats.cc).
+This module is the TPU-native equivalent for the *run* level: the
+subsystems built in earlier rounds (device feed, ZeRO exchange,
+autotuner, resilience, PS client, NaN guard) each own private signals;
+the RunLog is where they all land, on one clock, with four outputs:
+
+* **JSONL run log** (``MXNET_RUNLOG=path``): one record per step plus
+  compile/checkpoint/program/event records — schema in
+  :mod:`.schema`.  Step records are appended buffered and flushed on
+  every sampled step (and every non-step record), so the tail a hard
+  kill can lose is bounded by one sample period — and the flight
+  recorder re-dumps exactly those last steps on every managed death
+  path anyway.  Every complete line is valid JSON.
+* **Chrome-trace lane**: when the profiler is collecting, every step/
+  feed-wait/checkpoint span and the throughput/loss counters land in
+  ``profiler.dump()``'s timeline next to the op events (and the
+  ``jax.profiler`` device capture the same run/stop toggles).
+* **compile/memory introspection**: :func:`describe_program` compiles
+  a step (or reuses a Compiled/Lowered) and records XLA's
+  ``memory_analysis()``/``cost_analysis()`` plus the HLO collective
+  counts (``parallel.zero.collective_bytes``) as a ``program_report``.
+* **crash flight recorder**: a ring of the last
+  ``MXNET_FLIGHTREC_DEPTH`` step records plus config/env/compile
+  fingerprints, dumped through the resilience atomic writer on
+  SIGTERM drain, NaN-abort, fault-injection crash or an unhandled
+  exception inside ``Module.fit`` — the post-mortem a dead run
+  otherwise takes to the grave.
+
+Hot-path contract: with ``MXNET_RUNLOG`` unset, :func:`current` is two
+dict lookups returning ``None`` and every wire point no-ops — no file
+IO, no device syncs.  With it set, an unsampled step costs one dict
+build + one list append: serialization (``json.dumps``), the buffered
+writes and the flush syscall are all deferred to the next sampled
+step (or the next non-step record), and device syncs (loss readback)
+happen only every ``MXNET_TELEMETRY_SAMPLE`` steps.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+
+__all__ = ["RunLog", "current", "reset", "close", "compile_event",
+           "compile_fingerprint", "event", "count", "checkpoint_event",
+           "program_report", "flight_dump", "describe_program",
+           "flight_path_for"]
+
+_LOCK = threading.RLock()
+_STATE = {"log": None, "resolved": False}
+
+#: fingerprint key -> the compile cause it maps to when it changes
+_CAUSE_OF = {"shape": "shape", "dtype": "dtype", "train": "train_mode",
+             "autotune": "autotune_winner", "hyper": "hyper_params",
+             "sharding": "sharding"}
+
+#: fixed Chrome-trace tid for the telemetry lane (op events use the
+#: real thread ids, which are large — a small constant sorts first)
+_TRACE_TID = 7
+
+
+def _jsonable(v):
+    """Coerce numpy/jax scalars and tuples so json.dumps never throws
+    on a telemetry record (a logging layer must not kill the run)."""
+    if isinstance(v, (str, int, float, bool, type(None))):
+        return v
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if hasattr(v, "item"):
+        try:
+            return v.item()
+        except Exception:
+            pass
+    return str(v)
+
+
+def flight_path_for(runlog_path):
+    return f"{runlog_path}.flight.json"
+
+
+def compile_fingerprint(shape, dtype, train, winners=None, hyper=None,
+                        sharding="none"):
+    """The canonical compile-event fingerprint.  All three program
+    builders (``make_train_step``, ``Executor``, gluon ``CachedOp``)
+    build theirs through here, so the keys :data:`_CAUSE_OF` diffs
+    into retrace causes can never drift between them."""
+    fp = {"shape": str(shape), "dtype": str(dtype),
+          "train": bool(train),
+          "autotune": {k: v for k, v in (winners or {}).items()
+                       if v is not None},
+          "sharding": sharding}
+    if hyper is not None:
+        fp["hyper"] = hyper
+    return fp
+
+
+class RunLog:
+    """One run's telemetry sink (see module docstring)."""
+
+    def __init__(self, path, sample=None, flight_depth=None,
+                 textfile=None):
+        from ..config import get_env
+
+        self.path = os.fspath(path)
+        self.sample = max(1, int(sample if sample is not None
+                                 else get_env("MXNET_TELEMETRY_SAMPLE")))
+        depth = int(flight_depth if flight_depth is not None
+                    else get_env("MXNET_FLIGHTREC_DEPTH"))
+        self.flight_depth = depth
+        self.textfile = textfile if textfile is not None \
+            else (get_env("MXNET_METRICS_TEXTFILE") or None)
+        self._t0 = time.perf_counter()
+        self._lock = threading.RLock()
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        self._f = open(self.path, "a", buffering=1 << 16)
+        self._pending = []
+        self._ring = collections.deque(maxlen=depth) if depth > 0 \
+            else None
+        self.counters = {"steps": 0, "bad_steps": 0, "ps_retries": 0,
+                         "faults": 0, "compiles": 0, "checkpoints": 0,
+                         "h2d_bytes": 0, "feed_wait_s": 0.0,
+                         "preempt_signals": 0}
+        self._fps = {}          # program -> last compile fingerprint
+        self._programs = {}     # program -> last program_report body
+        self._last_program = None
+        self._ctx = {"sharding": "none"}
+        self._recent = collections.deque(maxlen=64)  # (t, samples)
+        self._last = {"loss": None, "samples_per_sec": None}
+        self._closed = False
+        self._write({"type": "run_start", "time": time.time(),
+                     "pid": os.getpid(), "env": self._env_snapshot(),
+                     "config": {"sample": self.sample,
+                                "flight_depth": depth,
+                                "textfile": self.textfile},
+                     "jax": self._jax_snapshot()})
+
+    # ------------------------------------------------------- plumbing
+    @staticmethod
+    def _env_snapshot():
+        return {k: v for k, v in os.environ.items()
+                if k.startswith(("MXNET_", "DMLC_", "JAX_", "XLA_"))}
+
+    @staticmethod
+    def _jax_snapshot():
+        try:
+            import jax
+
+            devs = jax.devices()
+            return {"version": jax.__version__,
+                    "platform": devs[0].platform, "devices": len(devs)}
+        except Exception:
+            return {}
+
+    def _now(self):
+        return time.perf_counter() - self._t0
+
+    def _write(self, rec, flush=True, raw=False):
+        """Emit one record.  ``flush=False`` (unsampled steps) only
+        queues the dict — serialization and IO are paid in batch at the
+        next flushing record, keeping the hot path syscall-free.
+        ``raw=True`` skips the ``_jsonable`` recursion for records
+        built from known scalars (``default=str`` catches strays)."""
+        if not raw:
+            rec = _jsonable(rec)
+        with self._lock:
+            if self._closed:
+                return
+            if not flush:
+                self._pending.append(rec)
+                return
+            try:
+                if self._pending:
+                    self._f.write("".join(
+                        json.dumps(p, default=str) + "\n"
+                        for p in self._pending))
+                self._f.write(json.dumps(rec, default=str) + "\n")
+                self._f.flush()
+            except (OSError, ValueError):
+                pass  # a full disk must not kill the training run
+            finally:
+                self._pending.clear()
+
+    def set_context(self, **ctx):
+        """Sticky fields stamped onto every later step record (e.g.
+        ``sharding='ps'`` from ``Module.init_optimizer``)."""
+        self._ctx.update(ctx)
+
+    def should_sync(self, step_no):
+        """Whether this step is a sampled one (the caller pays one
+        device sync to read loss/metrics)."""
+        return step_no % self.sample == 0
+
+    # ----------------------------------------------------------- step
+    def step(self, epoch, batch, wall_s, samples, step_no=None,
+             loss=None, synced=False, feed_wait_s=None, h2d_bytes=None,
+             bad_step=False, sharding=None):
+        """Record one training step.  ``feed_wait_s``/``h2d_bytes`` are
+        DELTAS for this step (the fit session computes them from
+        ``DeviceFeedIter.stats()`` snapshots)."""
+        c = self.counters
+        step_no = c["steps"] if step_no is None else int(step_no)
+        c["steps"] += 1
+        if bad_step:
+            c["bad_steps"] += 1
+        if feed_wait_s:
+            c["feed_wait_s"] += float(feed_wait_s)
+        if h2d_bytes:
+            c["h2d_bytes"] += int(h2d_bytes)
+        sps = (float(samples) / wall_s) if wall_s > 0 else None
+        # collective accounting comes from the program driving these
+        # steps: an explicit set_context(program=...) pin wins, else
+        # the most recently traced program (the one a fit loop just
+        # compiled), never an arbitrary stale report
+        prog = self._programs.get(
+            self._ctx.get("program") or self._last_program)
+        coll = prog.get("collectives") if prog else None
+        t = self._now()
+        rec = {
+            "type": "step", "t": round(t, 6), "epoch": int(epoch),
+            "step": step_no, "batch": int(batch),
+            "wall_ms": round(wall_s * 1e3, 4), "samples": int(samples),
+            "samples_per_sec": round(sps, 3) if sps else None,
+            "loss": float(loss) if loss is not None else None,
+            "synced": bool(synced),
+            "feed_wait_ms": round(feed_wait_s * 1e3, 4)
+            if feed_wait_s is not None else None,
+            "h2d_bytes": int(h2d_bytes) if h2d_bytes is not None
+            else None,
+            "collective_counts": dict(coll["counts"]) if coll else None,
+            "collective_bytes": int(coll["total_bytes"]) if coll
+            else None,
+            "sharding": sharding if sharding is not None
+            else self._ctx.get("sharding", "none"),
+            "bad_step": bool(bad_step),
+            "ps_retries": c["ps_retries"], "faults": c["faults"],
+            "checkpoints": c["checkpoints"],
+        }
+        # hot path: the record is built from known scalars, so skip the
+        # _jsonable recursion and only pay the flush syscall on sampled
+        # steps (default=str catches any stray numpy scalar)
+        self._write(rec, flush=synced, raw=True)
+        if self._ring is not None:
+            self._ring.append(rec)
+        self._recent.append((t, samples))
+        if loss is not None:
+            self._last["loss"] = float(loss)
+        if sps:
+            self._last["samples_per_sec"] = sps
+        self._trace_step(t, wall_s, feed_wait_s, sps, loss)
+        if synced and self.textfile:
+            self.write_textfile()
+        return rec
+
+    def _trace_step(self, t_end, wall_s, feed_wait_s, sps, loss):
+        """Mirror the step onto the profiler's Chrome-trace timeline
+        (one telemetry lane next to the op events)."""
+        from .. import profiler
+
+        if not profiler.is_running():
+            return
+        self._trace_meta()
+        start = profiler.now_us() - wall_s * 1e6
+        if feed_wait_s:
+            profiler.record_span("feed_wait", "telemetry",
+                                 start, feed_wait_s * 1e6,
+                                 tid=_TRACE_TID)
+        profiler.record_span(f"step {self.counters['steps'] - 1}",
+                             "telemetry", start, wall_s * 1e6,
+                             tid=_TRACE_TID)
+        if sps:
+            profiler.record_counter("throughput", round(sps, 2),
+                                    cat="telemetry", tid=_TRACE_TID)
+        if loss is not None:
+            profiler.record_counter("loss", float(loss),
+                                    cat="telemetry", tid=_TRACE_TID)
+
+    def _trace_meta(self):
+        from .. import profiler
+
+        # once per profiler run WINDOW, not once per RunLog: a dump
+        # (finished=True) drains the buffer, so the next window needs
+        # its lane-name metadata re-emitted
+        gen = profiler.run_generation()
+        if getattr(self, "_trace_named_gen", None) != gen:
+            profiler.record_meta("thread_name", {"name": "telemetry"},
+                                 tid=_TRACE_TID)
+            self._trace_named_gen = gen
+
+    def recent_throughput(self, since=None):
+        """samples/sec over the recent step window (the authoritative
+        rate ``callback.Speedometer`` reads when telemetry is live).
+        ``since`` (a ``time.perf_counter()`` stamp) restricts the
+        window to steps recorded after it, so a reporting interval
+        that opened mid-run (Speedometer's tic) is not diluted by an
+        eval pass or the previous epoch's steps."""
+        recent = list(self._recent)
+        if since is not None:
+            cut = since - self._t0
+            recent = [(t, s) for t, s in recent if t >= cut]
+        if len(recent) < 2:
+            return None
+        (t0, _), (t1, _) = recent[0], recent[-1]
+        if t1 <= t0:
+            return None
+        n = sum(s for _, s in recent[1:])
+        return n / (t1 - t0)
+
+    # -------------------------------------------------- compile events
+    def compile_event(self, program, fingerprint, cache="miss",
+                      causes=None):
+        """Record a program (re)trace.  ``fingerprint`` keys are diffed
+        against the program's last one to derive the retrace causes:
+        shape / dtype / train_mode / autotune_winner / hyper_params /
+        sharding; the first trace of a program is ``first_trace``."""
+        fingerprint = _jsonable(fingerprint)
+        with self._lock:
+            prev = self._fps.get(program)
+            if causes is None:
+                if prev is None:
+                    causes = ["first_trace"]
+                else:
+                    keys = set(prev) | set(fingerprint)
+                    causes = sorted(
+                        {_CAUSE_OF.get(k, "program") for k in keys
+                         if prev.get(k) != fingerprint.get(k)})
+                    causes = causes or ["program"]
+            self._fps[program] = fingerprint
+            self.counters["compiles"] += 1
+        rec = {"type": "compile", "t": round(self._now(), 6),
+               "program": program, "cache": cache,
+               "causes": list(causes), "fingerprint": fingerprint}
+        self._write(rec)
+        from .. import profiler
+
+        if profiler.is_running():
+            self._trace_meta()
+            profiler.record_instant(f"compile:{program}", "telemetry",
+                                    args={"causes": list(causes)},
+                                    tid=_TRACE_TID)
+        return rec
+
+    # ------------------------------------------------- program reports
+    def program_report(self, program, memory=None, flops=None,
+                       bytes_accessed=None, collectives=None,
+                       extra=None):
+        body = {"memory": memory or {}, "flops": float(flops or 0.0),
+                "bytes_accessed": float(bytes_accessed or 0.0),
+                "collectives": collectives}
+        if extra:
+            body.update(extra)
+        with self._lock:
+            self._programs[program] = body
+            self._last_program = program
+        self._write({"type": "program_report",
+                     "t": round(self._now(), 6), "program": program,
+                     **body})
+        return body
+
+    # ------------------------------------------------------ checkpoint
+    def checkpoint_event(self, prefix, version, duration_s, nbytes):
+        self.counters["checkpoints"] += 1
+        self._write({"type": "checkpoint", "t": round(self._now(), 6),
+                     "prefix": str(prefix), "version": int(version),
+                     "duration_s": round(float(duration_s), 6),
+                     "bytes": int(nbytes)})
+        from .. import profiler
+
+        if profiler.is_running():
+            self._trace_meta()
+            profiler.record_span(
+                "checkpoint", "telemetry",
+                profiler.now_us() - duration_s * 1e6, duration_s * 1e6,
+                args={"version": int(version), "bytes": int(nbytes)},
+                tid=_TRACE_TID)
+
+    # ---------------------------------------------------------- events
+    def event(self, kind, **fields):
+        self._write({"type": "event", "t": round(self._now(), 6),
+                     "kind": kind, **fields})
+
+    def count(self, counter, delta=1):
+        with self._lock:
+            self.counters[counter] = \
+                self.counters.get(counter, 0) + delta
+
+    # -------------------------------------------------- flight recorder
+    @property
+    def flight_path(self):
+        return flight_path_for(self.path)
+
+    def flight_dump(self, reason):
+        """Atomically write the flight-recorder snapshot: the last
+        ``flight_depth`` step records plus config/env/compile
+        fingerprints and counters.  Safe to call from crash paths (the
+        fault-injection point is disabled so a ``ckpt.write`` fault
+        spec cannot tear the post-mortem of its own crash)."""
+        if self._ring is None:
+            return None
+        from ..resilience.checkpoint import atomic_write_bytes
+
+        payload = _jsonable({
+            "reason": reason, "time": time.time(), "pid": os.getpid(),
+            "depth": self._ring.maxlen, "counters": dict(self.counters),
+            "context": dict(self._ctx), "env": self._env_snapshot(),
+            "programs": dict(self._fps),
+            "program_reports": dict(self._programs),
+            "steps": list(self._ring),
+        })
+        try:
+            atomic_write_bytes(
+                self.flight_path,
+                json.dumps(payload, indent=1).encode(),
+                inject_point=None)
+        except OSError:
+            return None
+        self.event("flight_dump", reason=reason, path=self.flight_path)
+        return self.flight_path
+
+    # ------------------------------------------------ metrics textfile
+    def write_textfile(self):
+        """Prometheus-textfile export (node_exporter textfile collector
+        convention), atomically rewritten so a scraper never reads a
+        torn file."""
+        if not self.textfile:
+            return None
+        from ..resilience.checkpoint import atomic_write_bytes
+
+        lines = []
+        for k, v in sorted(self.counters.items()):
+            kind = "counter" if isinstance(v, int) else "gauge"
+            lines.append(f"# TYPE mxnet_tpu_{k} {kind}")
+            lines.append(f"mxnet_tpu_{k} {v}")
+        for k, v in sorted(self._last.items()):
+            if v is None:
+                continue
+            lines.append(f"# TYPE mxnet_tpu_{k} gauge")
+            lines.append(f"mxnet_tpu_{k} {v}")
+        try:
+            atomic_write_bytes(self.textfile,
+                               ("\n".join(lines) + "\n").encode(),
+                               inject_point=None)
+        except OSError:
+            return None
+        return self.textfile
+
+    # ------------------------------------------------------------ close
+    def close(self):
+        with self._lock:
+            if self._closed:
+                return
+            self._write({"type": "run_end", "t": round(self._now(), 6),
+                         "counters": dict(self.counters)})
+            if self.textfile:
+                self.write_textfile()
+            self._closed = True
+            try:
+                self._f.close()
+            except OSError:
+                pass
+
+
+# -------------------------------------------------- module-level state
+def current():
+    """The active RunLog, or None.  The no-op fast exit: two dict
+    lookups when ``MXNET_RUNLOG`` is unset."""
+    if not _STATE["resolved"]:
+        _resolve()
+    return _STATE["log"]
+
+
+def _resolve():
+    with _LOCK:
+        if _STATE["resolved"]:
+            return
+        from ..config import get_env
+
+        path = get_env("MXNET_RUNLOG")
+        log = None
+        if path:
+            try:
+                log = RunLog(path)
+            except Exception as e:  # noqa: BLE001 — logging layer
+                # an unwritable run-log path or a bad telemetry knob
+                # (MXNET_TELEMETRY_SAMPLE=twenty) disables telemetry
+                # with a warning — it must not kill every forward/
+                # step/fit that touches a wire point
+                import warnings
+
+                warnings.warn(f"MXNET_RUNLOG={path!r} unusable ({e}); "
+                              "telemetry disabled", stacklevel=3)
+        _STATE["log"] = log
+        _STATE["resolved"] = True
+
+
+def reset(path=None):
+    """Close any active log and re-resolve — from ``path`` when given,
+    else from ``MXNET_RUNLOG`` (tests and bench arm telemetry at a
+    precise point rather than at import)."""
+    with _LOCK:
+        if _STATE["log"] is not None:
+            _STATE["log"].close()
+        _STATE["log"] = None
+        _STATE["resolved"] = False
+        if path is not None:
+            _STATE["log"] = RunLog(path)
+            _STATE["resolved"] = True
+    return _STATE["log"]
+
+
+def close():
+    with _LOCK:
+        if _STATE["log"] is not None:
+            _STATE["log"].close()
+        _STATE["log"] = None
+        _STATE["resolved"] = False
+
+
+# --------------------------------------- convenience no-op-safe wrappers
+def compile_event(program, fingerprint, cache="miss", causes=None):
+    rl = current()
+    if rl is not None:
+        rl.compile_event(program, fingerprint, cache=cache,
+                         causes=causes)
+
+
+def event(kind, **fields):
+    rl = current()
+    if rl is not None:
+        rl.event(kind, **fields)
+
+
+def count(counter, delta=1):
+    rl = current()
+    if rl is not None:
+        rl.count(counter, delta)
+
+
+def checkpoint_event(prefix, version, duration_s, nbytes):
+    rl = current()
+    if rl is not None:
+        rl.checkpoint_event(prefix, version, duration_s, nbytes)
+
+
+def program_report(program, **kw):
+    rl = current()
+    if rl is not None:
+        rl.program_report(program, **kw)
+
+
+def flight_dump(reason):
+    rl = current()
+    if rl is not None:
+        return rl.flight_dump(reason)
+    return None
+
+
+# --------------------------------------------- program introspection
+def describe_program(fn_or_compiled, *args, program="program",
+                     record=True, **kwargs):
+    """Compile/memory introspection of one XLA program — the
+    ``profile_memory`` analog XLA actually exposes.
+
+    ``fn_or_compiled`` may be a jitted callable (lowered+compiled here
+    with ``*args``; the persistent compilation cache makes a re-compile
+    of an already-seen program a disk read), a ``Lowered``, or a
+    ``Compiled``.  Returns a dict with ``memory`` (argument/output/
+    temp/alias/generated-code bytes from ``compiled.memory_analysis()``),
+    ``flops``/``bytes_accessed`` (``cost_analysis()``) and
+    ``collectives`` (HLO collective counts/bytes via
+    ``parallel.zero.collective_bytes``); records a ``program_report``
+    into the active RunLog when ``record`` is True.
+    """
+    compiled = fn_or_compiled
+    if hasattr(compiled, "lower"):
+        compiled = compiled.lower(*args, **kwargs)
+    if hasattr(compiled, "compile"):
+        compiled = compiled.compile()
+
+    memory = {}
+    try:
+        ma = compiled.memory_analysis()
+        for field in ("argument_size_in_bytes", "output_size_in_bytes",
+                      "temp_size_in_bytes", "alias_size_in_bytes",
+                      "generated_code_size_in_bytes"):
+            v = getattr(ma, field, None)
+            if v is not None:
+                memory[field.replace("_size_in_bytes", "_bytes")] = \
+                    int(v)
+    except Exception:
+        pass  # backend without memory stats: report what we can
+    flops = bytes_accessed = 0.0
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0))
+        bytes_accessed = float(ca.get("bytes accessed", 0.0))
+    except Exception:
+        pass
+    collectives = None
+    try:
+        from ..parallel.zero import collective_bytes
+
+        collectives = collective_bytes(compiled.as_text())
+    except Exception:
+        pass
+    report = {"program": program, "memory": memory, "flops": flops,
+              "bytes_accessed": bytes_accessed,
+              "collectives": collectives}
+    if record:
+        rl = current()
+        if rl is not None:
+            rl.program_report(program, memory=memory, flops=flops,
+                              bytes_accessed=bytes_accessed,
+                              collectives=collectives)
+    return report

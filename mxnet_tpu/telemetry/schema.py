@@ -1,0 +1,136 @@
+"""Run-log record schema: one place tests, CI and the bench harness
+agree on what a telemetry record must look like.
+
+Every line of an ``MXNET_RUNLOG`` JSONL file is one record with a
+``type`` discriminator; :func:`validate_record` returns a list of
+human-readable problems (empty = valid).  The step-record field table
+is the documented contract (README "Observability"):
+
+========  =============================================================
+type      meaning
+========  =============================================================
+run_start process/config/env fingerprint, written when the log opens
+step      one training step (wall time, throughput, feed stats, ...)
+compile   a program (re)trace with its cause (shape/dtype/...)
+program_report  compiled-program introspection (memory/flops/collectives)
+checkpoint  one atomic checkpoint write with its duration
+event     everything else (bad_step, ps_retry, fault, autotune, ...)
+run_end   final counters, written at close
+========  =============================================================
+"""
+from __future__ import annotations
+
+__all__ = ["STEP_FIELDS", "RECORD_TYPES", "COMPILE_CAUSES",
+           "validate_record", "validate_lines"]
+
+#: step-record contract: field -> (types, required).  ``None`` is legal
+#: for optional measurements (loss on an unsampled step, feed stats
+#: when no device feed wraps the iterator).
+STEP_FIELDS = {
+    "type": (str, True),
+    "t": ((int, float), True),            # seconds since run start
+    "epoch": (int, True),
+    "step": (int, True),                  # global step (monotonic)
+    "batch": (int, True),                 # batch index within the epoch
+    "wall_ms": ((int, float), True),
+    "samples": (int, True),
+    "samples_per_sec": ((int, float, type(None)), True),
+    "loss": ((int, float, type(None)), True),
+    "synced": (bool, True),               # sampled device sync happened
+    "feed_wait_ms": ((int, float, type(None)), True),
+    "h2d_bytes": ((int, type(None)), True),
+    "collective_counts": ((dict, type(None)), True),
+    "collective_bytes": ((int, type(None)), True),
+    "sharding": (str, True),              # optimizer-sharding mode
+    "bad_step": (bool, True),
+    "ps_retries": (int, True),            # cumulative process counters
+    "faults": (int, True),
+    "checkpoints": (int, True),
+}
+
+RECORD_TYPES = ("run_start", "step", "compile", "program_report",
+                "checkpoint", "event", "run_end")
+
+#: the concrete retrace causes a compile record may carry
+COMPILE_CAUSES = ("first_trace", "shape", "dtype", "train_mode",
+                  "autotune_winner", "hyper_params", "sharding",
+                  "program")
+
+
+def _check_fields(rec, spec):
+    problems = []
+    for name, (types, required) in spec.items():
+        if name not in rec:
+            if required:
+                problems.append(f"missing field {name!r}")
+            continue
+        if not isinstance(rec[name], types):
+            problems.append(
+                f"field {name!r} has type {type(rec[name]).__name__}, "
+                f"want {types}")
+    return problems
+
+
+def validate_record(rec):
+    """Validate one parsed record; returns a list of problems."""
+    if not isinstance(rec, dict):
+        return ["record is not an object"]
+    t = rec.get("type")
+    if t not in RECORD_TYPES:
+        return [f"unknown record type {t!r}"]
+    if t == "step":
+        return _check_fields(rec, STEP_FIELDS)
+    if t == "compile":
+        problems = _check_fields(rec, {
+            "t": ((int, float), True), "program": (str, True),
+            "cache": (str, True), "causes": (list, True),
+            "fingerprint": (dict, True)})
+        for c in rec.get("causes", ()):
+            if c not in COMPILE_CAUSES:
+                problems.append(f"unknown compile cause {c!r}")
+        if rec.get("cache") not in ("hit", "miss"):
+            problems.append(f"cache must be hit/miss, got "
+                            f"{rec.get('cache')!r}")
+        return problems
+    if t == "program_report":
+        return _check_fields(rec, {
+            "t": ((int, float), True), "program": (str, True),
+            "memory": (dict, True), "flops": ((int, float), True),
+            "collectives": ((dict, type(None)), True)})
+    if t == "checkpoint":
+        return _check_fields(rec, {
+            "t": ((int, float), True), "prefix": (str, True),
+            "version": (int, True), "duration_s": ((int, float), True),
+            "bytes": (int, True)})
+    if t == "event":
+        return _check_fields(rec, {"t": ((int, float), True),
+                                   "kind": (str, True)})
+    if t == "run_start":
+        return _check_fields(rec, {"time": ((int, float), True),
+                                   "pid": (int, True),
+                                   "env": (dict, True),
+                                   "config": (dict, True)})
+    if t == "run_end":
+        return _check_fields(rec, {"t": ((int, float), True),
+                                   "counters": (dict, True)})
+    return []
+
+
+def validate_lines(lines):
+    """Validate an iterable of JSONL lines; returns (records, problems)
+    where problems carry the 1-based line number."""
+    import json
+
+    records, problems = [], []
+    for i, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError as e:
+            problems.append(f"line {i}: not JSON ({e})")
+            continue
+        records.append(rec)
+        problems.extend(f"line {i}: {p}" for p in validate_record(rec))
+    return records, problems

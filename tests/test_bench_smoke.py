@@ -80,10 +80,27 @@ def test_smoke_emits_valid_json_with_heartbeats():
     assert 1 <= shd["all-gather"] <= 8
     assert shd["all-reduce"] <= 2
     assert col["launches_sharded"] < col["launches_replicated"]
+    # the telemetry phase armed a run log, reported real steps into
+    # it, and re-read its own JSONL (round 10: the observability layer
+    # validates itself every bench run)
+    tm = out["telemetry"]
+    assert tm["schema_valid"] is True, tm["schema_problems"]
+    assert tm["steps"] > 0
+    assert tm["records"]["step"] == tm["steps"]
+    assert tm["records"]["run_start"] == 1
+    assert tm["records"]["run_end"] == 1
+    assert tm["synced_steps"] >= 1  # step 0 is always sampled
+    assert tm["sample_period"] >= 1
+    prog = tm["program_report"]
+    assert prog is not None
+    assert prog["flops"] > 0
+    assert prog["memory"].get("argument_bytes", 0) > 0
+    assert prog["collectives"] is not None
     # a heartbeat per phase, so a hang is attributable
     for phase in ("import", "device_init", "build", "autotune",
                   "compile", "K1", "K2", "trials", "feed",
-                  "checkpoint", "collectives", "conv_ab", "done"):
+                  "checkpoint", "collectives", "telemetry", "conv_ab",
+                  "done"):
         assert f"phase={phase}" in r.stderr, f"missing phase {phase}"
 
 

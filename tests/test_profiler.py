@@ -127,5 +127,88 @@ def test_bad_config_raises():
         profiler.set_state("bogus")
 
 
+def test_set_config_refused_while_running():
+    """Reference parity (observability round): reconfiguring
+    mid-collection (e.g. switching `filename`) would silently split or
+    lose events — refuse, like the C++ profiler does."""
+    profiler.set_state("run")
+    try:
+        with pytest.raises(mx.MXNetError, match="running"):
+            profiler.set_config(filename="elsewhere.json")
+    finally:
+        profiler.set_state("stop")
+
+
+def test_dump_unfinished_keeps_collecting(tmp_path):
+    """dump(finished=False) writes a snapshot and KEEPS collecting;
+    dump(finished=True) flushes and stops — they are no longer the
+    same operation (observability-round satellite)."""
+    out = str(tmp_path / "t.json")
+    profiler.set_config(filename=out)
+    profiler.set_state("run")
+    mx.nd.ones((2,)).wait_to_read()
+    profiler.dump(finished=False)
+    assert profiler.is_running(), "snapshot dump must keep collecting"
+    with open(out) as f:
+        n_mid = len(json.load(f)["traceEvents"])
+    assert n_mid > 0
+    (mx.nd.ones((2,)) * 3).wait_to_read()
+    profiler.dump()  # finished: flush everything and stop
+    assert not profiler.is_running()
+    with open(out) as f:
+        n_final = len(json.load(f)["traceEvents"])
+    # the final dump carries the FULL timeline (snapshot didn't drain)
+    assert n_final > n_mid
+
+
+def test_merged_telemetry_lane(tmp_path):
+    """Observability-round acceptance: telemetry step/feed-wait/
+    checkpoint spans and the throughput/loss counter tracks land in
+    the SAME Chrome trace as the op events — one Perfetto timeline."""
+    from mxnet_tpu import telemetry
+
+    out = str(tmp_path / "merged.json")
+    profiler.set_config(filename=out)
+    profiler.set_state("run")
+    rl = telemetry.reset(str(tmp_path / "run.jsonl"))
+    try:
+        a = mx.nd.dot(mx.nd.ones((4, 4)), mx.nd.ones((4, 4)))
+        a.wait_to_read()
+        rl.step(0, 0, 0.004, 32, loss=0.5, synced=True,
+                feed_wait_s=0.001)
+        rl.compile_event("train_step", {"shape": "(32, 6)",
+                                        "dtype": "float32"})
+        rl.checkpoint_event("pfx", 1, 0.002, 1234)
+    finally:
+        telemetry.close()
+        profiler.set_state("stop")
+    profiler.dump()
+    with open(out) as f:
+        evs = json.load(f)["traceEvents"]
+
+    # the op lane is there...
+    assert "dot" in {e["name"] for e in evs}
+    # ...and the telemetry lane rides the same timeline
+    tele = [e for e in evs if e.get("cat") == "telemetry"]
+    spans = {e["name"] for e in tele if e["ph"] == "X"}
+    assert "step 0" in spans
+    assert "feed_wait" in spans
+    assert "checkpoint" in spans
+    assert any(e["ph"] == "i" and e["name"] == "compile:train_step"
+               for e in tele)
+    counters = {e["name"] for e in tele if e["ph"] == "C"}
+    assert {"throughput", "loss"} <= counters
+    # the lane is named for Perfetto and pinned to its own tid, and
+    # every telemetry event actually sits on that tid
+    lane_tid = [e for e in evs if e.get("ph") == "M"
+                and e.get("args", {}).get("name") == "telemetry"]
+    assert lane_tid, "telemetry lane metadata missing"
+    tid = lane_tid[0]["tid"]
+    assert all(e["tid"] == tid for e in tele)
+    # spans are stamped on the profiler clock (ts >= 0, numbers)
+    for e in tele:
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+
+
 def test_lazy_namespace():
     assert mx.profiler is profiler

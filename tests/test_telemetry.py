@@ -1,0 +1,605 @@
+"""Unified run telemetry tests (observability round).
+
+The tentpole under test is ``mxnet_tpu/telemetry``: one process-wide
+RunLog every subsystem reports into, with four outputs — the per-step
+JSONL run log, the merged Chrome-trace lane (asserted in
+test_profiler.py), compile/memory introspection, and the crash flight
+recorder:
+
+* a smoke ``Module.fit`` with the run log armed emits schema-valid
+  JSONL whose step records carry feed-wait deltas, H2D bytes and
+  collective counts, plus compile events with concrete retrace causes;
+* forced retraces name their cause: a dtype change records ``dtype``,
+  a shape change ``shape``, an autotune-winner flip
+  ``autotune_winner`` (for both the fused train step and the gluon
+  CachedOp path);
+* a SIGTERM-killed fit leaves an untorn flight-recorder dump with the
+  last ``MXNET_FLIGHTREC_DEPTH`` step records (subprocess-asserted,
+  like the resilience drain tests);
+* with ``MXNET_RUNLOG`` unset the hot path takes the no-op fast exit,
+  and at default sampling the per-step cost is small (loose overhead
+  smoke — the <2% acceptance target is asserted with CI headroom).
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autotune, gluon, telemetry
+from mxnet_tpu import sym
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel import make_train_step
+from mxnet_tpu.telemetry import schema
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.unit
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry(monkeypatch):
+    """Every test starts and ends with telemetry disarmed (the module
+    state is process-wide) and without an ambient MXNET_RUNLOG."""
+    monkeypatch.delenv("MXNET_RUNLOG", raising=False)
+    monkeypatch.delenv("MXNET_METRICS_TEXTFILE", raising=False)
+    telemetry.close()
+    yield
+    telemetry.close()
+
+
+def _mlp():
+    d = sym.Variable("data")
+    fc1 = sym.FullyConnected(d, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(fc2, sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def _toy_data():
+    rng = onp.random.RandomState(7)
+    X = rng.randn(64, 10).astype("float32")
+    y = (X @ rng.randn(10, 4)).argmax(axis=1).astype("float32")
+    return X, y
+
+
+def _fit(num_epoch=2, **kwargs):
+    mx.random.seed(11)
+    onp.random.seed(11)
+    X, y = _toy_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=False)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=num_epoch, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1),
+                              ("momentum", 0.9)),
+            initializer=mx.init.Xavier(), **kwargs)
+    return mod
+
+
+def _read(path):
+    with open(path) as f:
+        return schema.validate_lines(f)
+
+
+# ----------------------------------------------------- the JSONL run log
+def test_fit_runlog_is_schema_valid(tmp_path):
+    """THE acceptance scenario: a smoke fit with the run log armed
+    emits schema-valid JSONL whose step records include feed-wait,
+    collective bytes, and at least one compile event with a concrete
+    retrace cause."""
+    path = str(tmp_path / "run.jsonl")
+    telemetry.reset(path)
+    _fit(2, checkpoint=str(tmp_path / "ck"))
+    telemetry.close()
+
+    recs, problems = _read(path)
+    assert not problems, problems[:10]
+    by_type = {}
+    for r in recs:
+        by_type.setdefault(r["type"], []).append(r)
+    assert "run_start" in by_type and "run_end" in by_type
+
+    steps = by_type["step"]
+    assert len(steps) == 2 * 8  # 64 rows / batch 8, two epochs
+    # the device feed wraps fit's iterator by default: every step
+    # carries the wait/H2D deltas computed from stats() snapshots
+    assert all(s["feed_wait_ms"] is not None for s in steps)
+    assert sum(s["h2d_bytes"] for s in steps) > 0
+    # collective accounting from the compiled program's introspection
+    assert steps[-1]["collective_counts"] is not None
+    assert steps[-1]["collective_bytes"] == 0  # single-device fit
+    assert steps[-1]["sharding"] == "none"
+    # sampled sync: step 0 synced (default period 25) and carried the
+    # metric; unsampled steps stay async with loss null
+    assert steps[0]["synced"] is True
+    assert steps[0]["loss"] is not None
+    assert any(s["synced"] is False and s["loss"] is None
+               for s in steps)
+
+    compiles = by_type["compile"]
+    assert any(c["program"].startswith("executor:") for c in compiles)
+    assert all(set(c["causes"]) <= set(schema.COMPILE_CAUSES)
+               for c in compiles)
+    assert any("first_trace" in c["causes"] for c in compiles)
+    # program introspection rode along with the trace
+    assert any(r["memory"] or r["flops"] >= 0
+               for r in by_type["program_report"])
+    # the wired checkpoint writer reported its timed atomic write
+    assert by_type["checkpoint"][0]["duration_s"] > 0
+    assert by_type["checkpoint"][0]["bytes"] > 0
+    # fit session bracketed the run
+    events = {e["kind"] for e in by_type["event"]}
+    assert {"fit_start", "fit_end"} <= events
+
+
+def test_runlog_unset_is_noop():
+    """Acceptance: with MXNET_RUNLOG unset the hot path takes the
+    no-op fast exit — no RunLog, a falsy fit session, no device
+    syncs requested."""
+    assert telemetry.current() is None
+    session = telemetry.fit_session(batch_size=8)
+    assert not session
+    assert session.should_sync() is False
+    session.step_begin()
+    session.step_end(0, 0)   # no-op, no error
+    assert session.flight("x") is None
+    # the convenience wire points all no-op
+    telemetry.event("noop")
+    telemetry.count("steps")
+    telemetry.checkpoint_event("p", 1, 0.1, 10)
+    assert telemetry.flight_dump("x") is None
+    assert telemetry.current() is None
+
+
+def test_env_knobs_registered():
+    from mxnet_tpu.config import describe_env, get_env, list_env
+
+    table = describe_env()
+    for k in ("MXNET_RUNLOG", "MXNET_TELEMETRY_SAMPLE",
+              "MXNET_FLIGHTREC_DEPTH", "MXNET_METRICS_TEXTFILE"):
+        assert k in list_env() and k in table
+    assert get_env("MXNET_TELEMETRY_SAMPLE") >= 1
+
+
+# ------------------------------------------------------- retrace causes
+def _dense_step(**kw):
+    net = nn.Dense(8, in_units=6)
+    net.initialize()
+    loss_fn = gluon.loss.L2Loss()
+    return make_train_step(net, loss_fn, optimizer="sgd",
+                           learning_rate=0.1, donate=False, **kw)
+
+
+def test_train_step_retrace_causes_dtype_and_shape(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    telemetry.reset(path)
+    step_fn, params, opt = _dense_step()
+    key = jax.random.key(0)
+    x32 = jnp.ones((4, 6), "float32")
+    y32 = jnp.ones((4, 8), "float32")
+    step_fn(params, opt, x32, y32, key, 1.0)          # first trace
+    step_fn(params, opt, x32.astype("float16"), y32, key, 1.0)
+    step_fn(params, opt, jnp.ones((8, 6), "float16"),
+            jnp.ones((8, 8), "float32"), key, 1.0)
+    telemetry.close()
+
+    recs, problems = _read(path)
+    assert not problems, problems[:10]
+    causes = [c["causes"] for c in recs
+              if c["type"] == "compile" and c["program"] == "train_step"]
+    assert causes[0] == ["first_trace"]
+    assert causes[1] == ["dtype"]
+    assert causes[2] == ["shape"]
+
+
+def test_train_step_retrace_cause_autotune_winner(tmp_path, monkeypatch):
+    """Flip the cached autotune winner between two builds of the same
+    program: the second build's compile event must name
+    ``autotune_winner`` as the retrace cause."""
+    monkeypatch.setenv("MXNET_AUTOTUNE_CACHE_DIR", str(tmp_path))
+    autotune.cache_clear()
+    path = str(tmp_path / "run.jsonl")
+    telemetry.reset(path)
+    key = jax.random.key(0)
+    x = jnp.ones((4, 6), "float32")
+    y = jnp.ones((4, 8), "float32")
+
+    step_a, p_a, o_a = _dense_step()
+    step_a(p_a, o_a, x, y, key, 1.0)  # winners: {} (nothing cached)
+
+    # an autotune session elsewhere records a winner for exactly this
+    # signature; the NEXT program build picks it up at trace time
+    autotune.record("conv1x1_dot", x.shape, x.dtype, "dot")
+    step_b, p_b, o_b = _dense_step()
+    step_b(p_b, o_b, x, y, key, 1.0)
+    telemetry.close()
+    autotune.cache_clear()
+
+    recs, problems = _read(path)
+    assert not problems, problems[:10]
+    compiles = [c for c in recs if c["type"] == "compile"
+                and c["program"] == "train_step"]
+    assert compiles[0]["causes"] == ["first_trace"]
+    assert compiles[-1]["causes"] == ["autotune_winner"]
+    assert compiles[-1]["fingerprint"]["autotune"] == {
+        "conv1x1_dot": "dot"}
+
+
+def test_cachedop_retrace_causes(tmp_path):
+    """The gluon jit path is observed too: one compile record per new
+    CachedOp program, with the same cause derivation."""
+    path = str(tmp_path / "run.jsonl")
+    telemetry.reset(path)
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    net.hybridize()
+    net(mx.nd.zeros((2, 3)))
+    net(mx.nd.zeros((5, 3)))                   # shape retrace
+    net(mx.nd.zeros((5, 3), dtype="float16"))  # dtype retrace
+    telemetry.close()
+
+    recs, problems = _read(path)
+    assert not problems, problems[:10]
+    compiles = [c for c in recs if c["type"] == "compile"
+                and c["program"].startswith("cachedop:")]
+    assert [c["causes"] for c in compiles] == [
+        ["first_trace"], ["shape"], ["dtype"]]
+
+
+def test_autotune_event_recorded(tmp_path, monkeypatch):
+    """A tuning decision lands in the run log: which variant won and
+    whether the registry answered from cache."""
+    monkeypatch.setenv("MXNET_AUTOTUNE_CACHE_DIR", str(tmp_path))
+    autotune.cache_clear()
+    path = str(tmp_path / "run.jsonl")
+    telemetry.reset(path)
+    timings = iter([0.002, 0.001])
+    winner, info = autotune.tune(
+        "conv1x1_dot", (4, 6), "float32",
+        autotune.VARIANT_OPS["conv1x1_dot"],
+        lambda _v: next(timings))
+    assert winner == "dot" and not info["cached"]
+    # second consult answers from cache — and says so in the log
+    winner2, info2 = autotune.tune(
+        "conv1x1_dot", (4, 6), "float32",
+        autotune.VARIANT_OPS["conv1x1_dot"],
+        lambda _v: pytest.fail("cache hit must not re-measure"))
+    assert winner2 == "dot" and info2["cached"]
+    telemetry.close()
+    autotune.cache_clear()
+
+    recs, problems = _read(path)
+    assert not problems, problems[:10]
+    evs = [e for e in recs if e["type"] == "event"
+           and e["kind"] == "autotune"]
+    assert [(e["winner"], e["cached"]) for e in evs] == [
+        ("dot", False), ("dot", True)]
+
+
+# --------------------------------------------------- the flight recorder
+_SIGTERM_SCRIPT = """
+    import os, signal
+    os.environ["MXNET_RUNLOG"] = __RUNLOG_PATH__
+    os.environ["MXNET_FLIGHTREC_DEPTH"] = "5"
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+
+    def _mlp():
+        d = sym.Variable("data")
+        fc1 = sym.FullyConnected(d, num_hidden=16, name="fc1")
+        act = sym.Activation(fc1, act_type="relu", name="relu1")
+        fc2 = sym.FullyConnected(act, num_hidden=4, name="fc2")
+        return sym.SoftmaxOutput(fc2, sym.Variable("softmax_label"),
+                                 name="softmax")
+
+    rng = onp.random.RandomState(7)
+    X = rng.randn(64, 10).astype("float32")
+    y = (X @ rng.randn(10, 4)).argmax(axis=1).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=False)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+
+    def killer(param):
+        # simulated preemption: SIGTERM lands after epoch 1, batch 2
+        if param.epoch == 1 and param.nbatch == 2:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    mod.fit(it, num_epoch=3, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1),),
+            initializer=mx.init.Xavier(), batch_end_callback=killer)
+    print("COMPLETED")
+"""
+
+
+def _run_script(body, timeout=180):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    prelude = textwrap.dedent(f"""\
+        import sys
+        sys.path.insert(0, {_REPO!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        """)
+    return subprocess.run(
+        [sys.executable, "-c", prelude + textwrap.dedent(body)],
+        capture_output=True, text=True, timeout=timeout, env=env)
+
+
+def test_sigterm_fit_leaves_untorn_flight_dump(tmp_path):
+    """Acceptance: a SIGTERM-killed fit leaves an untorn flight
+    recorder dump with the last N step records."""
+    runlog = str(tmp_path / "run.jsonl")
+    r = _run_script(_SIGTERM_SCRIPT.replace("__RUNLOG_PATH__",
+                                            repr(runlog)))
+    assert r.returncode == -signal.SIGTERM, (r.returncode,
+                                             r.stderr[-2000:])
+    assert "COMPLETED" not in r.stdout  # drained, not completed
+
+    flight_path = telemetry.flight_path_for(runlog)
+    assert os.path.exists(flight_path)
+    # atomic: the dump parses whole and no torn temp files remain
+    with open(flight_path) as f:
+        flight = json.load(f)
+    assert not [n for n in os.listdir(tmp_path) if ".tmp" in n]
+
+    assert flight["reason"] == "preempt_drain"
+    assert flight["depth"] == 5
+    # 11 steps ran (8 of epoch 0 + 3 of epoch 1); the ring keeps the
+    # LAST five
+    assert len(flight["steps"]) == 5
+    assert [s["type"] for s in flight["steps"]] == ["step"] * 5
+    assert flight["steps"][-1]["epoch"] == 1
+    assert flight["steps"][-1]["batch"] == 2
+    assert flight["counters"]["steps"] == 11
+    assert flight["counters"]["preempt_signals"] >= 1
+    # config/env/compile fingerprints ride along for the post-mortem
+    assert "MXNET_FLIGHTREC_DEPTH" in flight["env"]
+    assert flight["programs"]  # the traced executor fingerprint
+    # the run log itself survived too, every complete line valid
+    recs, problems = _read(runlog)
+    assert not problems, problems[:10]
+    assert any(r["type"] == "event" and r["kind"] == "flight_dump"
+               for r in recs)
+
+
+def test_unhandled_exception_in_fit_dumps_flight(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    telemetry.reset(path)
+
+    def bomb(param):
+        if param.nbatch == 2:
+            raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        _fit(1, batch_end_callback=bomb)
+    telemetry.close()
+
+    with open(telemetry.flight_path_for(path)) as f:
+        flight = json.load(f)
+    assert flight["reason"] == "exception:RuntimeError"
+    assert flight["steps"]
+    recs, _ = _read(path)
+    ends = [r for r in recs if r["type"] == "event"
+            and r["kind"] == "fit_end"]
+    assert ends and ends[-1]["outcome"] == "error"
+
+
+def test_flight_depth_zero_disables_ring(tmp_path):
+    rl = telemetry.reset(None)  # stays None: env unset
+    assert rl is None
+    rl = telemetry.RunLog(str(tmp_path / "r.jsonl"), flight_depth=0)
+    rl.step(0, 0, 0.01, 8)
+    assert rl.flight_dump("x") is None
+    rl.close()
+    assert not os.path.exists(
+        telemetry.flight_path_for(str(tmp_path / "r.jsonl")))
+
+
+# ----------------------------------------------- metrics textfile export
+def test_metrics_textfile_atomic_export(tmp_path):
+    tf = str(tmp_path / "metrics.prom")
+    rl = telemetry.RunLog(str(tmp_path / "r.jsonl"), sample=1,
+                          textfile=tf)
+    rl.step(0, 0, 0.01, 8, loss=0.5, synced=True)
+    rl.step(0, 1, 0.01, 8, loss=0.4, synced=True)
+    rl.close()
+    with open(tf) as f:
+        text = f.read()
+    assert "# TYPE mxnet_tpu_steps counter" in text
+    assert "mxnet_tpu_steps 2" in text
+    assert "mxnet_tpu_loss 0.4" in text
+    assert not [n for n in os.listdir(tmp_path) if ".tmp" in n]
+
+
+# ------------------------------------------------- program introspection
+def test_describe_program(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    telemetry.reset(path)
+
+    @jax.jit
+    def f(a, b):
+        return a @ b
+
+    a = jnp.ones((8, 16), "float32")
+    rep = telemetry.describe_program(f, a, a.T, program="matmul")
+    telemetry.close()
+    assert rep["program"] == "matmul"
+    assert rep["flops"] > 0
+    assert rep["memory"].get("argument_bytes", 0) > 0
+    assert rep["collectives"] is not None
+    assert rep["collectives"]["counts"]["all-reduce"] == 0
+    recs, problems = _read(path)
+    assert not problems, problems[:10]
+    assert any(r["type"] == "program_report" and r["program"] == "matmul"
+               for r in recs)
+
+
+# --------------------------------------------------- satellites: monitor
+def test_monitor_install_accepts_module():
+    from mxnet_tpu.monitor import Monitor
+
+    X, y = _toy_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=False)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mon = Monitor(interval=1, pattern=".*")
+    mon.install(mod)       # unbound: defers to bind
+    mon.install(mod)       # idempotent
+    mod.bind(data_shapes=it.provide_data,
+             label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd")
+    batch = next(iter(it))
+    mon.tic()
+    mod.forward(batch, is_train=True)
+    stats = mon.toc()
+    assert stats, "monitor saw no executor outputs through the module"
+    assert any("softmax" in name for _, name, _ in
+               [(s[0], s[1], s[2]) for s in stats])
+
+    # legacy end-to-end path: fit(monitor=...) keeps working
+    mon2 = Monitor(interval=1)
+    _fit(1, monitor=mon2)
+    assert mon2.exes
+
+
+def test_monitor_install_rejects_garbage():
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.monitor import Monitor
+
+    with pytest.raises(MXNetError, match="Monitor.install"):
+        Monitor(interval=1).install(object())
+
+
+# ------------------------------------------------ satellites: speedometer
+def test_speedometer_uses_monotonic_clock(monkeypatch):
+    from mxnet_tpu import callback
+
+    sp = callback.Speedometer(batch_size=8, frequent=1)
+
+    class P:
+        epoch, nbatch, eval_metric = 0, 0, None
+
+    t0 = time.perf_counter()
+    sp(P())  # init tick
+    assert sp.init and abs(sp.tic - time.perf_counter()) < 5.0
+    # a wall-clock jump must not produce a negative/absurd rate: the
+    # monotonic tic is immune to time.time moving backwards
+    monkeypatch.setattr(time, "time", lambda: t0 - 3600.0)
+    P.nbatch = 1
+    speed = sp._speed()
+    assert speed >= 0
+
+
+def test_speedometer_reads_runlog_rate(tmp_path):
+    from mxnet_tpu import callback
+
+    rl = telemetry.reset(str(tmp_path / "r.jsonl"))
+    sp = callback.Speedometer(batch_size=8, frequent=1)
+    sp.init = True
+    sp.tic = time.perf_counter()  # interval opens, THEN steps land
+    for i in range(4):
+        rl.step(0, i, 0.01, 8)
+        time.sleep(0.002)
+    authoritative = rl.recent_throughput()
+    assert authoritative and authoritative > 0
+    # with telemetry live the Speedometer reports the RunLog's window
+    # rate, not its own wall-clock division
+    assert sp._speed() == pytest.approx(rl.recent_throughput(),
+                                        rel=0.5)
+    # ...but NOT when the window is stale for this interval (an eval
+    # loop records no steps): then it falls back to its own clock
+    # instead of quoting the old training rate
+    sp.tic = time.perf_counter()
+    time.sleep(0.002)
+    stale = sp._speed()
+    assert stale != pytest.approx(authoritative, rel=0.01)
+    telemetry.close()
+
+
+# ------------------------------------------------------- overhead smoke
+def test_overhead_at_default_sampling(tmp_path):
+    """Loose acceptance smoke: telemetry at the default sampling must
+    not visibly tax the step loop.  The <2% target is a number for the
+    bench smoke's convnet step (~ms); the same A/B here uses a step of
+    comparable cost and asserts with CI headroom (min-of-chunks, 35%
+    bound) so scheduler noise cannot flake the suite — while a genuine
+    regression of the contract (a blocking device sync or an
+    unbuffered write per step) roughly doubles the loop and still
+    fails loudly.  The per-step host cost itself is bounded by
+    test_step_hot_path_is_cheap below."""
+    net = nn.Dense(256, in_units=256)
+    net.initialize()
+    loss_fn = gluon.loss.L2Loss()
+    step_fn, params, opt = make_train_step(
+        net, loss_fn, optimizer="sgd", learning_rate=0.1, donate=False)
+    key = jax.random.key(0)
+    x = jnp.ones((128, 256), "float32")
+    y = jnp.ones((128, 256), "float32")
+    step_fn(params, opt, x, y, key, 1.0)  # compile outside both arms
+
+    def chunk(session):
+        # each chunk drains the async queue at its end: without the
+        # final block_until_ready the off arm would only time dispatch
+        # while the on arm's sampled float(loss) pays BOTH arms'
+        # queued compute — a 50x phantom "overhead"
+        t0 = time.perf_counter()
+        out = None
+        for i in range(40):
+            session.step_begin()
+            out = step_fn(params, opt, x, y, key, 1.0)
+            synced = session.should_sync()
+            session.step_end(0, i,
+                             loss=float(out[0]) if synced else None,
+                             synced=synced)
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    from mxnet_tpu.telemetry.session import FitSession
+
+    off = FitSession(None)
+    rl = telemetry.reset(str(tmp_path / "r.jsonl"))
+    on = FitSession(rl, batch_size=128)
+    chunk(off), chunk(on)  # warm both paths
+    # paired rounds + median ratio: host-contention phases on a noisy
+    # CI box hit both arms of a round alike and cancel in the ratio
+    ratios = []
+    for _ in range(5):
+        t_off = chunk(off)
+        ratios.append(chunk(on) / t_off)
+    telemetry.close()
+    ratios.sort()
+    overhead = ratios[len(ratios) // 2] - 1.0
+    assert overhead < 0.35, f"telemetry overhead {overhead:.1%}"
+
+
+def test_step_hot_path_is_cheap(tmp_path):
+    """The unsampled step record itself (dict build + pending append —
+    serialization and the flush syscall are deferred to the sampled
+    step) must stay in the tens-of-microseconds range on the host.
+    This is the direct bound on the contract the A/B smoke above can
+    only assert loosely through scheduler noise."""
+    rl = telemetry.reset(str(tmp_path / "r.jsonl"))
+    from mxnet_tpu.telemetry.session import FitSession
+
+    s = FitSession(rl, batch_size=32)
+    for i in range(100):  # warm
+        s.step_begin()
+        s.step_end(0, i, synced=False)
+    n = 2000
+    t0 = time.perf_counter()
+    for i in range(n):
+        s.step_begin()
+        s.step_end(0, i, synced=False)
+    per_step = (time.perf_counter() - t0) / n
+    telemetry.close()
+    assert per_step < 200e-6, f"per-step telemetry {per_step*1e6:.0f}us"
