@@ -60,10 +60,33 @@ the round its headline artifact):
   each program's HLO collective counts/bytes under ``"collectives"``
   in the JSON — the launch-count win is measurable without TPUs;
 * the ``telemetry`` phase arms a run log (telemetry.RunLog), reports
-  real steps + program introspection into it, then RE-READS its own
-  JSONL — schema verdict, record counts and the step's
+  real steps + program introspection into it, folds the profiler's op
+  events into the aggregate opstats table (count/avg/p99/bytes per
+  op), records numerics-monitor ``tensor_stats`` rows, then RE-READS
+  its own JSONL — schema verdict, record counts and the step's
   memory/flop/collective report land under ``"telemetry"`` in the
   JSON (the observability layer validating itself every bench run);
+
+HARNESS PROTOCOL (round 11 — stall-proofing; r05's stall sat inside an
+uninterruptible XLA call where none of the above could run):
+
+* a hang WATCHDOG thread (telemetry.Watchdog; ``--watchdog`` /
+  MXNET_WATCHDOG_SEC, bench defaults it ON) is armed BEFORE the first
+  device_put/trace and beaten by every heartbeat: when the heartbeat
+  goes quiet — even with the main thread blocked in C++ — it appends
+  all-thread faulthandler stack dumps to ``<partial>.stacks.txt``,
+  flushes the flight recorder with reason ``stall``, emits a
+  ``watchdog`` run-log record, and stamps the stall into the partial
+  JSON.  It observes; the external kill still executes;
+* the PARTIAL headline JSON (``--partial-json`` / BENCH_PARTIAL_JSON,
+  default ``BENCH_partial.json`` beside bench.py) is atomically
+  rewritten after EVERY phase with ``degraded: true`` + the completed
+  phases' results, and removed only after the final stdout emit — so
+  an external ``timeout -k`` (or ``kill -9``) can never again leave
+  zero artifact; the SIGTERM emitter prints it as the JSON line;
+* every ``Deadline``-triggered degradation also logs a ``deadline``
+  run-log event with the phase name and remaining budget, so the
+  reasons survive in the run log even when the final JSON does not.
 * ``--checkpoint PREFIX`` writes timed atomic checkpoints
   (resilience.checkpoint) after the measure and feed phases — write
   cost lands under ``"checkpoint": {"write_s": ...}`` in the JSON
@@ -82,6 +105,7 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 from functools import partial
 
@@ -90,17 +114,90 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 _T0 = time.monotonic()
 _EMITTED = False
 
+#: hang watchdog (telemetry.Watchdog), armed in main() before the
+#: first device_put/trace; every heartbeat beats it
+_WD = [None]
+
+#: partial headline JSON: atomically rewritten after every phase so an
+#: external kill — SIGKILL included — always leaves a phase-level
+#: artifact on disk.  "blob" holds the last main-thread serialization
+#: of the results dict: the watchdog thread stamps stalls onto that
+#: frozen snapshot, never onto the live (mutating) dict.
+_PARTIAL = {"path": None, "phases": [], "blob": None,
+            "lock": threading.Lock(), "extra": {}}
+
 
 def _heartbeat(phase, **info):
     extra = "".join(f" {k}={v}" for k, v in info.items())
     print(f"[bench] phase={phase} t=+{time.monotonic() - _T0:.1f}s"
           f"{extra}", file=sys.stderr, flush=True)
+    wd = _WD[0]
+    if wd is not None:
+        wd.beat(phase)
+
+
+def _write_partial(out, phase=None, extra=None):
+    """Atomically rewrite the partial headline JSON with everything
+    measured so far (``degraded: true`` + completed-phase list).
+
+    The main thread passes the live results dict (serialized HERE, on
+    the owning thread, into ``_PARTIAL["blob"]``); the watchdog thread
+    passes ``out=None`` and only merges its stall stamp onto that
+    frozen snapshot — it must never iterate the live dict the main
+    thread is mutating mid-phase."""
+    path = _PARTIAL["path"]
+    if not path:
+        return
+    with _PARTIAL["lock"]:
+        if phase and phase not in _PARTIAL["phases"]:
+            _PARTIAL["phases"].append(phase)
+        if extra:
+            _PARTIAL["extra"].update(extra)
+        if out is not None:
+            try:
+                _PARTIAL["blob"] = json.dumps(out)
+            except (TypeError, ValueError):
+                pass  # keep the previous good snapshot
+        payload = json.loads(_PARTIAL["blob"]) if _PARTIAL["blob"] \
+            else {}
+        payload.update(_PARTIAL["extra"])
+        payload["degraded"] = True
+        payload["partial"] = True
+        payload["phases_completed"] = list(_PARTIAL["phases"])
+        reason = payload.get("reason")
+        kill_note = ("partial artifact: the run was still in flight "
+                     "(or killed) before the final emit")
+        payload["reason"] = f"{reason}; {kill_note}" if reason \
+            else kill_note
+        tmp = f"{path}.tmp{os.getpid()}.{threading.get_ident()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def _clear_partial():
+    path = _PARTIAL["path"]
+    if path:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
 
 
 def _emit(payload):
     global _EMITTED
     print(json.dumps(payload), flush=True)
     _EMITTED = True
+    # the final JSON made it to stdout: the partial is now redundant
+    _clear_partial()
 
 
 class _Deadline:
@@ -114,6 +211,21 @@ class _Deadline:
 
     def exceeded(self, margin=0.0):
         return self.remaining() <= margin
+
+    def note(self, phase):
+        """A deadline check just triggered degradation: log a RunLog
+        ``deadline`` event with the phase and remaining budget — the
+        reasons list in the final JSON is exactly the artifact a hang
+        loses, the run log survives."""
+        if "mxnet_tpu" not in sys.modules:
+            return  # degrading before import: nothing to log into
+        try:
+            from mxnet_tpu import telemetry as _tm
+
+            _tm.event("deadline", phase=str(phase),
+                      remaining_s=round(self.remaining(), 3))
+        except Exception:
+            pass  # telemetry must never break the degrade path
 
 
 def _median(xs):
@@ -242,6 +354,7 @@ def _measure(step_fn, params, opt_state, x, y, key, batch, deadline,
         # no budget left for even the K2 compile: a single-K rate is a
         # biased estimate (constant overhead uncancelled) but beats
         # silence
+        deadline.note("measure:single-K")
         return {"ms_per_step": step_est * 1e3,
                 "throughput": batch / step_est,
                 "k1": k1, "k2": k1, "trials": 0, "degraded": True,
@@ -259,9 +372,11 @@ def _measure(step_fn, params, opt_state, x, y, key, batch, deadline,
         chosen = plans[-1]
         degraded = True
         reasons.append("deadline: fell back to smallest K plan")
+        deadline.note("measure:k-plan")
     elif chosen != plans[0]:
         degraded = True
         reasons.append(f"deadline: reduced K plan to {chosen}")
+        deadline.note("measure:k-plan")
     if chosen[0] != k1:
         run(chosen[0])  # warm the downgraded K1 program too
         t_k1 = run(chosen[0])
@@ -277,6 +392,7 @@ def _measure(step_fn, params, opt_state, x, y, key, batch, deadline,
             reasons.append(
                 f"deadline: stopped after {len(trials)}/{n_trials} "
                 "trials")
+            deadline.note("measure:trials")
             break
         t1, t2 = run(k1), run(k2)
         trials.append((t2 - t1) / (k2 - k1))
@@ -287,6 +403,7 @@ def _measure(step_fn, params, opt_state, x, y, key, batch, deadline,
         trials = [max(t_k2_warm - t_k1, 1e-9) / (k2 - k1)]
         degraded = True
         reasons.append("deadline: single warmup-slope estimate")
+        deadline.note("measure:warmup-slope")
     dt = _median(trials)
     return {"ms_per_step": dt * 1e3, "throughput": batch / dt,
             "k1": k1, "k2": k2, "trials": len(trials),
@@ -370,15 +487,23 @@ def _measure_telemetry(step_fn, params, opt_state, x, y, key, smoke,
                        deadline):
     """Telemetry phase: arm a run log, run REAL steps reporting into
     it (program introspection + per-step records on the default
-    sampling), then RE-READ the JSONL — the dogfood check: the bench
-    validates its own run log against the schema and folds the result
-    into the headline JSON.  Returns (report, params, opt_state) —
-    threaded because the step donates its inputs."""
+    sampling), fold the profiler's op events into the aggregate
+    opstats table, record numerics-monitor tensor_stats rows, then
+    RE-READ the JSONL — the dogfood check: the bench validates its own
+    run log against the schema and folds the result into the headline
+    JSON.  Returns (report, params, opt_state) — threaded because the
+    step donates its inputs."""
     import shutil
     import tempfile
 
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler as prof
     from mxnet_tpu import telemetry as tm
     from mxnet_tpu.config import get_env
+    from mxnet_tpu.telemetry import numerics as tm_num
+    from mxnet_tpu.telemetry import opstats as tm_ops
     from mxnet_tpu.telemetry import schema as tm_schema
 
     n = 4 if smoke else 8
@@ -387,6 +512,9 @@ def _measure_telemetry(step_fn, params, opt_state, x, y, key, smoke,
     path = os.path.join(tmpdir, "run.jsonl")
     rl = tm.reset(path)
     p, o = params, opt_state
+    opstats_report = None
+    numerics_report = None
+    started_prof = False
     try:
         try:
             # compile/memory introspection of the measured step
@@ -394,11 +522,23 @@ def _measure_telemetry(step_fn, params, opt_state, x, y, key, smoke,
             # already built)
             tm.describe_program(step_fn, p, o, x, y, key, 1.0,
                                 program="train_step")
+            # profiler collection window: step spans mirror onto the
+            # telemetry lane AND a few representative eager op
+            # dispatches land in the operator lane, so the aggregate
+            # opstats fold has both kinds of events to chew on.  An
+            # externally armed profiler is left alone — this phase
+            # only stops a collection it started itself.
+            if not prof.is_running():
+                prof.set_config(aggregate_stats=True,
+                                profile_imperative=True)
+                prof.set_state("run")
+                started_prof = True
             for i in range(n):
                 if deadline.exceeded(margin=0.0):
                     # the un-killable contract beats completeness:
                     # report however many steps landed before the
                     # budget ran out
+                    deadline.note("telemetry:steps")
                     break
                 t0 = time.perf_counter()
                 loss, p, o = step_fn(p, o, x, y, key, 1.0)
@@ -408,7 +548,45 @@ def _measure_telemetry(step_fn, params, opt_state, x, y, key, smoke,
                 lv = float(loss) if synced else None
                 rl.step(0, i, time.perf_counter() - t0, batch,
                         loss=lv, synced=synced)
+            if deadline.exceeded(margin=0.0):
+                # budget gone: no eager ops, no opstats fold, and
+                # above all no first-time jit of the numerics
+                # summarizer — every extra second here eats the
+                # external timeout's grace window, the exact rc=124
+                # window this phase exists to keep the bench out of
+                deadline.note("telemetry:reports")
+                opstats_report = "skipped (deadline)"
+                numerics_report = "skipped (deadline)"
+            else:
+                arr = mx.nd.array(onp.ones((64, 64), "float32"))
+                for _ in range(3):
+                    ((arr * 2.0) + 1.0).asnumpy()
+                if started_prof:
+                    prof.set_state("stop")
+                # the profiler.dumps() analog: per-op count/total/avg/
+                # min/max/p99/bytes, as a RunLog record + text table
+                rows = tm_ops.record(source="bench", top=32)
+                table = tm_ops.dumps(sort_by="total")
+                opstats_report = {
+                    "ops": len(rows),
+                    "table_lines": len(table.splitlines()),
+                    "has_p99": all("p99_us" in r
+                                   for r in rows.values()),
+                    "has_bytes": any(r.get("bytes")
+                                     for r in rows.values()),
+                }
+                # numerics monitor (Monitor 2.0) over the step's named
+                # parameter tensors: one sampled tensor_stats record —
+                # the in-graph gradient path is exercised by the unit
+                # suite; here the bench proves the record pipeline
+                named = dict(list(p.items())[:8])
+                vecs = tm_num.summarize_named(named)
+                nrows, bad = tm_num.emit(rl, 0, vecs, where="param")
+                numerics_report = {"tensors": len(nrows),
+                                   "nonfinite": bad}
         finally:
+            if started_prof and prof.is_running():
+                prof.set_state("stop")
             tm.close()  # next telemetry.current() re-resolves env
         with open(path) as f:
             recs, problems = tm_schema.validate_lines(f)
@@ -428,6 +606,8 @@ def _measure_telemetry(step_fn, params, opt_state, x, y, key, smoke,
             "program_report": {k: prog.get(k) for k in
                                ("memory", "flops", "collectives")}
             if prog else None,
+            "opstats": opstats_report,
+            "tensor_stats": numerics_report,
         }, p, o
     finally:
         # a phase failure lands in main()'s degraded handler — the
@@ -578,6 +758,7 @@ def _conv_ab(batch, smoke, deadline):
         if flag == "1" and deadline.exceeded():
             degraded = True
             reasons.append("deadline: conv A/B dot arm skipped")
+            deadline.note("conv_ab:dot-arm")
             break
         os.environ["MXNET_CONV_1X1_DOT"] = flag
         try:
@@ -624,6 +805,19 @@ def main(argv=None):
                     help="restore params/opt state from a checkpoint "
                          "prefix before measuring; the JSON records "
                          "resumed: true")
+    ap.add_argument("--watchdog", type=float, default=None,
+                    help="hang-watchdog quiet timeout in seconds "
+                         "(MXNET_WATCHDOG_SEC; bench defaults it ON: "
+                         "60 smoke / 300 full; 0 disables).  On a "
+                         "stall it dumps all-thread stacks and stamps "
+                         "the partial JSON — it never kills")
+    ap.add_argument("--partial-json", dest="partial_json", default=None,
+                    help="path of the partial headline JSON, "
+                         "atomically rewritten after every phase "
+                         "(BENCH_PARTIAL_JSON; default "
+                         "BENCH_partial.json beside bench.py; 'none' "
+                         "disables).  Removed after the final stdout "
+                         "emit")
     ap.add_argument("--collectives-probe", dest="collectives_probe",
                     type=int, default=None, help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
@@ -653,13 +847,25 @@ def main(argv=None):
     }
     reasons = []
 
-    def bail(reason):
+    # partial headline JSON: armed BEFORE any phase so even an import
+    # hang + SIGKILL leaves an artifact saying how far the run got
+    partial = args.partial_json or os.environ.get("BENCH_PARTIAL_JSON")
+    if partial is None:
+        partial = os.path.join(os.path.dirname(os.path.abspath(
+            __file__)), "BENCH_partial.json")
+    if str(partial).lower() in ("none", "off", ""):
+        partial = None
+    _PARTIAL["path"] = partial
+    _write_partial(out, "start")
+
+    def bail(reason, phase="bail"):
+        deadline.note(phase)
         out["degraded"] = True
         out["reason"] = reason
         _emit(out)
 
     if deadline.exceeded():
-        return bail("deadline exceeded before import")
+        return bail("deadline exceeded before import", "pre-import")
 
     _heartbeat("import")
     if args.smoke:
@@ -675,18 +881,48 @@ def main(argv=None):
 
     import jax
 
+    # hang watchdog: armed BEFORE the first device_put/trace — the
+    # r05 stall predated phase 1's measurement loop entirely, sitting
+    # in device/platform init where no cooperative check runs.  On a
+    # stall it stamps the partial JSON (from its own thread) so even
+    # a SIGKILL'd run says WHERE it wedged.
+    wd_timeout = args.watchdog
+    if wd_timeout is None:
+        env_wd = os.environ.get("MXNET_WATCHDOG_SEC")
+        wd_timeout = float(env_wd) if env_wd else \
+            (60.0 if args.smoke else 300.0)
+    if wd_timeout > 0:
+        from mxnet_tpu.telemetry.watchdog import Watchdog
+
+        stack_path = (f"{partial}.stacks.txt" if partial else None)
+
+        def _on_stall(phase, quiet_s, stacks):
+            # out=None: stamp onto the last frozen snapshot — this
+            # runs on the watchdog thread while main mutates `out`
+            _write_partial(None, extra={
+                "stalled": {"phase": phase,
+                            "quiet_s": round(quiet_s, 1),
+                            "stacks": stacks}})
+
+        _WD[0] = Watchdog(timeout=wd_timeout, stack_path=stack_path,
+                          on_stall=_on_stall).arm("import")
+        out["watchdog_sec"] = wd_timeout
+
     if args.smoke:
         jax.config.update("jax_platforms", "cpu")
     cache_dir = setup_compilation_cache()
     out["compilation_cache"] = cache_dir
     if deadline.exceeded():
-        return bail("deadline exceeded during import")
+        return bail("deadline exceeded during import", "import")
+    _write_partial(out, "import")
 
     _heartbeat("device_init")
     devs = jax.devices()
     _heartbeat("device_init", platform=devs[0].platform, n=len(devs))
     if deadline.exceeded():
-        return bail("deadline exceeded during device init")
+        return bail("deadline exceeded during device init",
+                    "device_init")
+    _write_partial(out, "device_init")
 
     _heartbeat("build")
     t_build0 = time.monotonic()
@@ -705,7 +941,8 @@ def main(argv=None):
     out["autotune"] = _at.last_report() if do_tune else {
         "skipped": "disabled" if args.no_autotune else "deadline"}
     if deadline.exceeded():
-        return bail("deadline exceeded during model build")
+        return bail("deadline exceeded during model build", "build")
+    _write_partial(out, "build")
 
     out["resumed"] = False
     if args.resume_from:
@@ -726,7 +963,8 @@ def main(argv=None):
     step_bytes = float(ca.get("bytes accessed", 0.0))
     _heartbeat("compile", gflops=round(step_flops / 1e9, 1))
     if deadline.exceeded():
-        return bail("deadline exceeded during compile")
+        return bail("deadline exceeded during compile", "compile")
+    _write_partial(out, "compile")
 
     plans = [(1, 3, 2), (1, 2, 1)] if args.smoke else \
         [(3, 33, 3), (2, 13, 2), (1, 4, 1)]
@@ -764,6 +1002,7 @@ def main(argv=None):
     elif deadline.exceeded(margin=60.0):
         out["degraded"] = True
         reasons.append("deadline: skipped matmul-peak probe")
+        deadline.note("peak")
     else:
         _heartbeat("peak")
         peak = _matmul_peak_tflops()
@@ -786,6 +1025,14 @@ def main(argv=None):
                        "donated params/opt_state, persistent "
                        "compilation cache",
     })
+    # the headline number is now measured: the partial artifact carries
+    # it from here on, whatever kills the remaining phases
+    _write_partial(out, "measure")
+    from mxnet_tpu.resilience import faultsim as _fs
+
+    _fs.inject("bench.stall")  # test harness stall point (delay spec
+    #                            wedges here with NO heartbeats, so the
+    #                            watchdog path is provable end-to-end)
 
     # per-phase feed/compute overlap (async device feed vs blocking
     # per-step H2D) — the DeviceFeedIter A/B runs REAL steps
@@ -793,6 +1040,7 @@ def main(argv=None):
         out["device_feed"] = "skipped (deadline)"
         out["degraded"] = True
         reasons.append("deadline: skipped device-feed phase")
+        deadline.note("feed")
     else:
         _heartbeat("feed")
         try:
@@ -804,6 +1052,7 @@ def main(argv=None):
             out["device_feed"] = {"error": repr(exc)}
             out["degraded"] = True
             reasons.append(f"device-feed phase failed: {exc!r}")
+    _write_partial(out, "feed")
 
     if ckpt_prefix:
         _heartbeat("checkpoint", after="feed")
@@ -837,6 +1086,7 @@ def main(argv=None):
         out["collectives"] = "skipped (deadline)"
         out["degraded"] = True
         reasons.append("deadline: skipped collectives phase")
+        deadline.note("collectives")
     else:
         _heartbeat("collectives")
         try:
@@ -845,6 +1095,7 @@ def main(argv=None):
             out["collectives"] = {"error": repr(exc)}
             out["degraded"] = True
             reasons.append(f"collectives phase failed: {exc!r}")
+    _write_partial(out, "collectives")
 
     # run-telemetry dogfood (round 10): the bench arms a run log,
     # reports its own steps into it, re-reads the JSONL and folds the
@@ -853,6 +1104,7 @@ def main(argv=None):
         out["telemetry"] = "skipped (deadline)"
         out["degraded"] = True
         reasons.append("deadline: skipped telemetry phase")
+        deadline.note("telemetry")
     else:
         _heartbeat("telemetry")
         try:
@@ -864,6 +1116,7 @@ def main(argv=None):
             out["telemetry"] = {"error": repr(exc)}
             out["degraded"] = True
             reasons.append(f"telemetry phase failed: {exc!r}")
+    _write_partial(out, "telemetry")
 
     if args.conv_ab or args.smoke:
         # the A/B costs roughly two more build+compile+measure passes
@@ -875,6 +1128,7 @@ def main(argv=None):
             out["conv_1x1_ab"] = "skipped (deadline)"
             out["degraded"] = True
             reasons.append("deadline: skipped conv 1x1 A/B")
+            deadline.note("conv_ab")
         else:
             _heartbeat("conv_ab")
             ab, ab_deg, ab_reasons = _conv_ab(batch, args.smoke,
@@ -883,9 +1137,13 @@ def main(argv=None):
             if ab_deg:
                 out["degraded"] = True
                 reasons.extend(ab_reasons)
+        _write_partial(out, "conv_ab")
 
     if reasons:
         out["reason"] = "; ".join(reasons)
+    if _WD[0] is not None:
+        out["watchdog_stalls"] = _WD[0].stalls
+        _WD[0].close()
     _heartbeat("done", img_s=out["value"])
     _emit(out)
 
@@ -895,14 +1153,30 @@ def _install_sigterm_emitter():
     degraded JSON line on the way down instead of dying silent.  (Only
     fires when the interpreter regains control, so a SIGTERM landing
     inside a native XLA compile still depends on the -k grace period —
-    the deadline margins above exist to keep us out of that window.)"""
+    the deadline margins above exist to keep us out of that window;
+    the partial JSON on disk survives even the SIGKILL case.)"""
     import signal
 
     def _on_term(signum, frame):
         if not _EMITTED:
-            _emit({"metric": "resnet50_train_throughput", "value": None,
-                   "unit": "img/s/chip", "degraded": True,
-                   "reason": "terminated externally (SIGTERM)"})
+            payload = {"metric": "resnet50_train_throughput",
+                       "value": None, "unit": "img/s/chip",
+                       "degraded": True,
+                       "reason": "terminated externally (SIGTERM)"}
+            # everything the completed phases measured rides along:
+            # the partial artifact IS the headline now
+            try:
+                path = _PARTIAL["path"]
+                if path and os.path.exists(path):
+                    with open(path) as f:
+                        partial = json.load(f)
+                    partial["reason"] = (
+                        "terminated externally (SIGTERM); "
+                        + str(partial.get("reason", "")))
+                    payload = partial
+            except Exception:
+                pass
+            _emit(payload)
         sys.exit(124)
 
     try:

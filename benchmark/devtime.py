@@ -91,14 +91,18 @@ def chain_overhead():
 
 def device_chain_time(fn, args, k_small=2, trials=3, target_spread=0.8,
                       max_seconds=20.0, max_runs=2_000_000,
-                      subtract_overhead=False):
+                      subtract_overhead=False, return_samples=False):
     """Median marginal seconds per call of ``fn(*args)`` on device.
 
     fn must be jax-traceable with fixed shapes.  Returns (dt_seconds,
-    runs_used).  The K spread is sized adaptively so the marginal time
-    (runs x dt) is ~``target_spread`` seconds — the tunnel's dispatch+
-    readback constant jitters by tens of ms, so the spread must dwarf
-    it — clamped so one timing stays under ``max_seconds``.
+    runs_used) — or (dt_seconds, runs_used, samples) with
+    ``return_samples=True``, where ``samples`` is the per-trial
+    marginal-seconds list (ascending) so callers can report
+    tail-latency percentiles, not just the median.  The K spread is
+    sized adaptively so the marginal time (runs x dt) is
+    ~``target_spread`` seconds — the tunnel's dispatch+readback
+    constant jitters by tens of ms, so the spread must dwarf it —
+    clamped so one timing stays under ``max_seconds``.
     """
     import jax
     import jax.numpy as jnp
@@ -164,5 +168,9 @@ def device_chain_time(fn, args, k_small=2, trials=3, target_spread=0.8,
     ts.sort()
     dt = ts[len(ts) // 2]
     if subtract_overhead:
-        dt = max(dt - chain_overhead(), 0.0)
+        oh = chain_overhead()
+        dt = max(dt - oh, 0.0)
+        ts = [max(t - oh, 0.0) for t in ts]
+    if return_samples:
+        return dt, runs, ts
     return dt, runs

@@ -332,10 +332,11 @@ def bench_op(opname, inputs, params, ctx, runs):
         def fn(*args):
             return op.fn(*args, **kwargs)
 
-    dt, _ = device_chain_time(fn, vals, target_spread=0.4,
-                              trials=max(3, min(runs // 8, 5)),
-                              subtract_overhead=True)
-    return dt
+    dt, _, samples = device_chain_time(
+        fn, vals, target_spread=0.4,
+        trials=max(3, min(runs // 8, 5)),
+        subtract_overhead=True, return_samples=True)
+    return dt, samples
 
 
 # ops whose signatures genuinely need bespoke shapes/params beyond the
@@ -583,7 +584,8 @@ def main():
                 skipped.append(name)
                 continue
         try:
-            dt = bench_op(name, spec[0], spec[1], ctx, args.runs)
+            dt, samples = bench_op(name, spec[0], spec[1], ctx,
+                                   args.runs)
         except Exception as e:
             # a curated or explicitly requested op failing must be
             # visible; only blind auto-probe misses go to the skip list
@@ -593,7 +595,21 @@ def main():
             if not args.ops:
                 skipped.append(name)
             continue
-        row = {"op": name, "avg_time_ms": round(dt * 1e3, 4),
+        # avg is now a TRUE mean over the per-trial marginal times
+        # (it used to alias device_chain_time's median, which made the
+        # p50 column a duplicate); p50/p99 are nearest-rank (the
+        # shared telemetry.opstats convention), so tools/benchdiff.py
+        # trends tail latency alongside the mean
+        from mxnet_tpu.telemetry.opstats import percentile
+
+        samples = sorted(samples) or [dt]
+        mean = sum(samples) / len(samples)
+        p50 = percentile(samples, 0.50)
+        p99 = percentile(samples, 0.99)
+        row = {"op": name, "avg_time_ms": round(mean * 1e3, 4),
+               "p50_time_ms": round(p50 * 1e3, 4),
+               "p99_time_ms": round(p99 * 1e3, 4),
+               "trials": len(samples),
                "method": "device-chain"}
         if name in prev:
             row["prev_ms"] = prev[name]

@@ -79,6 +79,37 @@ telemetry_smoke() {
     JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py -q
 }
 
+benchdiff_smoke() {
+    # round-over-round trend gate, two halves:
+    # 1) tools/benchdiff.py must parse EVERY committed BENCH_r*/
+    #    OPPERF_* artifact without crashing (r05's rc=124/parsed:null
+    #    included — flagged as a REGRESSION with reason "missing
+    #    metric") — unpinned, so new rounds stay covered;
+    # 2) the --fail-on-regression exit contract is asserted on the
+    #    r01–r05 window PINNED by glob, so a good future r06 making
+    #    the latest round green cannot flip this gate red.
+    python tools/benchdiff.py > /tmp/benchdiff_smoke.txt
+    cat /tmp/benchdiff_smoke.txt
+    grep -Eq "r05 .*regression: missing metric" /tmp/benchdiff_smoke.txt
+    if python tools/benchdiff.py --bench 'BENCH_r0[1-5].json' \
+            --opperf 'OPPERF_r0[1-5].jsonl' --fail-on-regression \
+            > /dev/null 2>&1; then
+        echo "benchdiff_smoke: expected nonzero exit on the r05 gap"
+        return 1
+    fi
+}
+
+watchdog_smoke() {
+    # stall-proofing gate on CPU in seconds: the hang watchdog must
+    # dump stacks for a wedged phase, the partial headline JSON must
+    # survive a SIGKILL with every completed phase, and the unarmed
+    # paths must stay no-ops.  Also collected by tier-1
+    # (tests/test_watchdog.py, tests/test_numerics.py), so a
+    # regression turns the unit suite red between CI runs.
+    JAX_PLATFORMS=cpu python -m pytest tests/test_watchdog.py \
+        tests/test_numerics.py -q
+}
+
 collectives_budget() {
     # sharded-server launch-count gate: the dp(16) dryrun runs the
     # flat-bucketed exchange (optimizer_sharding="ps") and ASSERTS its

@@ -202,6 +202,29 @@ register_env("MXNET_FLIGHTREC_DEPTH", 64, int,
              "atomically on SIGTERM drain, NaN-abort, fault-injection "
              "crash or an unhandled exception inside Module.fit.  "
              "0 disables the recorder (run log still written).")
+register_env("MXNET_WATCHDOG_SEC", 0.0, float,
+             "Hang watchdog (telemetry.Watchdog): >0 arms a background "
+             "thread per bench phase / per Module.fit that, when the "
+             "heartbeat goes quiet for this many seconds — even with "
+             "the main thread blocked inside an uninterruptible XLA "
+             "call — appends an all-thread faulthandler stack dump, "
+             "flushes the crash flight recorder with reason 'stall', "
+             "and emits a 'watchdog' run-log record.  It observes, it "
+             "never kills.  0 (default) = no thread, zero hot-path "
+             "cost.")
+register_env("MXNET_NUMERICS", False, bool,
+             "In-graph numerics monitor (telemetry.numerics, Monitor "
+             "2.0): compile per-gradient summary reductions "
+             "(l2/min/max/NaN/Inf counts/zero fraction) into the "
+             "train step and record sampled 'tensor_stats' run-log "
+             "records — so a NaN step is EXPLAINED (which tensor, "
+             "which step) before the bad-step guard aborts.  Off by "
+             "default: the traced program is bit-identical to a build "
+             "without the monitor.")
+register_env("MXNET_NUMERICS_SAMPLE", 0, int,
+             "Steps between numerics-monitor tensor_stats emissions "
+             "(each costs one device readback of the summary "
+             "vectors).  0 = follow MXNET_TELEMETRY_SAMPLE.")
 register_env("MXNET_METRICS_TEXTFILE", "", str,
              "Prometheus-textfile export path (node_exporter textfile "
              "collector convention): telemetry counters + last "

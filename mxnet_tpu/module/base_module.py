@@ -297,6 +297,29 @@ class BaseModule:
                       aux_params=aux_p, optimizer_states=states,
                       batch_cursor=batch_cursor, epoch=epoch)
 
+    def _emit_tensor_stats(self, step, epoch, bad_step):
+        """Numerics-monitor emission for the eager executor path: one
+        jitted summary pass over the named gradient buffers, recorded
+        as a ``tensor_stats`` run-log record.  Only ever called on
+        sampled or bad steps; never lets a telemetry failure kill
+        training."""
+        from .. import telemetry as _tm
+        from ..telemetry import numerics as _nm
+
+        rl = _tm.current()
+        grads_of = getattr(self, "_named_grads", None)
+        if rl is None or grads_of is None:
+            return
+        try:
+            grads = grads_of()
+            if not grads:
+                return
+            vecs = _nm.summarize_named(grads)
+            _nm.emit(rl, step, vecs, where="grad", epoch=epoch)
+        except Exception:
+            self.logger.debug("numerics monitor emission failed",
+                              exc_info=True)
+
     def _outputs_finite(self):
         """NaN/Inf probe over the step's outputs (forces a device
         sync — only ever called with the bad-step guard armed)."""
@@ -323,14 +346,27 @@ class BaseModule:
                     resume_cursor=0, session=None):
         from ..config import get_env
         from ..resilience import faultsim
+        from ..telemetry import numerics as _nm
 
-        if session is None:  # direct callers (tests) get the shell
+        if session is None:  # direct callers (tests) get the shell —
+            # runlog-less AND watchdog-less: fit() owns the armed
+            # session and finish()es it; nothing on this path would
+            # ever close an auto-armed watchdog thread, so it must
+            # not exist (a leaked one fires bogus stall dumps after
+            # the short fit returns)
             from ..telemetry.session import FitSession
 
-            session = FitSession(None)
+            session = FitSession(None, watchdog=False)
 
         bad_limit = int(get_env("MXNET_BAD_STEP_LIMIT"))
         bad_run = 0
+        # numerics monitor (MXNET_NUMERICS), eager executor flavour:
+        # the gradients are host-visible arrays here, so the jitted
+        # summaries run ONLY on sampled steps and on every bad step —
+        # off-sample the monitor costs nothing at all
+        numerics_on = _nm.armed()
+        nm_period = _nm.sample_period() if numerics_on else 0
+        nm_step = 0
         checkpoint_period = int(max(1, checkpoint_period))
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
@@ -369,6 +405,10 @@ class BaseModule:
                 if bad_limit > 0:
                     bad_step = (faultsim.inject("step.loss_nan")
                                 == "nan") or not self._step_finite()
+                if numerics_on and (bad_step
+                                    or nm_step % nm_period == 0):
+                    self._emit_tensor_stats(nm_step, epoch, bad_step)
+                nm_step += 1
                 if bad_step:
                     # skip-and-count, like dynamic loss scaling: the
                     # update is withheld so one NaN batch cannot poison

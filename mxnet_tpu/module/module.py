@@ -431,6 +431,14 @@ class Module(BaseModule):
                 return False
         return True
 
+    def _named_grads(self):
+        """The live gradient buffers by parameter name — the numerics
+        monitor's (MXNET_NUMERICS) eager observation point: fit
+        summarises these on sampled and bad steps so a NaN step names
+        the tensor that went non-finite."""
+        return {n: self._exec.grad_dict[n]
+                for n in self._update_param_names()}
+
     # optimizer-state hooks for fit's checkpoint/resume plumbing
     def _get_optimizer_states(self):
         if self._updater is None:

@@ -43,6 +43,19 @@ class Monitor:
                 return onp.abs(arr).mean()
 
             stat_func = asum_stat
+        elif stat_func == "numerics":
+            # Monitor 2.0 bridge: the telemetry.numerics summary
+            # (l2/min/max/nan/inf/zero_frac) through the classic
+            # tic/toc protocol — the same six numbers the in-graph
+            # monitor records as tensor_stats
+            from .telemetry import numerics as _nm
+
+            def numerics_stat(x):
+                row = _nm.stats_row(_nm.summary(
+                    onp.asarray(getattr(x, "_data", x))))
+                return [f"{k}={row[k]:.6g}" for k in _nm.STAT_FIELDS]
+
+            stat_func = numerics_stat
         self.stat_func = stat_func
         self.interval = int(interval)
         self.activated = False

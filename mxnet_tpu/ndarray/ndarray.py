@@ -443,7 +443,9 @@ def invoke(op, inputs, out=None, **params):
     scope = _profiler.op_scope(opdef.name)
     if scope is not None:
         with scope:
-            return _invoke_impl(opdef, inputs, out, params)
+            result = _invoke_impl(opdef, inputs, out, params)
+            scope.set_result(result)  # bytes column for opstats
+            return result
     return _invoke_impl(opdef, inputs, out, params)
 
 

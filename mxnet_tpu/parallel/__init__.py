@@ -379,6 +379,33 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
     if nan_guard:
         opt_state["_bad_steps"] = jnp.zeros((), jnp.int32)
 
+    # ---- in-graph numerics monitor (telemetry.numerics, Monitor 2.0):
+    # per-gradient summary reductions compile INTO the step and ride in
+    # the returned state under the reserved _numerics key — zero host
+    # callbacks, zero sync; the telemetry wrapper below reads them back
+    # only on sampled steps.  Unarmed = the traced program is
+    # bit-identical to a build without the monitor.
+    from ..telemetry import numerics as _nm
+
+    numerics_on = _nm.armed()
+    if numerics_on and ps_mode:
+        import warnings
+
+        warnings.warn(
+            "MXNET_NUMERICS under optimizer_sharding='ps' is not "
+            "supported yet (gradients live as scattered bucket "
+            "shards, not named tensors) — monitor disabled for this "
+            "step", stacklevel=2)
+        numerics_on = False
+    if numerics_on:
+        opt_state["_numerics"] = _nm.summary_template(
+            dict.fromkeys([*names, "__loss"]))
+
+    def _nm_pack(grads, loss):
+        stats = _nm.summarize_tree(grads)
+        stats["__loss"] = _nm.summary(loss)
+        return stats
+
     def _scale_bookkeeping(finite, scale, good):
         """Dynamic-loss-scale update shared by the replicated and
         sharded arms — ONE copy, because the two must stay
@@ -434,6 +461,8 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
             }
             new_s["_loss_scale"] = _scale_bookkeeping(finite, scale,
                                                       good)
+            if numerics_on:
+                new_s["_numerics"] = _nm_pack(grads, sloss / scale)
             # unscale with the scale the loss was COMPUTED with, not the
             # adjusted one, or the reported loss jumps 2x on every
             # scale-change step
@@ -471,8 +500,14 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
             }
             new_s["_bad_steps"] = jnp.where(
                 finite, jnp.int32(0), opt_state_["_bad_steps"] + 1)
+            if numerics_on:
+                # stats of the step AS IT HAPPENED, guard or no guard:
+                # the bad step's NaN counts are the explanation
+                new_s["_numerics"] = _nm_pack(grads, loss)
             return loss, new_p, new_s
         new_p, new_s = _apply_updates(params_, opt_state_, grads, t, key)
+        if numerics_on:
+            new_s["_numerics"] = _nm_pack(grads, loss)
         return loss, new_p, new_s
 
     # ---- sharded-server step (optimizer_sharding="ps") ---------------
@@ -689,6 +724,8 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
     _tm_sharding = "ps" if ps_mode else "none"
     _tm_seen = set()
     _tm_last = [None]
+    _nm_period = _nm.sample_period() if numerics_on else 0
+    _nm_step = [0]
 
     def step_fn(p, o, x, y, key, t, _inner=_jitted_step):
         rl = _tm.current()
@@ -719,7 +756,24 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
                     pass  # telemetry must never kill the step
                 _tm_seen.add(sig)
                 _tm_last[0] = sig
-        return _inner(p, o, x, y, key, t)
+        result = _inner(p, o, x, y, key, t)
+        if numerics_on and rl is not None:
+            # sampled readback of the in-graph summaries: the ONLY
+            # steps that pay a device sync for the monitor.  Inside an
+            # outer trace (bench's chained fori_loop) the values are
+            # tracers — nothing to read, skip.
+            try:
+                loss_v, _, new_s = result
+                vecs = new_s.get("_numerics")
+                if vecs is not None and not isinstance(
+                        loss_v, jax.core.Tracer):
+                    i = _nm_step[0]
+                    _nm_step[0] = i + 1
+                    if i % _nm_period == 0:
+                        _nm.emit(rl, i, vecs, where="grad")
+            except Exception:
+                pass  # the monitor must never kill the step
+        return result
 
     from ..resilience import faultsim
 

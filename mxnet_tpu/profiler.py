@@ -38,7 +38,7 @@ __all__ = [
     "set_config", "profiler_set_config", "set_state", "profiler_set_state",
     "dump", "dump_profile", "dumps", "pause", "resume", "op_scope",
     "now_us", "run_generation", "record_span", "record_counter",
-    "record_instant", "record_meta",
+    "record_instant", "record_meta", "events_snapshot",
     "Domain", "Task", "Frame", "Event", "Counter", "Marker",
 ]
 
@@ -197,6 +197,17 @@ def record_op(name, dur_us, cat="operator", args=None):
             ent[3] = max(ent[3], dur_us)
 
 
+def events_snapshot():
+    """A copy of the buffered Chrome-trace events collected so far.
+
+    The public hook the aggregate-opstats layer
+    (:mod:`mxnet_tpu.telemetry.opstats`) folds per-op tables from:
+    unlike :func:`dump`, it neither drains the buffer nor stops
+    collection, so a mid-run aggregate costs one list copy."""
+    with _lock:
+        return list(_events)
+
+
 def record_span(name, cat, start_us, dur_us, args=None, tid=None):
     """Public lane hook: one complete 'X' span on the trace clock
     (``now_us``).  Used by telemetry.RunLog to put step/feed-wait/
@@ -236,17 +247,32 @@ def op_scope(name):
 class _OpScope:
     """Context manager used by the nd dispatcher to time op dispatch."""
 
-    __slots__ = ("name", "_start")
+    __slots__ = ("name", "_start", "_bytes")
 
     def __init__(self, name):
         self.name = name
+        self._bytes = None
+
+    def set_result(self, out):
+        """Attach the output size so the aggregate opstats table can
+        report bytes per op; only ever paid while profiling is on."""
+        total = 0
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        for o in outs:
+            data = getattr(o, "_data", o)
+            n = getattr(data, "nbytes", None)
+            if n is not None:
+                total += int(n)
+        self._bytes = total or None
 
     def __enter__(self):
         self._start = _now_us()
         return self
 
     def __exit__(self, *exc):
-        record_op(self.name, _now_us() - self._start)
+        args = {"bytes": self._bytes} if self._bytes is not None \
+            else None
+        record_op(self.name, _now_us() - self._start, args=args)
         return False
 
 

@@ -16,6 +16,9 @@ Points wired in-tree:
 ``ps.pull``     _ps.py client, inside every pull/spull attempt
 ``ckpt.write``  resilience/checkpoint.py, MID-payload in atomic_write
 ``step.loss_nan``  make_train_step host wrapper + Module.fit step guard
+``bench.stall``  bench.py after the measure phase (a ``delay`` here
+                 wedges the harness with NO heartbeats — the watchdog
+                 stall-path test point)
 ==============  =======================================================
 
 Spec grammar (env ``MXNET_FAULT_SPEC`` or ``faultsim.reset(spec)``)::

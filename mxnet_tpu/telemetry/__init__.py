@@ -17,7 +17,7 @@ Env knobs (registered in :mod:`mxnet_tpu.config`): ``MXNET_RUNLOG``,
 ``MXNET_TELEMETRY_SAMPLE``, ``MXNET_FLIGHTREC_DEPTH``,
 ``MXNET_METRICS_TEXTFILE``.
 """
-from . import schema  # noqa: F401
+from . import numerics, opstats, schema  # noqa: F401
 from .runlog import (  # noqa: F401
     RunLog,
     checkpoint_event,
@@ -34,10 +34,12 @@ from .runlog import (  # noqa: F401
     reset,
 )
 from .session import FitSession, fit_session  # noqa: F401
+from .watchdog import Watchdog, stack_path_for  # noqa: F401
 
 __all__ = [
     "RunLog", "current", "reset", "close", "compile_event",
     "compile_fingerprint", "event", "count", "checkpoint_event",
     "program_report", "flight_dump", "flight_path_for",
     "describe_program", "FitSession", "fit_session", "schema",
+    "Watchdog", "stack_path_for", "numerics", "opstats",
 ]

@@ -1,0 +1,137 @@
+"""In-graph numerics monitor — Monitor 2.0 (``MXNET_NUMERICS``).
+
+The reference Monitor installs a per-op output callback; inside one
+fused XLA program those outputs don't exist, so PR 3's NaN guard could
+only say *that* a step went non-finite, never *which tensor made it
+so*.  This module closes that gap with summary reductions that are
+traceable — they compile INTO the step — and cheap enough to run every
+step:
+
+``summary(x)`` -> a ``(6,)`` float32 vector
+    ``[l2_norm, min, max, nan_count, inf_count, zero_fraction]``
+    (l2 over the finite elements so one Inf doesn't erase the norm;
+    min/max are raw, so a poisoned tensor shows its NaN/Inf there).
+
+Wire points:
+
+* ``make_train_step`` (armed at BUILD time by ``MXNET_NUMERICS``):
+  per-gradient summaries plus the loss ride in the returned optimizer
+  state under the reserved ``_numerics`` key — no signature change, no
+  host callback, no sync.  The telemetry wrapper reads them back ONLY
+  on sampled steps (``MXNET_NUMERICS_SAMPLE``, 0 = follow
+  ``MXNET_TELEMETRY_SAMPLE``) and emits ``tensor_stats`` records, so a
+  NaN step is *explained* (which tensor, which step) in the run log
+  before the guard kills the run.
+* ``Module.fit`` (eager executor path): gradients are host-visible
+  arrays, so the jitted ``summarize_named`` runs only on sampled steps
+  and on every bad step — the diagnosis costs nothing off-sample.
+* ``Monitor(stat_func="numerics")`` reports the same six numbers
+  through the classic tic/toc protocol.
+
+Unarmed contract: ``MXNET_NUMERICS`` unset means the traced program is
+bit-identical to a build without this module (no extra outputs, no
+reserved state entry) and the per-step host cost is one captured
+boolean check.
+"""
+from __future__ import annotations
+
+__all__ = ["STAT_FIELDS", "armed", "sample_period", "summary",
+           "summarize_tree", "summary_template", "stats_row",
+           "summarize_named", "emit", "nonfinite"]
+
+#: order of the packed summary vector
+STAT_FIELDS = ("l2", "min", "max", "nan", "inf", "zero_frac")
+
+
+def armed():
+    """``MXNET_NUMERICS`` from the registry (build/arm-time check —
+    never on the per-step hot path)."""
+    from ..config import get_env
+
+    try:
+        return bool(get_env("MXNET_NUMERICS"))
+    except Exception:
+        return False
+
+
+def sample_period():
+    """Steps between ``tensor_stats`` emissions.  0 = follow
+    ``MXNET_TELEMETRY_SAMPLE`` (one knob to rule the sync cadence)."""
+    from ..config import get_env
+
+    n = int(get_env("MXNET_NUMERICS_SAMPLE"))
+    if n <= 0:
+        n = int(get_env("MXNET_TELEMETRY_SAMPLE"))
+    return max(1, n)
+
+
+# ------------------------------------------------------------- traceable
+def summary(x):
+    """The packed (6,) float32 summary — traceable, fuses into the
+    surrounding program as a handful of reductions."""
+    import jax.numpy as jnp
+
+    xf = jnp.asarray(x).astype(jnp.float32)
+    nan = jnp.isnan(xf).sum().astype(jnp.float32)
+    inf = jnp.isinf(xf).sum().astype(jnp.float32)
+    finite = jnp.where(jnp.isfinite(xf), xf, 0.0)
+    l2 = jnp.sqrt(jnp.sum(finite * finite))
+    zero_frac = jnp.mean((xf == 0.0).astype(jnp.float32))
+    # raw min/max: a poisoned tensor SHOWS its NaN/Inf here
+    return jnp.stack([l2, jnp.min(xf), jnp.max(xf), nan, inf,
+                      zero_frac])
+
+
+def summarize_tree(named):
+    """``{name: array}`` -> ``{name: summary(array)}`` (traceable)."""
+    return {str(k): summary(v) for k, v in named.items()}
+
+
+def summary_template(named):
+    """Zeros with the summaries' structure — the initial opt_state
+    entry (donated pytrees need a stable structure from step 0)."""
+    import jax.numpy as jnp
+
+    return {str(k): jnp.zeros((len(STAT_FIELDS),), jnp.float32)
+            for k in named}
+
+
+# ----------------------------------------------------------- host side
+def stats_row(vec):
+    """One host-read (6,) vector -> the labelled record row."""
+    import numpy as onp
+
+    v = onp.asarray(vec, dtype="float64")
+    return {"l2": float(v[0]), "min": float(v[1]), "max": float(v[2]),
+            "nan": int(v[3]), "inf": int(v[4]),
+            "zero_frac": float(v[5])}
+
+
+def nonfinite(rows):
+    """Whether any summarised tensor carried a NaN/Inf element."""
+    return any(r["nan"] > 0 or r["inf"] > 0 for r in rows.values())
+
+
+_EAGER = {"fn": None}
+
+
+def summarize_named(named):
+    """Jitted eager summariser for host-visible tensors (the Module
+    path): call ONLY on sampled/bad steps — the computation itself is
+    sampled there, not just the readback."""
+    import jax
+
+    if _EAGER["fn"] is None:
+        _EAGER["fn"] = jax.jit(summarize_tree)
+    return _EAGER["fn"]({k: getattr(v, "_data", v)
+                         for k, v in named.items()})
+
+
+def emit(rl, step, named_vecs, where="grad", epoch=None):
+    """Read the summary vectors to host and write one ``tensor_stats``
+    record (the single device sync the sampled step pays)."""
+    rows = {k: stats_row(v) for k, v in named_vecs.items()}
+    bad = nonfinite(rows)
+    rl.tensor_stats(step, rows, where=where, nonfinite=bad,
+                    epoch=epoch)
+    return rows, bad

@@ -129,7 +129,7 @@ class RunLog:
         self.counters = {"steps": 0, "bad_steps": 0, "ps_retries": 0,
                          "faults": 0, "compiles": 0, "checkpoints": 0,
                          "h2d_bytes": 0, "feed_wait_s": 0.0,
-                         "preempt_signals": 0}
+                         "preempt_signals": 0, "watchdog_stalls": 0}
         self._fps = {}          # program -> last compile fingerprint
         self._programs = {}     # program -> last program_report body
         self._last_program = None
@@ -383,6 +383,47 @@ class RunLog:
                 args={"version": int(version), "bytes": int(nbytes)},
                 tid=_TRACE_TID)
 
+    # ------------------------------------------------ watchdog / stats
+    def watchdog(self, phase, quiet_s, stack_path):
+        """One hang-watchdog stall: the heartbeat went quiet for
+        ``quiet_s`` during ``phase`` and an all-thread stack dump
+        landed at ``stack_path`` (telemetry.watchdog fires this from
+        its own thread — the stalled main thread cannot)."""
+        self._write({"type": "watchdog", "t": round(self._now(), 6),
+                     "phase": str(phase),
+                     "quiet_s": round(float(quiet_s), 3),
+                     "stack_path": str(stack_path)
+                     if stack_path else None})
+        from .. import profiler
+
+        if profiler.is_running():
+            self._trace_meta()
+            profiler.record_instant(
+                "watchdog_stall", "telemetry",
+                args={"phase": str(phase),
+                      "quiet_s": round(float(quiet_s), 3)},
+                tid=_TRACE_TID)
+
+    def opstats(self, rows, source="profiler"):
+        """The aggregate per-op table (telemetry.opstats) as one
+        ``program_report``-style record."""
+        self._write({"type": "opstats", "t": round(self._now(), 6),
+                     "source": str(source), "ops": len(rows),
+                     "rows": rows})
+
+    def tensor_stats(self, step, tensors, where="grad",
+                     nonfinite=False, epoch=None):
+        """One sampled numerics-monitor snapshot: per-tensor summary
+        rows (l2/min/max/nan/inf/zero_frac) for named activations or
+        gradients — the record that EXPLAINS a NaN step."""
+        self._write({"type": "tensor_stats",
+                     "t": round(self._now(), 6),
+                     "step": int(step),
+                     "epoch": int(epoch) if epoch is not None else None,
+                     "where": str(where),
+                     "nonfinite": bool(nonfinite),
+                     "tensors": tensors})
+
     # ---------------------------------------------------------- events
     def event(self, kind, **fields):
         self._write({"type": "event", "t": round(self._now(), 6),
@@ -440,6 +481,16 @@ class RunLog:
             kind = "counter" if isinstance(v, int) else "gauge"
             lines.append(f"# TYPE mxnet_tpu_{k} {kind}")
             lines.append(f"mxnet_tpu_{k} {v}")
+        # Prometheus-convention *_total counter aliases for the rates
+        # dashboards actually graph: retraces (compile events), feed
+        # wait seconds, and watchdog stalls
+        for name, v in (("retrace_total", self.counters["compiles"]),
+                        ("feed_wait_seconds_total",
+                         self.counters["feed_wait_s"]),
+                        ("watchdog_stalls_total",
+                         self.counters["watchdog_stalls"])):
+            lines.append(f"# TYPE mxnet_tpu_{name} counter")
+            lines.append(f"mxnet_tpu_{name} {v}")
         for k, v in sorted(self._last.items()):
             if v is None:
                 continue

@@ -14,13 +14,17 @@ step      one training step (wall time, throughput, feed stats, ...)
 compile   a program (re)trace with its cause (shape/dtype/...)
 program_report  compiled-program introspection (memory/flops/collectives)
 checkpoint  one atomic checkpoint write with its duration
-event     everything else (bad_step, ps_retry, fault, autotune, ...)
+watchdog  a hang-watchdog stall (phase, quiet seconds, stack dump path)
+opstats   aggregate per-op table folded from the profiler's op events
+tensor_stats  sampled numerics-monitor summary of named tensors
+event     everything else (bad_step, ps_retry, fault, deadline, ...)
 run_end   final counters, written at close
 ========  =============================================================
 """
 from __future__ import annotations
 
 __all__ = ["STEP_FIELDS", "RECORD_TYPES", "COMPILE_CAUSES",
+           "OPSTATS_ROW_FIELDS", "TENSOR_STATS_ROW_FIELDS",
            "validate_record", "validate_lines"]
 
 #: step-record contract: field -> (types, required).  ``None`` is legal
@@ -49,7 +53,29 @@ STEP_FIELDS = {
 }
 
 RECORD_TYPES = ("run_start", "step", "compile", "program_report",
-                "checkpoint", "event", "run_end")
+                "checkpoint", "watchdog", "opstats", "tensor_stats",
+                "event", "run_end")
+
+#: per-op row contract of an ``opstats`` record (telemetry.opstats)
+OPSTATS_ROW_FIELDS = {
+    "count": (int, True),
+    "total_us": ((int, float), True),
+    "min_us": ((int, float), True),
+    "max_us": ((int, float), True),
+    "avg_us": ((int, float), True),
+    "p99_us": ((int, float), True),
+    "bytes": ((int, type(None)), True),
+}
+
+#: per-tensor row contract of a ``tensor_stats`` record
+TENSOR_STATS_ROW_FIELDS = {
+    "l2": ((int, float), True),
+    "min": ((int, float), True),
+    "max": ((int, float), True),
+    "nan": (int, True),
+    "inf": (int, True),
+    "zero_frac": ((int, float), True),
+}
 
 #: the concrete retrace causes a compile record may carry
 COMPILE_CAUSES = ("first_trace", "shape", "dtype", "train_mode",
@@ -102,6 +128,37 @@ def validate_record(rec):
             "t": ((int, float), True), "prefix": (str, True),
             "version": (int, True), "duration_s": ((int, float), True),
             "bytes": (int, True)})
+    if t == "watchdog":
+        return _check_fields(rec, {
+            "t": ((int, float), True), "phase": (str, True),
+            "quiet_s": ((int, float), True),
+            "stack_path": ((str, type(None)), True)})
+    if t == "opstats":
+        problems = _check_fields(rec, {
+            "t": ((int, float), True), "source": (str, True),
+            "ops": (int, True), "rows": (dict, True)})
+        for name, row in (rec.get("rows") or {}).items():
+            if not isinstance(row, dict):
+                problems.append(f"opstats row {name!r} is not an object")
+                continue
+            problems.extend(f"opstats row {name!r}: {p}"
+                            for p in _check_fields(row,
+                                                   OPSTATS_ROW_FIELDS))
+        return problems
+    if t == "tensor_stats":
+        problems = _check_fields(rec, {
+            "t": ((int, float), True), "step": (int, True),
+            "where": (str, True), "nonfinite": (bool, True),
+            "tensors": (dict, True)})
+        for name, row in (rec.get("tensors") or {}).items():
+            if not isinstance(row, dict):
+                problems.append(
+                    f"tensor_stats row {name!r} is not an object")
+                continue
+            problems.extend(
+                f"tensor_stats row {name!r}: {p}"
+                for p in _check_fields(row, TENSOR_STATS_ROW_FIELDS))
+        return problems
     if t == "event":
         return _check_fields(rec, {"t": ((int, float), True),
                                    "kind": (str, True)})

@@ -16,7 +16,7 @@ __all__ = ["FitSession", "fit_session"]
 
 
 class FitSession:
-    def __init__(self, runlog, batch_size=0, feed=None):
+    def __init__(self, runlog, batch_size=0, feed=None, watchdog=None):
         self.rl = runlog
         self.batch_size = int(batch_size)
         self._feed = feed
@@ -24,6 +24,21 @@ class FitSession:
         self._t_step = None
         self._step_no = 0
         self._ended = False
+        # hang watchdog, armed per fit by MXNET_WATCHDOG_SEC (works
+        # with or without a run log: the stack dump is the point; the
+        # 'watchdog' record rides along only when telemetry is armed).
+        # step_begin beats it, finish() closes it.
+        self._wd = None
+        if watchdog is not False:
+            try:
+                from .watchdog import Watchdog, default_timeout
+
+                if watchdog is not None:
+                    self._wd = watchdog.arm("fit")
+                elif default_timeout() > 0:
+                    self._wd = Watchdog().arm("fit")
+            except Exception:
+                self._wd = None  # the observer must not break fit
         if runlog is not None:
             runlog.event("fit_start", batch_size=self.batch_size)
 
@@ -32,6 +47,8 @@ class FitSession:
 
     # ------------------------------------------------------------ steps
     def step_begin(self):
+        if self._wd is not None:
+            self._wd.beat("step")
         if self.rl is not None:
             self._t_step = time.perf_counter()
 
@@ -74,6 +91,9 @@ class FitSession:
         return path
 
     def finish(self, outcome="ok"):
+        if self._wd is not None:
+            self._wd.close()
+            self._wd = None
         if self.rl is None or self._ended:
             return
         self._ended = True

@@ -96,6 +96,19 @@ def test_smoke_emits_valid_json_with_heartbeats():
     assert prog["flops"] > 0
     assert prog["memory"].get("argument_bytes", 0) > 0
     assert prog["collectives"] is not None
+    # round 11: the aggregate opstats table (profiler.dumps() analog)
+    # landed in the run log — per-op count/avg/p99/bytes rows
+    assert tm["records"]["opstats"] == 1
+    assert tm["opstats"]["ops"] >= 1
+    assert tm["opstats"]["has_p99"] is True
+    assert tm["opstats"]["has_bytes"] is True
+    # and the numerics monitor recorded tensor_stats rows
+    assert tm["records"]["tensor_stats"] >= 1
+    assert tm["tensor_stats"]["tensors"] >= 1
+    assert tm["tensor_stats"]["nonfinite"] is False
+    # the hang watchdog was armed (bench defaults it on) and quiet
+    assert out["watchdog_sec"] > 0
+    assert out["watchdog_stalls"] == 0
     # a heartbeat per phase, so a hang is attributable
     for phase in ("import", "device_init", "build", "autotune",
                   "compile", "K1", "K2", "trials", "feed",
@@ -119,6 +132,97 @@ def test_smoke_checkpoint_resume_roundtrip(tmp_path):
     assert out2["resumed"] is True
     assert out2["resumed_from_epoch"] == 2
     assert "phase=resume" in r2.stderr
+
+
+def test_smoke_sigkill_leaves_partial_json_and_stack_dump(tmp_path):
+    """Round 11 acceptance: the r05 shape of failure, reproduced and
+    survived.  A bench wedged in an uninterruptible call (simulated by
+    a bench.stall delay fault with NO heartbeats) and then SIGKILLed —
+    the strongest kill, no handler runs — must leave:
+
+    * the PARTIAL headline JSON, atomically rewritten per phase, with
+      the measured value and every completed phase listed;
+    * the watchdog's all-thread stack-dump file (the watchdog fired
+      DURING the stall, from its own thread);
+    * the stall stamped into the partial artifact.
+    """
+    import signal
+    import time
+
+    partial = str(tmp_path / "partial.json")
+    env = dict(os.environ)
+    env["JAX_COMPILATION_CACHE_DIR"] = _CACHE_DIR
+    env["MXNET_FAULT_SPEC"] = "bench.stall:delay=90@1"
+    # streams go to FILES, not pipes: nobody drains a pipe during the
+    # 90 s stall, so a verbose child (JAX_LOG_COMPILES etc.) would
+    # block on a full pipe buffer inside _heartbeat's print — before
+    # the beat — and never reach the measure phase
+    out_f = open(tmp_path / "child.out", "wb")
+    err_f = open(tmp_path / "child.err", "wb")
+    proc = subprocess.Popen(
+        [sys.executable, _BENCH, "--smoke", "--no-autotune",
+         "--watchdog", "1", "--partial-json", partial],
+        stdout=out_f, stderr=err_f, env=env)
+    try:
+        stacks = partial + ".stacks.txt"
+        deadline = time.monotonic() + 180
+
+        def _ready():
+            # the measure phase must have landed in the partial AND
+            # the watchdog must have fired (inside the 90 s stall that
+            # follows measure — or earlier on a slow box; both leave
+            # the dump)
+            if not os.path.exists(stacks):
+                return False
+            try:
+                with open(partial) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                return False
+            return "measure" in doc.get("phases_completed", ())
+
+        while time.monotonic() < deadline:
+            if _ready():
+                break
+            if proc.poll() is not None:
+                err_f.flush()
+                pytest.fail("bench exited before the stall: "
+                            + (tmp_path / "child.err")
+                            .read_bytes().decode()[-2000:])
+            time.sleep(0.2)
+        assert _ready(), "watchdog never fired during the stall"
+        # give the on_stall partial rewrite a beat, then kill -9
+        time.sleep(0.5)
+        proc.kill()
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        out_f.close()
+        err_f.close()
+    assert proc.returncode == -signal.SIGKILL
+
+    # the partial artifact survived the SIGKILL, parses whole, and
+    # carries the completed phases' results
+    with open(partial) as f:
+        out = json.load(f)
+    assert out["partial"] is True
+    assert out["degraded"] is True
+    assert "measure" in out["phases_completed"]
+    assert out["value"] and out["value"] > 0       # phase-1 result
+    assert out["ms_per_step"] > 0
+    assert "killed" in out["reason"]
+    # the stall is attributed in the artifact, stacks linked
+    assert out["stalled"]["quiet_s"] >= 1
+    assert out["stalled"]["stacks"] == stacks
+    text = open(stacks).read()
+    assert "watchdog stall #1" in text
+    assert "bench.py" in text  # the wedged main thread's frames
+    # NOTE: a .tmp sibling MAY survive if the SIGKILL landed inside a
+    # later watchdog re-fire's write window — that is the point of the
+    # temp+rename protocol: the artifact itself (asserted parseable
+    # above) can never be the torn one.
 
 
 def test_smoke_deadline_degrades_not_dies():

@@ -1,0 +1,184 @@
+"""tools/benchdiff.py: the committed bench artifacts become a trend.
+
+The acceptance row: run over the repo's own BENCH_r01–r05 /
+OPPERF_r03–r04 artifacts, the differ must flag r05's missing metric as
+a REGRESSION (not crash on the ``parsed: null`` file) and exit nonzero
+under ``--fail-on-regression`` — that is the ``benchdiff_smoke`` CI
+cell.  Synthetic artifacts cover the p50/p99 tail-latency columns and
+the threshold arithmetic both ways.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.unit
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOL = os.path.join(_REPO, "tools", "benchdiff.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("benchdiff", _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bd = _load()
+
+
+# ------------------------------------------------- committed artifacts
+def test_committed_artifacts_flag_r05_as_regression(capsys):
+    rc = bd.main([])
+    out = capsys.readouterr().out
+    assert rc == 0  # reporting mode never fails the build
+    assert "r05" in out
+    # the r05 shape of failure: flagged as a regression with the
+    # reason, NOT a crash of the tool
+    assert "regression: missing metric (rc=124)" in out
+    assert "r01" in out and "baseline" in out
+    # the opperf artifacts trended too
+    assert "opperf trend" in out
+
+
+def test_committed_artifacts_fail_on_regression_exits_nonzero():
+    # pinned to the r01–r05 window: r05's missing metric is the latest
+    # round INSIDE it forever, so a good future r06 commit cannot flip
+    # this assertion (the unpinned run above still covers new rounds)
+    rc = bd.main(["--bench", os.path.join(_REPO, "BENCH_r0[1-5].json"),
+                  "--opperf", os.path.join(_REPO, "OPPERF_r0[1-5].jsonl"),
+                  "--fail-on-regression"])
+    assert rc == 2
+
+
+def test_cli_entrypoint_runs():
+    # --bench pinned to r01–r05 so the failures list (latest-round
+    # scoped) keeps naming r05 after future rounds are committed
+    r = subprocess.run(
+        [sys.executable, _TOOL, "--json",
+         "--bench", os.path.join(_REPO, "BENCH_r0[1-5].json")],
+        capture_output=True, text=True, cwd=_REPO)
+    assert r.returncode == 0, r.stderr[-500:]
+    doc = json.loads(r.stdout)
+    assert doc["headline"]["r05"]["verdict"] == "regression"
+    assert "missing metric" in doc["headline"]["r05"]["reason"]
+    assert doc["headline"]["r04"]["value"] == 2849.29
+    assert any("r05" in f for f in doc["failures"])
+
+
+# ---------------------------------------------------------- synthetic
+def _wrapper(n, rc, parsed):
+    return {"n": n, "cmd": "bench", "rc": rc, "parsed": parsed}
+
+
+def _write_rounds(tmp_path, rows):
+    for n, rc, parsed in rows:
+        p = tmp_path / f"BENCH_r{n:02d}.json"
+        p.write_text(json.dumps(_wrapper(n, rc, parsed)))
+    return str(tmp_path / "BENCH_r*.json")
+
+
+def test_threshold_splits_ok_improved_regression(tmp_path):
+    glob_b = _write_rounds(tmp_path, [
+        (1, 0, {"value": 1000.0}),
+        (2, 0, {"value": 1100.0}),   # +10% < 15% -> ok
+        (3, 0, {"value": 1500.0}),   # +36% -> improved
+        (4, 0, {"value": 1000.0}),   # -33% -> regression
+    ])
+    rounds = bd.headline_verdicts(
+        bd.load_bench(sorted(__import__("glob").glob(glob_b))), 0.15)
+    assert rounds["r01"]["verdict"] == "baseline"
+    assert rounds["r02"]["verdict"] == "ok"
+    assert rounds["r03"]["verdict"] == "improved"
+    assert rounds["r04"]["verdict"] == "regression"
+
+
+def test_missing_metric_and_malformed_files_never_crash(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps(_wrapper(1, 0, {"value": 100.0})))
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps(_wrapper(2, 124, None)))
+    (tmp_path / "BENCH_r03.json").write_text("{not json")
+    rounds = bd.headline_verdicts(bd.load_bench(
+        sorted(str(p) for p in tmp_path.glob("BENCH_r*.json"))), 0.15)
+    assert rounds["r02"]["verdict"] == "regression"
+    assert "rc=124" in rounds["r02"]["reason"]
+    assert rounds["r03"]["verdict"] == "regression"
+    assert "unreadable" in rounds["r03"]["reason"]
+    # a later round with a metric diffs against the last GOOD metric
+    (tmp_path / "BENCH_r04.json").write_text(
+        json.dumps(_wrapper(4, 0, {"value": 101.0})))
+    rounds = bd.headline_verdicts(bd.load_bench(
+        sorted(str(p) for p in tmp_path.glob("BENCH_r*.json"))), 0.15)
+    assert rounds["r04"]["verdict"] == "ok"
+
+
+def test_bare_headline_json_accepted(tmp_path):
+    """bench.py's own stdout line (or a partial artifact) parses too —
+    no driver wrapper required."""
+    (tmp_path / "BENCH_r07.json").write_text(json.dumps(
+        {"metric": "resnet50_train_throughput", "value": 3000.0,
+         "mfu": 0.5, "ms_per_step": 42.0, "degraded": True}))
+    rounds = bd.load_bench([str(tmp_path / "BENCH_r07.json")])
+    assert rounds["r07"]["value"] == 3000.0
+    assert rounds["r07"]["mfu"] == 0.5
+    assert rounds["r07"]["degraded"] is True
+
+
+def test_opperf_tail_latency_trend(tmp_path):
+    rows3 = [{"op": "dot", "avg_time_ms": 1.0, "p50_time_ms": 0.9,
+              "p99_time_ms": 1.2},
+             {"op": "conv", "avg_time_ms": 5.0, "p50_time_ms": 4.8,
+              "p99_time_ms": 5.5},
+             {"op": "only_in_r3", "avg_time_ms": 1.0}]
+    rows4 = [{"op": "dot", "avg_time_ms": 2.0, "p50_time_ms": 1.8,
+              "p99_time_ms": 6.0},       # 2x slower, p99 5x
+             {"op": "conv", "avg_time_ms": 2.0, "p50_time_ms": 1.9,
+              "p99_time_ms": 2.2}]       # 2.5x faster
+    for n, rows in ((3, rows3), (4, rows4)):
+        with open(tmp_path / f"OPPERF_r{n:02d}.jsonl", "w") as f:
+            f.write("\n".join(json.dumps(r) for r in rows) + "\n")
+    diff = bd.opperf_diff(bd.load_opperf(
+        sorted(str(p) for p in tmp_path.glob("OPPERF_r*.jsonl"))),
+        0.15)
+    assert diff["compared_ops"] == 2  # only_in_r3 dropped, no crash
+    assert [e["op"] for e in diff["regressions"]] == ["dot"]
+    assert diff["regressions"][0]["ratio"] == 2.0
+    assert diff["regressions"][0]["p99_ratio"] == 5.0
+    assert [e["op"] for e in diff["improvements"]] == ["conv"]
+
+
+def test_fail_on_regression_threshold_is_configurable(tmp_path):
+    glob_b = _write_rounds(tmp_path, [
+        (1, 0, {"value": 1000.0}),
+        (2, 0, {"value": 900.0}),   # -10%
+    ])
+    # 15% threshold tolerates -10%...
+    assert bd.main(["--bench", glob_b, "--opperf",
+                    str(tmp_path / "none*.jsonl"),
+                    "--fail-on-regression"]) == 0
+    # ...a 5% threshold does not
+    assert bd.main(["--bench", glob_b, "--opperf",
+                    str(tmp_path / "none*.jsonl"),
+                    "--threshold", "0.05",
+                    "--fail-on-regression"]) == 2
+
+
+def test_regenerated_opperf_smoke_has_percentiles():
+    """Satellite: the committed OPPERF_smoke.jsonl was regenerated with
+    the p50/p99 columns benchdiff trends tail latency from."""
+    rows = []
+    with open(os.path.join(_REPO, "OPPERF_smoke.jsonl")) as f:
+        for line in f:
+            row = json.loads(line)
+            if "op" in row and "avg_time_ms" in row:
+                rows.append(row)
+    assert rows
+    assert all("p50_time_ms" in r and "p99_time_ms" in r
+               for r in rows), "regenerate OPPERF_smoke.jsonl"
+    assert all(r["p99_time_ms"] >= r["p50_time_ms"] >= 0
+               for r in rows)

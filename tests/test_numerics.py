@@ -1,0 +1,292 @@
+"""In-graph numerics monitor (Monitor 2.0, ``MXNET_NUMERICS``).
+
+The contract under test, both directions:
+
+* ARMED: per-gradient summaries (l2/min/max/nan/inf/zero_frac) compile
+  into the train step, ride in the state under ``_numerics``, and land
+  as sampled schema-valid ``tensor_stats`` run-log records — a NaN step
+  is EXPLAINED (which tensor, which step) rather than just counted.
+  The eager Module.fit path emits the same records on sampled and bad
+  steps.
+* UNARMED: strict no-op — the traced program is bit-identical to a
+  build without the monitor (HLO text compared), no reserved state
+  entry exists, and the per-step host cost stays within the PR-5
+  paired-ratio A/B bound.
+"""
+import math
+import os
+import time
+
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, sym, telemetry
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel import make_train_step
+from mxnet_tpu.telemetry import numerics, schema
+
+pytestmark = pytest.mark.unit
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry(monkeypatch):
+    monkeypatch.delenv("MXNET_RUNLOG", raising=False)
+    monkeypatch.delenv("MXNET_NUMERICS", raising=False)
+    monkeypatch.delenv("MXNET_NUMERICS_SAMPLE", raising=False)
+    telemetry.close()
+    yield
+    telemetry.close()
+
+
+def _read(path):
+    with open(path) as f:
+        return schema.validate_lines(f)
+
+
+# ------------------------------------------------------------- summaries
+def test_summary_statistics_are_correct():
+    x = jnp.asarray([3.0, -4.0, 0.0, 0.0, float("nan"), float("inf")])
+    row = numerics.stats_row(numerics.summary(x))
+    assert row["l2"] == pytest.approx(5.0)  # over FINITE elements only
+    assert row["nan"] == 1 and row["inf"] == 1
+    assert row["zero_frac"] == pytest.approx(2 / 6)
+    # raw min/max carry the poison so the record shows it
+    assert math.isnan(row["min"]) or row["min"] == -4.0
+    assert numerics.nonfinite({"x": row})
+
+    clean = numerics.stats_row(numerics.summary(jnp.ones((4, 4))))
+    assert clean["nan"] == 0 and clean["inf"] == 0
+    assert clean["l2"] == pytest.approx(4.0)
+    assert clean["min"] == clean["max"] == 1.0
+    assert not numerics.nonfinite({"x": clean})
+
+
+def test_summary_is_traceable_and_int_safe():
+    f = jax.jit(numerics.summarize_tree)
+    out = f({"a": jnp.arange(8, dtype=jnp.int32),
+             "b": jnp.ones((2, 2), jnp.bfloat16)})
+    row = numerics.stats_row(out["a"])
+    assert row["max"] == 7.0 and row["zero_frac"] == pytest.approx(1 / 8)
+
+
+def _dense_step(**kw):
+    # Fixed prefix: the global gluon name counter must not leak other
+    # tests' layer counts into the param names these tests assert on.
+    net = nn.Dense(8, in_units=6, prefix="dense0_")
+    net.initialize()
+    loss_fn = gluon.loss.L2Loss()
+    return make_train_step(net, loss_fn, optimizer="sgd",
+                           learning_rate=0.1, donate=False, **kw)
+
+
+# --------------------------------------------------- armed in-graph path
+def test_train_step_armed_emits_sampled_tensor_stats(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.setenv("MXNET_NUMERICS", "1")
+    monkeypatch.setenv("MXNET_NUMERICS_SAMPLE", "2")
+    path = str(tmp_path / "run.jsonl")
+    telemetry.reset(path)
+    step_fn, p, o = _dense_step()
+    assert "_numerics" in o  # armed at build: summaries ride the state
+    key = jax.random.key(0)
+    x = jnp.ones((4, 6), "float32")
+    y = jnp.ones((4, 8), "float32")
+    for _ in range(5):
+        loss, p, o = step_fn(p, o, x, y, key, 1.0)
+    telemetry.close()
+
+    recs, problems = _read(path)
+    assert not problems, problems[:10]
+    ts = [r for r in recs if r["type"] == "tensor_stats"]
+    # sample period 2 over 5 steps -> steps 0, 2, 4
+    assert [r["step"] for r in ts] == [0, 2, 4]
+    assert all(r["where"] == "grad" for r in ts)
+    names = set(ts[0]["tensors"])
+    assert {"dense0_weight", "dense0_bias", "__loss"} <= names
+    assert all(not r["nonfinite"] for r in ts)
+    row = ts[0]["tensors"]["dense0_weight"]
+    assert row["l2"] > 0 and row["nan"] == 0
+
+
+def test_nan_step_is_explained_by_name(tmp_path, monkeypatch):
+    """THE acceptance scenario: a NaN step's tensor_stats record names
+    the tensors that went non-finite, before any guard kills the
+    run."""
+    monkeypatch.setenv("MXNET_NUMERICS", "1")
+    monkeypatch.setenv("MXNET_NUMERICS_SAMPLE", "1")  # every step
+    path = str(tmp_path / "run.jsonl")
+    telemetry.reset(path)
+    step_fn, p, o = _dense_step()
+    key = jax.random.key(0)
+    x = jnp.ones((4, 6), "float32")
+    y = jnp.ones((4, 8), "float32")
+    loss, p, o = step_fn(p, o, x, y, key, 1.0)
+    xn = x.at[0, 0].set(float("nan"))
+    loss, p, o = step_fn(p, o, xn, y, key, 1.0)
+    telemetry.close()
+
+    recs, problems = _read(path)
+    assert not problems, problems[:10]
+    ts = [r for r in recs if r["type"] == "tensor_stats"]
+    assert len(ts) == 2
+    assert ts[0]["nonfinite"] is False
+    assert ts[1]["nonfinite"] is True
+    poisoned = {n for n, r in ts[1]["tensors"].items()
+                if r["nan"] > 0 or r["inf"] > 0}
+    # the NaN input poisons the loss and flows back into both layers'
+    # gradients — each is named, with its element count
+    assert "__loss" in poisoned
+    assert "dense0_weight" in poisoned
+    assert ts[1]["tensors"]["dense0_weight"]["nan"] > 0
+
+
+def test_armed_with_nan_guard_keeps_bad_step_stats(tmp_path,
+                                                   monkeypatch):
+    """With the in-graph NaN guard armed too, the guard HOLDS the
+    update but the _numerics entry still carries the bad step's stats
+    (the explanation must survive the skip)."""
+    monkeypatch.setenv("MXNET_NUMERICS", "1")
+    monkeypatch.setenv("MXNET_NUMERICS_SAMPLE", "1")
+    path = str(tmp_path / "run.jsonl")
+    telemetry.reset(path)
+    step_fn, p, o = _dense_step(nan_guard=True)
+    key = jax.random.key(0)
+    x = jnp.ones((4, 6), "float32")
+    y = jnp.ones((4, 8), "float32")
+    loss, p, o = step_fn(p, o, x, y, key, 1.0)
+    w_before = onp.asarray(p["dense0_weight"])
+    xn = x.at[0, 0].set(float("nan"))
+    loss, p, o = step_fn(p, o, xn, y, key, 1.0)
+    telemetry.close()
+    # guard held the params...
+    assert onp.array_equal(onp.asarray(p["dense0_weight"]), w_before)
+    assert int(o["_bad_steps"]) == 1
+    # ...and the record still explains the skipped step
+    recs, _ = _read(path)
+    ts = [r for r in recs if r["type"] == "tensor_stats"]
+    assert ts[-1]["nonfinite"] is True
+
+
+# ------------------------------------------------------ module fit path
+def _mlp():
+    d = sym.Variable("data")
+    fc1 = sym.FullyConnected(d, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(fc2, sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def test_module_fit_emits_grad_tensor_stats(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_NUMERICS", "1")
+    monkeypatch.setenv("MXNET_NUMERICS_SAMPLE", "3")
+    path = str(tmp_path / "run.jsonl")
+    telemetry.reset(path)
+    rng = onp.random.RandomState(7)
+    X = rng.randn(64, 10).astype("float32")
+    y = (X @ rng.randn(10, 4)).argmax(axis=1).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=False)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1),),
+            initializer=mx.init.Xavier())
+    telemetry.close()
+
+    recs, problems = _read(path)
+    assert not problems, problems[:10]
+    ts = [r for r in recs if r["type"] == "tensor_stats"]
+    assert [r["step"] for r in ts] == [0, 3, 6]  # 8 steps, period 3
+    assert ts[0]["epoch"] == 0
+    assert {"fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"} \
+        <= set(ts[0]["tensors"])
+    assert all(r["where"] == "grad" for r in ts)
+
+
+def test_monitor_numerics_stat_func():
+    """Monitor 2.0 bridge: the classic tic/toc protocol reporting the
+    same six summary numbers."""
+    from mxnet_tpu.monitor import Monitor
+
+    mon = Monitor(interval=1, stat_func="numerics")
+    mon.activated = True
+    mon._stat_helper("layer_output0",
+                     mx.nd.array(onp.asarray([[3.0, -4.0, 0.0]])))
+    stats = mon.toc()
+    assert stats
+    _, name, val = stats[0]
+    assert name == "layer_output0"
+    assert "l2=5" in val and "nan=0" in val and "zero_frac=" in val
+
+
+# -------------------------------------------------- unarmed strict no-op
+def test_unarmed_program_is_bit_identical(monkeypatch):
+    """MXNET_NUMERICS unset: no reserved state entry, and the traced
+    program's HLO is byte-identical to another unarmed build — the
+    monitor leaves zero residue in the compiled step."""
+    key = jax.random.key(0)
+    x = jnp.ones((4, 6), "float32")
+    y = jnp.ones((4, 8), "float32")
+
+    step_a, p_a, o_a = _dense_step()
+    assert "_numerics" not in o_a
+    hlo_a = step_a.lower(p_a, o_a, x, y, key, 1.0).as_text()
+
+    # arm, build (program changes), disarm, build again: identical
+    monkeypatch.setenv("MXNET_NUMERICS", "1")
+    step_b, p_b, o_b = _dense_step()
+    assert "_numerics" in o_b
+    hlo_b = step_b.lower(p_b, o_b, x, y, key, 1.0).as_text()
+    monkeypatch.delenv("MXNET_NUMERICS")
+    step_c, p_c, o_c = _dense_step()
+    assert "_numerics" not in o_c
+    hlo_c = step_c.lower(p_c, o_c, x, y, key, 1.0).as_text()
+
+    assert hlo_a == hlo_c
+    assert hlo_a != hlo_b
+    # and the live call returns the untouched 3-tuple contract
+    loss, p2, o2 = step_c(p_c, o_c, x, y, key, 1.0)
+    assert set(o2) == set(o_c)
+
+
+def test_unarmed_per_step_host_cost_bound(tmp_path):
+    """PR-5 paired-ratio discipline: an UNARMED-numerics step loop
+    under an armed run log vs the same loop with telemetry off.  The
+    numerics branch in the step wrapper must cost ~nothing when
+    disarmed — a regression that does per-step host work unarmed
+    (reading state, building rows) blows the ratio up."""
+    net = nn.Dense(256, in_units=256)
+    net.initialize()
+    loss_fn = gluon.loss.L2Loss()
+    step_fn, params, opt = make_train_step(
+        net, loss_fn, optimizer="sgd", learning_rate=0.1, donate=False)
+    key = jax.random.key(0)
+    x = jnp.ones((128, 256), "float32")
+    y = jnp.ones((128, 256), "float32")
+    step_fn(params, opt, x, y, key, 1.0)  # compile outside both arms
+
+    def chunk():
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(40):
+            out = step_fn(params, opt, x, y, key, 1.0)
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    chunk()  # warm
+    ratios = []
+    for _ in range(5):
+        telemetry.close()
+        t_off = chunk()
+        telemetry.reset(str(tmp_path / "r.jsonl"))
+        t_on = chunk()
+        ratios.append(t_on / t_off)
+    telemetry.close()
+    # min-of-rounds: noise bursts inflate single rounds, a genuine
+    # per-step regression inflates them all (same discipline as the
+    # PR-5 overhead A/B)
+    overhead = min(ratios) - 1.0
+    assert overhead < 0.35, f"unarmed overhead {overhead:.1%}"

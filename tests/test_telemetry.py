@@ -577,8 +577,10 @@ def test_overhead_at_default_sampling(tmp_path):
         t_off = chunk(off)
         ratios.append(chunk(on) / t_off)
     telemetry.close()
-    ratios.sort()
-    overhead = ratios[len(ratios) // 2] - 1.0
+    # min-of-rounds, as documented above: a contention burst landing on
+    # one round's ON chunk inflates that round only, while a genuine
+    # per-step regression inflates every round and still fails
+    overhead = min(ratios) - 1.0
     assert overhead < 0.35, f"telemetry overhead {overhead:.1%}"
 
 
@@ -595,11 +597,16 @@ def test_step_hot_path_is_cheap(tmp_path):
     for i in range(100):  # warm
         s.step_begin()
         s.step_end(0, i, synced=False)
-    n = 2000
-    t0 = time.perf_counter()
-    for i in range(n):
-        s.step_begin()
-        s.step_end(0, i, synced=False)
-    per_step = (time.perf_counter() - t0) / n
+    # best-of-rounds: one scheduler preemption mid-round cannot fail
+    # the bound, a per-step regression slows every round
+    per_round = []
+    for r in range(4):
+        n = 500
+        t0 = time.perf_counter()
+        for i in range(n):
+            s.step_begin()
+            s.step_end(0, r * n + i, synced=False)
+        per_round.append((time.perf_counter() - t0) / n)
+    per_step = min(per_round)
     telemetry.close()
     assert per_step < 200e-6, f"per-step telemetry {per_step*1e6:.0f}us"

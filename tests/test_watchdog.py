@@ -1,0 +1,265 @@
+"""Hang watchdog (round 11 tentpole): stalls must be diagnosable.
+
+The r05 failure mode — 25 minutes of silence inside an uninterruptible
+XLA call, then an external kill and zero artifact — is reproduced here
+in miniature and must leave evidence every time:
+
+* a quiet heartbeat fires the watchdog from its own thread: all-thread
+  stack dump appended, ``watchdog`` run-log record, flight-recorder
+  dump with reason ``stall``, ``watchdog_stalls`` counter;
+* a beaten heartbeat never fires; unarmed (``MXNET_WATCHDOG_SEC``
+  unset/0) starts no thread at all;
+* ``Module.fit`` arms per fit and beats per step, so a wedged step
+  shows up in the run log while fit still completes (the watchdog
+  observes, it never kills);
+* the Prometheus textfile gains the ``retrace_total`` /
+  ``feed_wait_seconds_total`` / ``watchdog_stalls_total`` rows.
+"""
+import json
+import os
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, telemetry
+from mxnet_tpu.telemetry import schema
+from mxnet_tpu.telemetry.watchdog import Watchdog
+
+pytestmark = pytest.mark.unit
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry(monkeypatch):
+    monkeypatch.delenv("MXNET_RUNLOG", raising=False)
+    monkeypatch.delenv("MXNET_WATCHDOG_SEC", raising=False)
+    telemetry.close()
+    yield
+    telemetry.close()
+
+
+def _wait_for(pred, timeout=10.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+# ------------------------------------------------------------ unit level
+def test_quiet_heartbeat_fires_stack_dump(tmp_path):
+    sp = str(tmp_path / "stacks.txt")
+    fired = []
+    wd = Watchdog(timeout=0.2, stack_path=sp,
+                  on_stall=lambda ph, q, p: fired.append((ph, q, p)))
+    wd.arm("phase-one")
+    try:
+        assert _wait_for(lambda: wd.stalls >= 1)
+    finally:
+        wd.close()
+    assert fired and fired[0][0] == "phase-one"
+    assert fired[0][1] >= 0.2  # quiet at least the timeout
+    assert fired[0][2] == sp
+    text = open(sp).read()
+    assert "watchdog stall #1" in text
+    assert "phase=phase-one" in text
+    # faulthandler's all-thread dump: the watchdog thread itself and
+    # the (blocked) main thread both show
+    assert "Current thread" in text or "Thread" in text
+
+
+def test_beaten_heartbeat_never_fires(tmp_path):
+    wd = Watchdog(timeout=0.3, stack_path=str(tmp_path / "s.txt"))
+    wd.arm("busy")
+    try:
+        for _ in range(12):
+            time.sleep(0.05)
+            wd.beat()
+    finally:
+        wd.close()
+    assert wd.stalls == 0
+    assert not os.path.exists(str(tmp_path / "s.txt"))
+
+
+def test_unarmed_watchdog_is_noop(tmp_path):
+    # timeout 0 (the MXNET_WATCHDOG_SEC default): no thread, ever
+    wd = Watchdog(timeout=0, stack_path=str(tmp_path / "s.txt"))
+    wd.arm("x")
+    assert wd._thread is None
+    wd.beat()  # no error, near-free
+    wd.close()
+    assert wd.stalls == 0
+    # a FitSession without the env never builds one either
+    s = telemetry.fit_session(batch_size=8)
+    assert s._wd is None
+    s.step_begin()
+    s.finish()
+
+
+def test_stall_records_watchdog_runlog_and_flight(tmp_path):
+    """Armed telemetry: the stall lands as a schema-valid ``watchdog``
+    record, bumps the counter, and flushes the flight ring with
+    reason ``stall``."""
+    path = str(tmp_path / "run.jsonl")
+    rl = telemetry.reset(path)
+    rl.step(0, 0, 0.01, 8)  # something for the flight ring to carry
+    wd = Watchdog(timeout=0.2, stack_path=str(tmp_path / "s.txt"))
+    wd.arm("wedged-phase")
+    try:
+        assert _wait_for(lambda: rl.counters["watchdog_stalls"] >= 1)
+    finally:
+        wd.close()
+    telemetry.close()
+
+    with open(path) as f:
+        recs, problems = schema.validate_lines(f)
+    assert not problems, problems[:10]
+    wrecs = [r for r in recs if r["type"] == "watchdog"]
+    assert wrecs
+    assert wrecs[0]["phase"] == "wedged-phase"
+    assert wrecs[0]["quiet_s"] >= 0.2
+    assert wrecs[0]["stack_path"] == str(tmp_path / "s.txt")
+    # the flight dump rode along with reason "stall"
+    with open(telemetry.flight_path_for(path)) as f:
+        flight = json.load(f)
+    assert flight["reason"] == "stall"
+    assert flight["counters"]["watchdog_stalls"] >= 1
+    assert flight["steps"]
+
+
+def test_max_dumps_bounds_a_permanent_stall(tmp_path):
+    wd = Watchdog(timeout=0.05, stack_path=str(tmp_path / "s.txt"),
+                  max_dumps=2, poll=0.02)
+    wd.arm("stuck")
+    time.sleep(0.6)
+    wd.close()
+    assert wd.stalls == 2
+    assert open(str(tmp_path / "s.txt")).read().count(
+        "watchdog stall #") == 2
+
+
+def test_disarm_stops_firing(tmp_path):
+    wd = Watchdog(timeout=0.1, stack_path=str(tmp_path / "s.txt"),
+                  poll=0.02)
+    wd.arm("a")
+    assert _wait_for(lambda: wd.stalls >= 1)
+    n = wd.stalls
+    wd.disarm()
+    time.sleep(0.4)
+    assert wd.stalls == n
+    wd.close()
+
+
+# ------------------------------------------------------------- fit level
+def _mlp():
+    d = sym.Variable("data")
+    fc1 = sym.FullyConnected(d, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(fc2, sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def test_fit_armed_watchdog_catches_wedged_step(tmp_path, monkeypatch):
+    """MXNET_WATCHDOG_SEC arms per fit; a callback that wedges one
+    batch longer than the timeout produces a watchdog record, and fit
+    still completes normally — the watchdog observes, never kills."""
+    path = str(tmp_path / "run.jsonl")
+    monkeypatch.setenv("MXNET_WATCHDOG_SEC", "0.2")
+    telemetry.reset(path)
+    rng = onp.random.RandomState(7)
+    X = rng.randn(64, 10).astype("float32")
+    y = (X @ rng.randn(10, 4)).argmax(axis=1).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=False)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+
+    def wedge(param):
+        if param.epoch == 0 and param.nbatch == 2:
+            time.sleep(0.6)
+
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1),),
+            initializer=mx.init.Xavier(), batch_end_callback=wedge)
+    telemetry.close()
+
+    with open(path) as f:
+        recs, problems = schema.validate_lines(f)
+    assert not problems, problems[:10]
+    wrecs = [r for r in recs if r["type"] == "watchdog"]
+    assert wrecs, "wedged step did not fire the fit watchdog"
+    assert os.path.exists(telemetry.stack_path_for(path))
+    # fit COMPLETED: all 8 steps recorded and the run closed cleanly
+    assert sum(1 for r in recs if r["type"] == "step") == 8
+    ends = [r for r in recs if r["type"] == "event"
+            and r["kind"] == "fit_end"]
+    assert ends and ends[-1]["outcome"] == "ok"
+
+
+def test_fit_unarmed_watchdog_absent(tmp_path):
+    """Without MXNET_WATCHDOG_SEC the fit session carries no watchdog
+    and no stack file ever appears (the strict no-op contract)."""
+    path = str(tmp_path / "run.jsonl")
+    telemetry.reset(path)
+    rng = onp.random.RandomState(7)
+    X = rng.randn(32, 10).astype("float32")
+    y = (X @ rng.randn(10, 4)).argmax(axis=1).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=False)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1),),
+            initializer=mx.init.Xavier())
+    telemetry.close()
+    assert not os.path.exists(telemetry.stack_path_for(path))
+    with open(path) as f:
+        recs, problems = schema.validate_lines(f)
+    assert not problems
+    assert not [r for r in recs if r["type"] == "watchdog"]
+
+
+# --------------------------------------------- textfile counters satellite
+def test_textfile_gains_total_counter_rows(tmp_path):
+    tf = str(tmp_path / "metrics.prom")
+    rl = telemetry.RunLog(str(tmp_path / "r.jsonl"), sample=1,
+                          textfile=tf)
+    rl.step(0, 0, 0.01, 8, feed_wait_s=0.25, synced=True)
+    rl.compile_event("train_step", {"shape": "(8,)"})
+    rl.count("watchdog_stalls")
+    rl.close()
+    text = open(tf).read()
+    assert "# TYPE mxnet_tpu_retrace_total counter" in text
+    assert "mxnet_tpu_retrace_total 1" in text
+    assert "# TYPE mxnet_tpu_feed_wait_seconds_total counter" in text
+    assert "mxnet_tpu_feed_wait_seconds_total 0.25" in text
+    assert "# TYPE mxnet_tpu_watchdog_stalls_total counter" in text
+    assert "mxnet_tpu_watchdog_stalls_total 1" in text
+
+
+# -------------------------------------------- bench deadline event satellite
+def test_bench_deadline_note_emits_runlog_event(tmp_path):
+    """bench.py's Deadline.note: a deadline-triggered degradation logs
+    a RunLog ``deadline`` event with the phase and remaining budget —
+    the reasons survive even when the final JSON is lost to a kill."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    path = str(tmp_path / "run.jsonl")
+    telemetry.reset(path)
+    dl = bench._Deadline(0.0)  # already exceeded
+    assert dl.exceeded()
+    dl.note("measure:k-plan")
+    telemetry.close()
+    with open(path) as f:
+        recs, problems = schema.validate_lines(f)
+    assert not problems
+    evs = [r for r in recs if r["type"] == "event"
+           and r["kind"] == "deadline"]
+    assert len(evs) == 1
+    assert evs[0]["phase"] == "measure:k-plan"
+    assert evs[0]["remaining_s"] <= 0

@@ -1,0 +1,297 @@
+#!/usr/bin/env python
+"""Round-over-round bench trend differ (``tools/benchdiff.py``).
+
+The repo commits one ``BENCH_rNN.json`` headline artifact and
+(irregularly) one ``OPPERF_rNN.jsonl`` per-op artifact per round, but
+until now nothing ever READ them as a sequence: BENCH_r05 sat in the
+tree as ``rc: 124, parsed: null`` for a whole round and the only thing
+that noticed was a human.  This tool turns the committed artifacts into
+a machine-readable trend:
+
+* **headline trend** — one row per round (value, MFU, ms/step, rc,
+  degraded), with a verdict against the previous round that HAD a
+  metric: ``ok`` / ``improved`` / ``regression``.  A round with no
+  parsed metric (the r05 shape of failure) is a *regression with
+  reason "missing metric"*, never a crash of this tool.
+* **opperf trend** — per-op avg (and p50/p99 where present, so tail
+  latency trends too) across rounds, with the worst slowdowns and best
+  speedups between the last two rounds summarised.
+
+Exit code: 0 by default (reporting tool); ``--fail-on-regression``
+exits 2 when the LATEST headline round regressed (or lost its metric)
+beyond ``--threshold``, or any op slowed more than the threshold in
+the latest opperf round — the CI gate ``benchdiff_smoke`` runs exactly
+that over the committed artifacts.
+
+Usage::
+
+    python tools/benchdiff.py                      # repo-root defaults
+    python tools/benchdiff.py --bench 'BENCH_r*.json' \
+        --opperf 'OPPERF_r*.jsonl' --threshold 0.15 --json
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _round_of(path):
+    """'BENCH_r05.json' -> 'r05' (None when the name carries no round,
+    e.g. OPPERF_smoke.jsonl)."""
+    m = re.search(r"_r(\d+)\.", os.path.basename(path))
+    return f"r{int(m.group(1)):02d}" if m else None
+
+
+def load_bench(paths):
+    """Parse headline artifacts into ``{round: row}``.
+
+    Accepts both the driver wrapper shape (``{"n", "rc", "parsed"}``)
+    and a bare headline JSON (bench.py's own stdout line, or a partial
+    artifact).  A malformed file becomes a row with ``error`` — the
+    differ reports it, it never crashes on it."""
+    rounds = {}
+    for path in paths:
+        label = _round_of(path) or os.path.basename(path)
+        row = {"file": os.path.basename(path), "value": None,
+               "mfu": None, "ms_per_step": None, "rc": None,
+               "degraded": None, "error": None}
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            row["error"] = f"unreadable: {e}"
+            rounds[label] = row
+            continue
+        if isinstance(doc, dict) and "parsed" in doc:
+            row["rc"] = doc.get("rc")
+            if doc.get("n") is not None:
+                label = f"r{int(doc['n']):02d}"
+            parsed = doc.get("parsed")
+        else:
+            parsed = doc
+        if isinstance(parsed, dict):
+            row["value"] = parsed.get("value")
+            row["mfu"] = parsed.get("mfu")
+            row["ms_per_step"] = parsed.get("ms_per_step")
+            row["degraded"] = parsed.get("degraded")
+        rounds[label] = row
+    return rounds
+
+
+def headline_verdicts(rounds, threshold):
+    """Attach a verdict per round vs the previous round that had a
+    metric.  Missing metric = regression (reason says why), by design:
+    that IS the r05 failure mode this tool exists to flag."""
+    prev_value = None
+    order = sorted(rounds)
+    for label in order:
+        row = rounds[label]
+        v = row["value"]
+        if v is None:
+            rc = row["rc"]
+            reason = "missing metric"
+            if row["error"]:
+                reason += f" ({row['error']})"
+            elif rc not in (0, None):
+                reason += f" (rc={rc})"
+            row["verdict"] = "regression"
+            row["reason"] = reason
+            continue
+        if prev_value is None:
+            row["verdict"] = "baseline"
+            row["reason"] = None
+        else:
+            change = v / prev_value - 1.0
+            row["change"] = round(change, 4)
+            if change < -threshold:
+                row["verdict"] = "regression"
+                row["reason"] = f"{change:+.1%} vs previous metric"
+            elif change > threshold:
+                row["verdict"] = "improved"
+                row["reason"] = f"{change:+.1%} vs previous metric"
+            else:
+                row["verdict"] = "ok"
+                row["reason"] = f"{change:+.1%} vs previous metric"
+        prev_value = v
+    return rounds
+
+
+def load_opperf(paths):
+    """``{round: {op: row}}`` from the per-op JSONL artifacts; rows
+    keep avg and (when the artifact has them) p50/p99."""
+    rounds = {}
+    for path in paths:
+        label = _round_of(path) or \
+            os.path.splitext(os.path.basename(path))[0]
+        ops = {}
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        row = json.loads(line)
+                    except ValueError:
+                        continue
+                    if "op" not in row or "avg_time_ms" not in row:
+                        continue
+                    ops[row["op"]] = {
+                        "avg_ms": row["avg_time_ms"],
+                        "p50_ms": row.get("p50_time_ms"),
+                        "p99_ms": row.get("p99_time_ms"),
+                    }
+        except OSError:
+            continue
+        if ops:
+            rounds[label] = ops
+    return rounds
+
+
+def opperf_diff(rounds, threshold):
+    """Compare the last two opperf rounds: per-op avg ratio (and p99
+    ratio where both rounds have it), split into regressions (slower
+    than 1+threshold) and improvements."""
+    order = sorted(rounds)
+    if len(order) < 2:
+        return {"rounds": order, "regressions": [], "improvements": [],
+                "compared_ops": 0}
+    prev_label, last_label = order[-2], order[-1]
+    prev, last = rounds[prev_label], rounds[last_label]
+    regs, imps = [], []
+    compared = 0
+    for op in sorted(set(prev) & set(last)):
+        a, b = prev[op]["avg_ms"], last[op]["avg_ms"]
+        if not (isinstance(a, (int, float))
+                and isinstance(b, (int, float))) or a <= 0 or b <= 0:
+            continue
+        compared += 1
+        ratio = b / a
+        ent = {"op": op, "prev_ms": a, "last_ms": b,
+               "ratio": round(ratio, 3)}
+        if prev[op].get("p99_ms") and last[op].get("p99_ms"):
+            ent["p99_ratio"] = round(
+                last[op]["p99_ms"] / prev[op]["p99_ms"], 3)
+        if ratio > 1.0 + threshold:
+            regs.append(ent)
+        elif ratio < 1.0 / (1.0 + threshold):
+            imps.append(ent)
+    regs.sort(key=lambda e: e["ratio"], reverse=True)
+    imps.sort(key=lambda e: e["ratio"])
+    return {"rounds": order, "prev": prev_label, "last": last_label,
+            "compared_ops": compared, "regressions": regs,
+            "improvements": imps}
+
+
+def _fmt(v, spec="{:.2f}"):
+    return "-" if v is None else spec.format(v)
+
+
+def render(bench, opperf, threshold):
+    lines = [f"== headline trend (threshold {threshold:.0%}) =="]
+    lines.append(f"{'round':<10s}{'value':>12s}{'mfu':>8s}"
+                 f"{'ms/step':>10s}{'rc':>5s}{'degraded':>10s}"
+                 f"  verdict")
+    for label in sorted(bench):
+        r = bench[label]
+        verdict = r["verdict"]
+        if r.get("reason"):
+            verdict += f": {r['reason']}"
+        lines.append(
+            f"{label:<10s}{_fmt(r['value']):>12s}"
+            f"{_fmt(r['mfu'], '{:.3f}'):>8s}"
+            f"{_fmt(r['ms_per_step']):>10s}"
+            f"{('-' if r['rc'] is None else str(r['rc'])):>5s}"
+            f"{('-' if r['degraded'] is None else str(r['degraded'])):>10s}"
+            f"  {verdict}")
+    if opperf.get("compared_ops"):
+        lines.append("")
+        lines.append(f"== opperf trend {opperf['prev']} -> "
+                     f"{opperf['last']} "
+                     f"({opperf['compared_ops']} ops compared) ==")
+        for title, ents in (("slower", opperf["regressions"][:10]),
+                            ("faster", opperf["improvements"][:10])):
+            if not ents:
+                continue
+            lines.append(f"-- top {title} --")
+            for e in ents:
+                p99 = f" p99x{e['p99_ratio']}" if "p99_ratio" in e \
+                    else ""
+                lines.append(
+                    f"  {e['op']:<40.40s} {e['prev_ms']:>10.4f} -> "
+                    f"{e['last_ms']:>10.4f} ms  x{e['ratio']}{p99}")
+    elif opperf.get("rounds"):
+        lines.append("")
+        lines.append(f"== opperf: {len(opperf['rounds'])} round(s), "
+                     "need 2+ to diff ==")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--bench", default=None,
+                    help="glob of headline artifacts (default "
+                         "BENCH_r*.json in the repo root)")
+    ap.add_argument("--opperf", default=None,
+                    help="glob of per-op artifacts (default "
+                         "OPPERF_r*.jsonl in the repo root)")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="regression threshold as a fraction "
+                         "(default 0.15 = 15%%)")
+    ap.add_argument("--fail-on-regression", action="store_true",
+                    help="exit 2 when the latest headline round "
+                         "regressed/lost its metric, or the latest "
+                         "opperf round has ops slower than the "
+                         "threshold")
+    ap.add_argument("--json", dest="as_json", action="store_true",
+                    help="emit the machine-readable summary instead "
+                         "of the table")
+    args = ap.parse_args(argv)
+
+    bench_glob = args.bench or os.path.join(_REPO, "BENCH_r*.json")
+    opperf_glob = args.opperf or os.path.join(_REPO, "OPPERF_r*.jsonl")
+    bench_paths = sorted(glob.glob(bench_glob))
+    opperf_paths = sorted(glob.glob(opperf_glob))
+    if not bench_paths and not opperf_paths:
+        print(f"benchdiff: no artifacts match {bench_glob!r} or "
+              f"{opperf_glob!r}", file=sys.stderr)
+        return 1
+
+    bench = headline_verdicts(load_bench(bench_paths), args.threshold)
+    opperf = opperf_diff(load_opperf(opperf_paths), args.threshold)
+
+    failures = []
+    if bench:
+        last = sorted(bench)[-1]
+        if bench[last]["verdict"] == "regression":
+            failures.append(f"headline {last}: {bench[last]['reason']}")
+    if opperf.get("regressions"):
+        failures.append(
+            f"opperf {opperf['last']}: {len(opperf['regressions'])} "
+            f"op(s) slower than {1 + args.threshold:.2f}x")
+
+    if args.as_json:
+        print(json.dumps({"headline": bench, "opperf": opperf,
+                          "threshold": args.threshold,
+                          "failures": failures}))
+    else:
+        print(render(bench, opperf, args.threshold))
+        if failures:
+            print("\nREGRESSIONS:\n" + "\n".join(
+                f"  {f}" for f in failures))
+
+    if args.fail_on_regression and failures:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
