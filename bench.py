@@ -879,6 +879,17 @@ def main(argv=None):
     import mxnet_tpu  # noqa: F401  (registers ops; timed by heartbeat)
     from mxnet_tpu.config import setup_compilation_cache
 
+    if partial is not None:
+        # a faultsim `crash` action os._exit()s between its flight dump
+        # and any pending partial rewrite — register the partial
+        # flusher on the crash path so a faultsim-killed run (the
+        # multiprocess resize-drill children included) still leaves a
+        # parseable phase-level artifact
+        from mxnet_tpu.resilience import faultsim as _fsim
+
+        _fsim.on_crash(lambda: _write_partial(
+            None, extra={"fault_crash": True}))
+
     import jax
 
     # hang watchdog: armed BEFORE the first device_put/trace — the

@@ -118,8 +118,28 @@ collectives_budget() {
     # compiled step's HLO (vs one all-reduce per tensor replicated,
     # 54+ launches in the r05 artifact).  A bucketing regression fails
     # this cell on the CPU mesh before it ever reaches a pod.
-    JAX_PLATFORMS=cpu MXNET_DRYRUN_SCALING=0 MXNET_DRYRUN_CASES=dp \
+    # dp_elastic (round 12) adds the reshard-plan verdict: a resume at
+    # 16 -> 8 shards must re-plan (old plan != new plan) while both
+    # plans honor the budget, and a same-N resume must be a no-op.
+    JAX_PLATFORMS=cpu MXNET_DRYRUN_SCALING=0 \
+    MXNET_DRYRUN_CASES=dp,dp_elastic \
         python -c "import __graft_entry__ as g; g.dryrun_multichip(16)"
+}
+
+elastic_smoke() {
+    # elastic scale-out gate (round 12): the tier-1 half runs the
+    # single-host resize drill — train dp(4) under optimizer sharding,
+    # SIGTERM-drain mid-epoch, resume the SAME checkpoint at dp(2)
+    # AND dp(8): both re-plan buckets, re-shard adam state (per-chip
+    # state bytes ~ total/N at the new N), continue from the exact
+    # batch cursor and match the uninterrupted run; plus the topology/
+    # cursor-reslice/fallback-telemetry/crash-hook units.  The `slow`
+    # half is the REAL 2-process jax.distributed drill (gloo CPU
+    # collectives): elastic_init with an injected dist.init flake
+    # (retried), a cross-process sharded step with a dist.collective
+    # delay, SIGTERM drain on both ranks, relaunch at 1 process with a
+    # reshard — excluded from tier-1 by the marker, run here.
+    JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q
 }
 
 "$@"

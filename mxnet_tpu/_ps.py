@@ -797,7 +797,11 @@ class PSBackend:
                 retry_on=(ConnectionError, EOFError, OSError,
                           faultsim.FaultInjected),
                 attempts=3, base_delay=0.05, max_delay=1.0,
-                deadline=time.monotonic() + _deadline_sec(),
+                # the TOTAL retry budget is the PS deadline: attempts
+                # alone could overshoot it once backoff grows, and a
+                # client stuck retrying past the server's own wait
+                # deadline is just a slower failure
+                deadline_sec=_deadline_sec(),
                 on_retry=on_retry)
         except faultsim.FaultInjected:
             raise  # exhausted injected faults stay injected faults

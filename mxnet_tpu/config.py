@@ -230,6 +230,35 @@ register_env("MXNET_METRICS_TEXTFILE", "", str,
              "collector convention): telemetry counters + last "
              "throughput/loss, atomically rewritten on every sampled "
              "step.  Empty = off.")
+register_env("MXNET_ELASTIC", False, bool,
+             "Elastic multi-host runtime (resilience.elastic): arms "
+             "runtime.init_distributed()/elastic_init() multi-process "
+             "bring-up over jax.distributed, dp x tp meshes spanning "
+             "hosts, topology-stamped checkpoints, and reshard-on-"
+             "resize resume — a job resumed at a different world size "
+             "re-plans buckets and re-shards optimizer state instead "
+             "of dying.")
+register_env("MXNET_COORDINATOR", "", str,
+             "jax.distributed coordinator address as host:port "
+             "(process 0 binds it).  Empty falls back to the DMLC_* "
+             "launcher contract (DMLC_PS_ROOT_URI:DMLC_PS_ROOT_PORT "
+             "when DMLC_NUM_WORKER > 1); unresolvable = single-process "
+             "bring-up.")
+register_env("MXNET_NUM_PROCESSES", 0, int,
+             "Process count of the elastic job (0 = fall back to "
+             "DMLC_NUM_WORKER, then single-process).")
+register_env("MXNET_PROCESS_ID", -1, int,
+             "This process's id in the elastic job (-1 = fall back to "
+             "DMLC_WORKER_ID).")
+register_env("MXNET_DIST_INIT_ATTEMPTS", 4, int,
+             "Bounded-retry attempts around jax.distributed.initialize "
+             "in elastic_init (backoff + jitter via resilience.retry; "
+             "the dist.init fault point fires inside every attempt).")
+register_env("MXNET_DIST_INIT_TIMEOUT_SEC", 120.0, float,
+             "Total time budget (seconds) for elastic_init's "
+             "initialize retry loop — the deadline_sec cap, so attempt "
+             "counts cannot overshoot the bring-up SLA once backoff "
+             "grows.")
 register_env("DMLC_NUM_WORKER", 1, int,
              "Distributed worker count (tools/launch.py contract).")
 register_env("DMLC_WORKER_ID", 0, int, "This worker's rank.")

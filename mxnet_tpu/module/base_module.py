@@ -179,6 +179,42 @@ class BaseModule:
 
         resume_cursor = 0
         if resume_state is not None:
+            resume_cursor = int(resume_state.get("batch_cursor", 0))
+            # elastic resume: compare the checkpoint's topology stamp
+            # with the live world BEFORE any state lands.  A changed
+            # world size/bucket plan is a RESHARD (init_optimizer
+            # already re-ran plan_buckets for the new shard count;
+            # set_states below re-shards the gathered legacy pickle
+            # onto it) — logged and counted, never a death.  A
+            # same-topology resume is a verdict-level no-op.  The
+            # batch cursor re-slices across the new data-mesh width
+            # (global-batch units), raising only when the global
+            # batch itself changed.
+            old_topo = resume_state.get("topology")
+            if old_topo:
+                from .. import telemetry as _tm0
+                from ..resilience import elastic as _elastic
+
+                new_topo = self._topology_block()
+                verdict = _elastic.reshard_verdict(old_topo, new_topo)
+                resume_cursor = _elastic.reslice_cursor(
+                    resume_cursor, old_topo, new_topo)
+                if verdict["reshard"]:
+                    self.logger.info(
+                        "Elastic resume: topology changed (%s) — "
+                        "re-planned buckets and re-sharding optimizer "
+                        "state for the new world",
+                        "; ".join(verdict["reasons"]))
+                    _tm0.count("reshards")
+                    _tm0.event("resize",
+                               old_world=verdict["old_world"],
+                               new_world=verdict["new_world"],
+                               reasons=verdict["reasons"],
+                               batch_cursor=resume_cursor)
+                else:
+                    self.logger.info(
+                        "Elastic resume: topology unchanged (world "
+                        "%s) — no reshard", verdict["new_world"])
             states = resume_state.get("optimizer_states")
             if states:
                 set_states = getattr(self, "_set_optimizer_states",
@@ -186,7 +222,6 @@ class BaseModule:
                 if set_states is not None:
                     set_states(states)
             restore_rng(resume_state.get("rng"))
-            resume_cursor = int(resume_state.get("batch_cursor", 0))
 
         if validation_metric is None:
             validation_metric = eval_metric
@@ -295,7 +330,15 @@ class BaseModule:
         version = max(existing) + 1 if existing else max(1, int(epoch))
         ckpt_mgr.save(version, symbol=self._symbol, arg_params=arg_p,
                       aux_params=aux_p, optimizer_states=states,
-                      batch_cursor=batch_cursor, epoch=epoch)
+                      batch_cursor=batch_cursor, epoch=epoch,
+                      topology=self._topology_block())
+
+    def _topology_block(self):
+        """The world stamp for this module's checkpoints
+        (``resilience.elastic.topology_block``); subclasses with a
+        mesh/sharded updater override with the real thing.  None keeps
+        pre-elastic manifests byte-compatible."""
+        return None
 
     def _emit_tensor_stats(self, step, epoch, bad_step):
         """Numerics-monitor emission for the eager executor path: one

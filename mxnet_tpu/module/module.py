@@ -439,6 +439,33 @@ class Module(BaseModule):
         return {n: self._exec.grad_dict[n]
                 for n in self._update_param_names()}
 
+    def _topology_block(self):
+        """The world this module trains in, for the checkpoint
+        manifest's ``topology`` stamp: data-mesh width, process count,
+        optimizer-sharding mode, the live bucket-plan fingerprint and
+        the GLOBAL batch (local batch x process count — the unit the
+        resume cursor is kept in, so it re-slices across any world)."""
+        from ..parallel.zero import ShardedBucketUpdater
+        from ..resilience import elastic
+
+        upd = self._updater if isinstance(
+            self._updater, ShardedBucketUpdater) else None
+        global_batch = None
+        try:
+            local_b = int(
+                self._exec.arg_dict[self._data_names[0]].shape[0])
+            ctx = elastic.context()
+            global_batch = local_b * (ctx.num_processes
+                                      if ctx is not None else 1)
+        except Exception:
+            pass
+        return elastic.topology_block(
+            world_size=upd.n_shards if upd is not None else None,
+            mesh=self._mesh,
+            sharding="ps" if upd is not None else "none",
+            plan=upd.plan if upd is not None else None,
+            global_batch=global_batch)
+
     # optimizer-state hooks for fit's checkpoint/resume plumbing
     def _get_optimizer_states(self):
         if self._updater is None:

@@ -45,7 +45,8 @@ from ..base import MXNetError
 
 __all__ = ["Bucket", "plan_buckets", "flatten_bucket", "unflatten_bucket",
            "bucket_segments", "shard_slice", "collective_bytes",
-           "resolve_sharding_env", "ShardedBucketUpdater"]
+           "resolve_sharding_env", "plan_fingerprint",
+           "ShardedBucketUpdater"]
 
 
 # ------------------------------------------------------------ bucket plan
@@ -212,6 +213,24 @@ def gather_bucket(bucket, w_sh, axis):
 
     return unflatten_bucket(
         bucket, jax.lax.all_gather(w_sh, axis, tiled=True))
+
+
+def plan_fingerprint(plan, n_shards):
+    """Stable fingerprint of a bucket plan AT a shard count — the
+    checkpoint manifest's ``topology.plan_fingerprint`` (resilience.
+    elastic).  Two runs share a fingerprint iff their flat layouts are
+    interchangeable: same buckets in the same order with the same
+    member names/shapes/dtypes/padding, sharded the same number of
+    ways.  A resume whose fingerprint differs must re-plan + re-shard;
+    one whose fingerprint matches is a same-topology no-op."""
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(f"shards={int(n_shards)}".encode())
+    for b in plan:
+        h.update(repr((b.dtype, b.names, b.shapes, b.offsets,
+                       b.size, b.padded, b.group)).encode())
+    return h.hexdigest()[:16]
 
 
 def resolve_sharding_env():
@@ -551,6 +570,13 @@ class ShardedBucketUpdater:
         grads = {n: trip[n][0]._data for n in plan_names}
         weights = {n: trip[n][1] for n in plan_names}
         params = {n: weights[n]._data for n in plan_names}
+        # mid-step collective loss (resilience.faultsim dist.collective):
+        # fires BEFORE the jitted exchange, so an armed raise surfaces
+        # as a failed step with the donated state buffers still intact
+        # — the drain checkpoint that follows stays writable
+        from ..resilience import faultsim
+
+        faultsim.inject("dist.collective")
         try:
             new_p, self._states = self._fn(params, grads,
                                            self._states,
@@ -576,6 +602,14 @@ class ShardedBucketUpdater:
             self._t, int(getattr(self.optimizer, "num_update", 0)))
         for n, w in weights.items():
             w._adopt(new_p[n])
+
+    def topology(self):
+        """This updater's contribution to the checkpoint ``topology``
+        block: shard count, bucket-plan fingerprint, bucket count."""
+        return {"world_size": self.n_shards,
+                "plan_fingerprint": plan_fingerprint(self.plan,
+                                                     self.n_shards),
+                "n_buckets": len(self.plan)}
 
     # --------------------------------------- checkpoint (legacy layout)
     def _gather_per_param(self):

@@ -11,21 +11,25 @@ shard-restart recovery already proven in ``mxnet_tpu._ps``:
   (``MXNET_FAULT_SPEC``).
 * :mod:`.retry` — the shared bounded exponential-backoff-with-jitter
   helper (device-feed producer, PS client ops).
+* :mod:`.elastic` — multi-host bring-up (``jax.distributed`` with a
+  bounded-retry barrier), topology-stamped checkpoints and the
+  reshard-on-resize verdict: losing k hosts is a reshard, not a
+  restart.
 
 ``faultsim``/``retry`` are import-light (hot paths import them);
-``checkpoint``/``preempt`` load lazily because they pull in the
-ndarray stack.
+``checkpoint``/``preempt``/``elastic`` load lazily because they pull
+in the ndarray/jax stack.
 """
 from . import faultsim  # noqa: F401
 from .retry import retry_call  # noqa: F401
 
 __all__ = ["faultsim", "retry_call", "checkpoint", "preempt",
-           "CheckpointManager", "PreemptionDrain", "atomic_write_bytes",
-           "restore_rng"]
+           "elastic", "CheckpointManager", "PreemptionDrain",
+           "atomic_write_bytes", "restore_rng"]
 
 
 def __getattr__(name):
-    if name in ("checkpoint", "preempt"):
+    if name in ("checkpoint", "preempt", "elastic"):
         import importlib
 
         mod = importlib.import_module("." + name, __name__)

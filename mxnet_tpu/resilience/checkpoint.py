@@ -157,10 +157,14 @@ def _as_nd(v):
             and len(data.devices()) > 1:
         # mesh-backed array (replicated module weights, or a ZeRO
         # bucket shard under optimizer_sharding="ps"): GATHER to one
-        # host copy here, so what lands on disk is the legacy
-        # single-array layout and never aliases a device buffer a
-        # donating step may consume mid-save
-        return nd.array(onp.asarray(data))
+        # host copy here — via host_gather, which also handles arrays
+        # spanning PROCESSES on a real multi-host mesh — so what lands
+        # on disk is the legacy world-size-agnostic single-array
+        # layout and never aliases a device buffer a donating step may
+        # consume mid-save
+        from .elastic import host_gather
+
+        return nd.array(host_gather(data))
     return v if isinstance(v, nd.NDArray) else nd.array(onp.asarray(v))
 
 
@@ -228,7 +232,7 @@ class CheckpointManager:
     # ------------------------------------------------------------- save
     def save(self, version, symbol=None, arg_params=None,
              aux_params=None, optimizer_states=None, step=None,
-             batch_cursor=0, extra=None, epoch=None):
+             batch_cursor=0, extra=None, epoch=None, topology=None):
         """Write one atomic checkpoint version; returns its manifest.
 
         ``version`` names the files (``prefix-NNNN.*``); ``epoch`` is
@@ -239,6 +243,13 @@ class CheckpointManager:
         ``batch_cursor`` records how many batches of that epoch were
         already consumed (0 = a clean epoch boundary) — the resume
         cursor for mid-epoch preemption drains.
+
+        ``topology`` (``resilience.elastic.topology_block``) stamps
+        the world the checkpoint was written FROM — world size, mesh
+        shape, optimizer-sharding mode, bucket-plan fingerprint,
+        global batch — so a resume at a different world size can
+        detect the mismatch and re-plan/re-shard instead of dying,
+        while a same-topology resume provably skips the reshard.
         """
         t_save0 = time.perf_counter()
         version = int(version)
@@ -275,6 +286,7 @@ class CheckpointManager:
             "files": files,
             "rng": capture_rng(),
             "autotune_sha256": _autotune_hash(),
+            "topology": topology,
             "time": time.time(),
             "extra": extra or {},
         }
@@ -399,7 +411,9 @@ class CheckpointManager:
         training epoch from the manifest — diverges from the version
         after mid-epoch drains), ``step``, ``batch_cursor``,
         ``arg_params``, ``aux_params`` (NDArray dicts),
-        ``optimizer_states`` (bytes or None), ``rng`` and ``extra``.
+        ``optimizer_states`` (bytes or None), ``rng``, ``topology``
+        (the world stamp, or None for pre-elastic files) and
+        ``extra``.
         """
         from .. import ndarray as nd
 
@@ -407,16 +421,34 @@ class CheckpointManager:
         if epoch is None:
             # newest-good fallback, ONE read per candidate: the blobs
             # that verified are the blobs that get decoded
+            t_load0 = time.perf_counter()
+            skipped = []
             for cand in self._latest_candidates():
                 try:
                     man, blobs = self._read_verified(cand)
                     epoch = cand
                     break
                 except (OSError, ValueError, KeyError, MXNetError):
+                    skipped.append(int(cand))
                     continue
             if epoch is None:
                 raise MXNetError(
                     f"no verifiable checkpoint under {self.prefix!r}")
+            if skipped:
+                # the recovery was SILENT before: an operator whose
+                # newest checkpoint is rotting learned it only when the
+                # loss curve jumped back.  Emit a schema-valid
+                # checkpoint record naming the skipped bad versions and
+                # bump the ckpt_fallbacks counter (exported to the
+                # Prometheus textfile) so the rot pages someone.
+                from .. import telemetry
+
+                telemetry.count("ckpt_fallbacks")
+                telemetry.checkpoint_event(
+                    self.prefix, epoch,
+                    time.perf_counter() - t_load0,
+                    sum(len(b) for b in blobs.values()),
+                    reason="fallback", skipped_versions=skipped)
         else:
             epoch = int(epoch)
             if self.has_manifest(epoch):
@@ -452,6 +484,7 @@ class CheckpointManager:
             "optimizer_states": states,
             "rng": man.get("rng"),
             "autotune_sha256": man.get("autotune_sha256"),
+            "topology": man.get("topology"),
             "extra": man.get("extra", {}),
         }
 

@@ -19,6 +19,13 @@ Points wired in-tree:
 ``bench.stall``  bench.py after the measure phase (a ``delay`` here
                  wedges the harness with NO heartbeats — the watchdog
                  stall-path test point)
+``dist.init``   resilience/elastic.py, inside every
+                ``jax.distributed.initialize`` attempt (a ``raise``
+                exercises the bring-up retry loop end-to-end)
+``dist.collective``  elastic's bring-up barrier + the sharded
+                optimizer exchange (ShardedBucketUpdater.update_all),
+                BEFORE the jitted collective program — the mid-step
+                collective-loss simulation for resize drills
 ==============  =======================================================
 
 Spec grammar (env ``MXNET_FAULT_SPEC`` or ``faultsim.reset(spec)``)::
@@ -51,7 +58,7 @@ import time
 from ..base import MXNetError
 
 __all__ = ["FaultInjected", "inject", "reset", "hits", "armed",
-           "CRASH_EXIT_CODE"]
+           "on_crash", "CRASH_EXIT_CODE"]
 
 #: exit status of an armed ``crash`` action — distinguishable from a
 #: real signal kill in subprocess tests
@@ -83,6 +90,24 @@ class _Rule:
 _LOCK = threading.Lock()
 # spec None = not yet armed (first inject() reads MXNET_FAULT_SPEC)
 _STATE = {"spec": None, "rules": {}, "hits": {}}
+
+#: callbacks run on the ``crash`` path between the flight dump and
+#: ``os._exit`` — ``os._exit`` skips atexit AND every other thread's
+#: pending work, so state that must survive the simulated power loss
+#: (bench.py's partial headline JSON, armed from the main thread while
+#: the crash can fire on any thread) registers a flusher here
+_CRASH_HOOKS = []
+
+
+def on_crash(fn):
+    """Register ``fn()`` to run right before a ``crash`` action's
+    ``os._exit`` (after the flight dump).  Hooks must be fast and
+    exception-safe conceptually; any raise is swallowed — the crash
+    must fire even if a hook is broken.  Returns ``fn`` so it can be
+    used as a decorator."""
+    if fn not in _CRASH_HOOKS:
+        _CRASH_HOOKS.append(fn)
+    return fn
 
 
 def _parse(spec):
@@ -197,6 +222,15 @@ def inject(point):
     except Exception:
         pass  # the harness must fire even if telemetry is broken
     if rule.action == "crash":
+        # last-gasp flushers (bench partial JSON, ...): os._exit gives
+        # no other thread a chance to finish a pending write, so
+        # whatever must be parseable after the "power loss" flushes
+        # here, synchronously, on the crashing thread
+        for hook in list(_CRASH_HOOKS):
+            try:
+                hook()
+            except Exception:
+                pass
         os._exit(CRASH_EXIT_CODE)
     if rule.action == "raise":
         raise FaultInjected(point, n)

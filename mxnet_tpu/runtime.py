@@ -1,16 +1,50 @@
-"""Runtime feature detection (reference python/mxnet/runtime.py:28-90).
+"""Runtime feature detection + multi-process bring-up.
 
 The reference surfaces compile-time build flags (CUDA/CUDNN/NCCL/
 DIST_KVSTORE/..., include/mxnet/libinfo.h:141-190) through
-`mx.runtime.feature_list()`.  The TPU build has no compile-time matrix —
-capabilities are determined by the live JAX install — so features are
-probed at call time instead of baked in.
+`mx.runtime.feature_list()` (reference python/mxnet/runtime.py:28-90).
+The TPU build has no compile-time matrix — capabilities are determined
+by the live JAX install — so features are probed at call time instead
+of baked in.
+
+This module is also the front door for the elastic multi-process
+runtime: :func:`init_distributed` runs the ``resilience.elastic``
+bring-up (``jax.distributed.initialize`` with a bounded-retry barrier)
+when ``MXNET_ELASTIC``/the launcher env asks for it, and
+:func:`distributed_info` reports the live world.  Callers that never
+opt in pay nothing — the single-process path returns a local context
+without touching ``jax.distributed``.
 """
 from __future__ import annotations
 
 import collections
 
-__all__ = ["Feature", "feature_list", "Features"]
+__all__ = ["Feature", "feature_list", "Features", "init_distributed",
+           "distributed_info"]
+
+
+def init_distributed(coordinator=None, num_processes=None,
+                     process_id=None, **kw):
+    """Multi-process bring-up (idempotent).  Resolves the coordinator
+    and process identity from args > ``MXNET_COORDINATOR`` /
+    ``MXNET_NUM_PROCESSES`` / ``MXNET_PROCESS_ID`` > the ``DMLC_*``
+    launcher contract, retries ``jax.distributed.initialize`` with
+    backoff, proves the collective mesh with a barrier, and returns
+    the :class:`~mxnet_tpu.resilience.elastic.ElasticContext`.  Must
+    run BEFORE the first jax backend touch in a distributed job."""
+    from .resilience import elastic
+
+    return elastic.elastic_init(coordinator=coordinator,
+                                num_processes=num_processes,
+                                process_id=process_id, **kw)
+
+
+def distributed_info():
+    """The live elastic context, or None before ``init_distributed``
+    (single-process jobs get a local context once initialized)."""
+    from .resilience import elastic
+
+    return elastic.context()
 
 
 class Feature:
@@ -60,6 +94,15 @@ def _probe():
     except Exception:
         add("PALLAS", False)
     add("DIST_KVSTORE", True)  # jax.distributed (kvstore dist modes)
+    try:
+        from .resilience import elastic
+
+        # enabled = the env asks for multi-process bring-up;
+        # initialized state is reported separately below
+        add("ELASTIC", elastic.elastic_enabled()
+            or elastic.initialized())
+    except Exception:
+        add("ELASTIC", False)
     add("F16C", True)
     add("SIGNAL_HANDLER", False)
     add("PROFILER", True)

@@ -129,7 +129,9 @@ class RunLog:
         self.counters = {"steps": 0, "bad_steps": 0, "ps_retries": 0,
                          "faults": 0, "compiles": 0, "checkpoints": 0,
                          "h2d_bytes": 0, "feed_wait_s": 0.0,
-                         "preempt_signals": 0, "watchdog_stalls": 0}
+                         "preempt_signals": 0, "watchdog_stalls": 0,
+                         "ckpt_fallbacks": 0, "reshards": 0,
+                         "dist_init_retries": 0}
         self._fps = {}          # program -> last compile fingerprint
         self._programs = {}     # program -> last program_report body
         self._last_program = None
@@ -367,12 +369,19 @@ class RunLog:
         return body
 
     # ------------------------------------------------------ checkpoint
-    def checkpoint_event(self, prefix, version, duration_s, nbytes):
-        self.counters["checkpoints"] += 1
+    def checkpoint_event(self, prefix, version, duration_s, nbytes,
+                         **extra):
+        """One checkpoint write (or recovery — ``reason='fallback'``
+        with the skipped bad versions rides in ``extra``).  A fallback
+        is a recovery READ: it counts only ``ckpt_fallbacks`` (bumped
+        by the caller), never the ``checkpoints`` write counter the
+        step records carry."""
+        if extra.get("reason") != "fallback":
+            self.counters["checkpoints"] += 1
         self._write({"type": "checkpoint", "t": round(self._now(), 6),
                      "prefix": str(prefix), "version": int(version),
                      "duration_s": round(float(duration_s), 6),
-                     "bytes": int(nbytes)})
+                     "bytes": int(nbytes), **_jsonable(extra)})
         from .. import profiler
 
         if profiler.is_running():
@@ -596,10 +605,11 @@ def count(counter, delta=1):
         rl.count(counter, delta)
 
 
-def checkpoint_event(prefix, version, duration_s, nbytes):
+def checkpoint_event(prefix, version, duration_s, nbytes, **extra):
     rl = current()
     if rl is not None:
-        rl.checkpoint_event(prefix, version, duration_s, nbytes)
+        rl.checkpoint_event(prefix, version, duration_s, nbytes,
+                            **extra)
 
 
 def program_report(program, **kw):

@@ -1,0 +1,340 @@
+"""Worker for the 2-process elastic resize drill (test_elastic.py).
+
+Modes (argv[1]):
+
+* ``train <coordinator> <pid> <nprocs> <prefix>`` — distributed phase:
+  ``elastic_init`` over a real 2-process ``jax.distributed`` CPU mesh
+  (an armed ``dist.init:raise@1`` fault is retried; a
+  ``dist.collective`` delay fires mid-run), train DRAIN_AT steps of a
+  sharded-optimizer-state step built from the ``parallel.zero``
+  helpers, then every rank SIGTERMs itself at the same step boundary:
+  the PreemptionDrain converts it to a cooperative drain, the ranks
+  jointly gather the sharded state (``host_gather`` is a collective),
+  rank 0 writes the topology-stamped checkpoint, and both re-raise —
+  exiting with the signal's disposition (rc -15), exactly the
+  orchestrator contract.
+* ``resume <prefix>`` — single-process relaunch at world size 1
+  (N-k): detects the topology mismatch, RE-PLANS the buckets at 1
+  shard, re-shards the optimizer state, continues from the exact
+  cursor, prints the final params as JSON.
+* ``reference`` — single-process uninterrupted run of all TOTAL_STEPS,
+  prints the final params as JSON (the allclose oracle).
+
+The model/data are deterministic pure functions of the step index, so
+every world size consumes the SAME global batch sequence.
+"""
+import json
+import os
+import pickle
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp  # noqa: E402
+
+TOTAL_STEPS = 6
+DRAIN_AT = 3          # steps completed before the SIGTERM drain
+GLOBAL_BATCH = 8
+DIM_IN, DIM_OUT = 6, 4
+
+
+def _init_params():
+    rng = onp.random.RandomState(3)
+    return {"w": (rng.randn(DIM_IN, DIM_OUT) * 0.1).astype("float32"),
+            "b": onp.zeros((DIM_OUT,), "float32")}
+
+
+def _global_batch(t):
+    rng = onp.random.RandomState(100 + t)
+    x = rng.randn(GLOBAL_BATCH, DIM_IN).astype("float32")
+    y = rng.randn(GLOBAL_BATCH, DIM_OUT).astype("float32")
+    return x, y
+
+
+def _build_step(mesh, plan, opt, n_shards):
+    """One jitted sharded-optimizer step over ``mesh``: per-shard loss
+    grads psum to the full-batch mean, each bucket's gradient slice
+    updates only the locally-owned shard (``zero.bucket_shard_update``)
+    and the params all-gather back — the ZeRO-1 exchange, spanning
+    processes when the mesh does."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from mxnet_tpu.parallel import compat_shard_map
+    from mxnet_tpu.parallel.zero import (bucket_shard_update,
+                                         flatten_bucket, gather_bucket,
+                                         shard_slice)
+
+    def local(params, states, x_sh, y_sh, t):
+        idx = jax.lax.axis_index("data")
+
+        def loss_fn(p):
+            pred = x_sh @ p["w"] + p["b"]
+            return jnp.sum((pred - y_sh) ** 2) / GLOBAL_BATCH
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        loss = jax.lax.psum(loss, "data")
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, "data"), grads)
+        new_p, new_s = {}, []
+        for i, b in enumerate(plan):
+            g_sh = shard_slice(flatten_bucket(b, grads), n_shards, idx)
+            _, uw, us = bucket_shard_update(
+                b, opt, params, g_sh, states[i], t,
+                n_shards=n_shards, idx=idx, axis="data")
+            new_p.update(gather_bucket(b, uw, "data"))
+            new_s.append(us)
+        return loss, new_p, new_s
+
+    s_specs = [tuple(P("data") if getattr(s, "ndim", 0) else P()
+                     for s in st) for st in _fused_states(plan, opt)]
+    mapped = compat_shard_map(
+        local, mesh,
+        in_specs=({"w": P(), "b": P()}, s_specs, P("data"), P("data"),
+                  P()),
+        out_specs=(P(), {"w": P(), "b": P()}, s_specs))
+    return jax.jit(mapped)
+
+
+def _fused_states(plan, opt):
+    from mxnet_tpu.parallel.zero import flatten_bucket
+
+    params = _init_params()
+    return [opt.fused_state(flatten_bucket(
+        b, {n: params[n] for n in b.names})) for b in plan]
+
+
+def _place(mesh, params, per_param_states, plan):
+    """Device placement: params replicated, states sharded over 'data'
+    — built per-process with make_array_from_callback so the same code
+    places single- and multi-process meshes."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mxnet_tpu.parallel.zero import flatten_bucket
+
+    repl = NamedSharding(mesh, P())
+    shrd = NamedSharding(mesh, P("data"))
+
+    def put(host, sh):
+        host = onp.asarray(host)
+        return jax.make_array_from_callback(
+            host.shape, sh, lambda idx: host[idx])
+
+    p = {k: put(v, repl) for k, v in params.items()}
+    states = []
+    for b in plan:
+        ref = per_param_states[b.names[0]]
+        flat = []
+        for li in range(len(ref)):
+            if getattr(onp.asarray(ref[li]), "ndim", 0):
+                tree = {n: jnp.asarray(onp.asarray(
+                    per_param_states[n][li])) for n in b.names}
+                flat.append(put(flatten_bucket(b, tree), shrd))
+            else:
+                flat.append(put(ref[li], repl))
+        states.append(tuple(flat))
+    return p, states
+
+
+def _feed(mesh, t):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x, y = _global_batch(t)
+    sh = NamedSharding(mesh, P("data"))
+
+    def put(host):
+        return jax.make_array_from_callback(
+            host.shape, sh, lambda idx: host[idx])
+
+    return put(x), put(y)
+
+
+def _opt():
+    import mxnet_tpu as mx
+
+    return mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9,
+                               rescale_grad=1.0)
+
+
+def _gather_now(mesh, n_shards, p_dev, s_dev, plan):
+    from mxnet_tpu.resilience.elastic import host_gather
+
+    params_host = {k: host_gather(v) for k, v in p_dev.items()}
+    per_param = {}
+    for b, st in zip(plan, s_dev):
+        leaves = [host_gather(s) for s in st]
+        for name, shape, off in zip(b.names, b.shapes, b.offsets):
+            n = 1
+            for d in shape:
+                n *= int(d)
+            per_param[name] = tuple(
+                x[off:off + n].reshape(shape)
+                if getattr(x, "ndim", 0) else x for x in leaves)
+    return params_host, per_param
+
+
+def _train_loop(mesh, n_shards, params, per_param_states, start_step,
+                steps, drain=None, collective_point=False):
+    """Plain (non-generator) loop so the drain can break at a step
+    boundary and still gather jointly on every rank."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel.zero import plan_buckets
+    from mxnet_tpu.resilience import faultsim
+
+    opt = _opt()
+    plan = plan_buckets(params, n_shards)
+    if per_param_states is None:
+        st = _fused_states(plan, opt)
+        per_param_states = {}
+        for b, s in zip(plan, st):
+            for name, shape, off in zip(b.names, b.shapes, b.offsets):
+                n = 1
+                for d in shape:
+                    n *= int(d)
+                per_param_states[name] = tuple(
+                    onp.asarray(x)[off:off + n].reshape(shape)
+                    if getattr(x, "ndim", 0) else onp.asarray(x)
+                    for x in s)
+    step_fn = _build_step(mesh, plan, opt, n_shards)
+    p_dev, s_dev = _place(mesh, params, per_param_states, plan)
+    done = 0
+    for k in range(steps):
+        t = start_step + k
+        if collective_point:
+            faultsim.inject("dist.collective")
+        x, y = _feed(mesh, t)
+        loss, p_dev, s_dev = step_fn(p_dev, s_dev, x, y,
+                                     jnp.float32(t + 1))
+        done += 1
+        print(f"step {t} loss={float(onp.asarray(loss.addressable_data(0)).reshape(-1)[0]):.6f}",
+              flush=True)
+        if drain is not None and done >= DRAIN_AT:
+            # simulated preemption: every rank kills itself at the SAME
+            # step boundary, so the joint gather below never leaves a
+            # peer hanging in a collective
+            os.kill(os.getpid(), signal.SIGTERM)
+        if drain is not None and drain.requested is not None:
+            break
+    params_host, per_param = _gather_now(mesh, n_shards, p_dev, s_dev,
+                                         plan)
+    return params_host, per_param, plan, start_step + done
+
+
+def _save_ckpt(prefix, mesh, n_shards, params_host, per_param, plan,
+               cursor):
+    import mxnet_tpu as mx
+    from mxnet_tpu.resilience.checkpoint import CheckpointManager
+    from mxnet_tpu.resilience.elastic import topology_block
+
+    states = pickle.dumps({
+        name: tuple(mx.nd.array(leaf) for leaf in leaves)
+        for name, leaves in per_param.items()})
+    topo = topology_block(mesh=mesh, sharding="ps", plan=plan,
+                          global_batch=GLOBAL_BATCH)
+    CheckpointManager(prefix).save(
+        1, arg_params={k: mx.nd.array(v)
+                       for k, v in params_host.items()},
+        optimizer_states=states, batch_cursor=cursor, topology=topo)
+
+
+def main():
+    mode = sys.argv[1]
+    if mode == "train":
+        coordinator, pid, nprocs, prefix = (
+            sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+            sys.argv[5])
+        from mxnet_tpu.resilience import elastic, faultsim
+        from mxnet_tpu.resilience.preempt import PreemptionDrain
+
+        ctx = elastic.elastic_init(coordinator=coordinator,
+                                   num_processes=nprocs,
+                                   process_id=pid)
+        # the armed dist.init:raise@1 flake must have been RETRIED
+        # (hit 1 raised, hit 2 initialized)
+        if faultsim.armed("dist.init"):
+            assert faultsim.hits("dist.init") >= 2, \
+                faultsim.hits("dist.init")
+            print(f"[{pid}] dist.init flake retried "
+                  f"(hits={faultsim.hits('dist.init')})", flush=True)
+        n_shards = ctx.world_devices
+        mesh = elastic.elastic_mesh()
+        print(f"[{pid}] elastic up: world={n_shards} "
+              f"procs={ctx.num_processes}", flush=True)
+        drain = PreemptionDrain()
+        with drain:
+            params_host, per_param, plan, cursor = _train_loop(
+                mesh, n_shards, _init_params(), None, 0, TOTAL_STEPS,
+                drain=drain, collective_point=True)
+        assert drain.requested == signal.SIGTERM
+        assert cursor == DRAIN_AT, cursor
+        if pid == 0:
+            _save_ckpt(prefix, mesh, n_shards, params_host, per_param,
+                       plan, cursor)
+            print(f"[{pid}] drain checkpoint at cursor {cursor}",
+                  flush=True)
+        print(f"[{pid}] draining", flush=True)
+        drain.reraise()  # exits with SIGTERM's disposition (rc -15)
+        raise AssertionError("unreachable after reraise")
+    if mode == "resume":
+        prefix = sys.argv[2]
+        from mxnet_tpu.parallel.zero import plan_buckets
+        from mxnet_tpu.resilience import elastic
+        from mxnet_tpu.resilience.checkpoint import CheckpointManager
+        from mxnet_tpu.resilience.elastic import (reshard_verdict,
+                                                  reslice_cursor,
+                                                  topology_block)
+
+        elastic.elastic_init()  # single-process bring-up
+        st = CheckpointManager(prefix).load()
+        mesh = elastic.elastic_mesh()
+        n_shards = int(mesh.shape["data"])
+        params = {k: v.asnumpy()
+                  for k, v in st["arg_params"].items()}
+        new_topo = topology_block(
+            mesh=mesh, sharding="ps",
+            plan=plan_buckets(params, n_shards),
+            global_batch=GLOBAL_BATCH)
+        verdict = reshard_verdict(st["topology"], new_topo)
+        assert verdict["reshard"], verdict  # 2 shards -> 1: reshard
+        cursor = reslice_cursor(st["batch_cursor"], st["topology"],
+                                new_topo)
+        per_param = {k: tuple(onp.asarray(x.asnumpy()) for x in v)
+                     for k, v in pickle.loads(
+                         st["optimizer_states"]).items()}
+        params_host, _, _, done = _train_loop(
+            mesh, n_shards, params, per_param, cursor,
+            TOTAL_STEPS - cursor)
+        assert done == TOTAL_STEPS
+        print(json.dumps({
+            "final": {k: v.tolist() for k, v in params_host.items()},
+            "verdict": {"reshard": verdict["reshard"],
+                        "old_world": verdict["old_world"],
+                        "new_world": verdict["new_world"]},
+            "resumed_cursor": cursor}), flush=True)
+        return
+    if mode == "reference":
+        from mxnet_tpu.resilience import elastic
+
+        elastic.elastic_init()
+        mesh = elastic.elastic_mesh()
+        n_shards = int(mesh.shape["data"])
+        params_host, _, _, done = _train_loop(
+            mesh, n_shards, _init_params(), None, 0, TOTAL_STEPS)
+        assert done == TOTAL_STEPS
+        print(json.dumps({"final": {k: v.tolist()
+                                    for k, v in params_host.items()}}),
+              flush=True)
+        return
+    raise SystemExit(f"unknown mode {mode!r}")
+
+
+if __name__ == "__main__":
+    main()
