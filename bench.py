@@ -66,6 +66,12 @@ the round its headline artifact):
   its own JSONL — schema verdict, record counts and the step's
   memory/flop/collective report land under ``"telemetry"`` in the
   JSON (the observability layer validating itself every bench run);
+* the ``serving`` INFERENCE phase (round 13) stands the continuous-
+  batching model server (mxnet_tpu.serving) in front of the net's
+  inference forward — microbatch winner-seeded buckets, deadline-
+  aware admission — and drives bursty synthetic load: admitted
+  p50/p99 latency, shed counts, batch structure and the warm-start
+  budget land under ``"serving"`` in the JSON;
 
 HARNESS PROTOCOL (round 11 — stall-proofing; r05's stall sat inside an
 uninterruptible XLA call where none of the above could run):
@@ -615,6 +621,85 @@ def _measure_telemetry(step_fn, params, opt_state, x, y, key, smoke,
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def _measure_serving(net, smoke, deadline):
+    """INFERENCE serving phase (round 13): stand the continuous-
+    batching model server (mxnet_tpu.serving) in front of the bench
+    net's inference forward — seeded by the persisted tune_microbatch
+    winners — and drive BURSTY (not steady) synthetic load: two bursts
+    each submitting a queue's worth of requests at once, so admission
+    control, bucketed coalescing and (under pressure) load shedding
+    all execute for real.  Reports admitted-request p50/p99 latency,
+    shed/rejection counts, batch/bucket structure and the warm-start
+    budget into the headline JSON."""
+    import numpy as onp
+
+    from mxnet_tpu.parallel import functionalize
+    from mxnet_tpu.serving import ModelServer, ServeRejected
+    from mxnet_tpu.telemetry.opstats import percentile
+
+    params, apply_fn = functionalize(net, train=False)
+    side = 16 if smoke else 224
+    item = (3, side, side)
+    max_batch = 8
+    n_req = 48 if smoke else 192
+    # the SLO gates the REPORT (p99_within_slo), not the harness: a
+    # loaded CI box must degrade the verdict, never hang the phase
+    slo_ms = 5000.0 if smoke else 1000.0
+    ex = onp.random.rand(max_batch, *item).astype("float32")
+    srv = ModelServer.from_predictor(
+        apply_fn, params, ex, candidates=(1, 2), tune_iters=4,
+        slo_ms=slo_ms, coalesce_ms=1.0, name="bench")
+    srv.start(warm=True)
+    lat, shed, submitted = [], 0, 0
+    try:
+        sample = ex[0]
+        for _burst in range(2):
+            if deadline.exceeded():
+                deadline.note("serving:burst")
+                break
+            handles = []
+            for _ in range(n_req // 2):
+                submitted += 1
+                try:
+                    handles.append(srv.submit(sample))
+                except ServeRejected:
+                    shed += 1
+            for h in handles:
+                try:
+                    h.result(timeout=60)
+                    lat.append(h.latency_ms)
+                except ServeRejected:
+                    shed += 1
+        st = dict(srv.stats)
+        health = srv.health()
+        wr = srv.warm_report()
+    finally:
+        srv.drain(timeout=10.0)
+        srv.close()
+    lat.sort()
+    p99 = percentile(lat, 0.99)
+    return {
+        # the ACTUAL offered load: a deadline break mid-phase must not
+        # overstate it (completed + shed == requests, smoke-asserted)
+        "requests": submitted, "admitted": st["admitted"],
+        "completed": len(lat), "shed": shed,
+        "rejected_by_reason": st["rejected"],
+        "batches": st["batches"],
+        "mean_batch": round(st["admitted"] / st["batches"], 2)
+        if st["batches"] else None,
+        "buckets": wr["buckets"],
+        "microbatch": list(getattr(srv, "microbatch", (1, False))),
+        "p50_ms": round(percentile(lat, 0.50), 3),
+        "p99_ms": round(p99, 3),
+        "slo_ms": slo_ms,
+        "p99_within_slo": bool(lat) and p99 <= slo_ms,
+        "warm_start_s": round(wr["warm_start_s"], 4),
+        "steady_state_traces": wr["steady_state_traces"],
+        "breaker": health["breaker"],
+        "breaker_trips": st["breaker_trips"],
+    }
+
+
 def _ckpt_save(prefix, epoch, params, opt_state):
     """Atomic checkpoint of the trained params/opt state
     (resilience.checkpoint); returns the timed write duration so the
@@ -1107,6 +1192,25 @@ def main(argv=None):
             out["degraded"] = True
             reasons.append(f"collectives phase failed: {exc!r}")
     _write_partial(out, "collectives")
+
+    # INFERENCE serving phase (round 13): the continuous-batching
+    # model server under bursty synthetic load — admitted p50/p99,
+    # shed counts and the warm-start budget land in the headline JSON
+    if deadline.exceeded(margin=0.0 if args.smoke else 60.0):
+        out["serving"] = "skipped (deadline)"
+        out["degraded"] = True
+        reasons.append("deadline: skipped serving phase")
+        deadline.note("serving")
+    else:
+        _heartbeat("serving")
+        try:
+            out["serving"] = _measure_serving(net, args.smoke,
+                                              deadline)
+        except Exception as exc:  # auxiliary metric: never kill the run
+            out["serving"] = {"error": repr(exc)}
+            out["degraded"] = True
+            reasons.append(f"serving phase failed: {exc!r}")
+    _write_partial(out, "serving")
 
     # run-telemetry dogfood (round 10): the bench arms a run log,
     # reports its own steps into it, re-reads the JSONL and folds the

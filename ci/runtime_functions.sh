@@ -126,6 +126,20 @@ collectives_budget() {
         python -c "import __graft_entry__ as g; g.dryrun_multichip(16)"
 }
 
+serving_smoke() {
+    # fail-safe serving gate (round 13) on the CPU backend, seconds:
+    # continuous-batching unit drills (bucketed coalescing, deadline
+    # shed, breaker trip/re-warm, transient-fault retry inside the
+    # deadline budget) plus the bursty-load SLO drill — admitted p99
+    # inside the SLO while serve.model delay faults land mid-burst and
+    # the overload is absorbed as structured rejections — plus the
+    # SIGTERM drain and the crash->flight-dump->AOT-warm-relaunch
+    # subprocess halves.  Also collected by tier-1
+    # (tests/test_serving.py), so a regression turns the unit suite
+    # red between CI runs.
+    JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
+}
+
 elastic_smoke() {
     # elastic scale-out gate (round 12): the tier-1 half runs the
     # single-host resize drill — train dp(4) under optimizer sharding,

@@ -83,6 +83,7 @@ _LAZY = {
     "library": ".library",
     "deploy": ".deploy",
     "resilience": ".resilience",
+    "serving": ".serving",
     "telemetry": ".telemetry",
 }
 

@@ -191,6 +191,20 @@ def _key(op, shape, dtype, platform, mesh):
     return "|".join((op, str(tuple(shape)), str(dtype), platform, mesh))
 
 
+def _sane_entries(data):
+    """The entries dict of a parsed autotune.json, with anything a
+    corrupt/partially-written file could smuggle dropped: a non-dict
+    root or entries value becomes empty, non-dict entry values are
+    filtered — so every consumer's entry.get() stays safe and a
+    corrupt cache can only ever cost a re-measurement.  One helper
+    shared by the read (_load) and read-merge-write (_save) paths so
+    the sanitization rules cannot drift."""
+    entries = data.get("entries", {}) if isinstance(data, dict) else {}
+    if not isinstance(entries, dict):
+        entries = {}
+    return {k: v for k, v in entries.items() if isinstance(v, dict)}
+
+
 def _load(path):
     """mtime-checked load so winners recorded by ANOTHER process on the
     same host are visible without restarting (algo-registry sharing)."""
@@ -203,8 +217,7 @@ def _load(path):
             return _mem["entries"]
     try:
         with open(path) as f:
-            data = json.load(f)
-        entries = data.get("entries", {}) if isinstance(data, dict) else {}
+            entries = _sane_entries(json.load(f))
     except (OSError, ValueError):
         entries = {}
     with _lock:
@@ -228,7 +241,7 @@ def _save(path, new_entries):
                 pass
             try:
                 with open(path) as f:
-                    on_disk = json.load(f).get("entries", {})
+                    on_disk = _sane_entries(json.load(f))
             except (OSError, ValueError):
                 on_disk = {}
             on_disk.update(new_entries)

@@ -259,6 +259,28 @@ register_env("MXNET_DIST_INIT_TIMEOUT_SEC", 120.0, float,
              "initialize retry loop — the deadline_sec cap, so attempt "
              "counts cannot overshoot the bring-up SLA once backoff "
              "grows.")
+register_env("MXNET_SERVE_SLO_MS", 100.0, float,
+             "Default per-request deadline (milliseconds) of the "
+             "serving runtime (mxnet_tpu.serving.ModelServer): a "
+             "submit() without an explicit deadline_ms gets this SLO. "
+             "Admission control sheds requests the latency EWMA says "
+             "cannot finish inside it.")
+register_env("MXNET_SERVE_QUEUE_DEPTH", 256, int,
+             "Serving request-queue bound: submits beyond this many "
+             "waiting requests are rejected with a structured "
+             "ServeRejected(reason='queue_full') instead of growing "
+             "an unbounded backlog.")
+register_env("MXNET_SERVE_MAX_INFLIGHT", 0, int,
+             "Bound on admitted-but-unfinished serving requests "
+             "(queued + in the running batch).  0 = queue depth plus "
+             "one max-size batch.")
+register_env("MXNET_SERVE_BREAKER_LIMIT", 3, int,
+             "Serving circuit breaker: after this many CONSECUTIVE "
+             "model-invocation failures (exceptions or non-finite "
+             "outputs — the bad-step machinery's serving analog) the "
+             "breaker opens: requests get fast structured rejections "
+             "while the batcher re-warms on probe batches; a probe "
+             "success closes it.")
 register_env("DMLC_NUM_WORKER", 1, int,
              "Distributed worker count (tools/launch.py contract).")
 register_env("DMLC_WORKER_ID", 0, int, "This worker's rank.")

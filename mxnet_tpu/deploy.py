@@ -11,14 +11,31 @@ the same deploy-without-model-source contract the predict API serves.
     path = mx.deploy.export_model(net, example_x, "model.mxje")
     f = mx.deploy.load_model(path)     # -> callable on nd/np arrays
     y = f(x)
+
+Artifact framing (round 13): ``export_model`` prepends a fixed-size
+header — magic + payload length + CRC32 — so ``load_model`` verifies
+integrity BEFORE handing bytes to the deserializer: a truncated or
+bit-flipped ``.mxje`` (the torn-upload/partial-download case a model
+server restart hits first) raises a clean :class:`MXNetError` naming
+the path instead of an opaque deserialization crash.  Headerless
+artifacts from earlier rounds still load (magic sniff falls back to
+treating the whole file as the payload).
 """
 from __future__ import annotations
+
+import struct
+import zlib
 
 import numpy as onp
 
 from .base import MXNetError
 
-__all__ = ["export_model", "load_model", "stablehlo_text"]
+__all__ = ["export_model", "load_model", "load_exported",
+           "stablehlo_text", "artifact_info"]
+
+#: artifact header: magic, then ``<IQ`` = CRC32(payload), len(payload)
+_MAGIC = b"MXJE\x01\n"
+_HEADER = struct.Struct("<IQ")
 
 
 def _functional_forward(net):
@@ -46,26 +63,95 @@ def export_model(net, example_input, path, platforms=("cpu", "tpu")):
     def infer(xv):
         return apply_fn(params, xv)
 
+    from .resilience.checkpoint import atomic_write_bytes
+
     exp = jexport.export(
         jax.jit(infer),
         platforms=platforms)(jax.ShapeDtypeStruct(x.shape, x.dtype))
     blob = exp.serialize()
-    with open(path, "wb") as f:
-        f.write(blob)
+    # the resilience atomic writer (temp + fsync + rename + dir
+    # fsync, temp cleaned up on failure) so a crash mid-export can
+    # never leave a half-written file at the published path; the
+    # header lets the loader verify length+CRC before deserializing
+    atomic_write_bytes(
+        path,
+        _MAGIC + _HEADER.pack(zlib.crc32(blob) & 0xFFFFFFFF,
+                              len(blob)) + blob,
+        inject_point=None)
     return path
+
+
+def _read_payload(path):
+    """Read + integrity-check an artifact; returns the serialized
+    payload bytes.  Headered files verify length+CRC32; headerless
+    (pre-round-13) files pass through whole."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        raise MXNetError(
+            f"cannot read deploy artifact {path!r}: {e}") from e
+    if not data.startswith(_MAGIC):
+        return data  # legacy headerless artifact: best-effort load
+    off = len(_MAGIC)
+    if len(data) < off + _HEADER.size:
+        raise MXNetError(
+            f"corrupt deploy artifact {path!r}: truncated header "
+            f"({len(data)} bytes)")
+    crc, length = _HEADER.unpack_from(data, off)
+    blob = data[off + _HEADER.size:]
+    if len(blob) != length:
+        raise MXNetError(
+            f"corrupt deploy artifact {path!r}: payload is "
+            f"{len(blob)} bytes, header says {length} (truncated or "
+            "partially written)")
+    if zlib.crc32(blob) & 0xFFFFFFFF != crc:
+        raise MXNetError(
+            f"corrupt deploy artifact {path!r}: CRC32 mismatch "
+            "(bit rot or torn write)")
+    return blob
+
+
+def load_exported(path):
+    """Load + verify an artifact, returning the ``jax.export``
+    ``Exported`` object (``.call``, ``.in_avals``, ...) — the handle
+    the model server warm-starts from without retracing."""
+    from jax import export as jexport
+
+    blob = _read_payload(path)
+    try:
+        return jexport.deserialize(blob)
+    except MXNetError:
+        raise
+    except Exception as e:  # noqa: BLE001 — name the artifact, always
+        raise MXNetError(
+            f"failed to deserialize deploy artifact {path!r}: {e!r} "
+            "(re-export with deploy.export_model; round-13 exports "
+            "carry a CRC header that catches corruption before this "
+            "point)") from e
+
+
+def artifact_info(path):
+    """Shape/dtype metadata of an artifact's input signature without
+    building the runner: ``{"batch", "item_shape", "dtype",
+    "platforms"}`` — what a serving bucket plan needs."""
+    exp = load_exported(path)
+    aval = exp.in_avals[0]
+    return {"batch": int(aval.shape[0]),
+            "item_shape": tuple(int(s) for s in aval.shape[1:]),
+            "dtype": str(aval.dtype),
+            "platforms": tuple(getattr(exp, "platforms", ()) or ())}
 
 
 def load_model(path):
     """Load a serialized artifact; returns ``f(x) -> NDArray`` (no
     model Python code needed — the artifact carries the program and
-    the weights as constants)."""
-    from jax import export as jexport
-
+    the weights as constants).  Integrity is verified (CRC header)
+    before deserialization; corruption raises :class:`MXNetError`
+    naming the path."""
     from .ndarray import NDArray
 
-    with open(path, "rb") as f:
-        blob = f.read()
-    exp = jexport.deserialize(blob)
+    exp = load_exported(path)
 
     def run(x):
         import jax.numpy as jnp

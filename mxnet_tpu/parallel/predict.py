@@ -154,14 +154,25 @@ def tune_microbatch(apply_fn, params, sample_x, candidates=(1, 2, 4),
     if lvl == 1:
         entry = at.lookup_entry(op_key, sample_x.shape,
                                 sample_x.dtype)
-        if entry is not None:
-            w = entry.get("winner")
+        # a corrupt/partially-written autotune.json must mean
+        # "re-tune", never a crash: the loader already drops non-dict
+        # entries, and any malformed winner/timings payload inside a
+        # surviving entry falls through to the measuring path below
+        # (whose record() rewrites the file atomically)
+        try:
+            w = entry.get("winner") if entry else None
+        except AttributeError:
+            w = None
+        if w is not None:
             if isinstance(w, (list, tuple)) and len(w) == 2 \
                     and w[0] in candidates and b % int(w[0]) == 0:
                 results = {}
-                for ks, t in entry.get("timings", {}).items():
-                    kk, form = ks.split(":")
-                    results[(int(kk), form == "unroll")] = float(t)
+                try:
+                    for ks, t in (entry.get("timings") or {}).items():
+                        kk, form = str(ks).split(":")
+                        results[(int(kk), form == "unroll")] = float(t)
+                except (AttributeError, TypeError, ValueError):
+                    results = {}
                 best = (int(w[0]), bool(w[1]))
                 # the stored race must be EXACTLY what this call would
                 # probe: a narrower earlier race must not answer a

@@ -8,6 +8,14 @@ tests arm deterministically by hit-count — "crash during the 3rd
 checkpoint write" becomes a reproducible scenario instead of a
 ``kill -9`` race.
 
+Point names are REGISTERED, not free-form: the in-tree points below
+are built in, and subsystems add their own at import time via
+:func:`register_point` (``mxnet_tpu.serving`` registers ``serve.*``
+this way) — so a spec naming an unknown point fails LOUDLY at arm
+time (``reset``/first ``inject``) instead of silently never firing, a
+typo'd drill can no longer green-pass by injecting nothing.  Arm the
+spec after importing the subsystem that registers the point.
+
 Points wired in-tree:
 
 ==============  =======================================================
@@ -26,6 +34,15 @@ Points wired in-tree:
                 optimizer exchange (ShardedBucketUpdater.update_all),
                 BEFORE the jitted collective program — the mid-step
                 collective-loss simulation for resize drills
+``serve.admit``  serving/server.py, inside every admission decision
+                (registered by ``mxnet_tpu.serving`` at import)
+``serve.batch``  serving/server.py batcher, before each dispatched
+                microbatch (registered by ``mxnet_tpu.serving``)
+``serve.model``  serving/server.py, inside every model invocation —
+                ``delay`` = a slow model, ``raise`` = a transient
+                failure the retry budget absorbs, ``nan`` = poisoned
+                outputs the breaker counts, ``crash`` = hard death
+                mid-traffic (registered by ``mxnet_tpu.serving``)
 ==============  =======================================================
 
 Spec grammar (env ``MXNET_FAULT_SPEC`` or ``faultsim.reset(spec)``)::
@@ -58,11 +75,45 @@ import time
 from ..base import MXNetError
 
 __all__ = ["FaultInjected", "inject", "reset", "hits", "armed",
-           "on_crash", "CRASH_EXIT_CODE"]
+           "on_crash", "register_point", "points", "CRASH_EXIT_CODE"]
 
 #: exit status of an armed ``crash`` action — distinguishable from a
 #: real signal kill in subprocess tests
 CRASH_EXIT_CODE = 87
+
+#: name -> one-line doc of every arm-able injection point.  The
+#: in-tree points are built in; subsystems extend the set at import
+#: time via :func:`register_point` so ``MXNET_FAULT_SPEC`` validation
+#: tracks what is actually wired, not a hard-coded list.
+_POINTS = {
+    "feed.h2d": "device-feed producer, before each H2D transfer",
+    "ps.push": "PS client, inside every push/spush attempt",
+    "ps.pull": "PS client, inside every pull/spull attempt",
+    "ckpt.write": "mid-payload in checkpoint atomic_write",
+    "step.loss_nan": "train-step host wrapper + fit step guard",
+    "bench.stall": "bench.py after the measure phase",
+    "dist.init": "inside every jax.distributed.initialize attempt",
+    "dist.collective": "before the jitted collective program",
+}
+
+
+def register_point(name, doc=""):
+    """Register a runtime injection point name so specs may arm it.
+
+    Subsystems outside resilience (serving's ``serve.*`` points) call
+    this at import time; a spec clause naming an UNREGISTERED point
+    raises :class:`MXNetError` at arm time — a typo'd drill must fail
+    loudly, not green-pass by never injecting.  Idempotent; returns
+    ``name`` so it can be used in assignments."""
+    with _LOCK:
+        _POINTS[str(name)] = str(doc)
+    return name
+
+
+def points():
+    """Sorted names of every registered injection point."""
+    with _LOCK:
+        return sorted(_POINTS)
 
 
 class FaultInjected(Exception):
@@ -144,7 +195,15 @@ def _parse(spec):
         except ValueError:
             raise MXNetError(
                 f"bad hit range {hitpart!r} in {clause!r}") from None
-        rules.setdefault(point.strip(), []).append(
+        point = point.strip()
+        if point not in _POINTS:
+            known = ", ".join(sorted(_POINTS))
+            raise MXNetError(
+                f"unknown fault point {point!r} in {clause!r} "
+                f"(registered points: {known}; subsystems register "
+                "theirs via faultsim.register_point at import — arm "
+                "the spec after importing them)")
+        rules.setdefault(point, []).append(
             _Rule(action, value, lo, hi))
     return rules
 
@@ -168,8 +227,13 @@ def reset(spec=None):
 def _ensure_locked():
     if _STATE["spec"] is None:
         spec = os.environ.get("MXNET_FAULT_SPEC", "")
+        # parse BEFORE mutating state: an unknown-point spec (armed
+        # from the env before the registering subsystem imported) must
+        # stay LOUD on every later call — recording the spec first
+        # would swallow the error once and silently disarm the drill
+        rules = _parse(spec)
         _STATE["spec"] = spec
-        _STATE["rules"] = _parse(spec)
+        _STATE["rules"] = rules
         _STATE["hits"] = {}
 
 

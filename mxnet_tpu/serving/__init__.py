@@ -1,0 +1,32 @@
+"""Fail-safe inference serving (round 13).
+
+The reference ships a production predict story (c_predict_api.h + the
+model-server bindings); ours was a library — ``deploy.py`` exports
+jax.export artifacts, ``parallel/predict.py`` tunes microbatches —
+with no service in front of them.  This package is that service: an
+in-process, thread-based continuous-batching model server that is
+robust by construction.
+
+* :class:`~mxnet_tpu.serving.server.ModelServer` — request queue +
+  continuous batcher (microbatch size from live queue depth, re-padded
+  to a small set of bucketed batch shapes so retraces are bounded),
+  deadline-aware admission control with structured load shedding,
+  circuit breaker with probe-driven re-warm, SIGTERM drain, readiness/
+  liveness probes, crash-safe AOT warm start from ``deploy`` artifacts.
+* :class:`~mxnet_tpu.serving.server.ServeRejected` — the structured
+  rejection every shed/expired/tripped request receives (never a
+  silent hang).
+
+Fault points ``serve.admit`` / ``serve.batch`` / ``serve.model`` are
+registered with :mod:`mxnet_tpu.resilience.faultsim` when this package
+imports, so ``MXNET_FAULT_SPEC`` drills can target the serving path.
+"""
+from .server import (  # noqa: F401
+    ModelServer,
+    ServeHandle,
+    ServeRejected,
+    default_buckets,
+)
+
+__all__ = ["ModelServer", "ServeHandle", "ServeRejected",
+           "default_buckets"]

@@ -131,7 +131,9 @@ class RunLog:
                          "h2d_bytes": 0, "feed_wait_s": 0.0,
                          "preempt_signals": 0, "watchdog_stalls": 0,
                          "ckpt_fallbacks": 0, "reshards": 0,
-                         "dist_init_retries": 0}
+                         "dist_init_retries": 0, "serve_requests": 0,
+                         "serve_shed": 0, "serve_batches": 0,
+                         "serve_breaker_trips": 0}
         self._fps = {}          # program -> last compile fingerprint
         self._programs = {}     # program -> last program_report body
         self._last_program = None
@@ -412,6 +414,38 @@ class RunLog:
                 args={"phase": str(phase),
                       "quiet_s": round(float(quiet_s), 3)},
                 tid=_TRACE_TID)
+
+    def serve(self, *, model, batch, padded_to, queue_depth,
+              latency_ms, deadline_margin_ms=None, shed=0,
+              breaker="closed"):
+        """One dispatched serving microbatch (serving.ModelServer):
+        live request count vs the bucketed padded shape, dispatch
+        latency, queue depth left behind, the cumulative shed count
+        and the breaker state — the per-batch row an SLO dashboard
+        folds into p99s."""
+        dur_s = float(latency_ms) / 1e3
+        self._write({"type": "serve", "t": round(self._now(), 6),
+                     "model": str(model), "batch": int(batch),
+                     "padded_to": int(padded_to),
+                     "queue_depth": int(queue_depth),
+                     "latency_ms": round(float(latency_ms), 4),
+                     "deadline_margin_ms":
+                     round(float(deadline_margin_ms), 4)
+                     if deadline_margin_ms is not None else None,
+                     "shed": int(shed), "breaker": str(breaker)})
+        from .. import profiler
+
+        if profiler.is_running():
+            self._trace_meta()
+            profiler.record_span(
+                "serve_batch", "telemetry",
+                profiler.now_us() - dur_s * 1e6, dur_s * 1e6,
+                args={"batch": int(batch), "padded_to": int(padded_to),
+                      "queue_depth": int(queue_depth)},
+                tid=_TRACE_TID)
+            profiler.record_counter("serve_queue_depth",
+                                    int(queue_depth),
+                                    cat="telemetry", tid=_TRACE_TID)
 
     def opstats(self, rows, source="profiler"):
         """The aggregate per-op table (telemetry.opstats) as one

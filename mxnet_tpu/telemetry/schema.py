@@ -17,6 +17,8 @@ checkpoint  one atomic checkpoint write with its duration
 watchdog  a hang-watchdog stall (phase, quiet seconds, stack dump path)
 opstats   aggregate per-op table folded from the profiler's op events
 tensor_stats  sampled numerics-monitor summary of named tensors
+serve     one dispatched serving microbatch (size, pad, latency,
+          queue depth, cumulative shed, breaker state)
 event     everything else (bad_step, ps_retry, fault, deadline, ...)
 run_end   final counters, written at close
 ========  =============================================================
@@ -25,7 +27,7 @@ from __future__ import annotations
 
 __all__ = ["STEP_FIELDS", "RECORD_TYPES", "COMPILE_CAUSES",
            "OPSTATS_ROW_FIELDS", "TENSOR_STATS_ROW_FIELDS",
-           "validate_record", "validate_lines"]
+           "SERVE_FIELDS", "validate_record", "validate_lines"]
 
 #: step-record contract: field -> (types, required).  ``None`` is legal
 #: for optional measurements (loss on an unsampled step, feed stats
@@ -54,7 +56,21 @@ STEP_FIELDS = {
 
 RECORD_TYPES = ("run_start", "step", "compile", "program_report",
                 "checkpoint", "watchdog", "opstats", "tensor_stats",
-                "event", "run_end")
+                "serve", "event", "run_end")
+
+#: per-batch contract of a ``serve`` record (serving.ModelServer)
+SERVE_FIELDS = {
+    "type": (str, True),
+    "t": ((int, float), True),
+    "model": (str, True),
+    "batch": (int, True),                 # live requests in the batch
+    "padded_to": (int, True),             # the bucketed batch shape
+    "queue_depth": (int, True),           # queue at dispatch end
+    "latency_ms": ((int, float), True),
+    "deadline_margin_ms": ((int, float, type(None)), True),
+    "shed": (int, True),                  # cumulative shed count
+    "breaker": (str, True),
+}
 
 #: per-op row contract of an ``opstats`` record (telemetry.opstats)
 OPSTATS_ROW_FIELDS = {
@@ -159,6 +175,8 @@ def validate_record(rec):
                 f"tensor_stats row {name!r}: {p}"
                 for p in _check_fields(row, TENSOR_STATS_ROW_FIELDS))
         return problems
+    if t == "serve":
+        return _check_fields(rec, SERVE_FIELDS)
     if t == "event":
         return _check_fields(rec, {"t": ((int, float), True),
                                    "kind": (str, True)})

@@ -1,0 +1,142 @@
+"""Subprocess worker for the serving drills (tests/test_serving.py).
+
+Modes (argv[1]):
+
+* ``drain ARTIFACT OUT_JSON`` — serve the AOT artifact on the main
+  thread via ``run_until_drained`` while a background thread submits
+  traffic; on SIGTERM the drain finishes admitted requests, rejects
+  new ones (structured), writes the outcome report to OUT_JSON and
+  exits by re-raising the signal (rc -15).
+* ``crash ARTIFACT`` — serve traffic with ``MXNET_FAULT_SPEC``
+  arming ``serve.model:crash@N`` in the environment: the process dies
+  HARD (os._exit, no atexit — the power-loss simulation) mid-burst;
+  the armed run log's flight recorder is the only record left.
+* ``relaunch ARTIFACT OUT_JSON`` — the warm restart: load the same
+  artifact, serve a burst to completion, write the report (the parent
+  asserts the run log's retrace counter stayed 0: load-not-retrace).
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as onp  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from mxnet_tpu.serving import ModelServer, ServeRejected  # noqa: E402
+
+
+def _submit_traffic(srv, item_shape, outcome, stop, n=400, pace=0.002):
+    x = onp.ones(item_shape, "float32")
+    for _ in range(n):
+        if stop.is_set():
+            break
+        try:
+            h = srv.submit(x, deadline_ms=5000)
+            outcome["handles"].append(h)
+        except ServeRejected as e:
+            outcome["rejections"].append(e.reason)
+        except Exception as e:  # server closed under us mid-drain
+            outcome["errors"].append(repr(e))
+            break
+        time.sleep(pace)
+
+
+def main():
+    mode = sys.argv[1]
+    artifact = sys.argv[2]
+    srv = ModelServer.from_artifact(artifact, slo_ms=10000.0,
+                                    coalesce_ms=1.0)
+    srv.start(warm=True)
+    outcome = {"handles": [], "rejections": [], "errors": []}
+    stop = threading.Event()
+    item = srv.item_shape
+    t = threading.Thread(target=_submit_traffic,
+                         args=(srv, item, outcome, stop), daemon=True)
+    t.start()
+
+    if mode == "crash":
+        # serve.model:crash@N in MXNET_FAULT_SPEC kills us mid-batch;
+        # if the spec never fires, exit 0 so the parent can tell the
+        # difference
+        t.join(timeout=60)
+        srv.close()
+        print("no crash fired", flush=True)
+        return
+
+    if mode == "relaunch":
+        t.join(timeout=60)
+        stop.set()
+        srv.drain(timeout=30)
+        done = [h for h in outcome["handles"] if h.done]
+        ok = [h for h in outcome["handles"] if h.ok]
+        report = {
+            "submitted": len(outcome["handles"]),
+            "terminal": len(done),
+            "completed": len(ok),
+            "rejections": outcome["rejections"],
+            "errors": outcome["errors"],
+            "warm_report": srv.warm_report(),
+            "ready_during_serve": srv.stats["batches"] > 0,
+        }
+        srv.close()
+        # close the run log so the run_end record (final counters —
+        # the parent asserts compiles == 0) lands on disk
+        from mxnet_tpu import telemetry
+
+        telemetry.close()
+        with open(sys.argv[3], "w") as f:
+            json.dump(report, f)
+        print("relaunch done", flush=True)
+        return
+
+    assert mode == "drain"
+    # tell the parent we are serving (it sends SIGTERM once this file
+    # exists AND traffic has flowed)
+    ready_path = sys.argv[3] + ".ready"
+
+    def _mark_ready():
+        while not stop.is_set():
+            if srv.stats["completed"] >= 5:
+                with open(ready_path, "w") as f:
+                    f.write("ready")
+                return
+            time.sleep(0.01)
+
+    threading.Thread(target=_mark_ready, daemon=True).start()
+
+    def on_drained(server):
+        stop.set()
+        # every admitted request must have reached a terminal state
+        # BEFORE the signal re-raises — the bounded-in-flight contract
+        handles = list(outcome["handles"])
+        report = {
+            "submitted": len(handles),
+            "terminal": sum(1 for h in handles if h.done),
+            "completed": sum(1 for h in handles if h.ok),
+            "rejections": outcome["rejections"],
+            "draining_rejections": sum(
+                1 for r in outcome["rejections"] if r == "draining"),
+            "errors": outcome["errors"],
+            "health_after_drain": server.health(),
+        }
+        with open(sys.argv[3], "w") as f:
+            json.dump(report, f)
+            f.flush()
+            os.fsync(f.fileno())
+
+    srv.run_until_drained(on_drained=on_drained)
+    # unreachable on SIGTERM (reraise kills); reachable only if the
+    # server died on its own
+    print("server exited without a signal", flush=True)
+
+
+if __name__ == "__main__":
+    main()

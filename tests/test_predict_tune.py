@@ -53,6 +53,49 @@ def test_tune_skips_nondivisors_and_returns_best(setup):
     assert (2, False) in results and (2, True) in results
 
 
+@pytest.mark.parametrize("garbage", [
+    b"{ truncated json no close",               # not JSON at all
+    b'{"version": 1, "entries": "not-a-dict"}',  # wrong shape
+    b'{"version": 1, "entries": {"k": 3}}',      # scalar entry
+    b"\x00\x01\x02partial-write\xff",            # binary torn write
+], ids=["truncated", "entries-str", "scalar-entry", "binary"])
+def test_tune_with_corrupt_cache_retunes_and_rewrites(setup, garbage,
+                                                      tmp_path,
+                                                      monkeypatch):
+    """Round-13 satellite: a corrupt / partially-written autotune.json
+    must mean RE-TUNE (then an atomic rewrite), never a crash — the
+    winner registry is a cache, and a cache can only ever cost a
+    re-measurement."""
+    import json
+    import os
+
+    from mxnet_tpu import autotune as at
+
+    params, x = setup
+    monkeypatch.setenv("MXNET_AUTOTUNE_CACHE_DIR", str(tmp_path))
+    at.cache_clear()
+    cache = os.path.join(str(tmp_path), "autotune.json")
+    with open(cache, "wb") as f:
+        f.write(garbage)
+    best, results = tune_microbatch(_apply, params, x,
+                                    candidates=(1, 2), iters=2)
+    assert best in results
+    # the re-tune rewrote the file whole: valid JSON, the winner
+    # present, and no torn .tmp sibling left behind
+    with open(cache) as f:
+        data = json.load(f)
+    assert isinstance(data["entries"], dict) and data["entries"]
+    assert all(isinstance(v, dict) for v in data["entries"].values())
+    assert not [p for p in os.listdir(str(tmp_path))
+                if p.endswith(".tmp")]
+    # and the rewritten cache answers the next call without re-timing
+    at.cache_clear()
+    best2, _ = tune_microbatch(_apply, params, x, candidates=(1, 2),
+                               iters=2)
+    assert best2 == best
+    at.cache_clear()
+
+
 def test_unrolled_matches_map(setup):
     params, x = setup
     ref = make_predict_fn(_apply, microbatch=4, unroll=False)(params, x)

@@ -63,6 +63,11 @@ def _run_script(body, timeout=180):
 
 # ---------------------------------------------------------------- faultsim
 def test_fault_spec_parsing_and_actions():
+    # test points are REGISTERED at runtime (round 13: specs may only
+    # name registered points, so a typo'd drill fails loudly instead
+    # of silently injecting nothing)
+    for p in ("p.a", "p.b", "p.c"):
+        faultsim.register_point(p, "test point")
     faultsim.reset("p.a:delay=0.05@2;p.b:raise@1-2;p.c:nan@3+")
     assert faultsim.armed("p.a") and not faultsim.armed("p.zzz")
     assert faultsim.inject("p.a") is None  # hit 1: disarmed
@@ -82,12 +87,35 @@ def test_fault_spec_parsing_and_actions():
 
 
 def test_fault_spec_rejects_garbage():
+    faultsim.register_point("p", "test point")
     with pytest.raises(mx.MXNetError):
         faultsim.reset("nonsense")
     with pytest.raises(mx.MXNetError):
         faultsim.reset("p:explode@1")
     with pytest.raises(mx.MXNetError):
         faultsim.reset("p:raise@x")
+
+
+def test_fault_spec_unknown_point_is_loud():
+    """Round-13 satellite: MXNET_FAULT_SPEC validates point names
+    against the registry at ARM time — an unknown point is a loud
+    error (a typo'd drill must not green-pass by never firing), and a
+    runtime register_point makes the name arm-able without editing
+    faultsim."""
+    with pytest.raises(mx.MXNetError, match="unknown fault point"):
+        faultsim.reset("serve.typo_point:raise@1")
+    # serving registers its points at import: serve.* arm fine
+    import mxnet_tpu.serving  # noqa: F401
+
+    faultsim.reset("serve.model:delay=0.001@1")
+    assert faultsim.armed("serve.model")
+    # runtime registration opens new namespaces to specs immediately
+    name = faultsim.register_point("testsub.newpoint", "drill point")
+    faultsim.reset(f"{name}:raise@1")
+    with pytest.raises(faultsim.FaultInjected):
+        faultsim.inject(name)
+    assert name in faultsim.points()
+    faultsim.reset("")
 
 
 def test_retry_call_backoff_and_bounds():

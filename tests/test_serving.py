@@ -1,0 +1,648 @@
+"""Fail-safe inference serving (round 13): the continuous-batching
+model server, drilled.
+
+The contract under test, end to end:
+
+* requests coalesce into bucket-padded microbatches sized by live
+  queue depth and every admitted request gets ITS OWN row back;
+* admission control sheds load with structured rejections — queue
+  bound, deadline estimate, open breaker — never a silent hang;
+* transient model faults are retried inside the batch's deadline
+  budget (resilience.retry deadline_sec); persistent failures trip a
+  circuit breaker that serves rejections while probe batches re-warm;
+* SIGTERM drains: admitted work finishes, new work is rejected, the
+  exit is clean (rc -15);
+* a hard mid-traffic death (faultsim ``crash``: os._exit, no cleanup
+  — the ``kill -9`` simulation) leaves a flight-recorder dump, and
+  the relaunch serves from the CRC-verified AOT artifact with the
+  run-log retrace counter at 0 (load-not-retrace);
+* the bursty-load drill: with ``serve.model`` delay faults injected
+  mid-burst, admitted p99 stays inside the SLO while the overload is
+  absorbed as rejections (shed > 0, zero hangs).
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon, nd  # noqa: E402
+from mxnet_tpu.base import MXNetError  # noqa: E402
+from mxnet_tpu.resilience import faultsim  # noqa: E402
+from mxnet_tpu.serving import (  # noqa: E402
+    ModelServer,
+    ServeRejected,
+    default_buckets,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WORKER = os.path.join(_REPO, "tests", "serving_worker.py")
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faultsim.reset("")
+    yield
+    faultsim.reset("")
+
+
+def _np_model(delay=0.0, shapes=None, fail=None):
+    """A controllable batch-in/batch-out model: out = 2*x + 1."""
+
+    def model(xb):
+        if shapes is not None:
+            shapes.append(xb.shape)
+        if fail is not None and fail["on"]:
+            raise ValueError("model down")
+        if delay:
+            time.sleep(delay)
+        return xb * 2.0 + 1.0
+
+    return model
+
+
+def _drain_handles(handles, timeout=30.0):
+    """Every handle must reach a TERMINAL state inside the bound —
+    the zero-silent-hangs assertion shared by several drills."""
+    ok, rejected = [], []
+    for h in handles:
+        try:
+            h.result(timeout=timeout)
+            ok.append(h)
+        except ServeRejected as e:
+            rejected.append(e.reason)
+    return ok, rejected
+
+
+# ------------------------------------------------------------- batching
+def test_roundtrip_each_request_gets_its_own_row():
+    shapes = []
+    srv = ModelServer(_np_model(delay=0.01, shapes=shapes), (3,),
+                      max_batch=4, slo_ms=30000, coalesce_ms=5.0)
+    srv.start(warm=True)
+    try:
+        hs = [srv.submit(onp.full((3,), i, "float32"))
+              for i in range(11)]
+        for i, h in enumerate(hs):
+            out = h.result(timeout=30)
+            assert out.shape == (3,)
+            onp.testing.assert_allclose(out, 2.0 * i + 1.0)
+        st = srv.stats
+        assert st["completed"] == 11
+        assert st["batches"] < 11, "requests must have coalesced"
+        # every dispatched shape is a bucket: retraces are bounded by
+        # the bucket set, padding never leaks into results
+        assert set(s[0] for s in shapes) <= set(default_buckets(4))
+        assert srv.warm_report()["steady_state_traces"] == 0
+    finally:
+        srv.close()
+
+
+def test_batch_follows_live_queue_depth():
+    """Continuous batching: while the model is busy the queue grows,
+    and the NEXT batch takes what is queued (up to the largest
+    bucket) — queue depth, not a timer, sizes the microbatch."""
+    shapes = []
+    srv = ModelServer(_np_model(delay=0.05, shapes=shapes), (2,),
+                      max_batch=8, slo_ms=30000, coalesce_ms=1.0)
+    srv.start(warm=True)
+    try:
+        hs = [srv.submit(onp.zeros((2,), "float32"))
+              for _ in range(17)]
+        ok, rejected = _drain_handles(hs)
+        assert len(ok) == 17 and not rejected
+        assert max(s[0] for s in shapes) == 8, \
+            f"queue pressure never produced a full bucket: {shapes}"
+    finally:
+        srv.close()
+
+
+def test_bad_request_shape_is_loud():
+    srv = ModelServer(_np_model(), (3,), max_batch=2, slo_ms=1000)
+    srv.start(warm=False)
+    try:
+        with pytest.raises(MXNetError, match="item shape"):
+            srv.submit(onp.zeros((4,), "float32"))
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------------------ admission
+def test_queue_full_rejection_is_fast_and_structured():
+    srv = ModelServer(_np_model(delay=0.1), (2,), max_batch=2,
+                      slo_ms=60000, queue_depth=3, coalesce_ms=0.0)
+    srv.start(warm=True)
+    try:
+        handles, reasons, t_rej = [], [], []
+        for _ in range(20):
+            t0 = time.perf_counter()
+            try:
+                handles.append(srv.submit(onp.zeros((2,), "float32")))
+            except ServeRejected as e:
+                reasons.append(e.reason)
+                t_rej.append(time.perf_counter() - t0)
+        assert "queue_full" in reasons, reasons
+        # load shedding is FAST: rejection costs no model time
+        assert max(t_rej) < 0.05
+        ok, rejected = _drain_handles(handles)
+        assert len(ok) + len(rejected) == len(handles)
+        assert srv.stats["shed"] == len(reasons) + len(rejected)
+    finally:
+        srv.close()
+
+
+def test_deadline_shed_at_admission_and_dispatch():
+    srv = ModelServer(_np_model(delay=0.002), (2,), max_batch=2,
+                      slo_ms=30000, coalesce_ms=0.0)
+    srv.start(warm=True)  # warmup seeds the EWMA the estimate uses
+    try:
+        # an impossible deadline is shed AT ADMISSION, structured
+        with pytest.raises(ServeRejected) as ei:
+            srv.submit(onp.zeros((2,), "float32"), deadline_ms=0.01)
+        assert ei.value.reason == "deadline"
+        # dispatch-time re-check: admission believes the fast EWMA
+        # (~2 ms), then an injected 300 ms stall wedges the running
+        # batch — the queued request's deadline is long gone when its
+        # turn comes, so it is shed 'expired' instead of burning a
+        # model slot on an answer nobody will wait for
+        faultsim.reset("serve.model:delay=0.3@1")
+        h_slow = srv.submit(onp.zeros((2,), "float32"))  # eats 300 ms
+        time.sleep(0.05)  # let the batcher take h_slow ALONE (its
+        #                   300 ms stall dwarfs this margin)
+        h_tight = srv.submit(onp.zeros((2,), "float32"),
+                             deadline_ms=50.0)  # feasible per EWMA
+        h_slow.result(timeout=10)
+        with pytest.raises(ServeRejected) as ei:
+            h_tight.result(timeout=10)
+        assert ei.value.reason == "expired"
+        assert srv.stats["shed"] >= 2
+        assert srv.stats["expired"] >= 1
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------ faults / retry / breaker
+def test_transient_model_fault_retried_inside_deadline():
+    """serve.model raise@1: the first invocation of a batch fails
+    transiently; retry_call (deadline_sec = the batch's tightest
+    deadline budget) absorbs it and the requests complete."""
+    srv = ModelServer(_np_model(), (2,), max_batch=2, slo_ms=10000,
+                      coalesce_ms=0.0)
+    srv.start(warm=True)
+    faultsim.reset("serve.model:raise@1")
+    h = srv.submit(onp.full((2,), 3.0, "float32"))
+    try:
+        onp.testing.assert_allclose(h.result(timeout=10), 7.0)
+        assert faultsim.hits("serve.model") >= 2  # failed + retried
+        assert srv.stats["model_failures"] == 0
+        assert srv.health()["breaker"] == "closed"
+    finally:
+        srv.close()
+
+
+def test_persistent_fault_fails_structured_within_budget():
+    """Every retry attempt fails: the batch's requests get a
+    STRUCTURED model_error once the deadline budget is spent — the
+    deadline propagated through retry.deadline_sec, not an unbounded
+    retry loop."""
+    srv = ModelServer(_np_model(), (2,), max_batch=2, slo_ms=10000,
+                      breaker_limit=100, coalesce_ms=0.0)
+    srv.start(warm=True)
+    faultsim.reset("serve.model:raise@1+")
+    try:
+        t0 = time.perf_counter()
+        h = srv.submit(onp.zeros((2,), "float32"), deadline_ms=500)
+        with pytest.raises(ServeRejected) as ei:
+            h.result(timeout=10)
+        assert ei.value.reason == "model_error"
+        assert time.perf_counter() - t0 < 5.0
+    finally:
+        srv.close()
+
+
+def test_breaker_trips_serves_rejections_and_rewarns():
+    fail = {"on": False}
+    srv = ModelServer(_np_model(fail=fail), (2,), max_batch=2,
+                      slo_ms=10000, breaker_limit=2, coalesce_ms=0.0)
+    srv.start(warm=True)
+    try:
+        assert srv.submit(
+            onp.zeros((2,), "float32")).result(10) is not None
+        fail["on"] = True
+        for _ in range(2):  # two consecutive failures trip it
+            h = srv.submit(onp.zeros((2,), "float32"))
+            with pytest.raises(ServeRejected):
+                h.result(timeout=10)
+        deadline = time.monotonic() + 5
+        while srv.health()["breaker"] != "open" \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        health = srv.health()
+        assert health["breaker"] == "open"
+        assert health["ready"] is False  # not routable while open
+        assert srv.stats["breaker_trips"] == 1
+        # open breaker = fast structured rejection, no model time
+        with pytest.raises(ServeRejected) as ei:
+            srv.submit(onp.zeros((2,), "float32"))
+        assert ei.value.reason == "breaker_open"
+        # the model recovers; a probe batch re-warms and closes it
+        fail["on"] = False
+        deadline = time.monotonic() + 10
+        while srv.health()["breaker"] != "closed" \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert srv.health()["breaker"] == "closed"
+        assert srv.ready()
+        out = srv.submit(onp.zeros((2,), "float32")).result(10)
+        onp.testing.assert_allclose(out, 1.0)
+    finally:
+        srv.close()
+
+
+def test_batcher_fault_is_fully_accounted():
+    """serve.batch faults (batch assembly, not the model) must ride
+    the SAME failure path as model faults: structured rejections with
+    shed/rejected/model_failures accounting — a drill must never
+    report a healthy server while every batch dies."""
+    srv = ModelServer(_np_model(), (2,), max_batch=2, slo_ms=10000,
+                      breaker_limit=100, coalesce_ms=0.0)
+    srv.start(warm=True)
+    faultsim.reset("serve.batch:raise@1+")
+    try:
+        for _ in range(2):
+            h = srv.submit(onp.zeros((2,), "float32"))
+            with pytest.raises(ServeRejected) as ei:
+                h.result(timeout=10)
+            assert ei.value.reason == "model_error"
+        assert srv.stats["model_failures"] >= 2
+        assert srv.stats["shed"] >= 2
+        assert srv.stats["rejected"].get("model_error", 0) >= 2
+    finally:
+        srv.close()
+
+
+def test_admitted_requests_expire_behind_open_breaker():
+    """Admitted work must never hang behind an open breaker: requests
+    queued when the trip happens are swept 'expired' once their
+    deadline passes (the dispatch-time re-check cannot run while
+    nothing dispatches), so every handle goes terminal and a SIGTERM
+    drain is not stalled for its full timeout."""
+    fail = {"on": False}
+    srv = ModelServer(_np_model(fail=fail), (2,), max_batch=1,
+                      slo_ms=300, breaker_limit=1, coalesce_ms=0.0)
+    srv.start(warm=True)
+    fail["on"] = True
+    handles = [srv.submit(onp.zeros((2,), "float32"))
+               for _ in range(4)]
+    t0 = time.perf_counter()
+    reasons = []
+    for h in handles:
+        with pytest.raises(ServeRejected) as ei:
+            h.result(timeout=5)  # well under 5 s: ~the 300 ms SLO
+        reasons.append(ei.value.reason)
+    wait_s = time.perf_counter() - t0
+    try:
+        assert wait_s < 2.0, \
+            f"terminal states took {wait_s:.1f}s for a 300 ms SLO"
+        assert set(reasons) <= {"model_error", "expired"}
+        assert "expired" in reasons, reasons  # the sweep fired
+        # with nothing left in flight, drain is immediate, not a
+        # timeout burn
+        t0 = time.perf_counter()
+        assert srv.drain(timeout=5.0) is True
+        assert time.perf_counter() - t0 < 1.0
+    finally:
+        srv.close()
+
+
+def test_nan_poison_counts_as_model_failure():
+    """serve.model nan: poisoned outputs are the bad-step guard's
+    serving analog — withheld from callers (structured model_error)
+    and counted toward the breaker."""
+    srv = ModelServer(_np_model(), (2,), max_batch=2, slo_ms=10000,
+                      breaker_limit=3, coalesce_ms=0.0)
+    srv.start(warm=True)
+    faultsim.reset("serve.model:nan@1+")
+    try:
+        for _ in range(3):
+            h = srv.submit(onp.zeros((2,), "float32"))
+            with pytest.raises(ServeRejected) as ei:
+                h.result(timeout=10)
+            assert ei.value.reason == "model_error"
+        deadline = time.monotonic() + 5
+        while srv.health()["breaker"] != "open" \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert srv.health()["breaker"] == "open"
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------------------ telemetry
+def test_serve_records_counters_and_textfile(tmp_path, monkeypatch):
+    from mxnet_tpu import telemetry as tm
+    from mxnet_tpu.telemetry import schema as tm_schema
+
+    textfile = str(tmp_path / "metrics.prom")
+    monkeypatch.setenv("MXNET_METRICS_TEXTFILE", textfile)
+    path = str(tmp_path / "run.jsonl")
+    tm.reset(path)
+    srv = ModelServer(_np_model(delay=0.005), (2,), max_batch=4,
+                      slo_ms=30000, queue_depth=4, coalesce_ms=2.0)
+    srv.start(warm=True)
+    try:
+        handles, reasons = [], []
+        for _ in range(16):
+            try:
+                handles.append(srv.submit(onp.zeros((2,), "float32")))
+            except ServeRejected as e:
+                reasons.append(e.reason)
+        _drain_handles(handles)
+    finally:
+        srv.close()
+        tm.close()
+    with open(path) as f:
+        recs, problems = tm_schema.validate_lines(f)
+    assert not problems, problems[:5]
+    serves = [r for r in recs if r["type"] == "serve"]
+    assert serves, "serve records must land in the run log"
+    for r in serves:
+        assert 1 <= r["batch"] <= r["padded_to"]
+        assert r["padded_to"] in (1, 2, 4)
+        assert r["latency_ms"] > 0
+        assert r["model"] == "model"
+        assert r["breaker"] == "closed"
+    end = next(r for r in recs if r["type"] == "run_end")
+    c = end["counters"]
+    assert c["serve_requests"] == 16
+    assert c["serve_batches"] == len(serves)
+    assert c["serve_shed"] == len(reasons) + \
+        sum(1 for h in handles if not h.ok)
+    # Prometheus textfile rows for the serving counters
+    text = open(textfile).read()
+    assert "mxnet_tpu_serve_requests 16" in text
+    assert "mxnet_tpu_serve_batches" in text
+    assert "mxnet_tpu_serve_shed" in text
+    assert "mxnet_tpu_serve_breaker_trips 0" in text
+
+
+def test_bounded_retrace_compile_events(tmp_path):
+    """Non-AOT serving reports (at most) one compile event per padded
+    bucket shape — the run-log retrace counter bounds the program
+    count by construction."""
+    from mxnet_tpu import telemetry as tm
+
+    path = str(tmp_path / "run.jsonl")
+    tm.reset(path)
+    srv = ModelServer(_np_model(), (2,), max_batch=4, slo_ms=30000,
+                      coalesce_ms=0.0)
+    srv.start(warm=True)
+    try:
+        hs = [srv.submit(onp.zeros((2,), "float32"))
+              for _ in range(9)]
+        ok, _ = _drain_handles(hs)
+        assert len(ok) == 9
+    finally:
+        srv.close()
+        tm.close()
+    recs = [json.loads(ln) for ln in open(path)]
+    compiles = [r for r in recs if r["type"] == "compile"
+                and r["program"] == "serve:model"]
+    assert 1 <= len(compiles) <= len(default_buckets(4))
+    end = next(r for r in recs if r["type"] == "run_end")
+    assert end["counters"]["compiles"] <= len(default_buckets(4))
+
+
+# --------------------------------------------------------------- health
+def test_health_probe_lifecycle():
+    srv = ModelServer(_np_model(), (2,), max_batch=2, slo_ms=1000)
+    h = srv.health()
+    assert h["live"] is False and h["ready"] is False  # not started
+    srv.start(warm=True)
+    assert srv.live() and srv.ready()
+    assert srv.health()["ewma_ms"], "warmup must seed the EWMA"
+    srv.drain()
+    assert srv.ready() is False  # draining: not routable
+    srv.close()
+    h = srv.health()
+    assert h["live"] is False and h["ready"] is False
+
+
+# ---------------------------------------------------- microbatch seeding
+def test_from_predictor_seeds_buckets_from_tuned_winner(tmp_path,
+                                                        monkeypatch):
+    """The persisted tune_microbatch winner seeds the serving bucket
+    plan: every bucket is a multiple of the winning chunk count, and a
+    second server (fresh process semantics via cache_clear) reloads
+    the winner from autotune.json instead of re-timing."""
+    from mxnet_tpu import autotune as at
+    from mxnet_tpu.parallel import functionalize
+
+    monkeypatch.setenv("MXNET_AUTOTUNE_CACHE_DIR", str(tmp_path))
+    at.cache_clear()
+    net = gluon.nn.Dense(3, in_units=4)
+    net.initialize()
+    params, apply_fn = functionalize(net, train=False)
+    ex = onp.random.rand(4, 4).astype("float32")
+    srv = ModelServer.from_predictor(apply_fn, params, ex,
+                                     candidates=(1, 2), tune_iters=2,
+                                     slo_ms=30000)
+    srv.start(warm=True)
+    try:
+        k, _unroll = srv.microbatch
+        assert k in (1, 2)
+        assert all(b % k == 0 for b in srv.buckets)
+        assert srv.buckets[-1] == 4
+        out = srv.submit(ex[0]).result(timeout=30)
+        ref = onp.asarray(net(nd.array(ex[:1])).asnumpy())[0]
+        onp.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    finally:
+        srv.close()
+    # the winner persisted: a fresh consult answers from the cache
+    assert os.path.exists(os.path.join(str(tmp_path),
+                                       "autotune.json"))
+    at.cache_clear()
+    t0 = time.perf_counter()
+    srv2 = ModelServer.from_predictor(apply_fn, params, ex,
+                                      candidates=(1, 2), tune_iters=2,
+                                      slo_ms=30000)
+    reload_s = time.perf_counter() - t0
+    assert srv2.microbatch == srv.microbatch
+    assert reload_s < 5.0  # lookups + jit build, no timing race
+    at.cache_clear()
+
+
+# ------------------------------------------------------- the main drills
+def _export_artifact(tmp_path, batch=4):
+    net = gluon.nn.Dense(5, in_units=3)
+    net.initialize(init=mx.init.Xavier())
+    x = nd.zeros((batch, 3))
+    path = os.path.join(str(tmp_path), "served.mxje")
+    mx.deploy.export_model(net, x, path, platforms=("cpu",))
+    return path, net
+
+
+def test_aot_artifact_serving_matches_model(tmp_path):
+    path, net = _export_artifact(tmp_path)
+    srv = ModelServer.from_artifact(path, slo_ms=30000,
+                                    coalesce_ms=1.0)
+    srv.start(warm=True)
+    try:
+        assert srv.aot is True
+        x = onp.random.rand(3).astype("float32")
+        out = srv.submit(x).result(timeout=30)
+        ref = net(nd.array(x[None, :])).asnumpy()[0]
+        onp.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+        wr = srv.warm_report()
+        assert wr["aot"] is True
+        assert wr["warm_start_s"] > 0
+    finally:
+        srv.close()
+
+
+def test_bursty_load_drill_slo_shed_no_hangs():
+    """THE acceptance drill (in-process half): bursty — not steady —
+    synthetic load with serve.model DELAY faults injected mid-burst.
+    Admitted requests meet their deadline at p99; the overload is
+    absorbed as structured rejections (shed > 0); every submitted
+    request reaches a terminal state (zero silent hangs)."""
+    from mxnet_tpu.telemetry.opstats import percentile
+
+    srv = ModelServer(_np_model(delay=0.002), (4,), max_batch=4,
+                      slo_ms=3000.0, queue_depth=6, coalesce_ms=0.5)
+    srv.start(warm=True)
+    # mid-burst slow-downs: invocations 3-6 each stall 50 ms
+    faultsim.reset("serve.model:delay=0.05@3-6")
+    handles, shed = [], 0
+    try:
+        for _burst in range(3):
+            burst_handles = []
+            for _ in range(20):  # 20 at once >> queue_depth 6: bursty
+                try:
+                    burst_handles.append(
+                        srv.submit(onp.zeros((4,), "float32")))
+                except ServeRejected:
+                    shed += 1
+            ok, rejected = _drain_handles(burst_handles, timeout=30)
+            shed += len(rejected)
+            handles.extend(burst_handles)
+            time.sleep(0.02)  # burst gap
+        # zero silent hangs: every handle is terminal
+        assert all(h.done for h in handles)
+        lat = sorted(h.latency_ms for h in handles if h.ok)
+        assert lat, "some requests must have been admitted+served"
+        p99 = percentile(lat, 0.99)
+        assert p99 <= srv.slo_ms, \
+            f"admitted p99 {p99:.1f} ms blew the {srv.slo_ms} ms SLO"
+        # the burst overloaded the queue: load WAS shed, structured
+        assert shed > 0
+        assert shed == srv.stats["shed"]
+        st = srv.stats
+        assert st["requests"] == 60
+        assert len(lat) + shed == st["requests"]
+    finally:
+        srv.close()
+
+
+@pytest.mark.unit
+def test_sigterm_drain_exits_clean(tmp_path):
+    """SIGTERM mid-traffic: bounded in-flight work — admitted
+    requests finish, new ones get structured 'draining' rejections,
+    the report flushes, and the exit is the clean signal death the
+    orchestrator expects (rc -15)."""
+    artifact, _net = _export_artifact(tmp_path)
+    out_json = str(tmp_path / "drain.json")
+    env = dict(os.environ)
+    env.pop("MXNET_FAULT_SPEC", None)
+    proc = subprocess.Popen(
+        [sys.executable, _WORKER, "drain", artifact, out_json],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env)
+    try:
+        ready = out_json + ".ready"
+        deadline = time.monotonic() + 120
+        while not os.path.exists(ready) \
+                and time.monotonic() < deadline:
+            if proc.poll() is not None:
+                pytest.fail("worker died early: "
+                            + proc.stderr.read()[-2000:])
+            time.sleep(0.05)
+        assert os.path.exists(ready), "worker never started serving"
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    assert proc.returncode == -signal.SIGTERM
+    with open(out_json) as f:
+        report = json.load(f)
+    # bounded in-flight: every admitted request reached terminal state
+    assert report["submitted"] > 0
+    assert report["terminal"] == report["submitted"]
+    assert report["completed"] >= 5
+    assert not report["errors"], report["errors"]
+    # post-SIGTERM submits were rejected structured, not hung
+    assert report["health_after_drain"]["ready"] is False
+
+
+@pytest.mark.unit
+def test_kill_mid_traffic_flight_dump_then_warm_relaunch(tmp_path):
+    """The crash half of the acceptance drill: a hard death
+    mid-traffic (faultsim ``crash`` = os._exit with no cleanup — the
+    deterministic kill -9) leaves a flight-recorder dump, and the
+    RELAUNCH serves from the AOT artifact with the run-log retrace
+    counter at 0: load-not-retrace, warm inside the startup budget."""
+    artifact, _net = _export_artifact(tmp_path)
+    runlog1 = str(tmp_path / "crash.jsonl")
+    env = dict(os.environ)
+    env["MXNET_RUNLOG"] = runlog1
+    env["MXNET_FAULT_SPEC"] = "serve.model:crash@4"
+    r = subprocess.run(
+        [sys.executable, _WORKER, "crash", artifact],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert r.returncode == faultsim.CRASH_EXIT_CODE, \
+        (r.returncode, r.stderr[-2000:])
+    # the flight dump is the post-mortem the hard death left behind
+    flight = runlog1 + ".flight.json"
+    assert os.path.exists(flight)
+    with open(flight) as f:
+        dump = json.load(f)
+    assert dump["reason"] == "fault_crash:serve.model"
+    assert dump["counters"]["serve_requests"] > 0
+    assert dump["counters"]["serve_batches"] >= 1
+
+    # relaunch: same artifact, fresh run log — serving again, warm,
+    # with ZERO compile events (the AOT program cannot retrace)
+    runlog2 = str(tmp_path / "relaunch.jsonl")
+    report_json = str(tmp_path / "relaunch.json")
+    env = dict(os.environ)
+    env["MXNET_RUNLOG"] = runlog2
+    env.pop("MXNET_FAULT_SPEC", None)
+    r = subprocess.run(
+        [sys.executable, _WORKER, "relaunch", artifact, report_json],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    with open(report_json) as f:
+        report = json.load(f)
+    assert report["completed"] > 0
+    assert report["terminal"] == report["submitted"]
+    assert not report["errors"], report["errors"]
+    assert report["warm_report"]["aot"] is True
+    assert report["warm_report"]["warm_start_s"] < 30.0
+    recs = [json.loads(ln) for ln in open(runlog2)]
+    end = next(rc for rc in recs if rc["type"] == "run_end")
+    assert end["counters"]["compiles"] == 0, \
+        "AOT relaunch must be load-not-retrace"
+    assert end["counters"]["serve_batches"] >= 1
