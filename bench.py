@@ -831,6 +831,136 @@ def _measure_collectives(deadline):
     return json.loads(lines[-1])
 
 
+def _fk_chain_time(fn, init, deadline, iters=6):
+    """The shared in-step slope timer (autotune.chain_time) with the
+    bench's deadline degrade: a bitten budget shortens the slope to 2
+    iterations — a degraded slope beats no slope."""
+    from mxnet_tpu.autotune import chain_time
+
+    if deadline.exceeded():
+        iters = 2
+    return chain_time(fn, init, iters=iters)
+
+
+def _measure_fused_kernels(smoke, deadline):
+    """The ``fused_kernels`` phase (round 14): race every new Pallas
+    kernel variant in-step through the autotune registry on a
+    representative mini-program — the fused-bucket optimizer update
+    (``fused_bucket_opt``), flash attention with its block-size and
+    padding sub-variants (``flash_attention``), and the three-way
+    BN+ReLU+conv1x1 backward (``pallas_bnreluconv``: stock vs fused-
+    jnp vs fused-pallas).  Winners persist in autotune.json exactly
+    like the main step's conv race; on CPU the kernel arms run in
+    interpret mode (they lose, correctly — the phase proves the race
+    and the registry, the TPU run proves the speedup)."""
+    import numpy as onp
+
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import autotune as at
+    from mxnet_tpu.ops.flash_attention import flash_attention
+    from mxnet_tpu.ops.pallas_conv import fused_bn_relu_conv1x1
+    from mxnet_tpu.optimizer.optimizer import Adam
+    from mxnet_tpu.parallel import zero
+
+    report = {}
+    rng = onp.random.RandomState(0)
+
+    # -- fused_bucket_opt: the ZeRO-1 inner update over one flat bucket
+    L = 64 * 1024 if smoke else 4 * 1024 * 1024
+    w0 = jnp.asarray(rng.randn(L).astype("float32"))
+    g0 = jnp.asarray(rng.randn(L).astype("float32") * 1e-3)
+    opt = Adam(learning_rate=1e-3, wd=1e-4)
+    plan = zero.plan_buckets({"w": w0}, 1, capacity=L + 1)
+    bucket = plan[0]
+
+    def bucket_measure(_value):
+        m0 = jnp.zeros((L,), jnp.float32)
+        v0 = jnp.zeros((L,), jnp.float32)
+
+        def fn(c, i):
+            w, m, v = c
+            _, uw, (um, uv) = zero.bucket_shard_update(
+                bucket, opt, {"w": w}, g0, (m, v),
+                (i + 1).astype(jnp.float32), n_shards=1, idx=0,
+                axis=None)
+            return (uw, um, uv)
+
+        return _fk_chain_time(fn, (w0, m0, v0), deadline)
+
+    winner, info = at.tune("fused_bucket_opt", (L,), "float32",
+                           at.VARIANT_OPS["fused_bucket_opt"],
+                           bucket_measure)
+    report["fused_bucket_opt"] = {"winner": winner, **info}
+    if deadline.exceeded():
+        deadline.note("fused_kernels:bucket")
+
+    # -- flash_attention: fwd+bwd through the custom vjp; the smoke
+    # seq (96) is deliberately NOT tile-aligned so the pallas arm falls
+    # back (emitting the attributed event) while pallas_pad races the
+    # kernel through the padding shim
+    b, h, s, d = (1, 1, 96, 8) if smoke else (2, 8, 512, 64)
+    q0 = jnp.asarray(rng.randn(b, h, s, d).astype("float32") * 0.1)
+    kk = jnp.asarray(rng.randn(b, h, s, d).astype("float32") * 0.1)
+    vv = jnp.asarray(rng.randn(b, h, s, d).astype("float32") * 0.1)
+
+    def attn_measure(_value):
+        def loss(q):
+            return (flash_attention(q, kk, vv, causal=True)
+                    .astype(jnp.float32) ** 2).mean()
+
+        def fn(c, i):
+            return c - 0.01 * jax.grad(loss)(c)
+
+        return _fk_chain_time(fn, q0, deadline)
+
+    winner, info = at.tune("flash_attention", q0.shape, "float32",
+                           at.VARIANT_OPS["flash_attention"],
+                           attn_measure)
+    report["flash_attention"] = {"winner": winner, **info}
+
+    # -- pallas_bnreluconv: stock (unfused) vs fused-jnp vs
+    # fused-pallas backward over the bottleneck-tail shape
+    M, Ci, Co = (512, 8, 16) if smoke else (16384, 256, 64)
+    u0 = jnp.asarray(rng.randn(M, 1, 1, Ci).astype("float32"))
+    gamma = jnp.asarray(rng.rand(Ci).astype("float32") + 0.5)
+    beta = jnp.asarray(rng.randn(Ci).astype("float32") * 0.1)
+    wt = jnp.asarray(rng.randn(Co, 1, 1, Ci).astype("float32") * 0.1)
+
+    def brc_measure(value):
+        if value == "stock":
+            w2 = wt.reshape(Co, Ci).T
+
+            def loss(u):
+                # the unfused layer-path math XLA fuses on its own
+                u32 = u.astype(jnp.float32).reshape(-1, Ci)
+                mean = u32.mean(0)
+                var = ((u32 - mean) ** 2).mean(0)
+                bnout = ((u32 - mean) * jax.lax.rsqrt(var + 1e-5)
+                         * gamma + beta).astype(u.dtype)
+                act = jnp.maximum(bnout, 0)
+                y = act @ w2
+                return (y.astype(jnp.float32) ** 2).mean()
+        else:
+            def loss(u):
+                # fused op; jnp-vs-pallas backward follows the forced
+                # variant via _use_pallas at trace time
+                y, _, _ = fused_bn_relu_conv1x1(u, gamma, beta, wt)
+                return (y.astype(jnp.float32) ** 2).mean()
+
+        def fn(c, i):
+            return c - 0.01 * jax.grad(loss)(c)
+
+        return _fk_chain_time(fn, u0, deadline)
+
+    winner, info = at.tune("pallas_bnreluconv", u0.shape, "float32",
+                           at.VARIANT_OPS["pallas_bnreluconv"],
+                           brc_measure)
+    report["pallas_bnreluconv"] = {"winner": winner, **info}
+    report["raced"] = sorted(k for k in report if k != "raced")
+    return report
+
+
 def _conv_ab(batch, smoke, deadline):
     """Step-level MXNET_CONV_1X1_DOT A/B in NHWC (the flag only lowers
     CHANNEL-LAST 1x1 convs to dot_general — ops/conv.py:60-83).
@@ -1020,6 +1150,13 @@ def main(argv=None):
                     "device_init")
     _write_partial(out, "device_init")
 
+    # the bf16 dtype-ladder arm (round 14) races in the main step's
+    # autotune when no explicit compute_dtype pins the answer (smoke
+    # runs fp32 nets; full mode pins bfloat16, so the ladder race is
+    # a smoke/registry proof there).  Opt-in by knob; respect a
+    # caller's explicit setting.
+    os.environ.setdefault("MXNET_DTYPE_LADDER", "1")
+
     _heartbeat("build")
     t_build0 = time.monotonic()
     net, classes = _build_net(args.smoke, layout)
@@ -1192,6 +1329,27 @@ def main(argv=None):
             out["degraded"] = True
             reasons.append(f"collectives phase failed: {exc!r}")
     _write_partial(out, "collectives")
+
+    # fused-kernels phase (round 14): race every new Pallas kernel
+    # variant in-step through the autotune registry — the fused-bucket
+    # optimizer update, flash attention (block-size + padding-shim
+    # sub-variants) and the three-way BN+ReLU+conv backward — winners
+    # persisted in autotune.json beside the main step's
+    if deadline.exceeded(margin=0.0 if args.smoke else 60.0):
+        out["fused_kernels"] = "skipped (deadline)"
+        out["degraded"] = True
+        reasons.append("deadline: skipped fused-kernels phase")
+        deadline.note("fused_kernels")
+    else:
+        _heartbeat("fused_kernels")
+        try:
+            out["fused_kernels"] = _measure_fused_kernels(args.smoke,
+                                                          deadline)
+        except Exception as exc:  # auxiliary metric: never kill the run
+            out["fused_kernels"] = {"error": repr(exc)}
+            out["degraded"] = True
+            reasons.append(f"fused-kernels phase failed: {exc!r}")
+    _write_partial(out, "fused_kernels")
 
     # INFERENCE serving phase (round 13): the continuous-batching
     # model server under bursty synthetic load — admitted p50/p99,

@@ -418,6 +418,19 @@ def _bench_extra_inputs():
         "_fused_bucket_lars_update": (
             [flat, flat.copy(), flat.copy(), seg],
             dict(lr=0.1, momentum=0.9, num_segments=16)),
+        # round 14: the Pallas fused-bucket kernel arms of the same
+        # three updates (ops/pallas_opt.py — prep + rule + loss-scale
+        # check in one VMEM pass; interpret mode off-TPU) so benchdiff
+        # trends kernel-vs-jnp per round
+        "_pallas_bucket_sgd_mom_update": (
+            [flat, flat.copy(), flat.copy()],
+            dict(lr=0.1, momentum=0.9)),
+        "_pallas_bucket_adam_update": (
+            [flat, flat.copy(), flat.copy(), flat.copy()],
+            dict(lr=0.1)),
+        "_pallas_bucket_lars_update": (
+            [flat, flat.copy(), flat.copy(), seg],
+            dict(lr=0.1, momentum=0.9, num_segments=16)),
     })
     scalar_cmp = {
         name: ([a], dict(scalar=0.5))

@@ -63,7 +63,9 @@ opperf_smoke() {
 dot,Convolution,BatchNorm,FullyConnected,softmax,SyncBatchNorm,\
 _contrib_BNReluConv,sgd_update,adam_update,multi_lars,\
 _fused_bucket_sgd_mom_update,_fused_bucket_adam_update,\
-_fused_bucket_lars_update,_random_uniform,\
+_fused_bucket_lars_update,_pallas_bucket_sgd_mom_update,\
+_pallas_bucket_adam_update,_pallas_bucket_lars_update,\
+_random_uniform,\
 _npi_interp,_npi_full_like,_contrib_quantize,MultiBoxPrior \
         | tee OPPERF_smoke.jsonl
 }
@@ -80,23 +82,45 @@ telemetry_smoke() {
 }
 
 benchdiff_smoke() {
-    # round-over-round trend gate, two halves:
+    # round-over-round trend gate, three halves:
     # 1) tools/benchdiff.py must parse EVERY committed BENCH_r*/
     #    OPPERF_* artifact without crashing (r05's rc=124/parsed:null
     #    included — flagged as a REGRESSION with reason "missing
     #    metric") — unpinned, so new rounds stay covered;
     # 2) the --fail-on-regression exit contract is asserted on the
     #    r01–r05 window PINNED by glob, so a good future r06 making
-    #    the latest round green cannot flip this gate red.
+    #    the latest round green cannot flip this gate red;
+    # 3) round 14: BENCH_r06 exists — the unpinned run must give it a
+    #    real VERDICT (baseline/ok/improved/regression-with-a-number),
+    #    never the r05 "missing metric" shape again.
     python tools/benchdiff.py > /tmp/benchdiff_smoke.txt
     cat /tmp/benchdiff_smoke.txt
     grep -Eq "r05 .*regression: missing metric" /tmp/benchdiff_smoke.txt
+    grep -Eq "^r06 " /tmp/benchdiff_smoke.txt
+    if grep -Eq "r06 .*missing metric" /tmp/benchdiff_smoke.txt; then
+        echo "benchdiff_smoke: r06 must carry a metric-backed verdict"
+        return 1
+    fi
     if python tools/benchdiff.py --bench 'BENCH_r0[1-5].json' \
             --opperf 'OPPERF_r0[1-5].jsonl' --fail-on-regression \
             > /dev/null 2>&1; then
         echo "benchdiff_smoke: expected nonzero exit on the r05 gap"
         return 1
     fi
+}
+
+pallas_smoke() {
+    # fused-kernel gate (round 14) on CPU in seconds: every Pallas
+    # kernel runs in interpret mode against its jnp baseline — the
+    # fused-bucket optimizer updates (sgd bit-exact, adam ulp-tight,
+    # lars allclose, the fused loss-scale verdict, the ZeRO step and
+    # Module-updater plumbing, winner persistence across processes)
+    # and flash attention fwd+bwd incl. causal, non-square, the
+    # padding shim and the fallback telemetry event.  Also collected
+    # by tier-1, so a regression turns the unit suite red between CI
+    # runs.
+    JAX_PLATFORMS=cpu python -m pytest tests/test_pallas_opt.py \
+        tests/test_attention.py -q
 }
 
 watchdog_smoke() {

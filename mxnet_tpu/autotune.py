@@ -49,19 +49,86 @@ import time
 __all__ = ["variant_choice", "force", "program_scope", "lookup",
            "record", "tune", "tune_train_step", "mesh_desc",
            "cache_path", "cache_clear", "last_report",
-           "VARIANT_OPS"]
+           "dtype_ladder_armed", "chain_time", "VARIANT_OPS"]
 
 #: op -> {variant name: forced value}.  The forced value is what the
 #: op's trace-time ``variant_choice`` consumer receives.
 VARIANT_OPS = {
     "conv1x1_dot": {"conv": False, "dot": True},
-    "pallas_bnreluconv": {"jnp": False, "pallas": True},
+    # round 14: three-way — "stock" (the unfused layer path, the r05
+    # in-step winner), "jnp" (the fused op's jnp backward), "pallas"
+    # (the fused op's one-pass kernel backward).  All three race
+    # in-step so the per-shape winner is measured, not documented.
+    "pallas_bnreluconv": {"stock": "stock", "jnp": "jnp",
+                          "pallas": "pallas"},
+    # round 14: the Pallas fused-bucket optimizer kernels
+    # (ops/pallas_opt.py) vs the jnp fused_bucket_update baseline,
+    # consulted by parallel.zero.bucket_shard_update
+    "fused_bucket_opt": {"jnp": False, "pallas": True},
+    # round 14: flash-attention lowering incl. block-size sub-variants
+    # and the aligned-padding shim (ops/flash_attention.py)
+    "flash_attention": {"naive": "naive", "pallas": "pallas",
+                        "pallas_b256": "pallas_b256",
+                        "pallas_pad": "pallas_pad"},
+    # round 14: the bf16 dtype-ladder arm — make_train_step's compute
+    # dtype raced fp32 vs bf16 (amp_cast_params) per program signature;
+    # consulted only when the MXNET_DTYPE_LADDER knob arms it (a dtype
+    # change is not numerics-neutral, so adoption is opt-in)
+    "dtype_ladder": {"fp32": "fp32", "bf16": "bf16"},
 }
 
-#: env var that explicitly overrides each variant op (precedence 2)
+
+def _parse_bool(raw):
+    return raw.lower() in ("1", "true", "yes", "on")
+
+
+def _parse_flash(raw):
+    lowered = raw.lower()
+    if lowered in ("0", "false", "no", "off", "naive"):
+        return "naive"
+    if lowered in ("1", "true", "yes", "on", "pallas"):
+        return "pallas"
+    if lowered in ("pallas_b256", "pallas_pad"):
+        return lowered
+    return None  # unknown value: no override
+
+
+def _parse_ladder(raw):
+    lowered = raw.lower()
+    if lowered in ("bf16", "bfloat16"):
+        return "bf16"
+    if lowered in ("0", "off", "fp32", "float32"):
+        return "fp32"
+    return None  # "1"/"auto": armed, but no hand override
+
+
+def _parse_bnreluconv(raw):
+    lowered = raw.lower()
+    return lowered if lowered in ("stock", "jnp", "pallas") else None
+
+
+#: env var that explicitly overrides each variant op (precedence 2),
+#: with a per-op parser from the raw env string to the forced value
+#: (None = this raw value carries no override)
 _ENV_OVERRIDE = {
-    "conv1x1_dot": "MXNET_CONV_1X1_DOT",
+    "conv1x1_dot": ("MXNET_CONV_1X1_DOT", _parse_bool),
+    "fused_bucket_opt": ("MXNET_PALLAS_OPT", _parse_bool),
+    "flash_attention": ("MXNET_FLASH_ATTENTION", _parse_flash),
+    "dtype_ladder": ("MXNET_DTYPE_LADDER", _parse_ladder),
+    "pallas_bnreluconv": ("MXNET_BNRELUCONV_VARIANT",
+                          _parse_bnreluconv),
 }
+
+
+def dtype_ladder_armed():
+    """The bf16 ladder arm races/applies only when the knob arms it:
+    MXNET_DTYPE_LADDER set to anything but '0'/'off'/'fp32'-like.  A
+    cached bf16 winner changes step numerics, so it never applies to a
+    caller that did not opt in."""
+    raw = os.environ.get("MXNET_DTYPE_LADDER")
+    if raw is None:
+        return False
+    return raw.lower() not in ("", "0", "off", "false", "no")
 
 _tls = threading.local()
 _lock = threading.Lock()
@@ -104,9 +171,11 @@ def variant_choice(op, default=None):
         return forced[op]
     env = _ENV_OVERRIDE.get(op)
     if env is not None:
-        raw = os.environ.get(env)
+        raw = os.environ.get(env[0])
         if raw is not None:
-            return raw.lower() in ("1", "true", "yes", "on")
+            parsed = env[1](raw)
+            if parsed is not None:
+                return parsed
     applied = _get_scope("applied")
     if op in applied:
         return applied[op]
@@ -291,37 +360,52 @@ def last_report():
 
 
 # ------------------------------------------------------------- the tuner
-def _step_chain_time(step, params, opt_state, x, y, key, iters=8):
-    """Marginal sec/step of ``step(params, opt_state, x, y, key, t) ->
-    (loss, params, opt_state)`` measured INSIDE one jitted program: a
-    dynamic-bound fori_loop threads params/opt_state through the carry
-    (iterations serialize by construction), one loss readback drains
-    the pipeline, and the two-K slope cancels the dispatch+readback
-    constant (bench.py methodology; host timing loops alone are
-    untrustworthy on the tunnel)."""
+def chain_time(fn, init, iters=8):
+    """Marginal sec/iteration of ``fn(carry, i) -> carry`` measured
+    INSIDE one jitted program: a dynamic-bound fori_loop threads the
+    carry (iterations serialize by construction), ONE readback of the
+    first carry leaf drains the pipeline, and the two-K slope cancels
+    the dispatch+readback constant (bench.py methodology; host timing
+    loops alone are untrustworthy on the tunnel).  The ONE shared
+    timer behind every variant race — _step_chain_time, the
+    ShardedBucketUpdater's exchange race, bench's fused-kernels phase
+    — so a methodology fix lands everywhere at once."""
     import jax
     import jax.numpy as jnp
 
     @jax.jit
-    def multi(k, p, o):
-        def body(i, carry):
-            p_, o_, _ = carry
-            loss, p2, o2 = step(p_, o_, x, y, key,
-                                (i + 1).astype(jnp.float32))
-            return (p2, o2, loss)
+    def multi(k, c):
+        def body(i, c_):
+            return fn(c_, i)
 
-        return jax.lax.fori_loop(0, k, body,
-                                 (p, o, jnp.float32(0.0)))[2]
+        c2 = jax.lax.fori_loop(0, k, body, c)
+        return jax.tree_util.tree_leaves(c2)[0].ravel()[0]
 
     def run(k):
         t0 = time.perf_counter()
-        _ = float(multi(jnp.int32(k), params, opt_state))
+        _ = float(multi(jnp.int32(k), init))
         return time.perf_counter() - t0
 
     run(2)  # compile (the dynamic bound keeps it to ONE program)
     t1 = run(2)
     t2 = run(2 + iters)
     return max(t2 - t1, 1e-9) / iters
+
+
+def _step_chain_time(step, params, opt_state, x, y, key, iters=8):
+    """:func:`chain_time` over a make_train_step-shaped
+    ``step(params, opt_state, x, y, key, t) -> (loss, params,
+    opt_state)`` (loss rides the carry so the readback sees it)."""
+    import jax.numpy as jnp
+
+    def body(carry, i):
+        _, p_, o_ = carry
+        loss, p2, o2 = step(p_, o_, x, y, key,
+                            (i + 1).astype(jnp.float32))
+        return (loss, p2, o2)
+
+    return chain_time(body, (jnp.float32(0.0), params, opt_state),
+                      iters=iters)
 
 
 def tune(op, shape, dtype, variants, measure, platform=None, mesh=None,

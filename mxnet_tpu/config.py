@@ -138,6 +138,32 @@ register_env("MXNET_AUTOTUNE_CACHE_DIR", "", str,
              "Directory for autotune.json (persisted variant winners). "
              "Empty = next to JAX_COMPILATION_CACHE_DIR, falling back "
              "to ~/.cache/mxnet_tpu.")
+register_env("MXNET_PALLAS_OPT", "", str,
+             "Hand override for the 'fused_bucket_opt' autotune "
+             "variant (round 14): 1 forces the Pallas fused-bucket "
+             "optimizer kernels (ops/pallas_opt.py — prep + update + "
+             "loss-scale check in one VMEM pass), 0 forces the jnp "
+             "fused_bucket_update.  Unset: the in-step race decides "
+             "per (shape, dtype, platform, mesh).")
+register_env("MXNET_FLASH_ATTENTION", "", str,
+             "Hand override for the 'flash_attention' autotune "
+             "variant (round 14): naive/0, pallas/1, pallas_b256 "
+             "(256x256 blocks), or pallas_pad (tile-align by padding "
+             "+ masked keys).  Unset: cached winner, then the "
+             "TPU+tiling heuristic.")
+register_env("MXNET_DTYPE_LADDER", "", str,
+             "The bf16 dtype-ladder knob (round 14).  Unset/0: the "
+             "ladder never races or applies (a dtype change is not "
+             "numerics-neutral, so it is opt-in).  1/auto: "
+             "make_train_step races fp32 vs bf16 compute in-step "
+             "(compute_dtype=None steps only) and applies the cached "
+             "per-program winner.  bf16/fp32: hand-pin the arm.")
+register_env("MXNET_BNRELUCONV_VARIANT", "", str,
+             "Hand override for the 'pallas_bnreluconv' autotune "
+             "variant: stock (unfused layer path), jnp (fused op, jnp "
+             "backward), pallas (fused op, one-pass Pallas backward). "
+             "Unset: cached per-shape winner, then "
+             "MXNET_FUSED_BNRELUCONV.")
 register_env("MXNET_DEVICE_FEED", True, bool,
              "Async double-buffered device feed: DataLoader / "
              "Module.fit / bench.py wrap their batch source in "

@@ -23,5 +23,6 @@ from . import (  # noqa: F401  (import-for-registration)
     control_flow_ops,
     optimizer_ops,
     pallas_conv,
+    pallas_opt,
 )
 from .registry import OpDef, alias_op, get_op, list_ops, register_op  # noqa: F401

@@ -16,8 +16,26 @@ contributes dq directly while dk/dv accumulate across chunks.
 Causal masking uses bottom-right alignment (query i attends keys
 j <= i + seq_k - seq_q), identical across kernel/fallback/backward.
 
-Falls back to a fused jnp implementation off-TPU or for shapes that
-don't tile (seq % block != 0) — same math, same vjp.
+Round 14 — the kernel is an in-step autotune variant: the
+``flash_attention`` op in ``autotune.VARIANT_OPS`` races the naive
+fused-jnp math against the Pallas schedule (block-size sub-variants
+included) inside the caller's real jitted step, and the winner applies
+per (shape, dtype, platform, mesh) at trace time.  Variants:
+
+* ``naive``       — the fused jnp math (XLA's own fusion);
+* ``pallas``      — the kernel at the default 128/128 q/k blocks;
+* ``pallas_b256`` — 256/256 blocks (wins on long-seq shapes where the
+  larger q tile amortizes the k/v stream);
+* ``pallas_pad``  — tile-align by PADDING: non-aligned seq lens pad up
+  to the block size, padded keys are masked out of the softmax
+  (``kv_valid``), padded query rows are sliced off — so shapes that
+  used to silently fall back to jnp can still race the kernel.
+
+Falls back to the fused jnp implementation off-TPU or for shapes that
+don't tile (seq % block != 0) — same math, same vjp.  The silent part
+of that fallback is gone: a shape that WANTED the kernel but could not
+tile emits an ``autotune`` telemetry event naming the reason, so a
+run log shows exactly which attention shapes never raced.
 """
 from __future__ import annotations
 
@@ -31,22 +49,43 @@ from .registry import register_op
 _BLOCK_Q = 128
 _BLOCK_K = 128
 
+#: forced-value -> (block_q, block_k) for the kernel sub-variants
+_VARIANT_BLOCKS = {
+    "pallas": (_BLOCK_Q, _BLOCK_K),
+    "pallas_b256": (256, 256),
+    "pallas_pad": (_BLOCK_Q, _BLOCK_K),
+}
 
-def _naive_attention(q, k, v, causal, sm_scale):
-    """Reference math in fp32: softmax(q k^T * scale [+ mask]) v."""
+
+def _naive_attention(q, k, v, causal, sm_scale, kv_valid=None,
+                     q_valid=None):
+    """Reference math in fp32: softmax(q k^T * scale [+ mask]) v.
+    ``kv_valid``/``q_valid`` are the padding-shim contract: keys at
+    positions >= kv_valid are masked out, and the causal alignment is
+    computed against the VALID lengths so padding never shifts which
+    real keys a real query sees."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * sm_scale
+    qlen, klen = s.shape[-2], s.shape[-1]
     if causal:
-        qlen, klen = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((qlen, klen), bool), klen - qlen)
+        eff_k = klen if kv_valid is None else kv_valid
+        eff_q = qlen if q_valid is None else q_valid
+        mask = jnp.tril(jnp.ones((qlen, klen), bool), eff_k - eff_q)
         s = jnp.where(mask, s, -jnp.inf)
+    if kv_valid is not None and kv_valid < klen:
+        kmask = (jnp.arange(klen) < kv_valid)[None, None, None, :]
+        s = jnp.where(kmask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
+    if kv_valid is not None and kv_valid < klen:
+        # a fully-masked row softmaxes to uniform garbage; zero it the
+        # way the kernel's l=0 guard does
+        p = jnp.where(jnp.isfinite(s).any(-1, keepdims=True), p, 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", p,
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, sm_scale,
-                  block_k, seq_k):
+                  block_k, seq_k, kv_valid, q_valid):
     from jax.experimental import pallas as pl
 
     q = q_ref[0].astype(jnp.float32)  # (block_q, d)
@@ -54,7 +93,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, sm_scale,
     qi = pl.program_id(1)
     seq_q = pl.num_programs(1) * block_q
     # bottom-right causal alignment: shift query positions by sk - sq
-    q_off = qi * block_q + (seq_k - seq_q)
+    # computed against the VALID lengths when the padding shim
+    # appended masked keys / sliced-off queries
+    eff_k = seq_k if kv_valid is None else kv_valid
+    eff_q = seq_q if q_valid is None else q_valid
+    q_off = qi * block_q + (eff_k - eff_q)
 
     m = jnp.full((block_q,), -jnp.inf, jnp.float32)
     l = jnp.zeros((block_q,), jnp.float32)
@@ -69,12 +112,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, sm_scale,
         v_blk = v_ref[0, pl.dslice(kb * block_k, block_k),
                       :].astype(jnp.float32)
         s = q @ k_blk.T * sm_scale  # (block_q, block_k)
+        kpos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
         if causal:
             qpos = q_off + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            kpos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        if kv_valid is not None and kv_valid < seq_k:
+            # padding shim: keys past the true length never score
+            s = jnp.where(kpos < kv_valid, s, -jnp.inf)
         m_new = jnp.maximum(m, s.max(axis=-1))
         # guard fully-masked rows: exp(-inf - -inf) -> use safe max
         m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
@@ -85,19 +131,22 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, sm_scale,
         acc = acc * alpha[:, None] + p @ v_blk
         return m_new, l, acc
 
+    last_kb = num_kb
+    if kv_valid is not None and kv_valid < seq_k:
+        # the tail blocks past the true key length are fully masked
+        last_kb = (kv_valid + block_k - 1) // block_k
     if causal:
         # skip key blocks entirely above the diagonal
         last_kb = jnp.minimum((q_off + block_q + block_k - 1) // block_k,
-                              num_kb)
-    else:
-        last_kb = num_kb
+                              last_kb)
     m, l, acc = jax.lax.fori_loop(0, last_kb, body, (m, l, acc))
     out = acc / jnp.maximum(l, 1e-30)[:, None]
     o_ref[0] = out.astype(o_ref.dtype)
 
 
 def _flash_forward_pallas(q, k, v, causal, sm_scale, block_q=_BLOCK_Q,
-                          block_k=_BLOCK_K, interpret=False):
+                          block_k=_BLOCK_K, kv_valid=None,
+                          q_valid=None, interpret=False):
     from jax.experimental import pallas as pl
 
     b, h, sq, d = q.shape
@@ -109,7 +158,8 @@ def _flash_forward_pallas(q, k, v, causal, sm_scale, block_q=_BLOCK_Q,
     grid = (bh, sq // block_q)
     kernel = functools.partial(_flash_kernel, causal=causal,
                                sm_scale=sm_scale, block_k=block_k,
-                               seq_k=sk)
+                               seq_k=sk, kv_valid=kv_valid,
+                               q_valid=q_valid)
     out = pl.pallas_call(
         kernel,
         grid=grid,
@@ -125,37 +175,107 @@ def _flash_forward_pallas(q, k, v, causal, sm_scale, block_q=_BLOCK_Q,
     return out.reshape(b, h, sq, d)
 
 
+_FALLBACK_SEEN = set()
+
+
+def _fallback_event(reason, q, k, block_q, block_k):
+    """A shape that wanted the kernel but fell back to the fused jnp
+    math: emit an ``autotune`` run-log event naming the reason (the
+    silent half of _can_use_pallas, now attributed).  Deduped per
+    (shapes, blocks) — an eager predict loop re-executes this per
+    call, and N identical records explain nothing the first did not."""
+    dedup = (tuple(q.shape), tuple(k.shape), block_q, block_k)
+    if dedup in _FALLBACK_SEEN:
+        return
+    try:
+        from .. import telemetry
+
+        if telemetry.current() is None:
+            return  # unarmed: nothing recorded, don't latch the dedup
+        telemetry.event(
+            "autotune", op="flash_attention", winner="naive",
+            cached=False, reason=str(reason),
+            shape=str((tuple(q.shape), tuple(k.shape))),
+            blocks=f"{block_q}x{block_k}")
+        _FALLBACK_SEEN.add(dedup)
+    except Exception:
+        pass  # telemetry must never kill a trace
+
+
+def _on_tpu_target():
+    from .pallas_conv import _on_tpu  # ONE backend probe for all three
+    #                                   kernel families (ops package
+    #                                   import order: probe lazily)
+
+    return _on_tpu()
+
+
 def _can_use_pallas(q, k, block_q, block_k):
+    """Feasibility of the kernel for this shape+platform.  No longer a
+    silent gate: a tile-alignment miss emits a telemetry event naming
+    the reason (and the ``pallas_pad`` variant exists exactly so these
+    shapes can still race aligned-padded)."""
     sq, sk = q.shape[2], k.shape[2]
     if sq % block_q or sk % block_k:
+        _fallback_event(
+            f"seq not tile-aligned (seq_q {sq} % {block_q} = "
+            f"{sq % block_q}, seq_k {sk} % {block_k} = {sk % block_k});"
+            " the pallas_pad variant can race this shape padded",
+            q, k, block_q, block_k)
         return False
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:
-        return False
+    return _on_tpu_target()
 
 
 def _tiles(q, k, block_q=_BLOCK_Q, block_k=_BLOCK_K):
     return q.shape[2] % block_q == 0 and k.shape[2] % block_k == 0
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, sm_scale, interpret):
-    if _tiles(q, k) and (interpret or _can_use_pallas(q, k, _BLOCK_Q,
-                                                      _BLOCK_K)):
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, sm_scale, interpret, variant, kv_valid,
+           q_valid):
+    if variant == "naive":
+        return _naive_attention(q, k, v, causal, sm_scale,
+                                kv_valid=kv_valid, q_valid=q_valid)
+    if variant in _VARIANT_BLOCKS:
+        bq, bk = _VARIANT_BLOCKS[variant]
+        if not _tiles(q, k, bq, bk):
+            _fallback_event(
+                f"forced variant {variant!r} cannot tile "
+                f"(seq_q {q.shape[2]}, seq_k {k.shape[2]})",
+                q, k, bq, bk)
+            return _naive_attention(q, k, v, causal, sm_scale,
+                                    kv_valid=kv_valid, q_valid=q_valid)
+        # an explicitly chosen kernel variant runs the kernel even
+        # off-TPU (interpret mode): the race stays honest on any host
+        return _flash_forward_pallas(
+            q, k, v, causal, sm_scale, block_q=bq, block_k=bk,
+            kv_valid=kv_valid, q_valid=q_valid,
+            interpret=interpret or not _on_tpu_target())
+    # default heuristic (no variant decision): kernel on TPU where the
+    # shape tiles, fused jnp otherwise — _can_use_pallas emits the
+    # attributed fallback event on a tile-alignment miss
+    if (interpret and _tiles(q, k)) or \
+            _can_use_pallas(q, k, _BLOCK_Q, _BLOCK_K):
         return _flash_forward_pallas(q, k, v, causal, sm_scale,
+                                     kv_valid=kv_valid,
+                                     q_valid=q_valid,
                                      interpret=interpret)
-    return _naive_attention(q, k, v, causal, sm_scale)
+    return _naive_attention(q, k, v, causal, sm_scale,
+                            kv_valid=kv_valid, q_valid=q_valid)
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, interpret):
-    return _flash(q, k, v, causal, sm_scale, interpret), (q, k, v)
+def _flash_fwd(q, k, v, causal, sm_scale, interpret, variant, kv_valid,
+               q_valid):
+    return (_flash(q, k, v, causal, sm_scale, interpret, variant,
+                   kv_valid, q_valid), (q, k, v))
 
 
 _BWD_CHUNK = 512
 
 
-def _flash_bwd(causal, sm_scale, interpret, res, g):
+def _flash_bwd(causal, sm_scale, interpret, variant, kv_valid, q_valid,
+               res, g):
     # recompute in query chunks: O(chunk * seq_k) live attention rows
     # instead of the full O(seq^2) matrix
     q, k, v = res
@@ -169,12 +289,20 @@ def _flash_bwd(causal, sm_scale, interpret, res, g):
     def chunk_attn(q_c, k_, v_, off):
         s = jnp.einsum("bhqd,bhkd->bhqk", q_c.astype(jnp.float32),
                        k_.astype(jnp.float32)) * sm_scale
+        kpos = jnp.arange(sk)
         if causal:
-            qpos = off + jnp.arange(chunk) + (sk - sq)
-            kpos = jnp.arange(sk)
+            eff_k = sk if kv_valid is None else kv_valid
+            eff_q = sq if q_valid is None else q_valid
+            qpos = off + jnp.arange(chunk) + (eff_k - eff_q)
             s = jnp.where((qpos[:, None] >= kpos[None, :])[None, None],
                           s, -jnp.inf)
+        if kv_valid is not None and kv_valid < sk:
+            s = jnp.where((kpos < kv_valid)[None, None, None], s,
+                          -jnp.inf)
         p = jax.nn.softmax(s, axis=-1)
+        if kv_valid is not None and kv_valid < sk:
+            p = jnp.where(jnp.isfinite(s).any(-1, keepdims=True), p,
+                          0.0)
         return jnp.einsum("bhqk,bhkd->bhqd", p,
                           v_.astype(jnp.float32)).astype(q_c.dtype)
 
@@ -198,18 +326,64 @@ def _flash_bwd(causal, sm_scale, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _pad_to(x, axis, mult):
+    n = x.shape[axis]
+    pad = (-n) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _resolve_variant(variant):
+    """The trace-time variant decision: explicit arg > the autotune
+    registry's ``flash_attention`` choice (force > env > cached
+    winner) > None (the platform heuristic)."""
+    if variant is not None:
+        return variant
+    from ..autotune import variant_choice
+
+    return variant_choice("flash_attention")
+
+
 def flash_attention(q, k, v, causal=False, sm_scale=None,
-                    interpret=False):
-    """Fused attention over (batch, heads, seq, head_dim) operands."""
+                    interpret=False, variant=None):
+    """Fused attention over (batch, heads, seq, head_dim) operands.
+
+    ``variant`` picks the lowering explicitly (``naive`` / ``pallas``
+    / ``pallas_b256`` / ``pallas_pad``); None consults the autotune
+    registry (``VARIANT_OPS['flash_attention']``) and falls back to
+    the platform heuristic."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
-    return _flash(q, k, v, causal, float(sm_scale), interpret)
+    variant = _resolve_variant(variant)
+    if variant == "pallas_pad":
+        bq, bk = _VARIANT_BLOCKS["pallas_pad"]
+        sq, sk = q.shape[2], k.shape[2]
+        if sq % bq == 0 and sk % bk == 0:
+            variant = "pallas"  # already aligned: no shim needed
+        else:
+            # pad q AND k/v up to the blocks; the kernel computes the
+            # causal alignment against the VALID lengths (q_valid/
+            # kv_valid), padded keys are masked out of the softmax,
+            # and padded query rows are sliced off below
+            qp = _pad_to(q, 2, bq)
+            kp = _pad_to(k, 2, bk)
+            vp = _pad_to(v, 2, bk)
+            out = _flash(qp, kp, vp, causal, float(sm_scale),
+                         interpret, "pallas",
+                         sk if kp.shape[2] != sk else None,
+                         sq if qp.shape[2] != sq else None)
+            return out[:, :, :sq, :]
+    return _flash(q, k, v, causal, float(sm_scale), interpret, variant,
+                  None, None)
 
 
 @register_op("_contrib_dot_product_attention",
              aliases=("dot_product_attention",))
 def dot_product_attention(q, k, v, *, num_heads=1, causal=False,
-                          sm_scale=None, interpret=False):
+                          sm_scale=None, interpret=False, variant=None):
     """Multi-head attention over (batch, seq, num_heads*head_dim)
     inputs, flash-backed (the modern replacement for the reference's
     contrib attention helpers)."""
@@ -222,7 +396,7 @@ def dot_product_attention(q, k, v, *, num_heads=1, causal=False,
 
     out = flash_attention(split(q, sq), split(k, sk), split(v, sk),
                           causal=causal, sm_scale=sm_scale,
-                          interpret=interpret)
+                          interpret=interpret, variant=variant)
     return out.transpose(0, 2, 1, 3).reshape(b, sq, hd)
 
 

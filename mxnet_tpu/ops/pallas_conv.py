@@ -47,24 +47,32 @@ def _on_tpu():
 
 
 def enabled():
-    """The fused block is used by model code when this is on
-    (MXNET_FUSED_BNRELUCONV=1; default OFF).
+    """Is the fused block used by model code for the program being
+    traced?  Decision order: the ``pallas_bnreluconv`` autotune
+    variant (``stock`` = unfused layer path, ``jnp``/``pallas`` = the
+    fused op with that backward) — a tuner ``force`` scope, the
+    MXNET_BNRELUCONV_VARIANT hand override, or a cached per-shape
+    winner applied by the jit entry points' ``program_scope`` — then
+    the legacy MXNET_FUSED_BNRELUCONV env (1 = fused), default OFF.
 
-    r05 measurement (v5e, ResNet-50 bs128 bf16 NHWC): the one-pass
-    fused backward wins in ISOLATION (0.48ms pallas / 0.55ms jnp vs
-    1.18ms for XLA's two passes over the same tensors), but loses
-    in-step (54.8ms pallas / 61.5ms jnp vs 46.3ms stock): XLA assigns
-    conv-emitter-custom layouts to the surrounding activations, so
-    every custom-call boundary pays a ~0.6ms relayout copy, and the
-    pass-2 BN input gradient no longer fuses into the upstream conv's
-    backward across the opaque boundary.  Kept as an opt-in fused op
-    (correctness-tested vs the layer path); the win would need the
-    neighboring convs to speak default layouts too.
+    The r05 isolation-win/in-step-loss gap (the kernel won the 0.48 vs
+    1.18 ms microbench yet lost the step 54.8 vs 46.3 ms to relayout
+    copies at the custom-call boundary) is exactly why all THREE arms
+    — stock, fused-jnp, fused-pallas — are separate in-step autotune
+    entries now: the per-shape call is whatever autotune.json's
+    measured winner says for this program signature, not a docstring.
 
     Read at TRACE time: a hybridized block bakes the choice into its
     cached program, so flipping the env var after the first call does
     not retrace (same as every env-config knob read inside traced
     code).  Toggle before building/hybridizing the net."""
+    from ..autotune import variant_choice
+
+    choice = variant_choice("pallas_bnreluconv")
+    if choice in ("jnp", "pallas", True):
+        return True
+    if choice in ("stock", False):
+        return False
     env = os.environ.get("MXNET_FUSED_BNRELUCONV")
     if env is not None:
         return env == "1"
@@ -154,12 +162,16 @@ def _pick_block_m(M, Ci, Co, esize):
     return None
 
 
-def _bwd_pass1_pallas(dy, u, w2, g, b, mu, inv):
+def _bwd_pass1_pallas(dy, u, w2, g, b, mu, inv, interpret=None):
     M, Co = dy.shape
     Ci = u.shape[1]
     bm = _pick_block_m(M, Ci, Co, dy.dtype.itemsize)
     if bm is None:  # VMEM plan doesn't fit: wide 1x1s stay on XLA
         return _bwd_pass1_jnp(dy, u, w2, g, b, mu, inv)
+    if interpret is None:
+        # an explicitly chosen kernel arm off-TPU (the autotune race on
+        # a CPU host) runs in interpret mode — honest, just slow
+        interpret = _INTERPRET or not _target_is_tpu(dy)
     grid = ((M + bm - 1) // bm,)
     vec = lambda: pl.BlockSpec((1, Ci), lambda i: (0, 0))
     kern = partial(_bwd_kernel, rows_total=M, block_m=bm)
@@ -186,7 +198,7 @@ def _bwd_pass1_pallas(dy, u, w2, g, b, mu, inv):
         scratch_shapes=[pltpu.VMEM((Ci, Co), jnp.float32),
                         pltpu.VMEM((1, Ci), jnp.float32),
                         pltpu.VMEM((1, Ci), jnp.float32)],
-        interpret=_INTERPRET,
+        interpret=interpret,
     )(dy, u, w2, g, b, mu, inv)
 
 
@@ -270,12 +282,18 @@ def _use_pallas(x):
     # autotune variant "pallas_bnreluconv": a tuner race or a cached
     # per-program winner overrides the platform heuristic (the r05
     # lesson — isolated kernel wins can be in-step losses, so the
-    # kernel-vs-XLA call is owned by in-step timing where available)
+    # kernel-vs-XLA call is owned by in-step timing where available).
+    # "pallas" picks the kernel backward, "jnp"/"stock" the jnp math
+    # (inside a "stock" program this vjp should never trace, but the
+    # jnp pass is the right conservative answer if it does).
     from ..autotune import variant_choice
 
     choice = variant_choice("pallas_bnreluconv")
     if choice is not None:
-        return bool(choice) and feasible
+        # an explicit kernel choice is feasible ANYWHERE: off-TPU the
+        # pallas_call runs in interpret mode (keys carry the platform,
+        # so a TPU-recorded winner never leaks onto a CPU program)
+        return choice in ("pallas", True)
     return feasible
 
 

@@ -46,6 +46,7 @@ from ..base import MXNetError
 __all__ = ["Bucket", "plan_buckets", "flatten_bucket", "unflatten_bucket",
            "bucket_segments", "shard_slice", "collective_bytes",
            "resolve_sharding_env", "plan_fingerprint",
+           "flat_variant_key", "resolve_bucket_variant",
            "ShardedBucketUpdater"]
 
 
@@ -181,7 +182,8 @@ def shard_slice(flat, n_shards, idx):
 
 
 def bucket_shard_update(bucket, opt, params, g_sh, state, t, *, n_shards,
-                        idx, axis, seg=None, key=None):
+                        idx, axis, seg=None, key=None, pallas=None,
+                        want_finite=False):
     """The per-bucket owned-shard update core, shared by
     :meth:`ShardedBucketUpdater._build` and ``make_train_step``'s ps
     step — ONE copy, so the two arms' seg-id slicing and shard layout
@@ -190,18 +192,58 @@ def bucket_shard_update(bucket, opt, params, g_sh, state, t, *, n_shards,
     and runs the fused rule on it against the already-scattered
     gradient shard ``g_sh``.  Returns ``(w_sh, new_w_sh, new_state)``
     un-gathered, so the caller can finite-gate the update before
-    :func:`gather_bucket`."""
+    :func:`gather_bucket`.
+
+    ``pallas``: which lowering runs the update — True for the fused
+    Pallas bucket kernels (ops/pallas_opt.py: prep + rule + the
+    loss-scale finiteness check in ONE VMEM pass), False for the jnp
+    ``fused_bucket_update``, None to consult the ``fused_bucket_opt``
+    autotune variant at trace time (force > MXNET_PALLAS_OPT > cached
+    per-program winner > jnp).  An infeasible kernel (unsupported
+    rule/dtype) silently keeps the jnp arm — in a race that just
+    means the jnp arm wins.
+
+    ``want_finite=True`` returns a 4th element: the loss-scale verdict
+    ``isfinite(g_sh).all()`` of the RAW (pre-dtype-cast) gradient —
+    fused into the kernel's pass on the pallas arm, or None on the
+    jnp arm (the caller keeps its own jnp check, bit-identical to
+    today's)."""
     import jax.numpy as jnp
 
     w_sh = shard_slice(flatten_bucket(bucket, params), n_shards, idx)
-    kwargs = {}
+    seg_sh = None
     if seg is not None:
         ids, nseg = seg
-        kwargs = dict(
-            seg_ids=shard_slice(jnp.asarray(ids), n_shards, idx),
-            num_segments=nseg, axis_name=axis)
-    uw, us = opt.fused_bucket_update(w_sh, g_sh, state, t, key=key,
+        seg_sh = (shard_slice(jnp.asarray(ids), n_shards, idx), nseg)
+    use_pallas = pallas
+    if use_pallas is None:
+        from ..autotune import variant_choice
+
+        use_pallas = bool(variant_choice("fused_bucket_opt"))
+    finite = None
+    if use_pallas:
+        from ..ops import pallas_opt
+
+        res = pallas_opt.bucket_update(
+            opt, w_sh, g_sh, state, t, seg=seg_sh, axis_name=axis,
+            with_finite=want_finite)
+        if res is not None:
+            uw, us, finite = res
+            if want_finite:
+                return w_sh, uw, us, finite
+            return w_sh, uw, us
+    # the gradient may arrive in a wider dtype than the bucket (the ps
+    # step's f32 unscale): cast here so both arms and both callers
+    # share one rule (a no-op when dtypes already match)
+    gq = g_sh.astype(w_sh.dtype)
+    kwargs = {}
+    if seg_sh is not None:
+        kwargs = dict(seg_ids=seg_sh[0], num_segments=seg_sh[1],
+                      axis_name=axis)
+    uw, us = opt.fused_bucket_update(w_sh, gq, state, t, key=key,
                                      **kwargs)
+    if want_finite:
+        return w_sh, uw, us, None
     return w_sh, uw, us
 
 
@@ -213,6 +255,42 @@ def gather_bucket(bucket, w_sh, axis):
 
     return unflatten_bucket(
         bucket, jax.lax.all_gather(w_sh, axis, tiled=True))
+
+
+def flat_variant_key(plan):
+    """The ``fused_bucket_opt`` autotune key for a bucket plan: the
+    total padded element count + lead dtype — what the kernels
+    actually stream, shared by the ps train step, the Module updater
+    and the bench bucket race so a winner measured by one reaches the
+    others on the same plan."""
+    return ((sum(b.padded for b in plan),),
+            plan[0].dtype if plan else "float32")
+
+
+def resolve_bucket_variant(optimizer, plan, mesh=None):
+    """Resolve the ``fused_bucket_opt`` lowering for a bucket plan at
+    BUILD time: a force scope / MXNET_PALLAS_OPT override first, then
+    kernel feasibility, then the cached winner under the flat-layout
+    key.  Returns True (Pallas), False (jnp), or None — undecided, so
+    the trace-time ``variant_choice`` consult still applies (force
+    scopes entered around a later trace keep working)."""
+    from .. import autotune as _at
+    from ..ops import pallas_opt
+
+    choice = _at.variant_choice("fused_bucket_opt")
+    if choice is not None:
+        return bool(choice)
+    if not _at.enabled():
+        return False
+    shape, dtype = flat_variant_key(plan)
+    if pallas_opt.supported(optimizer, dtype) is not None:
+        return False
+    cached = _at.lookup("fused_bucket_opt", shape, dtype,
+                        mesh=_at.mesh_desc(mesh))
+    if cached is not None:
+        return bool(_at.VARIANT_OPS["fused_bucket_opt"].get(cached,
+                                                            False))
+    return None
 
 
 def plan_fingerprint(plan, n_shards):
@@ -411,6 +489,11 @@ class ShardedBucketUpdater:
         # exactly as eager's _update_count would produce)
         self._t = int(getattr(optimizer, "num_update", 0) or 0)
         self._fn = None
+        #: which lowering runs the per-shard update: True = the fused
+        #: Pallas bucket kernels (ops/pallas_opt), False = jnp; None =
+        #: not decided yet (resolved at first _build via the
+        #: "fused_bucket_opt" autotune registry — see _decide_variant)
+        self._pallas = None
         states = []
         for b in self.plan:
             st = optimizer.fused_state(flatten_bucket(
@@ -490,6 +573,7 @@ class ShardedBucketUpdater:
         self._rebuild_bucket_opts()
         self._states = self._flatten_to_plan(per_param)
         self._fn = None
+        self._pallas = None  # new plan = new variant key: re-decide
 
     def _place_state(self, st):
         import jax
@@ -499,7 +583,75 @@ class ShardedBucketUpdater:
                            else self._repl) for s in st)
 
     # ----------------------------------------------------------- update
-    def _build(self):
+    def _variant_key(self):
+        """The autotune cache key for this updater's program — the
+        shared flat-layout key (:func:`flat_variant_key`), plus the
+        mesh component."""
+        from .. import autotune as _at
+
+        shape, dtype = flat_variant_key(self.plan)
+        return shape, dtype, _at.mesh_desc(self.mesh)
+
+    def _decide_variant(self):
+        """Resolve the "fused_bucket_opt" lowering for this updater —
+        the eager-Module analog of make_train_step's in-step race.
+        :func:`resolve_bucket_variant` handles the shared precedence
+        (force/env override, feasibility, cached flat-key winner);
+        undecided on TPU triggers an in-step race of the updater's
+        OWN jitted exchange — jnp vs Pallas over the real bucket plan
+        with synthetic gradients — whose winner persists under the
+        same flat key the ps train step consults.  Off-TPU with no
+        override and no cache: jnp (the interpret-mode kernel can only
+        lose; racing it would cost minutes to learn that)."""
+        from .. import autotune as _at
+        from ..ops import pallas_opt
+
+        decided = resolve_bucket_variant(self.optimizer, self.plan,
+                                         self.mesh)
+        if decided is not None:
+            return decided
+        if not pallas_opt._on_tpu():
+            return False
+        shape, dtype, mesh_d = self._variant_key()
+
+        def measure(value):
+            return self._time_update(pallas=bool(value))
+
+        winner, _ = _at.tune("fused_bucket_opt", shape, dtype,
+                             _at.VARIANT_OPS["fused_bucket_opt"],
+                             measure, mesh=mesh_d)
+        return bool(_at.VARIANT_OPS["fused_bucket_opt"].get(
+            winner, False))
+
+    def _time_update(self, pallas):
+        """Marginal sec/update of THIS updater's exchange under the
+        given lowering: the shared :func:`autotune.chain_time`
+        two-K-slope over a non-donating jit of the real mapped update
+        on synthetic small gradients — the program that actually runs
+        per Module.update."""
+        import jax
+        import jax.numpy as jnp
+
+        from .. import autotune as _at
+
+        mapped = self._make_mapped(pallas)
+        p_shardings, _ = self._shardings()
+        params = {n: jnp.zeros(self._shapes[n],
+                               dtype=self._dtypes[n].name)
+                  for b in self.plan for n in b.names}
+        grads = {n: jnp.full(self._shapes[n], 1e-3,
+                             dtype=self._dtypes[n].name)
+                 for n in params}
+        params = jax.device_put(params,
+                                {n: p_shardings[n] for n in params})
+
+        def body(carry, i):
+            p_, s_ = carry
+            return mapped(p_, grads, s_, (i + 1).astype(jnp.float32))
+
+        return _at.chain_time(body, (params, self._states))
+
+    def _make_mapped(self, pallas):
         import jax
         from jax.sharding import PartitionSpec as P
 
@@ -523,7 +675,7 @@ class ShardedBucketUpdater:
                 _, uw, us = bucket_shard_update(
                     b, opts[i], params_, g_sh, states_[i], t,
                     n_shards=n_sh, idx=idx, axis=axis,
-                    seg=segs[i] if needs_seg else None)
+                    seg=segs[i] if needs_seg else None, pallas=pallas)
                 new_p.update(gather_bucket(b, uw, axis))
                 new_states.append(us)
             return new_p, new_states
@@ -531,14 +683,26 @@ class ShardedBucketUpdater:
         p_specs = {n: P() for b in plan for n in b.names}
         s_specs = [tuple(P(axis) if getattr(s, "ndim", 0) else P()
                          for s in st) for st in self._states]
-        mapped = compat_shard_map(
+        return compat_shard_map(
             local_update, self.mesh,
             in_specs=(p_specs, p_specs, s_specs, P()),
             out_specs=(p_specs, s_specs))
-        p_shardings = {n: self._repl for n in p_specs}
+
+    def _shardings(self):
+        p_shardings = {n: self._repl for b in self.plan
+                       for n in b.names}
         s_shardings = [tuple(self._state_sh if getattr(s, "ndim", 0)
                              else self._repl for s in st)
                        for st in self._states]
+        return p_shardings, s_shardings
+
+    def _build(self):
+        import jax
+
+        if self._pallas is None:
+            self._pallas = self._decide_variant()
+        mapped = self._make_mapped(self._pallas)
+        p_shardings, s_shardings = self._shardings()
         # donate only the states (we own them between calls); the
         # params/grads buffers stay live in the executor's NDArrays
         self._fn = jax.jit(
@@ -718,6 +882,8 @@ class ShardedBucketUpdater:
             self._rebuild_bucket_opts()
             self._hyper_sig = self._current_hyper_sig()
             self._fn = None  # hyper-params may have changed: re-trace
+            self._pallas = None  # a new optimizer may change kernel
+            #                      eligibility: re-decide the lowering
             # dumps carry the count on the optimizer itself — and it is
             # FRESHER than any "__step" states entry: an eager run that
             # resumed a sharded file carries the old "__step" inert

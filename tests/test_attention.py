@@ -138,3 +138,100 @@ def test_ring_attention_gradients():
     for a, b_ in zip(gr, gn):
         onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b_),
                                     rtol=1e-3, atol=1e-4)
+
+
+# ---------------------------------------- round 14: variants + pad shim
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_pad_variant_matches_naive_nonaligned(causal):
+    """The padding shim: non-tile-aligned, NON-SQUARE seq lens run the
+    kernel padded with masked keys; fwd and bwd match the reference
+    (bottom-right causal alignment computed against the VALID key
+    length, not the padded one)."""
+    rng = onp.random.RandomState(3)
+    q = jnp.asarray(rng.randn(2, 2, 70, 16).astype("float32") * 0.3)
+    k = jnp.asarray(rng.randn(2, 2, 90, 16).astype("float32") * 0.3)
+    v = jnp.asarray(rng.randn(2, 2, 90, 16).astype("float32") * 0.3)
+    ref = _naive_attention(q, k, v, causal, 0.25)
+    out = flash_attention(q, k, v, causal=causal, sm_scale=0.25,
+                          variant="pallas_pad", interpret=True)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=1e-5, atol=1e-6)
+
+    def loss_pad(q_, k_, v_):
+        return (flash_attention(q_, k_, v_, causal=causal,
+                                sm_scale=0.25, variant="pallas_pad",
+                                interpret=True) ** 2).sum()
+
+    def loss_naive(q_, k_, v_):
+        return (_naive_attention(q_, k_, v_, causal, 0.25) ** 2).sum()
+
+    gp = jax.grad(loss_pad, argnums=(0, 1, 2))(q, k, v)
+    gn = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gp, gn):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b_),
+                                    rtol=1e-4, atol=1e-5)
+
+
+def test_block_size_subvariant_matches_naive():
+    q, k, v = _qkv(b=1, h=2, s=256, d=16)
+    ref = _naive_attention(q, k, v, True, 0.25)
+    out = flash_attention(q, k, v, causal=True, sm_scale=0.25,
+                          variant="pallas_b256", interpret=True)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_variant_registry_consult(tmp_path, monkeypatch):
+    """flash_attention with no explicit variant consults the autotune
+    registry: a force scope pins the lowering, and a cached winner
+    applies through program_scope."""
+    from mxnet_tpu import autotune as at
+
+    monkeypatch.setenv("MXNET_AUTOTUNE_CACHE_DIR",
+                       str(tmp_path / "atc"))
+    at.cache_clear()
+    q, k, v = _qkv(b=1, h=1, s=64, d=8)
+    ref = _naive_attention(q, k, v, False, 1.0 / (8 ** 0.5))
+    with at.force(flash_attention="pallas_pad"):
+        out = flash_attention(q, k, v, interpret=True)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=1e-5, atol=1e-6)
+    # cached winner path: record + program_scope -> same answer
+    at.record("flash_attention", tuple(q.shape), "float32",
+              winner="naive", platform="cpu", mesh="none")
+    with at.program_scope(q.shape, "float32", platform="cpu",
+                          mesh="none"):
+        out2 = flash_attention(q, k, v)
+    onp.testing.assert_allclose(onp.asarray(out2), onp.asarray(ref),
+                                rtol=1e-6, atol=1e-7)
+    at.cache_clear()
+
+
+def test_fallback_emits_autotune_event(tmp_path):
+    """_can_use_pallas' silent fallback is gone: a non-tile-aligned
+    shape that consulted the default heuristic leaves an ``autotune``
+    event naming the reason in the armed run log."""
+    import json
+
+    from mxnet_tpu import telemetry
+
+    path = str(tmp_path / "run.jsonl")
+    rl = telemetry.reset(path)
+    try:
+        q, k, v = _qkv(b=1, h=1, s=100, d=8)
+        _ = flash_attention(q, k, v)  # 100 % 128 -> fallback
+    finally:
+        telemetry.close()
+    events = []
+    with open(path) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("type") == "event" and \
+                    rec.get("kind") == "autotune":
+                events.append(rec)
+    assert events, "fallback must leave an attributed autotune event"
+    ev = events[-1]
+    assert ev["op"] == "flash_attention"
+    assert ev["winner"] == "naive"
+    assert "tile-aligned" in ev["reason"]
+    assert "pallas_pad" in ev["reason"]

@@ -352,3 +352,58 @@ def test_module_fit_through_device_feed(cache_dir):
             optimizer_params=(("learning_rate", 0.05),))
     out = mod.get_outputs()[0].asnumpy()
     assert out.shape == (8, 3) and onp.isfinite(out).all()
+
+
+# ------------------------------------------- round 14: bf16 dtype ladder
+def test_dtype_ladder_races_and_reloads(cache_dir, monkeypatch):
+    """MXNET_DTYPE_LADDER=1 + compute_dtype=None: make_train_step races
+    fp32 vs bf16 compute in-step, persists the winner, and a rebuild
+    reloads it without re-timing.  Unarmed, the ladder never races."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import make_train_step
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize(init=mx.init.Xavier(), ctx=mx.cpu())
+    net(mx.nd.zeros((1, 6)))
+    x = jnp.asarray(onp.random.rand(4, 6).astype("float32"))
+    y = jnp.asarray(onp.random.randint(0, 3, (4,)).astype("float32"))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    # unarmed: the default roster has no ladder
+    make_train_step(net, loss_fn, learning_rate=0.1,
+                    sample_data=(x, y))
+    assert "dtype_ladder" not in at.last_report()
+
+    monkeypatch.setenv("MXNET_DTYPE_LADDER", "1")
+    step_fn, params, opt = make_train_step(
+        net, loss_fn, learning_rate=0.1, sample_data=(x, y))
+    rep = at.last_report()
+    assert rep["dtype_ladder"]["winner"] in ("fp32", "bf16")
+    assert set(rep["dtype_ladder"]["timings"]) == {"fp32", "bf16"}
+    loss, params, opt = step_fn(params, opt, x, y, jax.random.key(0),
+                                1.0)
+    assert onp.isfinite(float(loss))
+    # rebuild: the winner reloads (pure lookups)
+    make_train_step(net, loss_fn, learning_rate=0.1,
+                    sample_data=(x, y))
+    assert at.last_report()["dtype_ladder"]["cached"] is True
+
+    # a hand-pinned bf16 arm builds a runnable bf16-compute step
+    monkeypatch.setenv("MXNET_DTYPE_LADDER", "bf16")
+    step_fn, params, opt = make_train_step(net, loss_fn,
+                                           learning_rate=0.1)
+    loss, params, opt = step_fn(params, opt, x, y, jax.random.key(0),
+                                1.0)
+    assert onp.isfinite(float(loss))
+
+    # an explicit compute_dtype always wins over the ladder: no race
+    step_fn, params, opt = make_train_step(
+        net, loss_fn, learning_rate=0.1, compute_dtype="bfloat16",
+        sample_data=(x, y))
+    assert "dtype_ladder" not in at.last_report()

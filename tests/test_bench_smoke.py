@@ -54,6 +54,22 @@ def test_smoke_emits_valid_json_with_heartbeats():
     tune = out["autotune"]
     assert tune["conv1x1_dot"]["winner"] in ("conv", "dot")
     assert set(tune["conv1x1_dot"]["timings"]) == {"conv", "dot"}
+    # round 14: the bf16 dtype-ladder arm raced in the main step (the
+    # bench arms MXNET_DTYPE_LADDER; smoke leaves compute_dtype free)
+    assert tune["dtype_ladder"]["winner"] in ("fp32", "bf16")
+    # round 14: the fused-kernels phase raced every new Pallas variant
+    # through the autotune registry and reported winners + timings
+    fk = out["fused_kernels"]
+    assert sorted(fk["raced"]) == ["flash_attention",
+                                  "fused_bucket_opt",
+                                  "pallas_bnreluconv"]
+    assert fk["fused_bucket_opt"]["winner"] in ("jnp", "pallas")
+    assert fk["flash_attention"]["winner"] in (
+        "naive", "pallas", "pallas_b256", "pallas_pad")
+    assert fk["pallas_bnreluconv"]["winner"] in ("stock", "jnp",
+                                                 "pallas")
+    for op in fk["raced"]:
+        assert fk[op].get("cached") or fk[op]["timings"]
     # the device-feed phase measured real steps both ways and reported
     # the per-phase feed/compute overlap
     feed = out["device_feed"]
@@ -130,8 +146,8 @@ def test_smoke_emits_valid_json_with_heartbeats():
     # a heartbeat per phase, so a hang is attributable
     for phase in ("import", "device_init", "build", "autotune",
                   "compile", "K1", "K2", "trials", "feed",
-                  "checkpoint", "collectives", "serving", "telemetry",
-                  "conv_ab", "done"):
+                  "checkpoint", "collectives", "fused_kernels",
+                  "serving", "telemetry", "conv_ab", "done"):
         assert f"phase={phase}" in r.stderr, f"missing phase {phase}"
 
 

@@ -98,3 +98,52 @@ def test_fused_tail_updates_running_stats(fused_env):
     bn2 = list(blk.body._children.values())[4]
     assert float(
         onp.abs(bn2.running_var.data().asnumpy() - 1.0).max()) > 1e-6
+
+
+# --------------------------------------- round 14: the three-way variant
+def test_three_way_variant_gates_fused_block(fused_env):
+    """'stock' beats the MXNET_FUSED_BNRELUCONV env (the layer path
+    runs unfused); 'jnp'/'pallas' enable the fused op without the env;
+    _use_pallas maps the arm to the backward lowering."""
+    from mxnet_tpu import autotune as at
+    from mxnet_tpu.ops import pallas_conv as pc
+
+    assert pc.enabled() is True  # env=1 from the fixture
+    with at.force(pallas_bnreluconv="stock"):
+        assert pc.enabled() is False
+    os.environ.pop("MXNET_FUSED_BNRELUCONV", None)
+    assert pc.enabled() is False
+    with at.force(pallas_bnreluconv="jnp"):
+        assert pc.enabled() is True
+        assert pc._use_pallas(None) is False
+    with at.force(pallas_bnreluconv="pallas"):
+        assert pc.enabled() is True
+        assert pc._use_pallas(None) is True  # interpret off-TPU
+
+
+def test_variant_arms_share_numerics(fused_env):
+    """The jnp and pallas backward arms of the fused op agree (the
+    in-step race only ever trades SPEED, never gradients)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import autotune as at
+    from mxnet_tpu.ops.pallas_conv import fused_bn_relu_conv1x1
+
+    rng = onp.random.RandomState(4)
+    u = jnp.asarray(rng.randn(64, 1, 1, 8).astype("float32"))
+    gamma = jnp.asarray(rng.rand(8).astype("float32") + 0.5)
+    beta = jnp.asarray(rng.randn(8).astype("float32") * 0.1)
+    w = jnp.asarray(rng.randn(16, 1, 1, 8).astype("float32") * 0.1)
+
+    def loss(u_):
+        y, _, _ = fused_bn_relu_conv1x1(u_, gamma, beta, w)
+        return (y.astype(jnp.float32) ** 2).mean()
+
+    grads = {}
+    for arm in ("jnp", "pallas"):
+        with at.force(pallas_bnreluconv=arm):
+            grads[arm] = jax.grad(loss)(u)
+    onp.testing.assert_allclose(onp.asarray(grads["jnp"]),
+                                onp.asarray(grads["pallas"]),
+                                rtol=1e-5, atol=1e-6)
