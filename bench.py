@@ -72,6 +72,12 @@ the round its headline artifact):
   aware admission — and drives bursty synthetic load: admitted
   p50/p99 latency, shed counts, batch structure and the warm-start
   budget land under ``"serving"`` in the JSON;
+* the ``fleet`` INFERENCE phase (round 15) spawns 2 replica server
+  PROCESSES behind the fault-tolerant FleetRouter (HTTP front,
+  least-queue-depth routing, health probes) under bursty load, then
+  rolls a zero-downtime ``.mxje`` model swap across the fleet:
+  replicas/requests/shed/failovers/swap_ms/p50/p99/slo land under
+  ``"fleet"`` in the JSON;
 
 HARNESS PROTOCOL (round 11 — stall-proofing; r05's stall sat inside an
 uninterruptible XLA call where none of the above could run):
@@ -698,6 +704,120 @@ def _measure_serving(net, smoke, deadline):
         "breaker": health["breaker"],
         "breaker_trips": st["breaker_trips"],
     }
+
+
+def _measure_fleet(smoke, deadline):
+    """Fleet INFERENCE phase (round 15): stand the replicated serving
+    fleet (mxnet_tpu.serving.FleetRouter) — 2 replica server
+    PROCESSES behind least-queue-depth routing with health probes —
+    and drive bursty load through the HTTP front, then roll a
+    zero-downtime ``.mxje`` model swap across the fleet.  Reports
+    replicas/requests/shed/failovers/swap_ms/p50/p99/slo into the
+    headline JSON.
+
+    The replicas always run ``JAX_PLATFORMS=cpu`` on a compact
+    artifact: the phase measures the FLEET machinery (routing,
+    failover accounting, rolling-swap cost, drain exits) — the
+    chip-level inference latency story belongs to the ``serving``
+    phase — and two subprocesses must never contend for the benched
+    TPU's exclusive lock."""
+    import shutil
+    import tempfile
+
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.serving import FleetRouter, ServeRejected
+    from mxnet_tpu.telemetry.opstats import percentile
+
+    tmpdir = tempfile.mkdtemp(prefix="mxnet_tpu_bench_fleet_")
+    slo_ms = 8000.0 if smoke else 4000.0
+    n_req = 48 if smoke else 96
+    replicas = 2
+    try:
+        def export(name, seed):
+            mx.random.seed(seed)
+            net = gluon.nn.Dense(16, in_units=8)
+            net.initialize(init=mx.init.Xavier())
+            path = os.path.join(tmpdir, name)
+            mx.deploy.export_model(net, nd.zeros((4, 8)), path,
+                                   platforms=("cpu",))
+            return path
+
+        p1 = export("v1.mxje", 11)
+        p2 = export("v2.mxje", 12)
+        router = FleetRouter.spawn(
+            p1, replicas=replicas, slo_ms=slo_ms,
+            env={"JAX_PLATFORMS": "cpu"}, coalesce_ms=1.0,
+            ready_timeout=min(120.0, max(20.0, deadline.remaining())))
+        lat, shed, errors = [], 0, []
+        lock = threading.Lock()
+        swap = None
+        try:
+            x = onp.random.rand(8).astype("float32")
+
+            def worker(k):
+                nonlocal shed
+                for _ in range(k):
+                    t0 = time.perf_counter()
+                    try:
+                        router.submit(x, deadline_ms=slo_ms)
+                        with lock:
+                            lat.append(
+                                (time.perf_counter() - t0) * 1e3)
+                    except ServeRejected:
+                        with lock:
+                            shed += 1
+                    except Exception as exc:  # noqa: BLE001
+                        # an unexpected failure must stay in the
+                        # ledger — a dead worker thread would break
+                        # completed + shed + errors == requests and
+                        # hide the real error from the report
+                        with lock:
+                            errors.append(repr(exc))
+
+            for _burst in range(2):
+                if deadline.exceeded():
+                    deadline.note("fleet:burst")
+                    break
+                ts = [threading.Thread(target=worker,
+                                       args=(n_req // 8,))
+                      for _ in range(4)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(timeout=120)
+                _heartbeat("fleet", completed=len(lat), shed=shed)
+            if not deadline.exceeded():
+                swap = router.rolling_swap(p2)
+            else:
+                deadline.note("fleet:swap")
+            st = dict(router.stats)
+            health = router.health()
+        finally:
+            rcs = router.close()
+        lat.sort()
+        p99 = percentile(lat, 0.99) if lat else None
+        return {
+            "replicas": replicas,
+            "replicas_final": health["replicas"],
+            "requests": st["requests"], "completed": len(lat),
+            "shed": shed, "errors": len(errors),
+            "error_sample": errors[:3],
+            "failovers": st["failovers"],
+            "resizes": st["resizes"],
+            "swap_ms": swap["swap_ms"] if swap else None,
+            "swap_errors": len(swap["errors"]) if swap else None,
+            "p50_ms": round(percentile(lat, 0.50), 3) if lat
+            else None,
+            "p99_ms": round(p99, 3) if p99 is not None else None,
+            "slo_ms": slo_ms,
+            "p99_within_slo": bool(lat) and p99 <= slo_ms,
+            "drain_rcs": {str(k): v for k, v in rcs.items()},
+        }
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
 
 
 def _ckpt_save(prefix, epoch, params, opt_state):
@@ -1369,6 +1489,25 @@ def main(argv=None):
             out["degraded"] = True
             reasons.append(f"serving phase failed: {exc!r}")
     _write_partial(out, "serving")
+
+    # fleet INFERENCE phase (round 15): 2 replica serving processes
+    # behind the fault-tolerant router — bursty load over HTTP, a
+    # rolling model swap, clean drain exits — fleet robustness
+    # metrics (p99/shed/failovers/swap_ms) land in the headline JSON
+    if deadline.exceeded(margin=0.0 if args.smoke else 60.0):
+        out["fleet"] = "skipped (deadline)"
+        out["degraded"] = True
+        reasons.append("deadline: skipped fleet phase")
+        deadline.note("fleet")
+    else:
+        _heartbeat("fleet")
+        try:
+            out["fleet"] = _measure_fleet(args.smoke, deadline)
+        except Exception as exc:  # auxiliary metric: never kill the run
+            out["fleet"] = {"error": repr(exc)}
+            out["degraded"] = True
+            reasons.append(f"fleet phase failed: {exc!r}")
+    _write_partial(out, "fleet")
 
     # run-telemetry dogfood (round 10): the bench arms a run log,
     # reports its own steps into it, re-reads the JSONL and folds the

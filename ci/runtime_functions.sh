@@ -164,6 +164,22 @@ serving_smoke() {
     JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
 }
 
+fleet_smoke() {
+    # elastic serving fleet gate (round 15): the tier-1 half runs the
+    # HBM-budget/swap/frontend/router units plus THE 2-replica drill —
+    # bursty load over HTTP through the fault-tolerant router with one
+    # replica hard-killed mid-burst (fleet.replica crash fault, its
+    # in-flight work retried on the sibling inside the deadline), a
+    # queue-depth-EWMA scale-up resize (the round-12 reshard event),
+    # and a rolling .mxje model swap leaving the replica run-log
+    # retrace counter 0.  The `slow` half (run here, excluded from
+    # tier-1 by the marker) adds the scale-down-under-load drill (the
+    # SIGTERM'd replica drains via PreemptionDrain, the fleet sheds
+    # NOTHING) and the mid-swap replica crash (fleet.swap crash fault:
+    # the rest of the fleet still upgrades and serves).
+    JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q
+}
+
 elastic_smoke() {
     # elastic scale-out gate (round 12): the tier-1 half runs the
     # single-host resize drill — train dp(4) under optimizer sharding,

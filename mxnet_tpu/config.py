@@ -307,6 +307,30 @@ register_env("MXNET_SERVE_BREAKER_LIMIT", 3, int,
              "breaker opens: requests get fast structured rejections "
              "while the batcher re-warms on probe batches; a probe "
              "success closes it.")
+register_env("MXNET_FLEET_REPLICAS", 2, int,
+             "Default replica-process count of a spawned serving "
+             "fleet (serving.FleetRouter.spawn); the queue-depth "
+             "autoscaler grows/shrinks from here within its "
+             "min/max bounds.")
+register_env("MXNET_FLEET_PORT", 0, int,
+             "Default bind port of the serving HTTP frontend "
+             "(serving.ServeFrontend); 0 = ephemeral (replica "
+             "workers publish the chosen port through their "
+             "--port-file).")
+register_env("MXNET_FLEET_HBM_BUDGET_MB", 0.0, float,
+             "Per-host model-residency budget in MiB for "
+             "serving.ModelHost: a .mxje artifact is admitted only "
+             "if its describe_program() memory_analysis reserved "
+             "bytes fit next to the resident models, else a "
+             "structured ServeRejected(reason='hbm_budget').  "
+             "0 = unlimited.")
+register_env("MXNET_FLEET_SCALE_EWMA", 0.2, float,
+             "EWMA smoothing factor of the fleet autoscaler's "
+             "queue-depth signal (serving.FleetRouter): each health-"
+             "probe sweep folds the per-ready-replica queue depth in "
+             "with this weight; crossing scale_up_depth/"
+             "scale_down_depth triggers the reshard-not-restart "
+             "resize.")
 register_env("DMLC_NUM_WORKER", 1, int,
              "Distributed worker count (tools/launch.py contract).")
 register_env("DMLC_WORKER_ID", 0, int, "This worker's rank.")

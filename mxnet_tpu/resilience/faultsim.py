@@ -43,6 +43,16 @@ Points wired in-tree:
                 failure the retry budget absorbs, ``nan`` = poisoned
                 outputs the breaker counts, ``crash`` = hard death
                 mid-traffic (registered by ``mxnet_tpu.serving``)
+``fleet.route``  serving/fleet.py FleetRouter.submit, inside every
+                routing decision (registered by ``mxnet_tpu.serving``)
+``fleet.replica``  serving/frontend.py, inside every replica predict
+                request — ``crash`` armed in ONE replica's env is the
+                deterministic mid-burst replica death the fleet
+                drills route around (registered by
+                ``mxnet_tpu.serving``)
+``fleet.swap``  serving/fleet.py ModelHost.swap, before the next
+                artifact loads — ``crash`` = mid-swap replica death
+                (registered by ``mxnet_tpu.serving``)
 ==============  =======================================================
 
 Spec grammar (env ``MXNET_FAULT_SPEC`` or ``faultsim.reset(spec)``)::

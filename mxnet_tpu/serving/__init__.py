@@ -17,10 +17,34 @@ robust by construction.
   rejection every shed/expired/tripped request receives (never a
   silent hang).
 
-Fault points ``serve.admit`` / ``serve.batch`` / ``serve.model`` are
-registered with :mod:`mxnet_tpu.resilience.faultsim` when this package
-imports, so ``MXNET_FAULT_SPEC`` drills can target the serving path.
+Round 15 scales it out (:mod:`.fleet` / :mod:`.frontend`):
+
+* :class:`~mxnet_tpu.serving.frontend.ServeFrontend` — the thin HTTP
+  network front (stdlib ``ThreadingHTTPServer``, JSON bodies) mapping
+  the submit/deadline/breaker core onto the wire, structured
+  rejections included.
+* :class:`~mxnet_tpu.serving.fleet.ModelHost` — multi-model residency
+  with explicit HBM budgeting and zero-downtime model swap (load
+  beside, warm-probe, cut over between batches, roll back on a failed
+  probe).
+* :class:`~mxnet_tpu.serving.fleet.FleetRouter` — replicated
+  ModelServer processes behind least-queue-depth routing with health
+  probes, structured failover inside the original deadline,
+  queue-depth-EWMA autoscaling riding the round-12
+  reshard-not-restart resize, and rolling fleet-wide swaps.
+
+Fault points ``serve.admit`` / ``serve.batch`` / ``serve.model`` and
+``fleet.route`` / ``fleet.replica`` / ``fleet.swap`` are registered
+with :mod:`mxnet_tpu.resilience.faultsim` when this package imports,
+so ``MXNET_FAULT_SPEC`` drills can target the serving path.
 """
+from .fleet import (  # noqa: F401
+    FleetRouter,
+    ModelHost,
+    SwapRolledBack,
+    artifact_reserved_bytes,
+)
+from .frontend import ServeFrontend  # noqa: F401
 from .server import (  # noqa: F401
     ModelServer,
     ServeHandle,
@@ -29,4 +53,6 @@ from .server import (  # noqa: F401
 )
 
 __all__ = ["ModelServer", "ServeHandle", "ServeRejected",
-           "default_buckets"]
+           "default_buckets", "ModelHost", "FleetRouter",
+           "ServeFrontend", "SwapRolledBack",
+           "artifact_reserved_bytes"]

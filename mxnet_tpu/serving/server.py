@@ -110,7 +110,10 @@ class ServeRejected(MXNetError):
 
     Reasons: ``queue_full``, ``deadline`` (admission estimate misses
     the SLO), ``expired`` (dispatch-time re-check), ``breaker_open``,
-    ``draining``, ``shutdown``, ``model_error``.
+    ``draining``, ``shutdown``, ``model_error``; the fleet layer
+    (:mod:`.fleet`) adds ``hbm_budget`` (model residency would exceed
+    the per-host HBM budget) and ``no_replica`` (every replica is
+    ejected, draining or unready).
     """
 
     def __init__(self, reason, detail=""):
@@ -278,17 +281,21 @@ class ModelServer:
 
     # ----------------------------------------------------- constructors
     @classmethod
-    def from_artifact(cls, path, **kw):
+    def from_artifact(cls, path, exported=None, **kw):
         """Crash-safe AOT warm start: serve a CRC-verified
         ``deploy.export_model`` artifact.  The exported program fixes
         ONE batch shape, so the bucket set is exactly that shape (all
         batches pad to it) and the server can never retrace — cold
-        start is a deserialize, not a compile."""
+        start is a deserialize, not a compile.  ``exported`` reuses an
+        already-verified ``deploy.load_exported`` handle (the fleet's
+        HBM admission sized the artifact moments ago — no second
+        read)."""
         import jax.numpy as jnp
 
         from .. import deploy
 
-        exp = deploy.load_exported(path)
+        exp = exported if exported is not None \
+            else deploy.load_exported(path)
         aval = exp.in_avals[0]
         batch = int(aval.shape[0])
         item = tuple(int(s) for s in aval.shape[1:])
@@ -546,6 +553,7 @@ class ModelServer:
         while True:
             batch = None
             overdue = []
+            detail = None
             with self._cond:
                 if not self._running:
                     break
@@ -555,6 +563,17 @@ class ModelServer:
                     self._cond.wait(0.05)
                 elif self._breaker != "open":
                     batch = self._take_locked()
+                elif self._draining:
+                    # drain × open breaker: nothing will ever dispatch
+                    # this queue — the probe re-warm is NOT waited on
+                    # (it can fail forever) — so every queued request
+                    # goes terminal NOW with a structured rejection
+                    # and the drain completes instead of burning its
+                    # whole timeout on deadlines that cannot be met
+                    overdue = list(self._queue)
+                    self._queue.clear()
+                    detail = ("draining with the breaker open: no "
+                              "dispatch can ever take this request")
                 else:
                     # queued work admitted before the trip waits for
                     # the re-warm, but NEVER past its deadline: the
@@ -573,12 +592,13 @@ class ModelServer:
                         self._queue.extend(keep)
                     else:
                         self._cond.wait(0.02)
-            self._shed_expired(overdue)
+            self._shed_expired(overdue, detail=detail)
             self._hb = time.monotonic()
             if self._wd is not None:
                 self._wd.beat("serve")
             if self._breaker == "open":
-                self._try_rewarm()
+                if not self._draining:
+                    self._try_rewarm()
                 continue
             if batch:
                 try:
@@ -658,10 +678,11 @@ class ModelServer:
         for i, r in enumerate(live):
             self._finish(r, out=out[i])
 
-    def _shed_expired(self, expired):
+    def _shed_expired(self, expired, detail=None):
         """Shed requests whose deadline passed while waiting —
-        dispatch-time re-check and open-breaker sweep share this one
-        accounting path (under the same lock _shed_locked uses)."""
+        dispatch-time re-check, open-breaker sweep and the
+        drain-with-open-breaker sweep share this one accounting path
+        (under the same lock _shed_locked uses)."""
         if not expired:
             return
         with self._cond:
@@ -672,8 +693,9 @@ class ModelServer:
         for r in expired:
             self._telemetry_count("serve_shed")
             self._finish(r, err=ServeRejected(
-                "expired", "deadline passed before the model could "
-                           "take the request"))
+                "expired",
+                detail or "deadline passed before the model could "
+                          "take the request"))
 
     def _invoke(self, xb):
         poison = faultsim.inject("serve.model")
@@ -813,7 +835,7 @@ class ModelServer:
             quiet_bound = max(1.0, 10.0 * ew) + self.coalesce_s
             live = alive and (self._batch_running
                               or hb_age < quiet_bound)
-            return {
+            payload = {
                 "live": bool(live),
                 "ready": bool(self._ready and self._accepting
                               and alive
@@ -827,6 +849,21 @@ class ModelServer:
                 "ewma_ms": {b: round(v * 1e3, 3)
                             for b, v in sorted(self._ewma.items())},
             }
+        # readiness/liveness as Prometheus gauges (outside the lock):
+        # the fleet's health probes and an external textfile scraper
+        # read the SAME truth this method just computed.  The rows
+        # are labeled per model so two servers in one process cannot
+        # overwrite each other's readiness (and a 1/0 interleave
+        # cannot re-trigger the change-detecting textfile rewrite on
+        # every probe); a multi-model host suppresses these and
+        # publishes its unlabeled aggregate instead
+        if not getattr(self, "_suppress_health_gauges", False):
+            label = f'{{model="{self.name}"}}'
+            self._telemetry_gauge(f"serve_ready{label}",
+                                  int(payload["ready"]))
+            self._telemetry_gauge(f"serve_live{label}",
+                                  int(payload["live"]))
+        return payload
 
     def live(self):
         return self.health()["live"]
@@ -860,5 +897,14 @@ class ModelServer:
             from .. import telemetry
 
             telemetry.event(kind, **fields)
+        except Exception:
+            pass
+
+    @staticmethod
+    def _telemetry_gauge(name, value):
+        try:
+            from .. import telemetry
+
+            telemetry.gauge(name, value)
         except Exception:
             pass

@@ -30,6 +30,7 @@ from .runlog import (  # noqa: F401
     event,
     flight_dump,
     flight_path_for,
+    gauge,
     program_report,
     reset,
 )
@@ -38,8 +39,9 @@ from .watchdog import Watchdog, stack_path_for  # noqa: F401
 
 __all__ = [
     "RunLog", "current", "reset", "close", "compile_event",
-    "compile_fingerprint", "event", "count", "checkpoint_event",
-    "program_report", "flight_dump", "flight_path_for",
-    "describe_program", "FitSession", "fit_session", "schema",
-    "Watchdog", "stack_path_for", "numerics", "opstats",
+    "compile_fingerprint", "event", "count", "gauge",
+    "checkpoint_event", "program_report", "flight_dump",
+    "flight_path_for", "describe_program", "FitSession",
+    "fit_session", "schema", "Watchdog", "stack_path_for",
+    "numerics", "opstats",
 ]

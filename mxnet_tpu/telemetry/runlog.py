@@ -49,9 +49,9 @@ import threading
 import time
 
 __all__ = ["RunLog", "current", "reset", "close", "compile_event",
-           "compile_fingerprint", "event", "count", "checkpoint_event",
-           "program_report", "flight_dump", "describe_program",
-           "flight_path_for"]
+           "compile_fingerprint", "event", "count", "gauge",
+           "checkpoint_event", "program_report", "flight_dump",
+           "describe_program", "flight_path_for"]
 
 _LOCK = threading.RLock()
 _STATE = {"log": None, "resolved": False}
@@ -133,7 +133,11 @@ class RunLog:
                          "ckpt_fallbacks": 0, "reshards": 0,
                          "dist_init_retries": 0, "serve_requests": 0,
                          "serve_shed": 0, "serve_batches": 0,
-                         "serve_breaker_trips": 0}
+                         "serve_breaker_trips": 0,
+                         "fleet_requests": 0, "fleet_shed": 0,
+                         "fleet_failovers": 0, "fleet_resizes": 0,
+                         "fleet_swaps": 0}
+        self._gauges = {}       # name -> last value (textfile rows)
         self._fps = {}          # program -> last compile fingerprint
         self._programs = {}     # program -> last program_report body
         self._last_program = None
@@ -447,6 +451,28 @@ class RunLog:
                                     int(queue_depth),
                                     cat="telemetry", tid=_TRACE_TID)
 
+    def fleet(self, *, action, replicas, ready, queue_depth,
+              queue_ewma, requests, failovers, shed):
+        """One fleet-router observation (serving.fleet.FleetRouter):
+        the replica set's live/ready counts, the summed queue depth
+        and its autoscaling EWMA, and the router's cumulative
+        request/failover/shed counters — stamped with the ``action``
+        (probe / eject / resize / swap / close) that produced it."""
+        self._write({"type": "fleet", "t": round(self._now(), 6),
+                     "action": str(action), "replicas": int(replicas),
+                     "ready": int(ready),
+                     "queue_depth": int(queue_depth),
+                     "queue_ewma": round(float(queue_ewma), 4),
+                     "requests": int(requests),
+                     "failovers": int(failovers), "shed": int(shed)})
+        from .. import profiler
+
+        if profiler.is_running():
+            self._trace_meta()
+            profiler.record_counter("fleet_queue_ewma",
+                                    round(float(queue_ewma), 3),
+                                    cat="telemetry", tid=_TRACE_TID)
+
     def opstats(self, rows, source="profiler"):
         """The aggregate per-op table (telemetry.opstats) as one
         ``program_report``-style record."""
@@ -476,6 +502,20 @@ class RunLog:
         with self._lock:
             self.counters[counter] = \
                 self.counters.get(counter, 0) + delta
+
+    def gauge(self, name, value):
+        """Set a point-in-time gauge (readiness/liveness, residency
+        bytes...).  Gauges land in the Prometheus textfile next to the
+        counters; a CHANGED value rewrites the textfile immediately so
+        probes and scrapers read the same truth as the in-process
+        health() that set it (state flips are rare — steady-state
+        health polling costs one dict compare)."""
+        value = float(value)
+        with self._lock:
+            changed = self._gauges.get(name) != value
+            self._gauges[name] = value
+        if changed and self.textfile:
+            self.write_textfile()
 
     # -------------------------------------------------- flight recorder
     @property
@@ -539,6 +579,20 @@ class RunLog:
                 continue
             lines.append(f"# TYPE mxnet_tpu_{k} gauge")
             lines.append(f"mxnet_tpu_{k} {v}")
+        # point-in-time gauges (serve_ready/serve_live readiness and
+        # liveness rows the fleet's health probes also read).  Names
+        # may carry Prometheus labels ('serve_ready{model="m"}') —
+        # the TYPE line names the metric FAMILY, once
+        with self._lock:
+            gauges = dict(self._gauges)
+        typed = set()
+        for k, v in sorted(gauges.items()):
+            family = k.split("{", 1)[0]
+            if family not in typed:
+                typed.add(family)
+                lines.append(f"# TYPE mxnet_tpu_{family} gauge")
+            lines.append(f"mxnet_tpu_{k} "
+                         f"{int(v) if v == int(v) else v}")
         try:
             atomic_write_bytes(self.textfile,
                                ("\n".join(lines) + "\n").encode(),
@@ -637,6 +691,12 @@ def count(counter, delta=1):
     rl = current()
     if rl is not None:
         rl.count(counter, delta)
+
+
+def gauge(name, value):
+    rl = current()
+    if rl is not None:
+        rl.gauge(name, value)
 
 
 def checkpoint_event(prefix, version, duration_s, nbytes, **extra):

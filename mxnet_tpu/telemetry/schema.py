@@ -19,6 +19,9 @@ opstats   aggregate per-op table folded from the profiler's op events
 tensor_stats  sampled numerics-monitor summary of named tensors
 serve     one dispatched serving microbatch (size, pad, latency,
           queue depth, cumulative shed, breaker state)
+fleet     one fleet-router observation (replica counts, queue-depth
+          EWMA, cumulative request/failover/shed counters) stamped
+          with the action that produced it (probe/eject/resize/swap)
 event     everything else (bad_step, ps_retry, fault, deadline, ...)
 run_end   final counters, written at close
 ========  =============================================================
@@ -27,7 +30,8 @@ from __future__ import annotations
 
 __all__ = ["STEP_FIELDS", "RECORD_TYPES", "COMPILE_CAUSES",
            "OPSTATS_ROW_FIELDS", "TENSOR_STATS_ROW_FIELDS",
-           "SERVE_FIELDS", "validate_record", "validate_lines"]
+           "SERVE_FIELDS", "FLEET_FIELDS", "validate_record",
+           "validate_lines"]
 
 #: step-record contract: field -> (types, required).  ``None`` is legal
 #: for optional measurements (loss on an unsampled step, feed stats
@@ -56,7 +60,7 @@ STEP_FIELDS = {
 
 RECORD_TYPES = ("run_start", "step", "compile", "program_report",
                 "checkpoint", "watchdog", "opstats", "tensor_stats",
-                "serve", "event", "run_end")
+                "serve", "fleet", "event", "run_end")
 
 #: per-batch contract of a ``serve`` record (serving.ModelServer)
 SERVE_FIELDS = {
@@ -70,6 +74,22 @@ SERVE_FIELDS = {
     "deadline_margin_ms": ((int, float, type(None)), True),
     "shed": (int, True),                  # cumulative shed count
     "breaker": (str, True),
+}
+
+#: per-observation contract of a ``fleet`` record (serving.fleet):
+#: the router's view of its replica set at one moment, stamped with
+#: the action that produced the record
+FLEET_FIELDS = {
+    "type": (str, True),
+    "t": ((int, float), True),
+    "action": (str, True),                # probe|eject|resize|swap|...
+    "replicas": (int, True),              # replicas not ejected/dead
+    "ready": (int, True),                 # routable replicas
+    "queue_depth": (int, True),           # summed across the fleet
+    "queue_ewma": ((int, float), True),   # the autoscaler's signal
+    "requests": (int, True),              # cumulative router counters
+    "failovers": (int, True),
+    "shed": (int, True),
 }
 
 #: per-op row contract of an ``opstats`` record (telemetry.opstats)
@@ -177,6 +197,8 @@ def validate_record(rec):
         return problems
     if t == "serve":
         return _check_fields(rec, SERVE_FIELDS)
+    if t == "fleet":
+        return _check_fields(rec, FLEET_FIELDS)
     if t == "event":
         return _check_fields(rec, {"t": ((int, float), True),
                                    "kind": (str, True)})

@@ -14,6 +14,12 @@ Modes (argv[1]):
 * ``relaunch ARTIFACT OUT_JSON`` — the warm restart: load the same
   artifact, serve a burst to completion, write the report (the parent
   asserts the run log's retrace counter stayed 0: load-not-retrace).
+* ``drain_breaker OUT_JSON`` — the round-15 satellite drill: trip the
+  circuit breaker with queued long-deadline work behind it, then
+  ``run_until_drained`` on SIGTERM — every queued request must reach
+  a structured terminal state and the exit must be prompt (the drain
+  must NOT wait on a probe re-warm that can fail forever, nor on the
+  queued deadlines).
 """
 import json
 import os
@@ -49,8 +55,82 @@ def _submit_traffic(srv, item_shape, outcome, stop, n=400, pace=0.002):
         time.sleep(pace)
 
 
+def _drain_breaker_main(out_json):
+    """Breaker-open × SIGTERM-drain: a one-failure breaker trips on
+    the first dispatched batch while three more 60 s-deadline requests
+    sit queued behind it; the parent's SIGTERM must drain FAST — the
+    queued work swept to structured terminal states — never hang on a
+    re-warm probe or the 60 s deadlines."""
+    import threading as _t
+
+    from mxnet_tpu.serving import ModelServer as _MS
+
+    def bad_model(xb):
+        time.sleep(0.2)  # requests queue behind this dispatch
+        raise ValueError("model down")
+
+    srv = _MS(bad_model, (2,), max_batch=1, slo_ms=60000.0,
+              breaker_limit=1, coalesce_ms=0.0)
+    srv.start(warm=False)
+    x = onp.ones((2,), "float32")
+    handles = [srv.submit(x) for _ in range(4)]
+    # first dispatch fails -> breaker opens with 3 requests queued
+    deadline = time.monotonic() + 20
+    while srv.health()["breaker"] != "open" \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert srv.health()["breaker"] == "open", "breaker never tripped"
+    t_sig = {"t": None}
+
+    # the .ready file must appear only AFTER run_until_drained's
+    # PreemptionDrain installed the SIGTERM handler — written before
+    # it, the parent's signal can land in the gap and kill us under
+    # the default disposition (rc -15 with no report: a flaky test)
+    def mark_ready_when_armed():
+        import signal as _sig
+
+        while _sig.getsignal(_sig.SIGTERM) == _sig.SIG_DFL:
+            time.sleep(0.005)
+        with open(out_json + ".ready", "w") as f:
+            f.write("ready")
+        # and stamp the moment the drain starts (the server flips
+        # _draining right after the signal lands) so drain_s measures
+        # the drain itself, not the wait for the parent's SIGTERM
+        while not srv._draining:
+            time.sleep(0.005)
+        t_sig["t"] = time.monotonic()
+
+    _t.Thread(target=mark_ready_when_armed, daemon=True).start()
+
+    def on_drained(server):
+        reasons = []
+        for h in handles:
+            try:
+                h.result(timeout=0.1)
+                reasons.append("ok")
+            except Exception as e:  # noqa: BLE001
+                reasons.append(getattr(e, "reason", repr(e)))
+        report = {
+            "terminal": sum(1 for h in handles if h.done),
+            "submitted": len(handles),
+            "reasons": reasons,
+            "drain_s": time.monotonic() - (t_sig["t"]
+                                           or time.monotonic()),
+            "breaker": server.health()["breaker"],
+        }
+        with open(out_json, "w") as f:
+            json.dump(report, f)
+            f.flush()
+            os.fsync(f.fileno())
+
+    srv.run_until_drained(on_drained=on_drained)
+    print("server exited without a signal", flush=True)
+
+
 def main():
     mode = sys.argv[1]
+    if mode == "drain_breaker":
+        return _drain_breaker_main(sys.argv[2])
     artifact = sys.argv[2]
     srv = ModelServer.from_artifact(artifact, slo_ms=10000.0,
                                     coalesce_ms=1.0)

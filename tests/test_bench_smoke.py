@@ -140,6 +140,22 @@ def test_smoke_emits_valid_json_with_heartbeats():
     # steady state re-pads to warmed buckets: no post-warm traces
     assert srv["steady_state_traces"] == 0
     assert srv["breaker"] == "closed"
+    # the fleet INFERENCE phase (round 15): 2 replica processes
+    # behind the fault-tolerant router, bursty load over HTTP, a
+    # rolling model swap, clean drain exits
+    fl = out["fleet"]
+    assert fl["replicas"] == 2
+    assert fl["requests"] > 0
+    assert fl["errors"] == 0, fl["error_sample"]
+    assert fl["completed"] + fl["shed"] + fl["errors"] \
+        == fl["requests"]
+    assert fl["completed"] > 0
+    assert fl["p50_ms"] > 0 and fl["p99_ms"] >= fl["p50_ms"]
+    assert fl["slo_ms"] > 0
+    assert fl["p99_within_slo"] is True
+    assert fl["swap_ms"] > 0 and fl["swap_errors"] == 0
+    # every replica exited as a clean SIGTERM drain
+    assert sorted(fl["drain_rcs"].values()) == [-15, -15]
     # the hang watchdog was armed (bench defaults it on) and quiet
     assert out["watchdog_sec"] > 0
     assert out["watchdog_stalls"] == 0
@@ -147,7 +163,7 @@ def test_smoke_emits_valid_json_with_heartbeats():
     for phase in ("import", "device_init", "build", "autotune",
                   "compile", "K1", "K2", "trials", "feed",
                   "checkpoint", "collectives", "fused_kernels",
-                  "serving", "telemetry", "conv_ab", "done"):
+                  "serving", "fleet", "telemetry", "conv_ab", "done"):
         assert f"phase={phase}" in r.stderr, f"missing phase {phase}"
 
 

@@ -168,6 +168,82 @@ def test_fail_on_regression_threshold_is_configurable(tmp_path):
                     "--fail-on-regression"]) == 2
 
 
+def _fleet(p99, requests=100, shed=0, within=True):
+    return {"p99_ms": p99, "p50_ms": p99 / 2.0, "requests": requests,
+            "shed": shed, "p99_within_slo": within,
+            "slo_ms": 8000.0}
+
+
+def test_fleet_trend_verdicts_and_missing_metric(tmp_path):
+    """Round 15: the fleet INFERENCE phase trends like the headline —
+    baseline on first appearance, p99/shed/SLO regressions flagged,
+    and a round that HAD fleet data losing it is the r05 failure
+    shape ('missing fleet metric').  Rounds predating the phase carry
+    no fleet verdict at all (old artifacts never gate)."""
+    glob_b = _write_rounds(tmp_path, [
+        (1, 0, {"value": 1000.0}),                       # pre-fleet
+        (2, 0, {"value": 1000.0, "fleet": _fleet(10.0)}),
+        (3, 0, {"value": 1000.0, "fleet": _fleet(11.0)}),    # ok
+        (4, 0, {"value": 1000.0, "fleet": _fleet(30.0)}),    # p99 3x
+        (5, 0, {"value": 1000.0,
+                "fleet": _fleet(30.0, shed=40)}),        # shed jump
+        (6, 0, {"value": 1000.0,
+                "fleet": _fleet(30.0, shed=40, within=False)}),
+        (7, 0, {"value": 1000.0}),                   # lost the phase
+    ])
+    rounds = bd.fleet_verdicts(bd.load_bench(
+        sorted(__import__("glob").glob(glob_b))), 0.15)
+    assert rounds["r01"]["fleet_verdict"] is None
+    assert rounds["r02"]["fleet_verdict"] == "baseline"
+    assert rounds["r03"]["fleet_verdict"] == "ok"
+    assert rounds["r04"]["fleet_verdict"] == "regression"
+    assert "p99" in rounds["r04"]["fleet_reason"]
+    assert rounds["r05"]["fleet_verdict"] == "regression"
+    assert "shed rate" in rounds["r05"]["fleet_reason"]
+    assert rounds["r06"]["fleet_verdict"] == "regression"
+    assert "SLO" in rounds["r06"]["fleet_reason"]
+    assert rounds["r07"]["fleet_verdict"] == "regression"
+    assert rounds["r07"]["fleet_reason"] == "missing fleet metric"
+
+
+def test_fleet_regression_gates_with_fail_on_regression(tmp_path,
+                                                        capsys):
+    """A serving-robustness regression exits 2 under
+    --fail-on-regression even when the headline throughput is clean,
+    and the table carries the fleet section."""
+    glob_b = _write_rounds(tmp_path, [
+        (1, 0, {"value": 1000.0, "fleet": _fleet(10.0)}),
+        (2, 0, {"value": 1010.0, "fleet": _fleet(100.0)}),
+    ])
+    rc = bd.main(["--bench", glob_b, "--opperf",
+                  str(tmp_path / "none*.jsonl"),
+                  "--fail-on-regression"])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "fleet serving trend" in out
+    assert "fleet r02" in out
+    # the headline itself stayed ok — only the fleet gate fired
+    rounds = bd.headline_verdicts(bd.load_bench(
+        sorted(__import__("glob").glob(glob_b))), 0.15)
+    assert rounds["r02"]["verdict"] == "ok"
+
+
+def test_fleet_absent_everywhere_never_gates(tmp_path):
+    """The committed pre-round-15 artifacts carry no fleet phase: the
+    fleet gate must stay silent (the pinned r01–r05 CI window cannot
+    change behavior)."""
+    glob_b = _write_rounds(tmp_path, [
+        (1, 0, {"value": 1000.0}),
+        (2, 0, {"value": 1000.0}),
+    ])
+    assert bd.main(["--bench", glob_b, "--opperf",
+                    str(tmp_path / "none*.jsonl"),
+                    "--fail-on-regression"]) == 0
+    rounds = bd.fleet_verdicts(bd.load_bench(
+        sorted(__import__("glob").glob(glob_b))), 0.15)
+    assert all(rounds[r]["fleet_verdict"] is None for r in rounds)
+
+
 def test_regenerated_opperf_smoke_has_percentiles():
     """Satellite: the committed OPPERF_smoke.jsonl was regenerated with
     the p50/p99 columns benchdiff trends tail latency from."""

@@ -397,6 +397,37 @@ def test_serve_records_counters_and_textfile(tmp_path, monkeypatch):
     assert "mxnet_tpu_serve_breaker_trips 0" in text
 
 
+def test_health_exports_ready_live_gauges(tmp_path, monkeypatch):
+    """Round-15 satellite: health()'s readiness/liveness land as
+    Prometheus textfile gauge rows (serve_ready/serve_live), so fleet
+    probes and external scrapers read the same truth as health()."""
+    from mxnet_tpu import telemetry as tm
+
+    textfile = str(tmp_path / "metrics.prom")
+    monkeypatch.setenv("MXNET_METRICS_TEXTFILE", textfile)
+    tm.reset(str(tmp_path / "run.jsonl"))
+    srv = ModelServer(_np_model(), (2,), max_batch=2, slo_ms=1000)
+    row = 'mxnet_tpu_serve_ready{model="model"}'
+    try:
+        srv.health()  # not started: ready 0, live 0
+        text = open(textfile).read()
+        assert f"{row} 0" in text
+        assert "# TYPE mxnet_tpu_serve_ready gauge" in text
+        assert 'mxnet_tpu_serve_live{model="model"} 0' in text
+        srv.start(warm=True)
+        assert srv.ready()  # health() refreshes the gauges
+        text = open(textfile).read()
+        assert f"{row} 1" in text
+        assert 'mxnet_tpu_serve_live{model="model"} 1' in text
+        srv.drain()
+        assert srv.ready() is False
+        text = open(textfile).read()
+        assert f"{row} 0" in text
+    finally:
+        srv.close()
+        tm.close()
+
+
 def test_bounded_retrace_compile_events(tmp_path):
     """Non-AOT serving reports (at most) one compile event per padded
     bucket shape — the run-log retrace counter bounds the program
@@ -422,6 +453,81 @@ def test_bounded_retrace_compile_events(tmp_path):
     assert 1 <= len(compiles) <= len(default_buckets(4))
     end = next(r for r in recs if r["type"] == "run_end")
     assert end["counters"]["compiles"] <= len(default_buckets(4))
+
+
+# ------------------------------------------- breaker-open x SIGTERM-drain
+def test_drain_with_open_breaker_expires_queued_fast():
+    """Round-15 satellite: queued admitted work behind an OPEN breaker
+    must not pin a drain for its full deadline (here 60 s) — the drain
+    sweeps it to structured terminal states and returns promptly,
+    without waiting on a probe re-warm that can fail forever."""
+    fail = {"on": False}
+    srv = ModelServer(_np_model(delay=0.05, fail=fail), (2,),
+                      max_batch=1, slo_ms=60000.0, breaker_limit=1,
+                      coalesce_ms=0.0)
+    srv.start(warm=True)
+    fail["on"] = True
+    handles = [srv.submit(onp.zeros((2,), "float32"))
+               for _ in range(4)]
+    deadline = time.monotonic() + 10
+    while srv.health()["breaker"] != "open" \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert srv.health()["breaker"] == "open"
+    try:
+        t0 = time.perf_counter()
+        assert srv.drain(timeout=10.0) is True
+        drain_s = time.perf_counter() - t0
+        assert drain_s < 2.0, \
+            f"drain took {drain_s:.1f}s against 60 s deadlines"
+        reasons = []
+        for h in handles:
+            assert h.done  # terminal, all of them
+            with pytest.raises(ServeRejected) as ei:
+                h.result(timeout=0.1)
+            reasons.append(ei.value.reason)
+        assert set(reasons) <= {"model_error", "expired"}
+        assert "expired" in reasons, reasons  # the drain sweep fired
+    finally:
+        srv.close()
+
+
+@pytest.mark.unit
+def test_run_until_drained_with_open_breaker_exits_clean(tmp_path):
+    """The subprocess half: SIGTERM while the breaker is open and
+    long-deadline work is queued — run_until_drained must reach every
+    queued request's terminal state and exit rc -15 promptly, never
+    hang re-warming a dead model."""
+    out_json = str(tmp_path / "drain_breaker.json")
+    env = dict(os.environ)
+    env.pop("MXNET_FAULT_SPEC", None)
+    proc = subprocess.Popen(
+        [sys.executable, _WORKER, "drain_breaker", out_json],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env)
+    try:
+        ready = out_json + ".ready"
+        deadline = time.monotonic() + 120
+        while not os.path.exists(ready) \
+                and time.monotonic() < deadline:
+            if proc.poll() is not None:
+                pytest.fail("worker died early: "
+                            + proc.stderr.read()[-2000:])
+            time.sleep(0.05)
+        assert os.path.exists(ready), "breaker never tripped"
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=30)  # well under the 60 s request deadlines
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    assert proc.returncode == -signal.SIGTERM
+    with open(out_json) as f:
+        report = json.load(f)
+    assert report["terminal"] == report["submitted"] == 4
+    assert set(report["reasons"]) <= {"model_error", "expired"}
+    assert "expired" in report["reasons"], report["reasons"]
+    assert report["drain_s"] < 5.0, report["drain_s"]
 
 
 # --------------------------------------------------------------- health

@@ -518,9 +518,13 @@ def test_speedometer_reads_runlog_rate(tmp_path):
                                         rel=0.5)
     # ...but NOT when the window is stale for this interval (an eval
     # loop records no steps): then it falls back to its own clock
-    # instead of quoting the old training rate
+    # instead of quoting the old training rate.  The stale interval is
+    # 5x the per-step gap so the fallback rate (batch/interval) cannot
+    # numerically collide with the window rate (3*batch/3*gap) when
+    # the sleeps land exactly — they are the same number at equal
+    # durations, which made this assert flake under load
     sp.tic = time.perf_counter()
-    time.sleep(0.002)
+    time.sleep(0.01)
     stale = sp._speed()
     assert stale != pytest.approx(authoritative, rel=0.01)
     telemetry.close()

@@ -16,6 +16,13 @@ a machine-readable trend:
 * **opperf trend** — per-op avg (and p50/p99 where present, so tail
   latency trends too) across rounds, with the worst slowdowns and best
   speedups between the last two rounds summarised.
+* **fleet serving trend** (round 15) — the ``fleet`` INFERENCE
+  phase's robustness metrics (p99_ms, shed rate, p99-within-SLO)
+  round-over-round with the same baseline/ok/improved/regression
+  verdicts the headline gets: a p99 past the threshold, a shed-rate
+  jump, or an SLO flip is a REGRESSION; a round that HAD fleet data
+  before and lost it is "missing fleet metric" — serving robustness
+  regressions gate exactly like throughput ones.
 
 Exit code: 0 by default (reporting tool); ``--fail-on-regression``
 exits 2 when the LATEST headline round regressed (or lost its metric)
@@ -60,7 +67,9 @@ def load_bench(paths):
         label = _round_of(path) or os.path.basename(path)
         row = {"file": os.path.basename(path), "value": None,
                "mfu": None, "ms_per_step": None, "rc": None,
-               "degraded": None, "error": None}
+               "degraded": None, "error": None,
+               "fleet_p99_ms": None, "fleet_shed_rate": None,
+               "fleet_within_slo": None}
         try:
             with open(path) as f:
                 doc = json.load(f)
@@ -80,6 +89,13 @@ def load_bench(paths):
             row["mfu"] = parsed.get("mfu")
             row["ms_per_step"] = parsed.get("ms_per_step")
             row["degraded"] = parsed.get("degraded")
+            fl = parsed.get("fleet")
+            if isinstance(fl, dict) and fl.get("p99_ms") is not None:
+                row["fleet_p99_ms"] = fl["p99_ms"]
+                req = fl.get("requests") or 0
+                row["fleet_shed_rate"] = round(
+                    (fl.get("shed") or 0) / req, 4) if req else None
+                row["fleet_within_slo"] = fl.get("p99_within_slo")
         rounds[label] = row
     return rounds
 
@@ -119,6 +135,58 @@ def headline_verdicts(rounds, threshold):
                 row["verdict"] = "ok"
                 row["reason"] = f"{change:+.1%} vs previous metric"
         prev_value = v
+    return rounds
+
+
+def fleet_verdicts(rounds, threshold):
+    """Verdict the ``fleet`` serving phase round-over-round: LOWER
+    p99 is better (the ratio check inverts vs the headline), a
+    shed-rate jump past the threshold or an SLO verdict flipping
+    false regresses too.  Rounds before the phase existed carry no
+    fleet verdict at all; once a round HAS shipped fleet data, a
+    later round without it is the r05 failure shape again —
+    "missing fleet metric"."""
+    seen = False
+    prev = None
+    for label in sorted(rounds):
+        row = rounds[label]
+        p99 = row["fleet_p99_ms"]
+        if p99 is None:
+            if seen:
+                row["fleet_verdict"] = "regression"
+                row["fleet_reason"] = "missing fleet metric"
+            else:
+                row["fleet_verdict"] = None
+                row["fleet_reason"] = None
+            continue
+        shed = row["fleet_shed_rate"] or 0.0
+        in_slo = row["fleet_within_slo"]
+        if not seen:
+            row["fleet_verdict"] = "baseline"
+            row["fleet_reason"] = None
+        else:
+            p_p99, p_shed, p_slo = prev
+            ratio = (p99 / p_p99) if p_p99 else None
+            reasons = []
+            if ratio is not None and ratio > 1.0 + threshold:
+                reasons.append(f"p99 x{ratio:.2f}")
+            if shed - p_shed > threshold:
+                reasons.append(
+                    f"shed rate {p_shed:.0%} -> {shed:.0%}")
+            if p_slo and in_slo is False:
+                reasons.append("p99 blew the SLO")
+            if reasons:
+                row["fleet_verdict"] = "regression"
+                row["fleet_reason"] = "; ".join(reasons)
+            elif ratio is not None and ratio < 1.0 / (1.0 + threshold):
+                row["fleet_verdict"] = "improved"
+                row["fleet_reason"] = f"p99 x{ratio:.2f}"
+            else:
+                row["fleet_verdict"] = "ok"
+                row["fleet_reason"] = (f"p99 x{ratio:.2f}"
+                                       if ratio is not None else None)
+        seen = True
+        prev = (p99, shed, bool(in_slo))
     return rounds
 
 
@@ -210,6 +278,25 @@ def render(bench, opperf, threshold):
             f"{('-' if r['rc'] is None else str(r['rc'])):>5s}"
             f"{('-' if r['degraded'] is None else str(r['degraded'])):>10s}"
             f"  {verdict}")
+    fleet_rows = [label for label in sorted(bench)
+                  if bench[label].get("fleet_verdict")]
+    if fleet_rows:
+        lines.append("")
+        lines.append("== fleet serving trend ==")
+        lines.append(f"{'round':<10s}{'p99_ms':>10s}{'shed':>8s}"
+                     f"{'in_slo':>8s}  verdict")
+        for label in fleet_rows:
+            r = bench[label]
+            verdict = r["fleet_verdict"]
+            if r.get("fleet_reason"):
+                verdict += f": {r['fleet_reason']}"
+            shed = r["fleet_shed_rate"]
+            lines.append(
+                f"{label:<10s}"
+                f"{_fmt(r['fleet_p99_ms']):>10s}"
+                f"{('-' if shed is None else f'{shed:.0%}'):>8s}"
+                f"{('-' if r['fleet_within_slo'] is None else str(r['fleet_within_slo'])):>8s}"
+                f"  {verdict}")
     if opperf.get("compared_ops"):
         lines.append("")
         lines.append(f"== opperf trend {opperf['prev']} -> "
@@ -265,7 +352,9 @@ def main(argv=None):
               f"{opperf_glob!r}", file=sys.stderr)
         return 1
 
-    bench = headline_verdicts(load_bench(bench_paths), args.threshold)
+    bench = fleet_verdicts(
+        headline_verdicts(load_bench(bench_paths), args.threshold),
+        args.threshold)
     opperf = opperf_diff(load_opperf(opperf_paths), args.threshold)
 
     failures = []
@@ -273,6 +362,11 @@ def main(argv=None):
         last = sorted(bench)[-1]
         if bench[last]["verdict"] == "regression":
             failures.append(f"headline {last}: {bench[last]['reason']}")
+        # the fleet phase gates like the headline: only rounds after
+        # it first shipped carry a fleet verdict at all
+        if bench[last].get("fleet_verdict") == "regression":
+            failures.append(
+                f"fleet {last}: {bench[last]['fleet_reason']}")
     if opperf.get("regressions"):
         failures.append(
             f"opperf {opperf['last']}: {len(opperf['regressions'])} "
