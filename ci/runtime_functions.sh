@@ -180,6 +180,33 @@ fleet_smoke() {
     JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q
 }
 
+healing_smoke() {
+    # self-healing gate (round 16): the tier-1 half runs the peer
+    # liveness / guarded-collective / async-snapshot / supervisor /
+    # coordinator-migration units plus the fit-level ghost-peer
+    # stand-in drill (heal-exit rc 83, emergency checkpoint, resume
+    # bit-exact); the `slow` half runs THE drill — a real 2-process
+    # jax.distributed job with rank 1 SIGKILLed mid-step, the
+    # survivor healing out in milliseconds and the supervisor
+    # relaunch resuming at world size 1 from the async snapshot
+    # (strictly fresher than the sync save) to match the
+    # uninterrupted reference — and a short seeded chaos campaign.
+    JAX_PLATFORMS=cpu python -m pytest tests/test_healing.py -q
+}
+
+chaos_smoke() {
+    # the seeded chaos campaign (round 16): >=20 reproducible faults
+    # across all 7 scenario classes (SIGKILL at a seeded delay
+    # included) on the CPU mesh, each run supervised by the healing
+    # respawn policy and gated on the three invariants — zero hangs,
+    # zero torn artifacts (tools/ckpt_fsck.py --all clean after every
+    # run), every healed run matching its uninterrupted reference
+    # allclose(1e-5).  The fixed --seed makes a CI failure exactly
+    # reproducible on a laptop.
+    JAX_PLATFORMS=cpu python tools/chaos.py --seed 1234 --runs 21 \
+        --min-faults 20 --out /tmp/chaos_ci
+}
+
 elastic_smoke() {
     # elastic scale-out gate (round 12): the tier-1 half runs the
     # single-host resize drill — train dp(4) under optimizer sharding,

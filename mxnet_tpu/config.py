@@ -285,6 +285,51 @@ register_env("MXNET_DIST_INIT_TIMEOUT_SEC", 120.0, float,
              "initialize retry loop — the deadline_sec cap, so attempt "
              "counts cannot overshoot the bring-up SLA once backoff "
              "grows.")
+register_env("MXNET_PEER_TIMEOUT_SEC", 10.0, float,
+             "Peer liveness timeout (resilience.healing): a peer "
+             "whose heartbeat file goes stale for this many seconds "
+             "is declared DEAD by every survivor's FailureDetector "
+             "(a same-host peer whose pid vanished is declared dead "
+             "immediately — the SIGKILL fast path).  Also sets the "
+             "Heartbeater's default beat interval (timeout/4).")
+register_env("MXNET_HEARTBEAT_DIR", "", str,
+             "Shared directory of per-rank heartbeat files "
+             "(resilience.healing).  Set on a multi-process elastic "
+             "job and Module.fit arms the self-healing loop: this "
+             "rank beats, the failure detector polls at step "
+             "boundaries, and a declared peer death fires the "
+             "emergency checkpoint + PeerDeadError instead of "
+             "wedging in a collective.  Empty = healing unarmed.")
+register_env("MXNET_CKPT_ASYNC", True, bool,
+             "Snapshot checkpoints write asynchronously "
+             "(CheckpointManager.save_async: device->host capture at "
+             "the step boundary, serialization + atomic write on a "
+             "background thread with a bounded back-pressure queue). "
+             "0 forces the MXNET_SNAPSHOT_EVERY cadence writes "
+             "synchronous — the A/B arm and a debugging escape "
+             "hatch.")
+register_env("MXNET_SNAPSHOT_EVERY", 0, int,
+             "Batches between async snapshot checkpoints in "
+             "Module.fit (needs checkpoint=).  0 (default) keeps the "
+             "epoch-boundary-only cadence; N>0 makes the recovery "
+             "point at most N batches old — the freshest snapshot is "
+             "also what an emergency checkpoint (peer death, "
+             "watchdog abort) flushes without any collective.")
+register_env("MXNET_HEAL_MAX_RELAUNCH", 2, int,
+             "Respawn bound of the self-healing supervisor "
+             "(python -m mxnet_tpu.resilience.healing --relaunch): a "
+             "training command dying with a healable status (peer "
+             "death rc 83, any signal kill, the faultsim crash 87) "
+             "is relaunched at most this many times with "
+             "MXNET_HEAL_ATTEMPT exported; anything else is final.")
+register_env("MXNET_WATCHDOG_ABORT", False, bool,
+             "Hang-watchdog escalation (round 16, default OFF — the "
+             "observe-only contract is unchanged): after max_dumps "
+             "stall dumps with the heartbeat still dead a full "
+             "timeout later, flush the flight ring + the emergency "
+             "checkpoint (freshest snapshot) and os._exit(85), so a "
+             "permanently wedged job is rescheduled instead of "
+             "burning its whole wall budget.")
 register_env("MXNET_SERVE_SLO_MS", 100.0, float,
              "Default per-request deadline (milliseconds) of the "
              "serving runtime (mxnet_tpu.serving.ModelServer): a "

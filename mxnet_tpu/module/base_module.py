@@ -130,6 +130,24 @@ class BaseModule:
           guard: non-finite steps are skipped (update withheld); after
           that many consecutive bad steps fit restores the last good
           checkpoint and raises a diagnostic error.
+
+        Self-healing extensions (round 16, resilience.healing):
+
+        * ``MXNET_SNAPSHOT_EVERY`` > 0 (with ``checkpoint=`` set)
+          takes an async snapshot checkpoint every that many batches:
+          the device→host copy happens at the step boundary, the
+          atomic write on a background thread (``MXNET_CKPT_ASYNC=0``
+          forces the write synchronous), so the recovery point is
+          batches old instead of an epoch old at <5% step cost.
+        * when peer healing is armed (``MXNET_HEARTBEAT_DIR`` + a
+          multi-process elastic context, or an explicit
+          ``healing.arm``), every step boundary renews this rank's
+          heartbeat and polls the failure detector: a declared peer
+          death fires the EMERGENCY checkpoint (freshest snapshot —
+          no collective, the mesh is already broken) and raises
+          ``PeerDeadError`` out of fit; the healing supervisor
+          relaunches and the resume re-shards at the surviving world
+          size (``auto_reshards`` counted).
         """
         assert num_epoch is not None, "please specify number of epochs"
         from .. import initializer as init_mod
@@ -194,6 +212,7 @@ class BaseModule:
             if old_topo:
                 from .. import telemetry as _tm0
                 from ..resilience import elastic as _elastic
+                from ..resilience import healing as _healing0
 
                 new_topo = self._topology_block()
                 verdict = _elastic.reshard_verdict(old_topo, new_topo)
@@ -211,6 +230,17 @@ class BaseModule:
                                new_world=verdict["new_world"],
                                reasons=verdict["reasons"],
                                batch_cursor=resume_cursor)
+                    if _healing0.relaunch_attempt() > 0:
+                        # a supervisor relaunch healing a peer death:
+                        # this reshard happened with NO operator
+                        # action — count it apart from hand-driven
+                        # resizes
+                        _tm0.count("auto_reshards")
+                        _tm0.heal("resume",
+                                  old_world=verdict["old_world"],
+                                  new_world=verdict["new_world"],
+                                  batch_cursor=resume_cursor,
+                                  attempt=_healing0.relaunch_attempt())
                 else:
                     self.logger.info(
                         "Elastic resume: topology unchanged (world "
@@ -273,6 +303,12 @@ class BaseModule:
             train_data if isinstance(train_data, DeviceFeedIter)
             else None)
         session = _tm.fit_session(batch_size=batch_size, feed=feed)
+        # peer healing (round 16): arm the heartbeat + failure
+        # detector when the env configures them (MXNET_HEARTBEAT_DIR
+        # with a multi-process world); unarmed this is one env read
+        from ..resilience import healing as _healing
+
+        _healing.arm_from_env()
         drain = PreemptionDrain()
         try:
             with drain:
@@ -293,6 +329,14 @@ class BaseModule:
             session.finish("error")
             raise
         finally:
+            if ckpt_mgr is not None:
+                # drain the async snapshot queue (every captured
+                # snapshot lands or errors) and stop the writer; a
+                # later fit/save on the same manager re-arms lazily
+                try:
+                    ckpt_mgr.close_async()
+                except Exception:
+                    pass
             if owned_feed is not None:
                 owned_feed.close()
                 # restore the caller's end-of-fit contract: the source
@@ -304,20 +348,20 @@ class BaseModule:
         # closed — hand the signal back to its original disposition
         drain.reraise()
 
-    def _save_fit_checkpoint(self, ckpt_mgr, epoch, batch_cursor):
-        """Flush one atomic checkpoint version of the live module
-        state (params, optimizer state when available, RNG via the
-        manifest).
+    def _fit_checkpoint_state(self, ckpt_mgr, epoch, batch_cursor):
+        """(version, save kwargs) of the live module state — shared by
+        the sync drain/boundary saves and the async snapshot cadence
+        so the two flavors can never diverge in what they capture.
 
-        Version ids are strictly monotonic — an existing version is
-        NEVER rewritten in place, because per-version atomicity would
-        not survive a crash landing between the params and manifest
-        replaces of an in-place overwrite (the old good version would
-        be gone and the new one would fail CRC).  The manifest's
-        epoch/batch_cursor fields carry the resume truth; the filename
-        number is just a version id (it equals the epoch for clean
-        uninterrupted runs, and shifts past it after a mid-epoch
-        drain)."""
+        Version ids are strictly monotonic (``allocate_version``
+        accounts for queued-but-unwritten async snapshots too) — an
+        existing version is NEVER rewritten in place, because
+        per-version atomicity would not survive a crash landing
+        between the params and manifest replaces of an in-place
+        overwrite.  The manifest's epoch/batch_cursor fields carry the
+        resume truth; the filename number is just a version id (it
+        equals the epoch for clean uninterrupted runs, and shifts past
+        it after a mid-epoch drain or between-save snapshots)."""
         arg_p, aux_p = self.get_params()
         states = None
         get_states = getattr(self, "_get_optimizer_states", None)
@@ -326,12 +370,57 @@ class BaseModule:
                 states = get_states()
             except MXNetError:
                 states = None  # optimizer not initialized yet
-        existing = ckpt_mgr.epochs()
-        version = max(existing) + 1 if existing else max(1, int(epoch))
-        ckpt_mgr.save(version, symbol=self._symbol, arg_params=arg_p,
-                      aux_params=aux_p, optimizer_states=states,
-                      batch_cursor=batch_cursor, epoch=epoch,
-                      topology=self._topology_block())
+        version = ckpt_mgr.allocate_version(
+            min_version=max(1, int(epoch)))
+        # serialize the (constant) symbol once per module, not once
+        # per cadence snapshot: tojson of a large graph on the step
+        # boundary was the one capture cost left unmemoized
+        cache = getattr(self, "_symbol_json_cache", None)
+        if cache is None or cache[0] is not self._symbol:
+            cache = (self._symbol, self._symbol.tojson()
+                     if self._symbol is not None else None)
+            self._symbol_json_cache = cache
+        return version, dict(
+            symbol_json=cache[1], arg_params=arg_p, aux_params=aux_p,
+            optimizer_states=states, batch_cursor=batch_cursor,
+            epoch=epoch, topology=self._topology_block())
+
+    def _save_fit_checkpoint(self, ckpt_mgr, epoch, batch_cursor,
+                             lock_timeout=None):
+        """Flush one atomic checkpoint version synchronously (epoch
+        boundaries, preemption drains).  ``lock_timeout`` bounds the
+        writer-lock wait on the peer-death fallback path — when the
+        async writer is wedged on a hung disk HOLDING the lock, the
+        heal exit must proceed without it rather than join the
+        deadlock."""
+        version, kw = self._fit_checkpoint_state(ckpt_mgr, epoch,
+                                                 batch_cursor)
+        man = ckpt_mgr.save(version, lock_timeout=lock_timeout, **kw)
+        if man is None and lock_timeout is not None:
+            # the bounded wait expired (a wedged writer holds the
+            # lock): NOT silent — the operator must know this drain/
+            # heal exit left no fresh version behind
+            self.logger.warning(
+                "checkpoint version %d SKIPPED: writer lock still "
+                "held after %.0fs (wedged async write?)", version,
+                lock_timeout)
+        return man
+
+    def _snapshot_fit_checkpoint(self, ckpt_mgr, epoch, batch_cursor):
+        """One MXNET_SNAPSHOT_EVERY cadence snapshot: capture at this
+        step boundary, write off the critical path
+        (``CheckpointManager.save_async``; ``MXNET_CKPT_ASYNC=0``
+        forces the write synchronous for A/B and debugging).  The
+        freshest capture doubles as the emergency-checkpoint source a
+        peer death or watchdog abort flushes."""
+        from ..config import get_env
+
+        version, kw = self._fit_checkpoint_state(ckpt_mgr, epoch,
+                                                 batch_cursor)
+        if get_env("MXNET_CKPT_ASYNC"):
+            ckpt_mgr.save_async(version, **kw)
+        else:
+            ckpt_mgr.save(version, **kw)
 
     def _topology_block(self):
         """The world stamp for this module's checkpoints
@@ -389,6 +478,7 @@ class BaseModule:
                     resume_cursor=0, session=None):
         from ..config import get_env
         from ..resilience import faultsim
+        from ..resilience import healing as _healing
         from ..telemetry import numerics as _nm
 
         if session is None:  # direct callers (tests) get the shell —
@@ -403,6 +493,43 @@ class BaseModule:
 
         bad_limit = int(get_env("MXNET_BAD_STEP_LIMIT"))
         bad_run = 0
+
+        # peer healing (round 16): with a detector armed, the
+        # collective-bearing calls run under guard_collective so a
+        # peer dying MID-collective surfaces as PeerDeadError instead
+        # of wedging the survivor until the watchdog; unarmed, this
+        # is a plain call (one dict lookup)
+        def _guarded(fn, label):
+            det = _healing.detector()
+            if det is None:
+                return fn()
+            return _healing.guard_collective(fn, det, label=label)
+
+        def _heal_out(epoch, nbatch):
+            # the emergency checkpoint flushes the freshest snapshot
+            # (no collective — the mesh is already broken); with no
+            # snapshot captured yet, fall back to a direct save (the
+            # eager Module's state is process-local)
+            paths = _healing.fire_emergency("peer_death")
+            if not paths and ckpt_mgr is not None:
+                try:
+                    # bounded lock wait: if the emergency flush gave
+                    # up because a wedged writer holds _write_lock,
+                    # this fallback must not block on it forever —
+                    # heal_exit matters more than one more version
+                    self._save_fit_checkpoint(ckpt_mgr, epoch, nbatch,
+                                              lock_timeout=10.0)
+                except Exception:
+                    self.logger.exception(
+                        "peer-death fallback checkpoint failed")
+
+        # async snapshot cadence (round 16): every N batches, capture
+        # params/opt-state/RNG/cursor at the step boundary and write
+        # off the critical path — the recovery point a peer death or
+        # watchdog abort flushes is batches old, not an epoch old
+        snap_every = int(get_env("MXNET_SNAPSHOT_EVERY")) \
+            if ckpt_mgr is not None else 0
+        snap_step = 0
         # numerics monitor (MXNET_NUMERICS), eager executor flavour:
         # the gradients are host-visible arrays here, so the jitted
         # summaries run ONLY on sampled steps and on every bad step —
@@ -443,7 +570,12 @@ class BaseModule:
                 if monitor is not None:
                     monitor.tic()
                 session.step_begin()
-                self.forward_backward(data_batch)
+                try:
+                    _guarded(lambda: self.forward_backward(data_batch),
+                             "fit_forward_backward")
+                except _healing.PeerDeadError:
+                    _heal_out(epoch, nbatch)
+                    raise
                 bad_step = False
                 if bad_limit > 0:
                     bad_step = (faultsim.inject("step.loss_nan")
@@ -496,7 +628,11 @@ class BaseModule:
                                "(no checkpoint to restore)"))
                 else:
                     bad_run = 0
-                    self.update()
+                    try:
+                        _guarded(self.update, "fit_update")
+                    except _healing.PeerDeadError:
+                        _heal_out(epoch, nbatch)
+                        raise
                 try:
                     next_data_batch = next(data_iter)
                 except StopIteration:
@@ -522,17 +658,51 @@ class BaseModule:
                     for cb in _as_list(batch_end_callback):
                         cb(_BatchEndParam(epoch, nbatch, eval_metric))
                 nbatch += 1
+                snap_step += 1
+                # peer healing poll (one dict lookup unarmed): renew
+                # this rank's beat and raise PeerDeadError on a
+                # declared death — the emergency checkpoint flushes
+                # from the freshest snapshot (no collective: the mesh
+                # is already broken), then fit unwinds with the flight
+                # dump and the supervisor owns the relaunch.  The poll
+                # runs BEFORE the cadence snapshot: the snapshot's
+                # device→host gather is itself a collective on a
+                # mesh-backed module, and it must not start against a
+                # peer that died during the previous step
+                try:
+                    _healing.poll(step=snap_step)
+                except _healing.PeerDeadError:
+                    _heal_out(epoch, nbatch)
+                    raise
+                if snap_every > 0 and snap_step % snap_every == 0:
+                    try:
+                        _guarded(lambda: self._snapshot_fit_checkpoint(
+                            ckpt_mgr, epoch, nbatch), "fit_snapshot")
+                    except _healing.PeerDeadError:
+                        _heal_out(epoch, nbatch)
+                        raise
                 if drain is not None and drain.requested is not None:
                     # preemption drain: the in-flight step is done —
                     # flush a final checkpoint with the batch cursor,
-                    # then unwind (fit closes the feed and re-raises)
+                    # then unwind (fit closes the feed and re-raises).
+                    # Queued async snapshots land first (wait_async)
+                    # so the drain save is the newest version
+                    drained_ckpt = None
                     if ckpt_mgr is not None:
-                        self._save_fit_checkpoint(ckpt_mgr, epoch,
-                                                  nbatch)
+                        ckpt_mgr.wait_async(timeout=30.0)
+                        # bounded lock wait, like the peer-death
+                        # fallback: a writer wedged PAST wait_async's
+                        # budget still holds _write_lock, and the
+                        # drain must exit rc -15 before the external
+                        # kill -9 rather than join the deadlock
+                        drained_ckpt = self._save_fit_checkpoint(
+                            ckpt_mgr, epoch, nbatch,
+                            lock_timeout=15.0)
                     self.logger.info(
-                        "Preemption drain (signal %s): checkpoint at "
-                        "epoch %d batch %d", drain.requested, epoch,
-                        nbatch)
+                        "Preemption drain (signal %s): %s at epoch "
+                        "%d batch %d", drain.requested,
+                        "checkpoint" if drained_ckpt is not None
+                        else "NO checkpoint written", epoch, nbatch)
                     # post-mortem of the preempted run: the last N
                     # step records land beside the drain checkpoint
                     session.flight("preempt_drain")

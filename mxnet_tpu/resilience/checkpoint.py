@@ -30,6 +30,8 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import queue
+import threading
 import time
 import zlib
 
@@ -55,8 +57,13 @@ def atomic_write_bytes(path, data, inject_point="ckpt.write"):
     path = os.fspath(path)
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
+    # pid AND thread id: the async snapshot writer and an emergency
+    # flush may race toward the same target — distinct temp files keep
+    # both writes atomic (the loser's rename is a benign overwrite of
+    # identical content)
     tmp = os.path.join(
-        d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+        d, f".{os.path.basename(path)}.tmp.{os.getpid()}"
+           f".{threading.get_ident()}")
     try:
         with open(tmp, "wb") as f:
             half = len(data) // 2
@@ -86,10 +93,21 @@ def atomic_write_bytes(path, data, inject_point="ckpt.write"):
 def capture_rng():
     """Snapshot host (numpy) and device (mxnet_tpu._rng key) RNG state
     as JSON-serializable data, so a resumed run continues the exact
-    random stream of the interrupted one."""
+    random stream of the interrupted one.
+
+    The 624-word Mersenne state rides as base64 of its raw bytes: the
+    async snapshot cadence calls this at every capture, and a
+    2500-element Python list was the single most expensive item on
+    that step-boundary path (``restore_rng`` accepts both this and
+    the legacy list form, so old manifests keep loading)."""
+    import base64
+
     st = onp.random.get_state()
-    state = {"numpy": [st[0], onp.asarray(st[1]).tolist(), int(st[2]),
-                       int(st[3]), float(st[4])],
+    key = onp.asarray(st[1], onp.uint32)
+    state = {"numpy": [st[0],
+                       {"b64": base64.b64encode(
+                           key.tobytes()).decode("ascii")},
+                       int(st[2]), int(st[3]), float(st[4])],
              "device": None}
     try:
         import jax
@@ -106,13 +124,21 @@ def capture_rng():
 
 
 def restore_rng(state):
-    """Restore a :func:`capture_rng` snapshot (missing parts no-op)."""
+    """Restore a :func:`capture_rng` snapshot (missing parts no-op).
+    Accepts both the base64 key form and the legacy integer-list form
+    (pre-round-16 manifests)."""
     if not state:
         return
     np_st = state.get("numpy")
     if np_st:
+        key = np_st[1]
+        if isinstance(key, dict):
+            import base64
+
+            key = onp.frombuffer(
+                base64.b64decode(key["b64"]), onp.uint32)
         onp.random.set_state((np_st[0],
-                              onp.asarray(np_st[1], onp.uint32),
+                              onp.asarray(key, onp.uint32),
                               int(np_st[2]), int(np_st[3]),
                               float(np_st[4])))
     dev = state.get("device")
@@ -129,20 +155,30 @@ def restore_rng(state):
             pass
 
 
+_AT_HASH_CACHE = {"key": None, "hash": None}
+
+
 def _autotune_hash():
     """SHA-256 of the persisted autotune winners file, recorded so a
     resume can tell whether it is replaying under the same variant
-    choices the checkpointed run trained with."""
+    choices the checkpointed run trained with.  Memoized by
+    (path, mtime, size): the async snapshot cadence calls this per
+    capture and the winners file changes rarely — a stat beats a
+    read+hash on the step-boundary path."""
     try:
         from .. import autotune
 
         p = autotune.cache_path()
-        if os.path.exists(p):
+        st = os.stat(p)
+        key = (p, st.st_mtime_ns, st.st_size)
+        if _AT_HASH_CACHE["key"] != key:
             with open(p, "rb") as f:
-                return hashlib.sha256(f.read()).hexdigest()
+                _AT_HASH_CACHE["hash"] = \
+                    hashlib.sha256(f.read()).hexdigest()
+            _AT_HASH_CACHE["key"] = key
+        return _AT_HASH_CACHE["hash"]
     except Exception:
-        pass
-    return None
+        return None
 
 
 def _crc(blob):
@@ -209,6 +245,13 @@ class CheckpointManager:
     def __init__(self, prefix, keep_n=None):
         self.prefix = os.fspath(prefix)
         self.keep_n = keep_n
+        self._vlock = threading.Lock()
+        self._reserved = 0        # highest version handed out in-process
+        self._write_lock = threading.Lock()  # serializes version writes
+        self._async = None        # lazy _AsyncWriter
+        self._freshest = None     # newest captured snapshot (host-side)
+        self._written = set()     # versions already durably written
+        self._good_cache = set()  # versions that verified (this process)
 
     # ------------------------------------------------------------ paths
     def params_path(self, epoch):
@@ -230,9 +273,10 @@ class CheckpointManager:
         return os.path.dirname(os.path.abspath(self.prefix)) or "."
 
     # ------------------------------------------------------------- save
-    def save(self, version, symbol=None, arg_params=None,
-             aux_params=None, optimizer_states=None, step=None,
-             batch_cursor=0, extra=None, epoch=None, topology=None):
+    def save(self, version, symbol=None, symbol_json=None,
+             arg_params=None, aux_params=None, optimizer_states=None,
+             step=None, batch_cursor=0, extra=None, epoch=None,
+             topology=None, lock_timeout=None):
         """Write one atomic checkpoint version; returns its manifest.
 
         ``version`` names the files (``prefix-NNNN.*``); ``epoch`` is
@@ -251,73 +295,327 @@ class CheckpointManager:
         detect the mismatch and re-plan/re-shard instead of dying,
         while a same-topology resume provably skips the reshard.
         """
-        t_save0 = time.perf_counter()
-        version = int(version)
-        epoch = version if epoch is None else int(epoch)
-        arg_params = arg_params or {}
-        aux_params = aux_params or {}
-        save_dict = {f"arg:{k}": _as_nd(v) for k, v in
-                     arg_params.items()}
-        save_dict.update({f"aux:{k}": _as_nd(v) for k, v in
-                          aux_params.items()})
-        from .. import ndarray as nd
+        cap = self._capture(version, symbol=symbol,
+                            symbol_json=symbol_json,
+                            arg_params=arg_params,
+                            aux_params=aux_params,
+                            optimizer_states=optimizer_states,
+                            step=step, batch_cursor=batch_cursor,
+                            extra=extra, epoch=epoch,
+                            topology=topology)
+        return self._write_version(cap, lock_timeout=lock_timeout)
 
-        files = {}
-        payload = nd.save_buffer(save_dict)
-        ppath = self.params_path(version)
-        atomic_write_bytes(ppath, payload)
-        files[os.path.basename(ppath)] = {
-            "bytes": len(payload), "crc32": _crc(payload)}
-        if optimizer_states is not None:
-            spath = self.states_path(version)
-            atomic_write_bytes(spath, optimizer_states)
-            files[os.path.basename(spath)] = {
-                "bytes": len(optimizer_states),
-                "crc32": _crc(optimizer_states)}
-        if symbol is not None:
-            atomic_write_bytes(self.symbol_path(),
-                               symbol.tojson().encode())
-        manifest = {
-            "format": self.MANIFEST_FORMAT,
+    # -------------------------------------------------- capture / write
+    def _capture(self, version, symbol=None, symbol_json=None,
+                 arg_params=None, aux_params=None,
+                 optimizer_states=None, step=None, batch_cursor=0,
+                 extra=None, epoch=None, topology=None):
+        """Snapshot everything a checkpoint needs onto the HOST, now:
+        the device→host copy of every param (``_as_nd`` gathers
+        mesh-backed arrays — a collective, so this must run at a step
+        boundary while every peer is alive), the RNG state and the
+        autotune hash.  The returned dict is self-contained: writing
+        it later (async writer thread, emergency flush) touches no
+        device and needs no peer."""
+        version = int(version)
+        with self._vlock:
+            self._reserved = max(self._reserved, version)
+        save_dict = {f"arg:{k}": _as_nd(v) for k, v in
+                     (arg_params or {}).items()}
+        save_dict.update({f"aux:{k}": _as_nd(v) for k, v in
+                          (aux_params or {}).items()})
+        if symbol_json is None and symbol is not None:
+            symbol_json = symbol.tojson()
+        return {
             "version": version,
-            "epoch": epoch,
+            "epoch": version if epoch is None else int(epoch),
+            "save_dict": save_dict,
+            "optimizer_states": optimizer_states,
+            "symbol_json": symbol_json,
             "step": step,
             "batch_cursor": int(batch_cursor),
-            "files": files,
             "rng": capture_rng(),
             "autotune_sha256": _autotune_hash(),
             "topology": topology,
-            "time": time.time(),
             "extra": extra or {},
         }
-        atomic_write_bytes(self.manifest_path(version),
-                           json.dumps(manifest, indent=1).encode())
-        # the pointer goes LAST: a crash anywhere above leaves `latest`
-        # naming the previous complete version
-        atomic_write_bytes(
-            self.latest_path(),
-            json.dumps({"epoch": version,
-                        "manifest": os.path.basename(
-                            self.manifest_path(version))}).encode())
-        self._apply_retention()
+
+    def _write_version(self, cap, inject_point="ckpt.write",
+                       telemetry_extra=None, skip_if_written=False,
+                       lock_timeout=None):
+        """Serialize + atomically write one captured snapshot: every
+        payload write-to-temp+fsync+rename, manifest after payloads,
+        ``latest`` pointer LAST — a crash anywhere leaves the previous
+        complete version as ``latest``.  Serialized against concurrent
+        writers (async thread vs emergency flush vs sync save).
+        ``skip_if_written`` (the async/emergency paths, which race
+        toward the same allocated version) returns None instead of
+        rewriting a version this process already made durable; the
+        sync ``save()`` keeps its legacy rewrite-in-place semantics.
+        ``lock_timeout`` bounds the wait for the writer lock (the
+        emergency/abort paths: when the wedge IS a hung write holding
+        the lock, blocking here would stop the abort from ever
+        reaching its ``os._exit``) — on timeout, None."""
+        from .. import ndarray as nd
+
+        t_save0 = time.perf_counter()
+        version = cap["version"]
+        if lock_timeout is None:
+            self._write_lock.acquire()
+        elif not self._write_lock.acquire(timeout=float(lock_timeout)):
+            return None  # the lock holder is wedged: do not join it
+        try:
+            if skip_if_written and version in self._written:
+                return None  # already durably written (emergency won)
+            files = {}
+            payload = nd.save_buffer(cap["save_dict"])
+            ppath = self.params_path(version)
+            atomic_write_bytes(ppath, payload,
+                               inject_point=inject_point)
+            files[os.path.basename(ppath)] = {
+                "bytes": len(payload), "crc32": _crc(payload)}
+            states = cap.get("optimizer_states")
+            if states is not None:
+                spath = self.states_path(version)
+                atomic_write_bytes(spath, states,
+                                   inject_point=inject_point)
+                files[os.path.basename(spath)] = {
+                    "bytes": len(states), "crc32": _crc(states)}
+            sj = cap.get("symbol_json")
+            if sj is not None:
+                # the symbol file is SHARED across versions: skip the
+                # rewrite when this manager already wrote identical
+                # content (the cadence-snapshot path would otherwise
+                # re-write an unchanged multi-MB graph per snapshot)
+                sj_crc = _crc(sj.encode())
+                if getattr(self, "_symbol_crc", None) != sj_crc:
+                    atomic_write_bytes(self.symbol_path(),
+                                       sj.encode(),
+                                       inject_point=inject_point)
+                    self._symbol_crc = sj_crc
+            manifest = {
+                "format": self.MANIFEST_FORMAT,
+                "version": version,
+                "epoch": cap["epoch"],
+                "step": cap.get("step"),
+                "batch_cursor": int(cap.get("batch_cursor", 0)),
+                "files": files,
+                "rng": cap.get("rng"),
+                "autotune_sha256": cap.get("autotune_sha256"),
+                "topology": cap.get("topology"),
+                "time": time.time(),
+                "extra": cap.get("extra") or {},
+            }
+            atomic_write_bytes(self.manifest_path(version),
+                               json.dumps(manifest, indent=1).encode(),
+                               inject_point=inject_point)
+            # the pointer goes LAST: a crash anywhere above leaves
+            # `latest` naming the previous complete version.  On the
+            # ASYNC/emergency paths it only ever moves FORWARD (a
+            # queued snapshot landing after a newer drain save must
+            # not point resumes back at the older version); a sync
+            # save() keeps the legacy rule — the pointer follows the
+            # last explicit save, lower version number or not
+            cur = -1
+            if skip_if_written:
+                try:
+                    with open(self.latest_path(), "rb") as f:
+                        cur = int(json.loads(f.read())["epoch"])
+                except (OSError, ValueError, KeyError, TypeError):
+                    pass  # unreadable/corrupt pointer: overwrite it
+            if version >= cur:
+                atomic_write_bytes(
+                    self.latest_path(),
+                    json.dumps({"epoch": version,
+                                "manifest": os.path.basename(
+                                    self.manifest_path(version))}
+                               ).encode(),
+                    inject_point=inject_point)
+            self._written.add(version)
+            # just written from in-memory blobs whose CRCs the manifest
+            # records: good by construction for this process's
+            # retention decisions
+            self._good_cache.add(version)
+            self._apply_retention()
+        finally:
+            self._write_lock.release()
         from .. import telemetry
 
         telemetry.checkpoint_event(
             self.prefix, version, time.perf_counter() - t_save0,
-            sum(f["bytes"] for f in files.values()))
+            sum(f["bytes"] for f in files.values()),
+            **(telemetry_extra or {}))
         return manifest
 
+    def allocate_version(self, min_version=1):
+        """A fresh monotonic version id: past everything on disk AND
+        everything captured-but-unwritten in this process (the async
+        queue), so sync saves, async snapshots and emergency flushes
+        never collide.  ``min_version`` lets fit keep the legacy
+        version==epoch naming for the first clean save."""
+        with self._vlock:
+            eps = self.epochs()
+            v = max((eps[-1] + 1) if eps else 1, self._reserved + 1,
+                    int(min_version))
+            self._reserved = v
+            return v
+
+    # -------------------------------------------------- async snapshots
+    def save_async(self, version=None, symbol=None, symbol_json=None,
+                   arg_params=None, aux_params=None,
+                   optimizer_states=None, step=None, batch_cursor=0,
+                   extra=None, epoch=None, topology=None,
+                   queue_depth=2):
+        """Asynchronous snapshot checkpoint: capture NOW (device→host
+        at the caller's step boundary), write LATER (serialization +
+        atomic writes on a background thread), so the training step
+        never waits on the disk.
+
+        * the bounded queue (``queue_depth``) back-pressures: when the
+          disk cannot keep up, the CALLER blocks on the next
+          ``save_async`` instead of snapshots accumulating unboundedly
+          in host memory;
+        * the freshest capture is retained in memory and registered as
+          the EMERGENCY checkpoint source (:mod:`.healing`): a peer
+          death or watchdog abort flushes it synchronously — no
+          collective needed, the gather already happened while the
+          mesh was whole;
+        * the ``ckpt.async`` fault point fires mid-payload inside the
+          writer thread: an armed ``crash`` proves a mid-write death
+          leaves ``latest`` == previous-good with no torn final file;
+        * each completed write bumps the ``ckpt_async_writes`` counter
+          and emits the standard ``checkpoint`` record with
+          ``async=True``.
+
+        Returns the allocated version id immediately.
+        """
+        if version is None:
+            version = self.allocate_version()
+        cap = self._capture(version, symbol=symbol,
+                            symbol_json=symbol_json,
+                            arg_params=arg_params,
+                            aux_params=aux_params,
+                            optimizer_states=optimizer_states,
+                            step=step, batch_cursor=batch_cursor,
+                            extra=extra, epoch=epoch,
+                            topology=topology)
+        self._freshest = cap
+        if self._async is None:
+            self._async = _AsyncWriter(self, depth=int(queue_depth))
+            from . import healing
+
+            healing.register_emergency(self._emergency_hook)
+        self._async.submit(cap)
+        return int(version)
+
+    def wait_async(self, timeout=None):
+        """Block until every queued snapshot is durably written (the
+        drain/exit path: a final sync save must not overtake a queued
+        async one in the version order a resume trusts)."""
+        if self._async is not None:
+            return self._async.drain(timeout=timeout)
+        return True
+
+    def close_async(self, timeout=None):
+        """Drain and stop the writer thread; unregisters the emergency
+        hook.  Idempotent."""
+        wr, self._async = self._async, None
+        if wr is None:
+            return True
+        from . import healing
+
+        healing.unregister_emergency(self._emergency_hook)
+        return wr.close(timeout=timeout)
+
+    def flush_emergency(self, reason="emergency", lock_timeout=10.0):
+        """Synchronously write the freshest captured snapshot if it is
+        not yet on disk — the failure detector's death path and the
+        watchdog's abort escalation call this (directly or through
+        ``healing.fire_emergency``).  Fault injection is DISABLED for
+        this write, and the writer lock is acquired with a TIMEOUT:
+        when the wedge being escaped is itself a hung checkpoint write
+        holding the lock, the emergency must give up and let the abort
+        reach its ``os._exit`` instead of joining the deadlock.
+        Returns the manifest path written, or None when the freshest
+        snapshot is already durable (or unreachable)."""
+        cap = self._freshest
+        if cap is None:
+            return None
+        if cap["version"] in self._written:
+            return None
+        cap = dict(cap)
+        cap.setdefault("extra", {})
+        cap["extra"] = dict(cap["extra"], emergency=reason)
+        man = self._write_version(cap, inject_point=None,
+                                  telemetry_extra={"emergency": reason},
+                                  skip_if_written=True,
+                                  lock_timeout=lock_timeout)
+        if man is None:
+            return None
+        return self.manifest_path(cap["version"])
+
+    def _emergency_hook(self, reason):
+        return self.flush_emergency(reason)
+
+    # --------------------------------------------------------- retention
+    def _verified_good(self, e):
+        """verify() with a positive memo: a version this process wrote
+        or already verified is trusted without re-reading its payloads
+        on every retention sweep (rot after a positive verdict is the
+        accepted trade — retention is belt-and-braces, fsck re-reads
+        everything)."""
+        if e in self._good_cache:
+            return True
+        if self.verify(e):
+            self._good_cache.add(e)
+            return True
+        return False
+
     def _apply_retention(self):
+        """keep_n retention that can never garbage-collect the
+        recovery chain: the newest ``keep_n`` VERIFIED-GOOD versions
+        are kept (torn versions do not count against the window), and
+        only versions strictly older than the oldest kept good one are
+        pruned.  With every version healthy this is exactly the old
+        count-based prune; with the newest versions torn (foreign
+        truncation, bit rot, a lying fsync) the last good generations
+        survive — the count-based prune deleted the newest good
+        version while keeping its torn juniors."""
         if not self.keep_n or int(self.keep_n) <= 0:
             return
+        keep_n = int(self.keep_n)
         eps = self.epochs()
-        for e in eps[:-int(self.keep_n)]:
+        if len(eps) <= keep_n:
+            return
+        # NEWEST-first with early stop: verification walks down only
+        # until keep_n good versions are found.  A save through this
+        # manager just seeded its own version into the good-cache, so
+        # the steady state re-reads at most keep_n-1 older payloads —
+        # and only on the first sweep of a freshly constructed
+        # manager (later sweeps hit the cache for everything kept).
+        good_found = 0
+        floor = None
+        for e in reversed(eps):
+            if self._verified_good(e):
+                good_found += 1
+                if good_found >= keep_n:
+                    floor = e
+                    break
+        if good_found == 0:
+            return  # nothing verifies: delete NOTHING — any file may
+            #         be the operator's last forensic straw
+        if floor is None:
+            return  # fewer than keep_n good versions exist: keep all
+        for e in eps:
+            if e >= floor:
+                continue
             for p in (self.params_path(e), self.states_path(e),
                       self.manifest_path(e)):
                 try:
                     os.unlink(p)
                 except OSError:
                     pass
+            self._good_cache.discard(e)
 
     # ----------------------------------------------------------- lookup
     def epochs(self):
@@ -366,11 +664,24 @@ class CheckpointManager:
         """True iff the manifest parses and every payload matches its
         recorded size and CRC32 — catches truncation, bit rot, and
         torn non-atomic writes from foreign tools."""
+        return self.verify_detail(epoch) is None
+
+    def verify_detail(self, epoch):
+        """None when the version verifies, else a one-line problem
+        NAMING the offending file — what ``tools/ckpt_fsck.py`` prints
+        so an operator knows which artifact is torn, not just which
+        version."""
         try:
             self._read_verified(epoch)
-            return True
-        except (OSError, ValueError, KeyError, MXNetError):
-            return False
+            return None
+        except MXNetError as e:
+            return str(e)
+        except OSError as e:
+            return (f"checkpoint manifest/payload unreadable: "
+                    f"{getattr(e, 'filename', None) or e}")
+        except (ValueError, KeyError) as e:
+            return (f"checkpoint manifest {self.manifest_path(epoch)!r}"
+                    f" malformed ({type(e).__name__}: {e})")
 
     def _latest_candidates(self):
         """Version numbers to try, newest-first: the ``latest``
@@ -379,8 +690,9 @@ class CheckpointManager:
         try:
             with open(self.latest_path(), "rb") as f:
                 candidates.append(int(json.loads(f.read())["epoch"]))
-        except (OSError, ValueError, KeyError):
-            pass
+        except (OSError, ValueError, KeyError, TypeError):
+            pass  # unreadable/corrupt pointer (non-numeric epoch
+            #       included): fall back through on-disk versions
         for e in reversed(self.epochs()):
             if e not in candidates:
                 candidates.append(e)
@@ -509,3 +821,101 @@ class CheckpointManager:
             if pname in blobs:
                 return nd.load_buffer(blobs[pname], ctx=ctx)
         return nd.load(self.params_path(version), ctx=ctx)
+
+
+class _AsyncWriter:
+    """The snapshot-checkpoint background writer: one daemon thread
+    draining a BOUNDED queue of captured snapshots.
+
+    The bound is the back-pressure contract: a disk slower than the
+    snapshot cadence blocks the producer (the training loop's
+    ``save_async``) on ``queue.put`` instead of accumulating host
+    copies without limit.  The ``ckpt.async`` fault point fires
+    mid-payload inside every write this thread performs — an armed
+    ``crash`` is the power-loss-during-async-write drill.
+    """
+
+    def __init__(self, mgr, depth=2):
+        self.mgr = mgr
+        self._q = queue.Queue(maxsize=max(1, int(depth)))
+        self._cv = threading.Condition()
+        self._submitted = 0
+        self._completed = 0
+        self._stop = False
+        self._errors = []
+        self._thread = threading.Thread(
+            target=self._run, name="mxnet_tpu-ckpt-async", daemon=True)
+        self._thread.start()
+
+    def submit(self, cap):
+        with self._cv:
+            self._submitted += 1
+        self._q.put(cap)  # blocks when the disk is behind: backpressure
+
+    def _run(self):
+        while True:
+            cap = self._q.get()
+            if cap is None:
+                return
+            try:
+                faultsim.inject("ckpt.async")
+                man = self.mgr._write_version(
+                    cap, inject_point="ckpt.async",
+                    telemetry_extra={"async": True},
+                    skip_if_written=True)
+                if man is not None:
+                    from .. import telemetry
+
+                    telemetry.count("ckpt_async_writes")
+            except Exception as e:  # a broken disk must not kill the
+                # writer thread — but it must not be SILENT either:
+                # the operator believes batches-fresh recovery points
+                # exist, so every failed snapshot is logged, counted,
+                # and recorded (the emergency path will hit the same
+                # disk, with prior warning instead of none)
+                self._errors.append(e)
+                import logging
+
+                logging.getLogger("mxnet_tpu").warning(
+                    "async snapshot write failed (version %s): %r",
+                    cap.get("version"), e)
+                try:
+                    from .. import telemetry
+
+                    telemetry.count("ckpt_async_errors")
+                    telemetry.event("ckpt_async_error",
+                                    version=cap.get("version"),
+                                    error=repr(e))
+                except Exception:
+                    pass
+            finally:
+                with self._cv:
+                    self._completed += 1
+                    self._cv.notify_all()
+
+    def drain(self, timeout=None):
+        """True once every snapshot submitted so far is written (or
+        failed into ``errors``)."""
+        with self._cv:
+            return self._cv.wait_for(
+                lambda: self._completed >= self._submitted,
+                timeout=timeout)
+
+    def close(self, timeout=None):
+        timeout = 10.0 if timeout is None else float(timeout)
+        if not self._stop:
+            self._stop = True
+            try:
+                # the sentinel must NOT block forever: with the writer
+                # wedged on a bad disk the bounded queue stays full —
+                # close() (fit's finally) abandons the daemon thread
+                # instead of joining the hang
+                self._q.put(None, timeout=timeout)
+            except queue.Full:
+                pass
+        self._thread.join(timeout=timeout)
+        return not self._thread.is_alive()
+
+    @property
+    def errors(self):
+        return list(self._errors)

@@ -53,6 +53,16 @@ Points wired in-tree:
 ``fleet.swap``  serving/fleet.py ModelHost.swap, before the next
                 artifact loads — ``crash`` = mid-swap replica death
                 (registered by ``mxnet_tpu.serving``)
+``peer.heartbeat``  resilience/healing.py Heartbeater, inside every
+                beat — ``delay`` = a stalled heart the peers' failure
+                detectors must flag, ``raise`` = one dropped beat
+                (absorbed), ``crash`` = sudden death mid-beat
+``ckpt.async``  resilience/checkpoint.py async snapshot writer,
+                MID-payload in every atomic write of a ``save_async``
+                version — ``crash`` must leave latest == previous-good
+``heal.relaunch``  resilience/healing.py supervisor, before every
+                respawn of the training command (``raise`` aborts the
+                respawn policy, ``delay`` = slow scheduler)
 ==============  =======================================================
 
 Spec grammar (env ``MXNET_FAULT_SPEC`` or ``faultsim.reset(spec)``)::
@@ -104,6 +114,13 @@ _POINTS = {
     "bench.stall": "bench.py after the measure phase",
     "dist.init": "inside every jax.distributed.initialize attempt",
     "dist.collective": "before the jitted collective program",
+    "peer.heartbeat": "healing Heartbeater, inside every beat "
+                      "(delay = a stalled heart, raise = one dropped "
+                      "beat)",
+    "ckpt.async": "async snapshot writer thread, mid-payload in every "
+                  "atomic write of a save_async version",
+    "heal.relaunch": "healing supervisor, before every respawn of the "
+                     "training command",
 }
 
 

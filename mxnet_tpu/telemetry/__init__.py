@@ -31,6 +31,7 @@ from .runlog import (  # noqa: F401
     flight_dump,
     flight_path_for,
     gauge,
+    heal,
     program_report,
     reset,
 )
@@ -39,7 +40,7 @@ from .watchdog import Watchdog, stack_path_for  # noqa: F401
 
 __all__ = [
     "RunLog", "current", "reset", "close", "compile_event",
-    "compile_fingerprint", "event", "count", "gauge",
+    "compile_fingerprint", "event", "count", "gauge", "heal",
     "checkpoint_event", "program_report", "flight_dump",
     "flight_path_for", "describe_program", "FitSession",
     "fit_session", "schema", "Watchdog", "stack_path_for",

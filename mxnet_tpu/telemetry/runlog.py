@@ -49,7 +49,7 @@ import threading
 import time
 
 __all__ = ["RunLog", "current", "reset", "close", "compile_event",
-           "compile_fingerprint", "event", "count", "gauge",
+           "compile_fingerprint", "event", "count", "gauge", "heal",
            "checkpoint_event", "program_report", "flight_dump",
            "describe_program", "flight_path_for"]
 
@@ -136,7 +136,10 @@ class RunLog:
                          "serve_breaker_trips": 0,
                          "fleet_requests": 0, "fleet_shed": 0,
                          "fleet_failovers": 0, "fleet_resizes": 0,
-                         "fleet_swaps": 0}
+                         "fleet_swaps": 0, "peer_deaths": 0,
+                         "auto_reshards": 0, "ckpt_async_writes": 0,
+                         "ckpt_async_errors": 0,
+                         "emergency_ckpts": 0, "heal_relaunches": 0}
         self._gauges = {}       # name -> last value (textfile rows)
         self._fps = {}          # program -> last compile fingerprint
         self._programs = {}     # program -> last program_report body
@@ -473,6 +476,31 @@ class RunLog:
                                     round(float(queue_ewma), 3),
                                     cat="telemetry", tid=_TRACE_TID)
 
+    def heal(self, action, **fields):
+        """One self-healing runtime observation (resilience.healing):
+        a declared peer death, an abandoned collective, an emergency
+        checkpoint flush, the survivor's heal_exit, a supervisor
+        relaunch or the healed resume — stamped with the process's
+        cumulative healing counters so a single record tells the
+        whole story so far."""
+        c = self.counters
+        self._write({"type": "heal", "t": round(self._now(), 6),
+                     "action": str(action),
+                     "peer_deaths": int(c.get("peer_deaths", 0)),
+                     "emergency_ckpts": int(c.get("emergency_ckpts",
+                                                  0)),
+                     "heal_relaunches": int(c.get("heal_relaunches",
+                                                  0)),
+                     "auto_reshards": int(c.get("auto_reshards", 0)),
+                     **_jsonable(fields)})
+        from .. import profiler
+
+        if profiler.is_running():
+            self._trace_meta()
+            profiler.record_instant(
+                f"heal:{action}", "telemetry",
+                args=_jsonable(fields), tid=_TRACE_TID)
+
     def opstats(self, rows, source="profiler"):
         """The aggregate per-op table (telemetry.opstats) as one
         ``program_report``-style record."""
@@ -697,6 +725,12 @@ def gauge(name, value):
     rl = current()
     if rl is not None:
         rl.gauge(name, value)
+
+
+def heal(action, **fields):
+    rl = current()
+    if rl is not None:
+        rl.heal(action, **fields)
 
 
 def checkpoint_event(prefix, version, duration_s, nbytes, **extra):

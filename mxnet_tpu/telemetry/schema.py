@@ -22,6 +22,10 @@ serve     one dispatched serving microbatch (size, pad, latency,
 fleet     one fleet-router observation (replica counts, queue-depth
           EWMA, cumulative request/failover/shed counters) stamped
           with the action that produced it (probe/eject/resize/swap)
+heal      one self-healing runtime observation (peer_death /
+          collective_abandon / emergency_ckpt / heal_exit / relaunch /
+          resume) with the cumulative peer-death / emergency /
+          relaunch counters
 event     everything else (bad_step, ps_retry, fault, deadline, ...)
 run_end   final counters, written at close
 ========  =============================================================
@@ -30,8 +34,8 @@ from __future__ import annotations
 
 __all__ = ["STEP_FIELDS", "RECORD_TYPES", "COMPILE_CAUSES",
            "OPSTATS_ROW_FIELDS", "TENSOR_STATS_ROW_FIELDS",
-           "SERVE_FIELDS", "FLEET_FIELDS", "validate_record",
-           "validate_lines"]
+           "SERVE_FIELDS", "FLEET_FIELDS", "HEAL_FIELDS",
+           "validate_record", "validate_lines"]
 
 #: step-record contract: field -> (types, required).  ``None`` is legal
 #: for optional measurements (loss on an unsampled step, feed stats
@@ -60,7 +64,7 @@ STEP_FIELDS = {
 
 RECORD_TYPES = ("run_start", "step", "compile", "program_report",
                 "checkpoint", "watchdog", "opstats", "tensor_stats",
-                "serve", "fleet", "event", "run_end")
+                "serve", "fleet", "heal", "event", "run_end")
 
 #: per-batch contract of a ``serve`` record (serving.ModelServer)
 SERVE_FIELDS = {
@@ -90,6 +94,21 @@ FLEET_FIELDS = {
     "requests": (int, True),              # cumulative router counters
     "failovers": (int, True),
     "shed": (int, True),
+}
+
+#: per-observation contract of a ``heal`` record (resilience.healing):
+#: one self-healing runtime event — a declared peer death, an
+#: abandoned collective, an emergency checkpoint flush, the survivor's
+#: heal_exit, a supervisor relaunch or the healed resume — with the
+#: process's cumulative healing counters stamped on
+HEAL_FIELDS = {
+    "type": (str, True),
+    "t": ((int, float), True),
+    "action": (str, True),        # peer_death|collective_abandon|...
+    "peer_deaths": (int, True),   # cumulative process counters
+    "emergency_ckpts": (int, True),
+    "heal_relaunches": (int, True),
+    "auto_reshards": (int, True),
 }
 
 #: per-op row contract of an ``opstats`` record (telemetry.opstats)
@@ -199,6 +218,8 @@ def validate_record(rec):
         return _check_fields(rec, SERVE_FIELDS)
     if t == "fleet":
         return _check_fields(rec, FLEET_FIELDS)
+    if t == "heal":
+        return _check_fields(rec, HEAL_FIELDS)
     if t == "event":
         return _check_fields(rec, {"t": ((int, float), True),
                                    "kind": (str, True)})

@@ -24,11 +24,21 @@ is the whole point — the watchdog thread:
   partial headline JSON here, so even a later ``kill -9`` leaves the
   stall attributed in the artifact).
 
-The watchdog OBSERVES, it never kills: the external ``timeout -k`` (or
-the internal deadline) stays the executioner; the watchdog's job is
-making sure the death is diagnosable.  After firing it re-arms, so a
-long stall produces a bounded series of dumps (``max_dumps``) showing
-whether the stack is moving or truly stuck.
+The watchdog OBSERVES, it never kills — by default.  The external
+``timeout -k`` (or the internal deadline) stays the executioner; the
+watchdog's job is making sure the death is diagnosable.  After firing
+it re-arms, so a long stall produces a bounded series of dumps
+(``max_dumps``) showing whether the stack is moving or truly stuck.
+
+``MXNET_WATCHDOG_ABORT`` (round 16, default OFF) is the escalation
+for jobs whose orchestrator has no external executioner: once the
+``max_dumps`` stall dumps are exhausted and the heartbeat is STILL
+quiet for another full timeout, the watchdog flushes the flight ring,
+fires the emergency checkpoint (``resilience.healing`` — the freshest
+async snapshot, no collective needed), and ``os._exit``\\ s with
+:data:`WATCHDOG_ABORT_EXIT_CODE` — a permanently wedged job gets
+rescheduled instead of burning its whole wall budget.  The default
+observe-only contract is unchanged.
 
 Unarmed contract: ``MXNET_WATCHDOG_SEC`` unset/0 means no thread is
 ever started and ``beat()`` is a single attribute check — the hot path
@@ -43,7 +53,14 @@ import tempfile
 import threading
 import time
 
-__all__ = ["Watchdog", "stack_path_for", "default_timeout"]
+__all__ = ["Watchdog", "stack_path_for", "default_timeout",
+           "WATCHDOG_ABORT_EXIT_CODE"]
+
+#: exit status of a MXNET_WATCHDOG_ABORT escalation — distinct from
+#: the faultsim crash code (87), a healing peer-death exit (83) and
+#: any signal status, so the supervisor/orchestrator can tell "wedged
+#: and self-aborted" from every other death
+WATCHDOG_ABORT_EXIT_CODE = 85
 
 
 def stack_path_for(runlog_path):
@@ -84,12 +101,26 @@ class Watchdog:
     """
 
     def __init__(self, timeout=None, stack_path=None, on_stall=None,
-                 max_dumps=5, poll=None):
+                 max_dumps=5, poll=None, abort=None):
         self.timeout = default_timeout() if timeout is None \
             else float(timeout)
         self._explicit_stack_path = stack_path
         self.on_stall = on_stall
         self.max_dumps = int(max_dumps)
+        #: consecutive quiet periods in the CURRENT stall episode —
+        #: reset by every beat.  `stalls` stays the lifetime dump
+        #: budget; the abort escalation keys off the episode counter,
+        #: so a job that stalled early, recovered and trained for
+        #: hours is not executed on its next single-timeout hiccup
+        self.episode_stalls = 0
+        if abort is None:
+            try:
+                from ..config import get_env
+
+                abort = bool(get_env("MXNET_WATCHDOG_ABORT"))
+            except Exception:
+                abort = False
+        self.abort = bool(abort)
         self.stalls = 0
         self._poll = poll  # test hook; default derives from timeout
         self._lock = threading.Lock()
@@ -136,6 +167,7 @@ class Watchdog:
             return
         with self._lock:
             self._last_beat = time.monotonic()
+            self.episode_stalls = 0  # recovery ends the stall episode
             if phase is not None:
                 self._phase = str(phase)
 
@@ -173,14 +205,29 @@ class Watchdog:
                 phase = self._phase
             if not armed or quiet < self.timeout:
                 continue
-            if self.stalls >= self.max_dumps:
-                continue
-            self._fire(phase, quiet)
+            self.episode_stalls += 1
+            if self.abort and self.episode_stalls > self.max_dumps:
+                # escalation (MXNET_WATCHDOG_ABORT): max_dumps quiet
+                # periods IN THIS EPISODE are spent and the heartbeat
+                # is STILL dead a full timeout later — this job is
+                # wedged for good.  Leave every post-mortem artifact
+                # and die with a distinct status so the orchestrator
+                # reschedules instead of burning the wall budget.
+                # (Keyed on the per-episode counter: an early
+                # transient that exhausted the LIFETIME dump budget
+                # must not arm a hair trigger for the rest of the
+                # run.)
+                self._abort(phase, quiet)
+            if self.stalls < self.max_dumps:
+                self._fire(phase, quiet)
             with self._lock:
-                # re-arm: a still-stalled run fires again after another
-                # full quiet period, so the dump series shows whether
-                # the stacks are moving
-                self._last_beat = time.monotonic()
+                # re-arm in ALL cases (fired or dump-budget spent): a
+                # quiet PERIOD — not a poll tick — is the unit the
+                # episode counter and the dump series advance by, so
+                # a still-stalled run escalates one full timeout at a
+                # time and the dumps show whether the stacks move
+                if time.monotonic() - self._last_beat >= self.timeout:
+                    self._last_beat = time.monotonic()
 
     def _fire(self, phase, quiet_s):
         self.stalls += 1
@@ -215,6 +262,32 @@ class Watchdog:
                 self.on_stall(phase, quiet_s, path)
             except Exception:
                 pass
+
+    def _abort(self, phase, quiet_s):
+        """The MXNET_WATCHDOG_ABORT escalation: flight ring, emergency
+        checkpoint from the freshest snapshot, run log closed, then
+        ``os._exit`` — a wedged native call cannot be unwound, only
+        abandoned, and the exit code says why."""
+        try:
+            from ..resilience import healing
+
+            healing.fire_emergency("watchdog_abort")
+        except Exception:
+            pass
+        try:
+            from . import runlog as _rl
+
+            rl = _rl.current()
+            if rl is not None:
+                rl.heal("watchdog_abort", phase=str(phase),
+                        quiet_s=round(float(quiet_s), 3),
+                        stalls=self.stalls,
+                        code=WATCHDOG_ABORT_EXIT_CODE)
+                rl.flight_dump("watchdog_abort")
+                rl.close()
+        except Exception:
+            pass
+        os._exit(WATCHDOG_ABORT_EXIT_CODE)
 
     @staticmethod
     def _dump_stacks(f):

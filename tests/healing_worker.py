@@ -1,0 +1,328 @@
+"""Worker for THE self-healing SIGKILL drill (test_healing.py).
+
+The acceptance scenario of round 16: a REAL 2-process
+``jax.distributed`` CPU job, rank 1 **SIGKILLed mid-step** (not
+SIGTERM — no drain, no cleanup), and the survivor must heal with no
+operator action:
+
+* rank 1 (``HEAL_DIE_AT_STEP=K``) kills itself with SIGKILL right
+  before its step-K collective — rank 0 is left alone inside a psum
+  against a corpse;
+* rank 0 runs every step under :func:`healing.guard_collective` with
+  a live heartbeat + failure detector: the dead peer surfaces as
+  ``PeerDeadError`` within ``MXNET_PEER_TIMEOUT_SEC`` (same-host pid
+  probe: the detection latency is the poll, not the timeout), the
+  emergency checkpoint flushes the freshest ASYNC snapshot (cursor K
+  — strictly fresher than the synchronous epoch-cadence save at
+  cursor ``SYNC_AT``), and the survivor ``heal_exit``\\ s rc 83;
+* the healing supervisor (``python -m mxnet_tpu.resilience.healing
+  --relaunch``) wraps rank 0: on rc 83 it respawns the SAME command
+  with ``MXNET_HEAL_ATTEMPT=1``; the worker then reads the surviving
+  world from the heartbeat directory (``surviving_ranks`` →
+  ``elect_coordinator``), re-runs ``elastic_init`` at world size 1,
+  computes the PR-7 ``reshard_verdict`` (2 → 1: reshard), re-slices
+  the cursor, resumes from the snapshot and finishes — final params
+  match the uninterrupted reference ``allclose(1e-5)``.
+
+Modes (argv[1]):
+
+* ``run <coordinator> <pid> <nprocs> <prefix> <hb_dir>`` — the drill
+  (rank behavior switches on ``HEAL_DIE_AT_STEP`` and
+  ``MXNET_HEAL_ATTEMPT``);
+* ``reference`` — single-process uninterrupted run of TOTAL_STEPS,
+  prints final params JSON.
+
+Model/data are pure functions of the step index (the elastic_worker
+convention), so every world size consumes the same global stream.
+"""
+import json
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp  # noqa: E402
+
+TOTAL_STEPS = 7
+SYNC_AT = 2           # the synchronous "epoch-cadence" save cursor
+GLOBAL_BATCH = 8
+DIM_IN, DIM_OUT = 6, 4
+
+
+def _init_params():
+    rng = onp.random.RandomState(3)
+    return {"w": (rng.randn(DIM_IN, DIM_OUT) * 0.1).astype("float32"),
+            "b": onp.zeros((DIM_OUT,), "float32")}
+
+
+def _global_batch(t):
+    rng = onp.random.RandomState(100 + t)
+    x = rng.randn(GLOBAL_BATCH, DIM_IN).astype("float32")
+    y = rng.randn(GLOBAL_BATCH, DIM_OUT).astype("float32")
+    return x, y
+
+
+def _build_step(mesh):
+    """One jitted data-parallel SGD-momentum step over ``mesh``:
+    per-shard grads psum to the full-batch mean, momentum/params
+    replicated — so dp(2) and dp(1) produce identical updates and the
+    resumed world-1 run can match the world-2 start bit-for-bit."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from mxnet_tpu.parallel import compat_shard_map
+
+    def local(params, mom, x_sh, y_sh):
+        def loss_fn(p):
+            pred = x_sh @ p["w"] + p["b"]
+            return jnp.sum((pred - y_sh) ** 2) / GLOBAL_BATCH
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        loss = jax.lax.psum(loss, "data")
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, "data"), grads)
+        new_m = {k: 0.9 * mom[k] + grads[k] for k in grads}
+        new_p = {k: params[k] - 0.1 * new_m[k] for k in params}
+        return loss, new_p, new_m
+
+    spec = {"w": P(), "b": P()}
+    mapped = compat_shard_map(
+        local, mesh,
+        in_specs=(spec, spec, P("data"), P("data")),
+        out_specs=(P(), spec, spec))
+    return jax.jit(mapped)
+
+
+def _feed(mesh, t):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x, y = _global_batch(t)
+    sh = NamedSharding(mesh, P("data"))
+
+    def put(host):
+        return jax.make_array_from_callback(
+            host.shape, sh, lambda idx: host[idx])
+
+    return put(x), put(y)
+
+
+def _host(tree):
+    from mxnet_tpu.resilience.elastic import host_gather
+
+    return {k: host_gather(v) for k, v in tree.items()}
+
+
+def _nd(tree):
+    import mxnet_tpu as mx
+
+    return {k: mx.nd.array(onp.asarray(v)) for k, v in tree.items()}
+
+
+def _topo(mesh):
+    from mxnet_tpu.resilience.elastic import topology_block
+
+    return topology_block(mesh=mesh, sharding="none",
+                          global_batch=GLOBAL_BATCH)
+
+
+def _run_steps(mesh, params, mom, start, stop, per_step=None):
+    step_fn = _build_step(mesh)
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    repl = NamedSharding(mesh, P())
+
+    def put(host):
+        host = onp.asarray(host)
+        return jax.make_array_from_callback(
+            host.shape, repl, lambda idx: host[idx])
+
+    p_dev = {k: put(v) for k, v in params.items()}
+    m_dev = {k: put(v) for k, v in mom.items()}
+    for t in range(start, stop):
+        x, y = _feed(mesh, t)
+        loss, p_dev, m_dev = step_fn(p_dev, m_dev, x, y)
+        loss_v = float(onp.asarray(
+            loss.addressable_data(0)).reshape(-1)[0])
+        print(f"step {t} loss={loss_v:.6f}", flush=True)
+        if per_step is not None:
+            per_step(t, p_dev, m_dev)
+    return _host(p_dev), _host(m_dev)
+
+
+def _survivor_run(coordinator, pid, nprocs, prefix, hb_dir):
+    """Attempt 0, rank 0: the victim-side of the drill."""
+    import mxnet_tpu  # noqa: F401 — telemetry wire points
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.resilience import elastic, healing
+    from mxnet_tpu.resilience.checkpoint import CheckpointManager
+
+    die_at = int(os.environ.get("HEAL_DIE_AT_STEP", "0"))
+    ctx = elastic.elastic_init(coordinator=coordinator,
+                               num_processes=nprocs, process_id=pid)
+    mesh = elastic.elastic_mesh()
+    print(f"[{pid}] elastic up: world={ctx.world_devices} "
+          f"procs={ctx.num_processes}", flush=True)
+    det = healing.arm(hb_dir, pid, nprocs)
+    mgr = CheckpointManager(prefix)
+    params, mom = _init_params(), {
+        "w": onp.zeros((DIM_IN, DIM_OUT), "float32"),
+        "b": onp.zeros((DIM_OUT,), "float32")}
+
+    step_fn = _build_step(mesh)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    repl = NamedSharding(mesh, P())
+
+    def put(host):
+        host = onp.asarray(host)
+        return jax.make_array_from_callback(
+            host.shape, repl, lambda idx: host[idx])
+
+    p_dev = {k: put(v) for k, v in params.items()}
+    m_dev = {k: put(v) for k, v in mom.items()}
+    t_death = None
+    try:
+        for t in range(TOTAL_STEPS):
+            if die_at and t == die_at:
+                # rank "mid-step": SIGKILL myself right before my
+                # side of the step-K collective — the survivor is
+                # left inside a psum against a corpse
+                print(f"[{pid}] SIGKILL self at step {t}", flush=True)
+                os.kill(os.getpid(), signal.SIGKILL)
+
+            def one_step():
+                x, y = _feed(mesh, t)
+                loss, p2, m2 = step_fn(p_dev, m_dev, x, y)
+                # the readback forces the collective to complete (or
+                # fail) INSIDE the guard
+                loss_v = float(onp.asarray(
+                    loss.addressable_data(0)).reshape(-1)[0])
+                return loss_v, p2, m2
+
+            t0 = time.monotonic()
+            loss_v, p_dev, m_dev = healing.guard_collective(
+                one_step, det, poll=0.05, label=f"step{t}")
+            healing.poll(step=t)
+            print(f"[{pid}] step {t} loss={loss_v:.6f}", flush=True)
+            if pid == 0:
+                if t + 1 == SYNC_AT:
+                    # the synchronous epoch-cadence save: version 1,
+                    # cursor SYNC_AT — what recovery would be stuck
+                    # with WITHOUT async snapshots
+                    mgr.save(1, arg_params=_nd(_host(p_dev)),
+                             extra={"mom": None},
+                             batch_cursor=SYNC_AT, topology=_topo(mesh))
+                # async snapshot every step: params + momentum,
+                # cursor t+1; capture gathers to host (replicated →
+                # local read), write rides the background thread
+                import pickle
+
+                states = pickle.dumps(
+                    {k: onp.asarray(v) for k, v in
+                     _host(m_dev).items()})
+                mgr.save_async(arg_params=_nd(_host(p_dev)),
+                               optimizer_states=states,
+                               batch_cursor=t + 1,
+                               topology=_topo(mesh))
+    except healing.PeerDeadError as e:
+        t_death = time.monotonic() - t0
+        print(f"[{pid}] peer death detected in {t_death:.2f}s: {e}",
+              flush=True)
+        telemetry.heal("survivor_detected", detail=str(e),
+                       detect_s=round(t_death, 3))
+        # rc 83: emergency checkpoint (freshest snapshot) + flight
+        # dump + run_end, then os._exit — a jax.distributed teardown
+        # against a dead peer wedges the interpreter's atexit forever
+        healing.heal_exit("peer_death")
+    raise AssertionError("drill never reached the peer death")
+
+
+def _healed_resume(prefix, hb_dir, nprocs):
+    """Attempt >= 1: the supervisor's relaunch — resume at the
+    surviving world size with no operator action."""
+    import mxnet_tpu  # noqa: F401
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.resilience import elastic, healing
+    from mxnet_tpu.resilience.checkpoint import CheckpointManager
+
+    survivors = healing.surviving_ranks(hb_dir, nprocs, self_rank=0)
+    coord_rank, remap = healing.elect_coordinator(survivors)
+    print(f"[heal] survivors={survivors} coordinator={coord_rank} "
+          f"remap={remap}", flush=True)
+    elastic.elastic_init()  # world size 1: local bring-up
+    mesh = elastic.elastic_mesh()
+    st = CheckpointManager(prefix).load()
+    assert st["batch_cursor"] > SYNC_AT, (
+        "resume must come from the ASYNC snapshot, strictly fresher "
+        f"than the sync epoch save (cursor {st['batch_cursor']} vs "
+        f"{SYNC_AT})")
+    verdict = elastic.reshard_verdict(st["topology"], _topo(mesh))
+    assert verdict["reshard"], verdict
+    cursor = elastic.reslice_cursor(st["batch_cursor"],
+                                    st["topology"], _topo(mesh))
+    telemetry.count("auto_reshards")
+    telemetry.heal("resume", old_world=verdict["old_world"],
+                   new_world=verdict["new_world"], batch_cursor=cursor,
+                   attempt=healing.relaunch_attempt())
+    import pickle
+
+    params = {k: v.asnumpy() for k, v in st["arg_params"].items()}
+    mom = {k: onp.asarray(v) for k, v in
+           pickle.loads(st["optimizer_states"]).items()}
+    params_host, _ = _run_steps(mesh, params, mom, cursor, TOTAL_STEPS)
+    telemetry.close()
+    print(json.dumps({
+        "final": {k: onp.asarray(v).tolist()
+                  for k, v in params_host.items()},
+        "resumed_cursor": int(cursor),
+        "sync_cursor": SYNC_AT,
+        "verdict": {"reshard": verdict["reshard"],
+                    "old_world": verdict["old_world"],
+                    "new_world": verdict["new_world"]},
+        "survivors": survivors,
+        "coordinator": coord_rank}), flush=True)
+
+
+def main():
+    mode = sys.argv[1]
+    if mode == "run":
+        coordinator, pid, nprocs, prefix, hb_dir = (
+            sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+            sys.argv[5], sys.argv[6])
+        from mxnet_tpu.resilience import healing
+
+        attempt = healing.relaunch_attempt()
+        os.environ["MXNET_RUNLOG"] = f"{prefix}.runlog.r{pid}" \
+                                     f".a{attempt}.jsonl"
+        if attempt > 0:
+            _healed_resume(prefix, hb_dir, nprocs)
+            return
+        _survivor_run(coordinator, pid, nprocs, prefix, hb_dir)
+        return
+    if mode == "reference":
+        from mxnet_tpu.resilience import elastic
+
+        elastic.elastic_init()
+        mesh = elastic.elastic_mesh()
+        params_host, _ = _run_steps(
+            mesh, _init_params(),
+            {"w": onp.zeros((DIM_IN, DIM_OUT), "float32"),
+             "b": onp.zeros((DIM_OUT,), "float32")},
+            0, TOTAL_STEPS)
+        print(json.dumps({"final": {k: onp.asarray(v).tolist()
+                                    for k, v in params_host.items()}}),
+              flush=True)
+        return
+    raise SystemExit(f"unknown mode {mode!r}")
+
+
+if __name__ == "__main__":
+    main()

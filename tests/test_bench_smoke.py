@@ -122,6 +122,22 @@ def test_smoke_emits_valid_json_with_heartbeats():
     assert tm["records"]["tensor_stats"] >= 1
     assert tm["tensor_stats"]["tensors"] >= 1
     assert tm["tensor_stats"]["nonfinite"] is False
+    # the healing phase (round 16): async-checkpoint steal A/B under
+    # the <5% acceptance bar, the detect-to-resume drill, and an
+    # fsck-clean artifact tree
+    hl = out["healing"]
+    ov = hl["overhead"]
+    assert ov["plain_ms_per_step"] > 0
+    assert ov["async_ms_per_step"] > 0
+    assert ov["async_versions_written"] >= 1
+    assert ov["overhead_ok"] is True, ov
+    assert hl["detect_s"] >= 0
+    assert hl["resume_s"] > 0
+    assert hl["detect_to_resume_s"] >= hl["resume_s"]
+    assert hl["reshard_verdict"] == {"reshard": True, "old_world": 2,
+                                     "new_world": 1}
+    assert hl["fsck_clean"] is True
+    assert hl["fsck_versions"] >= 1
     # the INFERENCE serving phase (round 13) stood the continuous-
     # batching model server in front of the net and drove bursty load
     srv = out["serving"]
@@ -163,7 +179,8 @@ def test_smoke_emits_valid_json_with_heartbeats():
     for phase in ("import", "device_init", "build", "autotune",
                   "compile", "K1", "K2", "trials", "feed",
                   "checkpoint", "collectives", "fused_kernels",
-                  "serving", "fleet", "telemetry", "conv_ab", "done"):
+                  "healing", "serving", "fleet", "telemetry",
+                  "conv_ab", "done"):
         assert f"phase={phase}" in r.stderr, f"missing phase {phase}"
 
 

@@ -1,0 +1,819 @@
+"""Self-healing training runtime tests (round 16).
+
+The contract under test: an UNCOOPERATIVE death (SIGKILL, OOM,
+partition) is detected by the peer liveness layer, survivors abandon
+the wedged collective, the emergency checkpoint flushes the freshest
+async snapshot, and the supervisor relaunch reshards at the surviving
+world size — with no operator action.
+
+* heartbeat/failure-detector verdicts: stale beat, dead same-host pid
+  (the SIGKILL fast path), never-beat grace, sticky death;
+* `guard_collective` abandons a wedged callable on a peer death and
+  translates backend errors under a confirmed death;
+* `CheckpointManager.save_async`: bounded-queue back-pressure, an
+  injected `ckpt.async:crash` mid-write leaves latest ==
+  previous-good with no torn final file, emergency flush of the
+  freshest unwritten snapshot;
+* `Module.fit` wiring: MXNET_SNAPSHOT_EVERY cadence snapshots between
+  epoch saves; a fit-level peer death heal-exits rc 83 with the heal
+  chain in the run log, and the relaunched resume matches the
+  uninterrupted run (the tier-1 stand-in for THE drill);
+* the healing supervisor: healable-rc respawns with
+  MXNET_HEAL_ATTEMPT exported, bounded by --max-relaunch, the
+  heal.relaunch fault point firing per respawn;
+* coordinator migration when rank 0 is the corpse: lowest surviving
+  rank takes over, its checkpoint byte-compatible with a
+  rank-0-written one; ElasticHostIter re-partitions the remaining
+  stream exactly over the survivors;
+* tools/ckpt_fsck.py: clean trees pass, a corrupt payload fails
+  naming the file; tools/chaos.py schedules are seed-reproducible;
+* (slow) THE drill: real 2-process jax.distributed, rank 1 SIGKILLed
+  mid-step, supervisor relaunch at world size 1, resume from the
+  async snapshot (strictly fresher than the sync save), final params
+  allclose(1e-5) vs the uninterrupted reference, heal events +
+  peer_deaths/auto_reshards/ckpt_async_writes counters in the run
+  logs.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.resilience import elastic, faultsim, healing
+from mxnet_tpu.resilience.checkpoint import CheckpointManager
+from mxnet_tpu.telemetry import schema
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faultsim.reset("")
+    healing.disarm()
+    yield
+    faultsim.reset("")
+    healing.disarm()
+
+
+# ====================================================== peer liveness
+def test_detector_stale_beat_and_sticky(tmp_path):
+    hb = str(tmp_path / "hb")
+    healing._write_beat(hb, 0)
+    ghost = healing._write_beat(hb, 1)
+    # foreign host: the pid probe must not resurrect it
+    with open(ghost) as f:
+        payload = json.load(f)
+    payload["host"] = "test-ghost"
+    with open(ghost, "w") as f:
+        f.write(json.dumps(payload))
+    det = healing.FailureDetector(hb, rank=0, num_ranks=2, timeout=0.4)
+    assert det.dead_peers() == []  # fresh: alive
+    old = time.time() - 99.0
+    os.utime(ghost, (old, old))
+    assert det.dead_peers() == [1]
+    assert "stale" in det.reasons()[1]
+    # sticky: a resurrected beat cannot un-declare the death
+    healing._write_beat(hb, 1)
+    assert det.dead_peers() == [1]
+    with pytest.raises(healing.PeerDeadError, match=r"\[1\]"):
+        det.check()
+
+
+def test_detector_dead_pid_is_immediate(tmp_path):
+    """The SIGKILL fast path: a same-host corpse is declared dead on
+    the next poll, without waiting out the staleness timeout.  The
+    detector is armed FIRST (the drill ordering): a beat written
+    while it watches gets the pid probe, not the leftover grace."""
+    hb = str(tmp_path / "hb")
+    healing._write_beat(hb, 0)
+    det = healing.FailureDetector(hb, rank=0, num_ranks=2,
+                                  timeout=60.0)  # timeout irrelevant
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    path = healing._write_beat(hb, 1)
+    with open(path) as f:
+        payload = json.load(f)
+    payload["pid"] = p.pid  # a reaped pid on THIS host
+    with open(path, "w") as f:
+        f.write(json.dumps(payload))
+    t0 = time.monotonic()
+    assert det.dead_peers() == [1]
+    assert time.monotonic() - t0 < 1.0
+    assert "pid" in det.reasons()[1]
+
+
+def test_detector_leftover_beat_gets_grace(tmp_path):
+    """A stale beat file left by a PREVIOUS incarnation (fit never
+    cleans the shared dir) must not be an instant false death for a
+    peer that is merely still starting: it gets the startup grace,
+    and a fresh beat (mtime change) restores normal rules."""
+    hb = str(tmp_path / "hb")
+    leftover = healing._write_beat(hb, 1)
+    old = time.time() - 999.0
+    os.utime(leftover, (old, old))  # ancient leftover
+    time.sleep(0.05)
+    det = healing.FailureDetector(hb, rank=0, num_ranks=2,
+                                  timeout=0.6)
+    assert det.dead_peers() == []  # grace, despite age >> timeout
+    # the peer's new incarnation starts beating: alive for good
+    healing._write_beat(hb, 1)
+    assert det.dead_peers() == []
+    time.sleep(0.7)
+    # ... and once IT goes stale, the normal verdict applies
+    assert det.dead_peers() == [1]
+    assert "stale" in det.reasons()[1]
+
+
+def test_detector_never_beat_grace(tmp_path):
+    hb = str(tmp_path / "hb")
+    os.makedirs(hb)
+    det = healing.FailureDetector(hb, rank=0, num_ranks=2, timeout=0.3)
+    assert det.dead_peers() == []  # inside the startup grace
+    time.sleep(0.35)
+    assert det.dead_peers() == [1]
+    assert "never beat" in det.reasons()[1]
+
+
+def test_heartbeater_keeps_beating_and_faultsim_point(tmp_path):
+    hb_dir = str(tmp_path / "hb")
+    faultsim.reset("peer.heartbeat:delay=0.01@1-2")
+    with healing.Heartbeater(hb_dir, 0, interval=0.05):
+        time.sleep(0.3)
+        payload, age = healing._read_beat(hb_dir, 0)
+        assert payload["rank"] == 0 and payload["pid"] == os.getpid()
+        assert age < 0.25
+        assert faultsim.hits("peer.heartbeat") >= 2
+    # close removes the beat (a clean exit is not a death)
+    assert healing._read_beat(hb_dir, 0) == (None, None)
+
+
+def test_surviving_ranks_and_elect_coordinator(tmp_path):
+    hb = str(tmp_path / "hb")
+    for r in (1, 2, 3):
+        healing._write_beat(hb, r)
+    # rank 0 never beat (the corpse): survivors renumber from the
+    # lowest surviving rank
+    assert healing.surviving_ranks(hb, 4) == [1, 2, 3]
+    coord, remap = healing.elect_coordinator([1, 2, 3])
+    assert coord == 1
+    assert remap == {1: 0, 2: 1, 3: 2}
+    with pytest.raises(mx.MXNetError, match="no survivors"):
+        healing.elect_coordinator([])
+
+
+# ================================================= guarded collectives
+def test_guard_collective_abandons_on_peer_death(tmp_path):
+    hb = str(tmp_path / "hb")
+    os.makedirs(hb)
+    det = healing.FailureDetector(hb, rank=0, num_ranks=2, timeout=0.2)
+    release = threading.Event()
+    t0 = time.monotonic()
+    with pytest.raises(healing.PeerDeadError, match="abandoned"):
+        healing.guard_collective(lambda: release.wait(30), det,
+                                 poll=0.02)
+    assert time.monotonic() - t0 < 5.0  # NOT the 30 s block
+    release.set()
+
+
+def test_guard_collective_translates_backend_error(tmp_path):
+    hb = str(tmp_path / "hb")
+    os.makedirs(hb)
+    dead = healing.FailureDetector(hb, rank=0, num_ranks=2,
+                                   timeout=0.0)
+
+    def boom():
+        raise RuntimeError("Gloo connection reset by peer")
+
+    # a confirmed death: the backend error is translated
+    with pytest.raises(healing.PeerDeadError):
+        healing.guard_collective(boom, dead, poll=0.01)
+
+    # every peer alive: the original error surfaces untranslated
+    hb2 = str(tmp_path / "hb2")
+    healing._write_beat(hb2, 1)
+    alive = healing.FailureDetector(hb2, rank=0, num_ranks=2,
+                                    timeout=60.0)
+    with pytest.raises(RuntimeError, match="Gloo"):
+        healing.guard_collective(boom, alive, poll=0.01)
+    # happy path returns the value
+    assert healing.guard_collective(lambda: 41 + 1, alive) == 42
+
+
+def test_guard_collective_timeout_with_peers_alive(tmp_path):
+    hb = str(tmp_path / "hb")
+    healing._write_beat(hb, 1)
+    det = healing.FailureDetector(hb, rank=0, num_ranks=2,
+                                  timeout=60.0)
+    ev = threading.Event()
+    with pytest.raises(healing.CollectiveTimeout):
+        healing.guard_collective(lambda: ev.wait(30), det, poll=0.02,
+                                 timeout=0.2)
+    ev.set()
+
+
+# ================================================== async checkpoints
+def test_save_async_versions_and_emergency(tmp_path):
+    prefix = str(tmp_path / "ck")
+    mgr = CheckpointManager(prefix)
+    w = mx.nd.array(onp.ones((4, 4), "float32"))
+    v1 = mgr.save_async(arg_params={"w": w}, batch_cursor=1)
+    v2 = mgr.save_async(
+        arg_params={"w": mx.nd.array(onp.full((4, 4), 2.0,
+                                              "float32"))},
+        batch_cursor=2)
+    assert v2 == v1 + 1
+    assert mgr.wait_async(timeout=10)
+    st = mgr.load()
+    assert st["batch_cursor"] == 2
+    onp.testing.assert_array_equal(
+        st["arg_params"]["w"].asnumpy(), 2.0)
+    # freshest already durable: the emergency flush is a no-op
+    assert mgr.flush_emergency("test") is None
+    mgr.close_async()
+
+
+def test_save_async_backpressure_bounded_queue(tmp_path):
+    """A slow disk (ckpt.async delay) back-pressures the PRODUCER
+    through the bounded queue instead of accumulating snapshots."""
+    prefix = str(tmp_path / "ck")
+    mgr = CheckpointManager(prefix)
+    w = {"w": mx.nd.array(onp.ones((4,), "float32"))}
+    faultsim.reset("ckpt.async:delay=0.25@1-10")
+    t0 = time.monotonic()
+    for c in range(4):  # depth 1: submits 2..4 must wait for the disk
+        mgr.save_async(arg_params=w, batch_cursor=c + 1,
+                       queue_depth=1)
+    blocked = time.monotonic() - t0
+    assert blocked > 0.4, blocked  # at least two waits landed on us
+    assert mgr.wait_async(timeout=10)
+    mgr.close_async()
+    assert CheckpointManager(prefix).load()["batch_cursor"] == 4
+
+
+def test_emergency_flush_writes_unwritten_freshest(tmp_path):
+    """A peer death mid-queue: the freshest CAPTURED snapshot is
+    flushed synchronously even though the writer never got to it —
+    and the injected fault spec cannot kill the emergency write."""
+    prefix = str(tmp_path / "ck")
+    mgr = CheckpointManager(prefix)
+    w = {"w": mx.nd.array(onp.full((4,), 7.0, "float32"))}
+    # the writer wedges on a long delay; the capture is queued behind
+    faultsim.reset("ckpt.async:delay=1.5@1")
+    mgr.save_async(arg_params=w, batch_cursor=5, queue_depth=2)
+    path = mgr.flush_emergency("peer_death")
+    assert path is not None and os.path.exists(path)
+    st = CheckpointManager(prefix).load()
+    assert st["batch_cursor"] == 5
+    assert st["extra"]["emergency"] == "peer_death"
+    mgr.close_async()
+
+
+def test_ckpt_async_crash_leaves_previous_good(tmp_path):
+    """THE async atomicity drill: a crash mid-payload inside the
+    background writer must leave latest == previous-good and no torn
+    final file (the stray .tmp is the proof)."""
+    prefix = str(tmp_path / "ck")
+    r = _run_script(f"""
+        import numpy as onp
+        import mxnet_tpu as mx
+        from mxnet_tpu.resilience import faultsim
+        from mxnet_tpu.resilience.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager({prefix!r})
+        w = {{"w": mx.nd.array(onp.ones((64,), "float32"))}}
+        mgr.save(1, arg_params=w, batch_cursor=1)
+        faultsim.reset("ckpt.async:crash@2")
+        mgr.save_async(arg_params=w, batch_cursor=2)
+        assert mgr.wait_async(timeout=10)
+        raise SystemExit("unreachable: the crash must have fired")
+        """)
+    assert r.returncode == faultsim.CRASH_EXIT_CODE, r.stderr[-2000:]
+    mgr = CheckpointManager(prefix)
+    assert mgr.latest_epoch() == 1
+    st = mgr.load()
+    assert st["batch_cursor"] == 1
+    # no torn FINAL file: version 2's params never landed
+    assert not os.path.exists(mgr.params_path(2))
+    from tools import ckpt_fsck
+
+    report = ckpt_fsck.fsck(str(tmp_path), check_all=True)
+    assert report["clean"], report["problems"]
+
+
+# ============================================= fit wiring + stand-in
+def _fit_worker_body(prefix, extra=""):
+    return f"""
+        import json, os
+        import numpy as onp
+        import mxnet_tpu as mx
+        from mxnet_tpu import sym
+
+        mx.random.seed(11); onp.random.seed(11)
+        rng = onp.random.RandomState(7)
+        X = rng.randn(64, 10).astype("float32")
+        y = (X @ rng.randn(10, 4)).argmax(axis=1).astype("float32")
+        it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=False)
+        d = sym.Variable("data")
+        fc1 = sym.FullyConnected(d, num_hidden=16, name="fc1")
+        act = sym.Activation(fc1, act_type="relu", name="relu1")
+        fc2 = sym.FullyConnected(act, num_hidden=4, name="fc2")
+        net = sym.SoftmaxOutput(fc2, sym.Variable("softmax_label"),
+                                name="softmax")
+        mod = mx.mod.Module(net, context=mx.cpu())
+        prefix = {prefix!r}
+        {extra}
+    """
+
+
+def test_fit_snapshot_cadence_and_counters(tmp_path):
+    """MXNET_SNAPSHOT_EVERY=3 with checkpoint=: mid-epoch snapshot
+    versions (batch_cursor > 0) land BETWEEN the epoch-boundary saves,
+    the writer counts ckpt_async_writes, and every version verifies."""
+    prefix = str(tmp_path / "snap")
+    runlog = str(tmp_path / "rl.jsonl")
+    env = dict(os.environ, MXNET_SNAPSHOT_EVERY="3",
+               MXNET_RUNLOG=runlog)
+    r = _run_script(_fit_worker_body(prefix, """
+        mod.fit(it, num_epoch=2, optimizer="adam",
+                optimizer_params=(("learning_rate", 0.05),),
+                initializer=mx.init.Xavier(), checkpoint=prefix)
+        from mxnet_tpu import telemetry
+        telemetry.close()  # flush run_end + final counters
+        """), env=env)
+    assert r.returncode == 0, r.stderr[-3000:]
+    mgr = CheckpointManager(prefix)
+    eps = mgr.epochs()
+    assert len(eps) >= 3  # boundary saves + cadence snapshots
+    cursors = {e: mgr.load(e)["batch_cursor"] for e in eps}
+    assert any(c > 0 for c in cursors.values()), cursors  # mid-epoch
+    assert any(c == 0 for c in cursors.values()), cursors  # boundary
+    for e in eps:
+        assert mgr.verify(e), e
+    with open(runlog) as f:
+        records, problems = schema.validate_lines(f)
+    assert not problems, problems[:5]
+    end = [rec for rec in records if rec["type"] == "run_end"][-1]
+    assert end["counters"]["ckpt_async_writes"] >= 2
+    assert end["counters"]["checkpoints"] >= 3
+
+
+def test_fit_peer_death_heals_and_resume_matches(tmp_path):
+    """The tier-1 stand-in for THE drill: a fit armed with peer
+    healing sees a ghost peer die mid-epoch, heal-exits rc 83 with an
+    emergency checkpoint and the heal chain in its run log; the
+    relaunched fit resumes and matches the uninterrupted reference
+    bit-for-bit."""
+    prefix = str(tmp_path / "heal")
+    runlog = str(tmp_path / "rl0.jsonl")
+    ghost_body = _fit_worker_body(prefix, """
+        import time
+        from mxnet_tpu.resilience import healing
+
+        hb = prefix + ".hb"
+        state = {"armed": False, "stale": False}
+        def cb(param):
+            if not state["armed"]:
+                state["armed"] = True
+                healing.arm(hb, rank=0, num_ranks=2, timeout=0.5)
+                _ghost()
+            elif not state["stale"] and param.nbatch >= 4:
+                state["stale"] = True
+                p = healing._hb_path(hb, 1)
+                os.utime(p, (time.time() - 99, time.time() - 99))
+            elif not state["stale"]:
+                _ghost()
+        def _ghost():
+            p = healing._write_beat(hb, 1)
+            with open(p) as f:
+                payload = json.load(f)
+            payload["host"] = "test-ghost"
+            with open(p, "w") as f:
+                f.write(json.dumps(payload))
+        try:
+            mod.fit(it, num_epoch=2, optimizer="adam",
+                    optimizer_params=(("learning_rate", 0.05),),
+                    initializer=mx.init.Xavier(), checkpoint=prefix,
+                    batch_end_callback=cb)
+        except healing.PeerDeadError:
+            healing.heal_exit("peer_death")
+        raise SystemExit("ghost never declared dead")
+        """)
+    env = dict(os.environ, MXNET_SNAPSHOT_EVERY="2",
+               MXNET_RUNLOG=runlog)
+    r = _run_script(ghost_body, env=env)
+    assert r.returncode == healing.PEER_DEATH_EXIT_CODE, \
+        (r.returncode, r.stderr[-3000:])
+
+    # the heal chain is in the armed run log, schema-valid
+    with open(runlog) as f:
+        records, problems = schema.validate_lines(f)
+    assert not problems, problems[:5]
+    heals = [rec for rec in records if rec["type"] == "heal"]
+    actions = {h["action"] for h in heals}
+    assert "peer_death" in actions, actions
+    assert "heal_exit" in actions, actions
+    end = [rec for rec in records if rec["type"] == "run_end"][-1]
+    assert end["counters"]["peer_deaths"] == 1
+    # a checkpoint with a mid-epoch cursor exists to resume from
+    mgr = CheckpointManager(prefix)
+    st = mgr.load()
+    assert st["batch_cursor"] > 0
+
+    # relaunch: resume to completion (rc 0), then compare against the
+    # uninterrupted reference — bit-exact
+    r2 = _run_script(_fit_worker_body(prefix, """
+        mod.fit(it, num_epoch=2, optimizer="adam",
+                optimizer_params=(("learning_rate", 0.05),),
+                initializer=mx.init.Xavier(), resume_from=prefix)
+        arg_p, _ = mod.get_params()
+        print(json.dumps({k: v.asnumpy().ravel().tolist()
+                          for k, v in sorted(arg_p.items())}))
+        """))
+    assert r2.returncode == 0, r2.stderr[-3000:]
+    healed = json.loads(r2.stdout.strip().splitlines()[-1])
+
+    ref_prefix = str(tmp_path / "none")
+    r3 = _run_script(_fit_worker_body(ref_prefix, """
+        mod.fit(it, num_epoch=2, optimizer="adam",
+                optimizer_params=(("learning_rate", 0.05),),
+                initializer=mx.init.Xavier())
+        arg_p, _ = mod.get_params()
+        print(json.dumps({k: v.asnumpy().ravel().tolist()
+                          for k, v in sorted(arg_p.items())}))
+        """))
+    assert r3.returncode == 0, r3.stderr[-3000:]
+    ref = json.loads(r3.stdout.strip().splitlines()[-1])
+    for k in ref:
+        onp.testing.assert_array_equal(
+            onp.asarray(healed[k]), onp.asarray(ref[k]), err_msg=k)
+
+
+# ========================================================= supervisor
+def test_supervisor_relaunches_healable_rc(tmp_path):
+    """rc 83 (peer death) respawns with MXNET_HEAL_ATTEMPT bumped;
+    success on the relaunch ends the policy; heal.relaunch fires per
+    respawn."""
+    marker = str(tmp_path / "attempts.txt")
+    faultsim.reset("")
+    script = (
+        "import os, sys\n"
+        f"p = {marker!r}\n"
+        "a = os.environ.get('MXNET_HEAL_ATTEMPT', '?')\n"
+        "open(p, 'a').write(a + '\\n')\n"
+        "sys.exit(83 if a == '0' else 0)\n")
+    rc = healing.supervise(
+        [sys.executable, "-c", script], max_relaunch=3)
+    assert rc == 0
+    with open(marker) as f:
+        assert f.read().split() == ["0", "1"]
+    assert faultsim.hits("heal.relaunch") == 1
+
+
+def test_supervisor_bounded_and_final_statuses(tmp_path):
+    # always-dying command: bounded by max_relaunch, last rc returned
+    rc = healing.supervise(
+        [sys.executable, "-c", "import sys; sys.exit(83)"],
+        max_relaunch=2)
+    assert rc == 83
+    assert faultsim.hits("heal.relaunch") == 2
+    # a non-healable rc is final: no respawn
+    faultsim.reset("")
+    rc = healing.supervise(
+        [sys.executable, "-c", "import sys; sys.exit(3)"],
+        max_relaunch=5)
+    assert rc == 3
+    assert faultsim.hits("heal.relaunch") == 0
+
+
+def test_supervisor_cli_entrypoint(tmp_path):
+    marker = str(tmp_path / "cli.txt")
+    script = (
+        "import os, sys\n"
+        f"open({marker!r}, 'a').write("
+        "os.environ.get('MXNET_HEAL_ATTEMPT', '?') + '\\n')\n"
+        "sys.exit(87 if os.environ.get('MXNET_HEAL_ATTEMPT') == '0' "
+        "else 0)\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.resilience.healing",
+         "--relaunch", "--max-relaunch", "1", "--",
+         sys.executable, "-c", script],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    with open(marker) as f:
+        assert f.read().split() == ["0", "1"]
+
+
+# ==================================== coordinator migration (rank 0)
+def test_rank0_death_coordinator_migration_checkpoint_bytes(tmp_path):
+    """The dead host is rank 0: the coordinator role migrates to the
+    lowest surviving rank, and because checkpoints are world-size-
+    agnostic single-array layouts, the file the migrated coordinator
+    writes is BYTE-compatible with a rank-0-written one."""
+    hb = str(tmp_path / "hb")
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    dead = healing._write_beat(hb, 0)
+    with open(dead) as f:
+        payload = json.load(f)
+    payload["pid"] = p.pid
+    with open(dead, "w") as f:
+        f.write(json.dumps(payload))
+    for r in (1, 2, 3):
+        healing._write_beat(hb, r)
+    survivors = healing.surviving_ranks(hb, 4)
+    assert survivors == [1, 2, 3]
+    coord, remap = healing.elect_coordinator(survivors)
+    assert coord == 1 and remap[1] == 0
+
+    # identical gathered state, two writers: byte-identical .params
+    params = {"w": mx.nd.array(onp.arange(24, dtype="float32")
+                               .reshape(6, 4))}
+    topo = elastic.topology_block(world_size=3, sharding="none",
+                                  global_batch=24)
+    m_r0 = CheckpointManager(str(tmp_path / "as_rank0"))
+    m_mig = CheckpointManager(str(tmp_path / "as_migrated"))
+    m_r0.save(1, arg_params=params, batch_cursor=2, topology=topo)
+    m_mig.save(1, arg_params=params, batch_cursor=2, topology=topo)
+    with open(m_r0.params_path(1), "rb") as f:
+        b0 = f.read()
+    with open(m_mig.params_path(1), "rb") as f:
+        b1 = f.read()
+    assert b0 == b1
+
+
+def test_rank0_death_hostiter_resume_union_exact(tmp_path):
+    """reslice_cursor/ElasticHostIter drill with rank 0 dead: the
+    4-host stream re-partitions over the 3 renumbered survivors and
+    the union of their remaining slices is EXACTLY the global stream
+    from the cursor — no sample dropped or double-fed."""
+    GB, total = 24, 6
+
+    def batches():
+        # (x,) tuples: the raw-tuple path of ElasticHostIter (a bare
+        # ndarray would sniff as a DataBatch via its .data memoryview)
+        return [(onp.arange(GB * b, GB * (b + 1)).reshape(GB, 1)
+                 .astype("float32"),) for b in range(total)]
+
+    class _It:
+        def __init__(self):
+            self.bs = batches()
+
+        def __iter__(self):
+            return iter(self.bs)
+
+    cursor = 2  # global batches consumed by the 4-host world
+    old = elastic.topology_block(world_size=4, global_batch=GB)
+    new = elastic.topology_block(world_size=3, global_batch=GB)
+    assert elastic.reshard_verdict(old, new)["reshard"]
+    assert elastic.reslice_cursor(cursor, old, new) == 2
+
+    # survivors {1,2,3} renumber to {0,1,2} of a 3-host world
+    rows = {b: [] for b in range(cursor, total)}
+    for new_rank in range(3):
+        it = elastic.ElasticHostIter(_It(), new_rank, 3)
+        for b, sl in enumerate(it):
+            if b < cursor:
+                continue  # already trained before the death
+            rows[b].append(onp.asarray(sl[0]))
+    for b, parts in rows.items():
+        union = onp.sort(onp.concatenate(parts).ravel())
+        onp.testing.assert_array_equal(
+            union, onp.arange(GB * b, GB * (b + 1), dtype="float32"))
+
+
+# ================================================== fsck + chaos units
+def test_ckpt_fsck_clean_and_corrupt(tmp_path):
+    from tools import ckpt_fsck
+
+    prefix = str(tmp_path / "ck")
+    mgr = CheckpointManager(prefix)
+    w = {"w": mx.nd.array(onp.ones((16,), "float32"))}
+    mgr.save(1, arg_params=w, batch_cursor=0)
+    mgr.save(2, arg_params=w, batch_cursor=3)
+    assert ckpt_fsck.main([str(tmp_path), "--all"]) == 0
+    # tear version 2's payload: --all must fail NAMING the file
+    with open(mgr.params_path(2), "r+b") as f:
+        f.truncate(10)
+    report = ckpt_fsck.fsck(str(tmp_path), check_all=True)
+    assert not report["clean"]
+    assert any("ck-0002" in p for p in report["problems"])
+    assert ckpt_fsck.main([str(tmp_path), "--all"]) == 1
+    # nothing to check is its own (distinct) status
+    assert ckpt_fsck.main([str(tmp_path / "empty")]) == 2
+
+
+def test_chaos_schedule_is_seed_reproducible():
+    from tools import chaos
+
+    a = chaos._schedule(1234, 20, chaos.SCENARIOS)
+    b = chaos._schedule(1234, 20, chaos.SCENARIOS)
+    c = chaos._schedule(99, 20, chaos.SCENARIOS)
+    assert a == b
+    assert a != c
+    assert len(a) == 20
+    # round-robin covers every scenario
+    assert {e["scenario"] for e in a} == set(chaos.SCENARIOS)
+    assert len(set(chaos.SCENARIOS)) >= 5
+
+
+def test_heal_record_schema():
+    """heal records written through the real RunLog wire validate and
+    carry the cumulative healing counters."""
+    import tempfile
+
+    from mxnet_tpu import telemetry
+
+    with tempfile.TemporaryDirectory() as d:
+        tf = os.path.join(d, "metrics.prom")
+        log = telemetry.RunLog(os.path.join(d, "rl.jsonl"),
+                               textfile=tf)
+        log.count("peer_deaths")
+        log.heal("peer_death", peer=1, detail="pid gone")
+        log.heal("resume", old_world=2, new_world=1)
+        log.close()
+        with open(os.path.join(d, "rl.jsonl")) as f:
+            records, problems = schema.validate_lines(f)
+        with open(tf) as f:
+            prom = f.read()
+    assert not problems, problems
+    heals = [r for r in records if r["type"] == "heal"]
+    assert len(heals) == 2
+    assert heals[0]["peer_deaths"] == 1
+    assert heals[0]["peer"] == 1
+    # the healing counters ride the Prometheus textfile rows
+    for row in ("mxnet_tpu_peer_deaths 1",
+                "mxnet_tpu_auto_reshards 0",
+                "mxnet_tpu_ckpt_async_writes 0",
+                "mxnet_tpu_emergency_ckpts 0",
+                "mxnet_tpu_heal_relaunches 0"):
+        assert row in prom, (row, prom)
+
+
+# ====================================================== helpers (sub)
+def _run_script(body, timeout=240, env=None):
+    env = dict(env if env is not None else os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    prelude = textwrap.dedent(f"""\
+        import sys
+        sys.path.insert(0, {_REPO!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        """)
+    return subprocess.run(
+        [sys.executable, "-c", prelude + textwrap.dedent(body)],
+        capture_output=True, text=True, timeout=timeout, env=env)
+
+
+# =====================================================================
+# THE drill (slow tier): real 2-process jax.distributed + SIGKILL
+# =====================================================================
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _worker_env(**extra):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # children own their device topology
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("MXNET_FAULT_SPEC", None)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+@pytest.mark.slow
+def test_sigkill_drill_two_process_supervised_heal(tmp_path):
+    """THE acceptance drill: 2-process jax.distributed, rank 1
+    SIGKILLed mid-step.  The survivor detects the death within
+    MXNET_PEER_TIMEOUT_SEC (pid fast path: seconds), flushes the
+    emergency/async snapshot (strictly fresher than the sync save),
+    heal-exits rc 83; the supervisor relaunches at world size 1 and
+    the resume reshards (auto_reshards) from the snapshot cursor —
+    final params allclose(1e-5) vs the uninterrupted reference."""
+    worker = os.path.join(_REPO, "tests", "healing_worker.py")
+    prefix = str(tmp_path / "mp" / "ck")
+    hb_dir = str(tmp_path / "mp" / "hb")
+    os.makedirs(os.path.dirname(prefix))
+    port = _free_port()
+    die_at = 4
+    timeout_sec = 5.0
+
+    # rank 0 under the healing supervisor (the respawn owner)
+    sup = subprocess.Popen(
+        [sys.executable, "-m", "mxnet_tpu.resilience.healing",
+         "--relaunch", "--max-relaunch", "1", "--",
+         sys.executable, worker, "run", f"127.0.0.1:{port}", "0", "2",
+         prefix, hb_dir],
+        env=_worker_env(MXNET_PEER_TIMEOUT_SEC=timeout_sec),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    # rank 1: the victim, SIGKILLs itself mid-step
+    victim = subprocess.Popen(
+        [sys.executable, worker, "run", f"127.0.0.1:{port}", "1", "2",
+         prefix, hb_dir],
+        env=_worker_env(MXNET_PEER_TIMEOUT_SEC=timeout_sec,
+                        HEAL_DIE_AT_STEP=die_at),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+    v_out, _ = victim.communicate(timeout=300)
+    assert victim.returncode == -signal.SIGKILL, \
+        (victim.returncode, v_out[-2000:])
+    s_out, _ = sup.communicate(timeout=300)
+    sys.stdout.write(s_out[-2500:])
+    assert sup.returncode == 0, (sup.returncode, s_out[-3000:])
+
+    # the healed resume's verdict + cursors
+    payload = json.loads(
+        [ln for ln in s_out.splitlines()
+         if ln.strip().startswith("{")][-1])
+    assert payload["verdict"] == {"reshard": True, "old_world": 2,
+                                  "new_world": 1}
+    assert payload["survivors"] == [0]
+    assert payload["coordinator"] == 0
+    # resume is from the ASYNC snapshot: strictly fresher than the
+    # synchronous epoch-cadence save.  The survivor's last completed
+    # step is die_at or die_at-1 (the corpse can race one step ahead
+    # of the survivor's readback before dying)
+    assert payload["resumed_cursor"] > payload["sync_cursor"]
+    assert die_at - 1 <= payload["resumed_cursor"] <= die_at
+
+    # detection well inside the timeout (the pid fast path)
+    m = [ln for ln in s_out.splitlines()
+         if "peer death detected in" in ln]
+    assert m, s_out[-2000:]
+    detect_s = float(m[0].split("detected in ")[1].split("s")[0])
+    assert detect_s < timeout_sec, detect_s
+
+    # heal events + counters from the ARMED run logs
+    with open(f"{prefix}.runlog.r0.a0.jsonl") as f:
+        rec0, problems0 = schema.validate_lines(f)
+    assert not problems0, problems0[:5]
+    actions0 = {r["action"] for r in rec0 if r["type"] == "heal"}
+    assert {"peer_death", "survivor_detected",
+            "heal_exit"} <= actions0, actions0
+    end0 = [r for r in rec0 if r["type"] == "run_end"][-1]
+    assert end0["counters"]["peer_deaths"] == 1
+    assert end0["counters"]["ckpt_async_writes"] >= 1
+    with open(f"{prefix}.runlog.r0.a1.jsonl") as f:
+        rec1, problems1 = schema.validate_lines(f)
+    assert not problems1, problems1[:5]
+    actions1 = {r["action"] for r in rec1 if r["type"] == "heal"}
+    assert "resume" in actions1, actions1
+    end1 = [r for r in rec1 if r["type"] == "run_end"][-1]
+    assert end1["counters"]["auto_reshards"] == 1
+
+    # no torn artifacts anywhere in the drill tree
+    from tools import ckpt_fsck
+
+    report = ckpt_fsck.fsck(os.path.dirname(prefix), check_all=True)
+    assert report["clean"], report["problems"]
+
+    # final params match the uninterrupted reference
+    r = subprocess.run(
+        [sys.executable, worker, "reference"], env=_worker_env(),
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-3000:]
+    ref = json.loads(r.stdout.strip().splitlines()[-1])
+    for k in ref["final"]:
+        onp.testing.assert_allclose(
+            onp.asarray(payload["final"][k]),
+            onp.asarray(ref["final"][k]), rtol=1e-5, atol=1e-7,
+            err_msg=k)
+
+
+@pytest.mark.slow
+def test_chaos_campaign_smoke(tmp_path):
+    """A short seeded campaign through the real runner: one run of
+    each scenario class, zero failures, summary JSON well-formed."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "chaos.py"),
+         "--seed", "7", "--runs", "5", "--epochs", "2",
+         "--scenarios",
+         "sigkill,sigterm_drain,peer_death,ckpt_async_crash,"
+         "collective_delay",
+         "--out", str(tmp_path / "campaign")],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ,
+                 PYTHONPATH=_REPO + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")))
+    assert r.returncode == 0, (r.stdout[-4000:], r.stderr[-2000:])
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["ok"] is True
+    assert summary["failures"] == 0
+    assert summary["faults_injected"] >= 5
+    assert len(summary["scenarios"]) >= 5

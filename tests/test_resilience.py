@@ -243,6 +243,65 @@ def test_checkpoint_retention_verify_and_fallback(tmp_path):
         mgr.load(3)
 
 
+def test_retention_never_gc_newest_good_under_torn_juniors(tmp_path):
+    """Round-16 regression: count-based keep_n pruning deleted the
+    newest VERIFIED-GOOD version while keeping its torn juniors.  The
+    verify-aware retention keeps the newest keep_n GOOD versions
+    (torn ones do not count against the window) and prunes only
+    versions older than the oldest kept good one — so after a crash
+    plus foreign tears, the recovery chain survives.
+
+    The interrupted state comes from a real injected
+    ``ckpt.write:crash`` (subprocess: mid-payload death leaves the
+    version unlisted and the earlier ones intact), the torn listed
+    versions from a foreign truncation."""
+    prefix = str(tmp_path / "ck")
+    # versions 1, 2 good; an armed crash kills the save of version 3
+    # MID-payload: no manifest lands, versions 1-2 stay the truth
+    r = _run_script(f"""
+        import numpy as onp
+        import mxnet_tpu as mx
+        from mxnet_tpu.resilience import faultsim
+        from mxnet_tpu.resilience.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager({prefix!r})
+        for e in (1, 2):
+            mgr.save(e, arg_params={{"w": mx.nd.full((8,), float(e))}})
+        faultsim.reset("ckpt.write:crash@1")
+        mgr.save(3, arg_params={{"w": mx.nd.full((8,), 3.0)}})
+        raise SystemExit("unreachable")
+        """)
+    assert r.returncode == faultsim.CRASH_EXIT_CODE, r.stderr[-2000:]
+    mgr = CheckpointManager(prefix)
+    assert mgr.epochs() == [1, 2]
+    # the relaunch writes 3 and 4 — then both are torn by a foreign
+    # writer (bit rot / non-atomic tool), so the newest GOOD is 2
+    mgr.save(3, arg_params={"w": mx.nd.full((8,), 3.0)})
+    mgr.save(4, arg_params={"w": mx.nd.full((8,), 4.0)})
+    for e in (3, 4):
+        with open(mgr.params_path(e), "r+b") as f:
+            f.truncate(10)
+    # the next periodic save (a fresh manager: no in-process
+    # good-cache) triggers keep_n=2 retention.  The count-based prune
+    # deleted eps[:-2] = [1, 2, 3] — including version 2, the ONLY
+    # good fallback — keeping a torn junior instead.  Verify-aware
+    # retention keeps the newest 2 GOOD versions {2, 5}:
+    mgr2 = CheckpointManager(prefix, keep_n=2)
+    mgr2.save(5, arg_params={"w": mx.nd.full((8,), 5.0)})
+    eps = mgr2.epochs()
+    assert 2 in eps, eps            # the newest good version SURVIVES
+    assert 1 not in eps, eps        # older-than-kept-good still prunes
+    assert 5 in eps, eps
+    # ... and version 2 really is the recovery point once the newest
+    # write rots too: the fallback chain the old prune destroyed
+    with open(mgr2.params_path(5), "r+b") as f:
+        f.truncate(10)
+    fresh = CheckpointManager(prefix)
+    assert fresh.latest_epoch() == 2
+    onp.testing.assert_array_equal(
+        fresh.load()["arg_params"]["w"].asnumpy(), onp.full((8,), 2.0))
+
+
 def test_rng_capture_restore_roundtrip():
     mx.random.seed(13)
     snap = capture_rng()

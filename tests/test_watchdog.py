@@ -48,6 +48,75 @@ def _wait_for(pred, timeout=10.0):
     return False
 
 
+# ------------------------------------------------- abort escalation
+def test_watchdog_abort_escalation_subprocess(tmp_path):
+    """MXNET_WATCHDOG_ABORT (round 16, default OFF): once the
+    max_dumps stall dumps are exhausted and the heartbeat is STILL
+    dead a full timeout later, the watchdog flushes the flight ring +
+    the emergency checkpoint (freshest snapshot) and os._exits with
+    the distinct rc 85 — a permanently wedged job is rescheduled, not
+    left burning its wall budget."""
+    import subprocess
+    import sys
+    import textwrap
+
+    from mxnet_tpu.telemetry.watchdog import WATCHDOG_ABORT_EXIT_CODE
+
+    runlog = str(tmp_path / "rl.jsonl")
+    prefix = str(tmp_path / "ck")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MXNET_RUNLOG=runlog,
+               MXNET_WATCHDOG_ABORT="1")
+    body = textwrap.dedent(f"""
+        import sys, time
+        sys.path.insert(0, {repo!r})
+        import numpy as onp
+        import mxnet_tpu as mx
+        from mxnet_tpu.resilience.checkpoint import CheckpointManager
+        from mxnet_tpu.telemetry.watchdog import Watchdog
+
+        # the freshest snapshot the abort must flush: captured but
+        # never written (the writer is about to be "wedged")
+        mgr = CheckpointManager({prefix!r})
+        mgr._freshest = mgr._capture(
+            7, arg_params={{"w": mx.nd.full((4,), 9.0)}},
+            batch_cursor=5)
+        from mxnet_tpu.resilience import healing
+        healing.register_emergency(mgr._emergency_hook)
+
+        wd = Watchdog(timeout=0.2, max_dumps=1, poll=0.05).arm("wedge")
+        time.sleep(30)  # the permanent wedge: never beats again
+        """)
+    r = subprocess.run([sys.executable, "-c", body], env=env,
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == WATCHDOG_ABORT_EXIT_CODE, \
+        (r.returncode, r.stderr[-2000:])
+    # the emergency checkpoint landed from the watchdog thread
+    from mxnet_tpu.resilience.checkpoint import CheckpointManager
+
+    st = CheckpointManager(prefix).load()
+    assert st["batch_cursor"] == 5
+    assert st["extra"]["emergency"] == "watchdog_abort"
+    # flight dump + heal record + run_end all flushed before the exit
+    assert os.path.exists(runlog + ".flight.json")
+    with open(runlog) as f:
+        records, problems = schema.validate_lines(f)
+    assert not problems, problems[:5]
+    heals = [rec for rec in records if rec["type"] == "heal"]
+    assert any(h["action"] == "watchdog_abort" for h in heals)
+    assert any(rec["type"] == "run_end" for rec in records)
+    # observe-only default unchanged: same wedge, abort OFF, the
+    # process survives past the dump budget (killed by us, not by
+    # the watchdog)
+    env2 = dict(env, MXNET_WATCHDOG_ABORT="0")
+    body2 = body.replace("time.sleep(30)", "time.sleep(1.2)\n"
+                         "print('survived', wd.stalls)")
+    r2 = subprocess.run([sys.executable, "-c", body2], env=env2,
+                        capture_output=True, text=True, timeout=60)
+    assert r2.returncode == 0, (r2.returncode, r2.stderr[-2000:])
+    assert "survived" in r2.stdout
+
+
 # ------------------------------------------------------------ unit level
 def test_quiet_heartbeat_fires_stack_dump(tmp_path):
     sp = str(tmp_path / "stacks.txt")
