@@ -627,6 +627,100 @@ def _measure_telemetry(step_fn, params, opt_state, x, y, key, smoke,
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def _measure_data_plane(smoke, deadline):
+    """The ``data_plane`` phase (round 17): the multi-worker record
+    pipeline fed a shard with SEEDED corruption — one torn frame, one
+    unpackable header, one undecodable payload.  Reported: feed
+    throughput with ``MXNET_IO_WORKERS=4`` vs the single-producer
+    baseline, per-batch p50/p99 latency, consumer feed-wait, and the
+    quarantine evidence (skip count == seeded corruption, manifest
+    entries) — the epoch must COMPLETE, structurally degraded, never
+    dead."""
+    import shutil
+    import tempfile
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.telemetry.opstats import percentile
+    from mxnet_tpu.test_utils import corrupt_rec, write_rec_corpus
+
+    tmpdir = tempfile.mkdtemp(prefix="bench_dataplane_")
+    try:
+        n = 64 if smoke else 256
+        size = 24
+        rec = os.path.join(tmpdir, "bench.rec")
+        offsets = write_rec_corpus(rec, n=n, size=size, seed=7)
+        # seeded corruption, 3 records via the shared recipe: torn
+        # frame / unpackable header / undecodable payload
+        corrupt_rec(rec, offsets, torn=[n // 4], unpack=[n // 2],
+                    decode=[3 * n // 4])
+
+        def run_epochs(workers, epochs=2):
+            it = mx.io.ImageRecordIter(
+                path_imgrec=rec, data_shape=(3, size, size),
+                batch_size=16, std_r=255.0, std_g=255.0, std_b=255.0,
+                io_workers=workers, device_feed=False,
+                quarantine_manifest=os.path.join(
+                    tmpdir, f"q{workers}.json"))
+            lat_ms = []
+            samples = 0
+            t0 = time.perf_counter()
+            try:
+                for ep in range(epochs):
+                    while True:
+                        tb = time.perf_counter()
+                        try:
+                            batch = it.next()
+                        except StopIteration:
+                            break
+                        lat_ms.append(
+                            (time.perf_counter() - tb) * 1e3)
+                        samples += batch.data[0].shape[0] \
+                            - (batch.pad or 0)
+                    _heartbeat("data_plane", workers=workers, epoch=ep)
+                    if ep + 1 < epochs:
+                        it.reset()
+                wall = time.perf_counter() - t0
+                return {"samples": samples, "wall_s": wall,
+                        "lat_ms": lat_ms,
+                        "stats": it.data_plane_stats()}
+            finally:
+                it.close()
+
+        multi = run_epochs(4)
+        if deadline.exceeded():
+            single = None
+            deadline.note("data_plane_single_arm")
+        else:
+            single = run_epochs(0)
+        stats = multi["stats"]
+        import json as _json
+
+        with open(stats["manifest"]) as f:
+            manifest = _json.load(f)
+        report = {
+            "records": n, "corrupt": 3, "workers": 4,
+            "skipped": stats["skipped"],
+            "respawns": stats["respawns"],
+            "manifest_entries": len(manifest["entries"]),
+            "throughput_img_s": round(
+                multi["samples"] / max(multi["wall_s"], 1e-9), 2),
+            "p50_batch_ms": round(
+                percentile(sorted(multi["lat_ms"]), 0.5), 4),
+            "p99_batch_ms": round(
+                percentile(sorted(multi["lat_ms"]), 0.99), 4),
+            "feed_wait_s": round(sum(multi["lat_ms"]) / 1e3, 4),
+        }
+        if single is not None:
+            report["single_thread_img_s"] = round(
+                single["samples"] / max(single["wall_s"], 1e-9), 2)
+        else:  # skipped on deadline: say so, never a silent absence
+            report["single_thread_img_s"] = None
+            report["note"] = "single-thread arm skipped (deadline)"
+        return report
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def _measure_healing(smoke, deadline):
     """The ``healing`` phase (round 16): the self-healing runtime's
     two headline numbers, measured for real.
@@ -1650,6 +1744,26 @@ def main(argv=None):
             out["degraded"] = True
             reasons.append(f"healing phase failed: {exc!r}")
     _write_partial(out, "healing")
+
+    # data-plane phase (round 17): the multi-worker record pipeline
+    # under seeded corruption — throughput, skip counts, feed-wait and
+    # p99 batch latency land in the headline JSON; the epoch must
+    # complete with the corruption QUARANTINED, never dead
+    if deadline.exceeded(margin=0.0 if args.smoke else 60.0):
+        out["data_plane"] = "skipped (deadline)"
+        out["degraded"] = True
+        reasons.append("deadline: skipped data-plane phase")
+        deadline.note("data_plane")
+    else:
+        _heartbeat("data_plane")
+        try:
+            out["data_plane"] = _measure_data_plane(args.smoke,
+                                                    deadline)
+        except Exception as exc:  # auxiliary metric: never kill the run
+            out["data_plane"] = {"error": repr(exc)}
+            out["degraded"] = True
+            reasons.append(f"data-plane phase failed: {exc!r}")
+    _write_partial(out, "data_plane")
 
     # INFERENCE serving phase (round 13): the continuous-batching
     # model server under bursty synthetic load — admitted p50/p99,

@@ -194,17 +194,35 @@ healing_smoke() {
     JAX_PLATFORMS=cpu python -m pytest tests/test_healing.py -q
 }
 
+io_smoke() {
+    # fault-tolerant data plane gate (round 17) on CPU in seconds:
+    # MXRecordIO resync-on-magic (torn frames / truncated tails /
+    # decoy magic in payloads — every intact record recovered, every
+    # gap named by byte offset), corrupt-record quarantine through
+    # the MXNET_IO_WORKERS pool (skip + counter + manifest, the
+    # MXNET_IO_MAX_SKIP_FRAC ceiling fails loudly), worker crash /
+    # straggler detection with bounded respawn, THE corruption drill
+    # (corrupt shard + 4 workers + io.worker:crash mid-epoch: epoch
+    # completes with data_records_skipped == k, SIGTERM-drain + resume
+    # at a different worker count sample-exact, ElasticHostIter
+    # re-slice union-exact) and the worker-kill subprocess half.
+    # Also collected by tier-1 (tests/test_dataplane.py), so a
+    # regression turns the unit suite red between CI runs.
+    JAX_PLATFORMS=cpu python -m pytest tests/test_dataplane.py -q
+}
+
 chaos_smoke() {
-    # the seeded chaos campaign (round 16): >=20 reproducible faults
-    # across all 7 scenario classes (SIGKILL at a seeded delay
+    # the seeded chaos campaign (rounds 16-17): >=25 reproducible
+    # faults across all 9 scenario classes (SIGKILL at a seeded
+    # delay, mid-epoch record corruption and the io-worker kill
     # included) on the CPU mesh, each run supervised by the healing
     # respawn policy and gated on the three invariants — zero hangs,
     # zero torn artifacts (tools/ckpt_fsck.py --all clean after every
     # run), every healed run matching its uninterrupted reference
     # allclose(1e-5).  The fixed --seed makes a CI failure exactly
     # reproducible on a laptop.
-    JAX_PLATFORMS=cpu python tools/chaos.py --seed 1234 --runs 21 \
-        --min-faults 20 --out /tmp/chaos_ci
+    JAX_PLATFORMS=cpu python tools/chaos.py --seed 1234 --runs 27 \
+        --min-faults 25 --out /tmp/chaos_ci
 }
 
 elastic_smoke() {

@@ -97,9 +97,14 @@ def _ptr(a, typ):
     return a.ctypes.data_as(ctypes.POINTER(typ))
 
 
-def parse_records(buf):
+def parse_records(buf, return_offsets=False):
     """Split a raw .rec byte buffer into payload memoryviews using the
-    native parser (dmlc framing incl. continuation flags)."""
+    native parser (dmlc framing incl. continuation flags).
+
+    ``return_offsets=True`` also returns each LOGICAL record's
+    frame-start byte offset (``(records, offsets)``) — the parser
+    already computes them, and the data plane's quarantine manifest
+    promises seekable offsets."""
     lib = get_lib()
     arr = onp.frombuffer(buf, dtype=onp.uint8)
     max_records = max(len(arr) // 8, 1)
@@ -116,10 +121,12 @@ def parse_records(buf):
         raise IOError(
             "truncated recordio buffer: last record extends past EOF")
     records = []
+    rec_offsets = []  # frame start of each logical record
     i = 0
     mv = memoryview(buf)
     magic = onp.uint32(0xCED7230A).tobytes()
     while i < n:
+        rec_offsets.append(int(offsets[i]) - 8)  # payload - header
         if lflags[i] == 0:  # whole record in one part
             records.append(mv[offsets[i]:offsets[i] + sizes[i]])
             i += 1
@@ -136,6 +143,8 @@ def parse_records(buf):
                 if end:
                     break
             records.append(memoryview(magic.join(parts)))
+    if return_offsets:
+        return records, rec_offsets
     return records
 
 

@@ -77,6 +77,27 @@ register_env("MXNET_CPU_WORKER_NTHREADS", 0, int,
 register_env("MXNET_TPU_PREFETCH_BUFFER", 4, int,
              "Batches kept ready ahead of the training loop "
              "(ImageRecordIter prefetch_buffer default).")
+register_env("MXNET_IO_WORKERS", 0, int,
+             "Decode/augment worker pool size behind ImageRecordIter/"
+             "ImageDetRecordIter (round 17).  0 (default) preserves "
+             "the single-producer-thread behavior; N>0 runs N workers "
+             "behind a sequence-ordered emitter — batch assembly is "
+             "by index plan, so worker count, respawns and stragglers "
+             "never perturb which sample lands in which batch row.")
+register_env("MXNET_IO_WORKER_RESPAWN", 2, int,
+             "Respawn budget of the io worker pool: a worker that "
+             "dies holding a batch or wedges past the per-batch "
+             "deadline is replaced (its batch re-dispatched) at most "
+             "this many times per iterator; exhausting the budget "
+             "fails LOUDLY with the quarantine manifest attached.")
+register_env("MXNET_IO_MAX_SKIP_FRAC", 0.1, float,
+             "Quarantine ceiling: the fraction of a .rec shard's "
+             "records that may be skipped (framing resyncs + "
+             "unpack/decode quarantines) before the data plane "
+             "refuses to continue — corrupt records degrade "
+             "structurally (skip + counter + manifest) up to this "
+             "bound, but the pipeline never silently trains on a "
+             "substantially shrunken dataset.")
 register_env("MXNET_PROFILER_AUTOSTART", False, bool,
              "Start the profiler at import (reference knob; wired to "
              "mx.profiler.set_state('run')).")

@@ -34,13 +34,24 @@ def _pad_size(n):
 
 class MXRecordIO:
     """Sequential .rec reader/writer (reference MXRecordIO; C++ framing
-    dmlc-core src/recordio.cc)."""
+    dmlc-core src/recordio.cc).
 
-    def __init__(self, uri, flag):
+    ``resync=True`` (readers only) arms resync-on-magic: a torn or
+    garbled frame no longer raises mid-stream — the reader scans
+    forward to the next plausible magic boundary and returns the next
+    whole record, reporting each gap via ``on_skip(offset,
+    bytes_skipped, reason)``.  The dmlc continuation framing exists
+    precisely so this is possible (see :meth:`write`).  Strict mode
+    (the default — what write-side verification wants) raises exactly
+    as before."""
+
+    def __init__(self, uri, flag, resync=False, on_skip=None):
         self.uri = uri
         self.flag = flag
         self.fp = None
         self.is_open = False
+        self._resync = bool(resync)
+        self.on_skip = on_skip
         self.open()
 
     def open(self):
@@ -63,11 +74,14 @@ class MXRecordIO:
         d = dict(self.__dict__)
         d["is_open"] = is_open
         d.pop("fp", None)
+        d.pop("on_skip", None)  # callbacks don't pickle portably
         return d
 
     def __setstate__(self, d):
         self.__dict__.update(d)
         self.fp = None
+        self.on_skip = None
+        self._resync = d.get("_resync", False)
         is_open = d.get("is_open", False)
         self.is_open = False
         if is_open:
@@ -136,14 +150,19 @@ class MXRecordIO:
             self.fp.read(pad)
         return cflag, buf
 
-    def read(self):
-        """Read one logical record, reassembling continuation parts."""
-        assert not self.writable
+    def _read_logical(self, check_first=False):
         cflag, buf = self._read_part()
         if buf is None:
             return None
         if cflag == 0:
             return buf
+        if check_first and cflag not in (0, 1):
+            # a resync scan can land on a continuation MIDDLE/END part
+            # of a chain whose begin frame was lost; reassembling from
+            # here would return a silently-truncated record
+            raise MXNetError(
+                f"record starts with continuation cflag {cflag} "
+                "(orphaned multi-part tail)")
         parts = [buf]
         while cflag != 3:
             cflag, nxt = self._read_part()
@@ -152,6 +171,119 @@ class MXRecordIO:
                     "truncated multi-part record at end of file")
             parts.append(nxt)
         return struct.pack("<I", _kMagic).join(parts)
+
+    def read(self):
+        """Read one logical record, reassembling continuation parts.
+
+        Strict mode (default): any framing damage — bad magic,
+        truncated payload, broken continuation chain — raises
+        :class:`MXNetError` exactly where it is found.
+
+        Resync mode (``resync=True``): the damage is skipped — scan
+        forward to the next plausible frame boundary (magic at a
+        4-byte-aligned offset whose header describes a frame that fits
+        the file and chains onto another magic or EOF) and return the
+        next WHOLE record.  Every gap is reported through
+        ``on_skip(offset, bytes_skipped, reason)`` and counted on the
+        ``io_resyncs`` telemetry counter; reaching EOF mid-scan
+        returns None like a clean end of stream.
+        """
+        assert not self.writable
+        from .resilience import faultsim
+
+        if not self._resync:
+            faultsim.inject("io.read")  # an armed raise = a torn frame
+            return self._read_logical()
+        gap = None  # (start offset, first reason) of the current gap
+        while True:
+            start = self.fp.tell()
+            try:
+                faultsim.inject("io.read")
+                rec = self._read_logical(check_first=True)
+            except (MXNetError, faultsim.FaultInjected) as exc:
+                # consecutive failures merge into ONE reported gap —
+                # a torn multi-part chain or a long corrupt extent is
+                # one region lost, not one skip event per bad frame
+                if gap is None:
+                    gap = (start, str(exc))
+                if self._resync_scan(start + 4) is None:
+                    self._report_skip(gap[0],
+                                      self._file_size() - gap[0],
+                                      gap[1])
+                    return None
+                continue
+            if gap is not None:
+                self._report_skip(gap[0], start - gap[0], gap[1])
+            return rec
+
+    def _file_size(self):
+        return os.fstat(self.fp.fileno()).st_size
+
+    def _report_skip(self, offset, nbytes, reason):
+        try:
+            from . import telemetry
+
+            telemetry.count("io_resyncs")
+            telemetry.event("io_resync", file=self.uri,
+                            offset=int(offset),
+                            bytes_skipped=int(nbytes), reason=reason)
+        except Exception:
+            pass  # telemetry must never break the read path
+        if self.on_skip is not None:
+            self.on_skip(int(offset), int(nbytes), reason)
+
+    def _plausible_frame(self, pos, size):
+        """Whether a frame starting at ``pos`` could be real: magic,
+        sane cflag, a length that fits the file, and the frame's end
+        landing on EOF or another magic (payloads can contain stray
+        magic-looking bytes — chaining to the NEXT boundary rejects
+        them)."""
+        here = self.fp.tell()
+        try:
+            self.fp.seek(pos)
+            head = self.fp.read(8)
+            if len(head) < 8:
+                return False
+            magic, lrec = struct.unpack("<II", head)
+            if magic != _kMagic:
+                return False
+            length = lrec & 0x1FFFFFFF
+            end = pos + 8 + length + _pad_size(length)
+            if end > size:
+                return False
+            if end == size:
+                return True
+            self.fp.seek(end)
+            nxt = self.fp.read(4)
+            return len(nxt) == 4 and \
+                struct.unpack("<I", nxt)[0] == _kMagic
+        finally:
+            self.fp.seek(here)
+
+    def _resync_scan(self, from_pos):
+        """Scan forward from ``from_pos`` for the next plausible frame
+        boundary (frames are 4-byte aligned by the writer's padding);
+        position the fp there and return the offset, or None (fp at
+        EOF) when no further record exists."""
+        size = self._file_size()
+        magic_bytes = struct.pack("<I", _kMagic)
+        pos = max(0, int(from_pos))
+        pos += (-pos) % 4  # align up
+        chunk = 1 << 16
+        while pos < size:
+            self.fp.seek(pos)
+            buf = self.fp.read(chunk + 8)
+            i = buf.find(magic_bytes)
+            while i != -1:
+                cand = pos + i
+                if cand % 4 == 0 and cand + 8 <= size \
+                        and self._plausible_frame(cand, size):
+                    self.fp.seek(cand)
+                    return cand
+                i = buf.find(magic_bytes, i + 1)
+            pos += chunk
+        self.fp.seek(size)
+        return None
 
     def tell(self):
         return self.fp.tell()

@@ -63,6 +63,21 @@ Points wired in-tree:
 ``heal.relaunch``  resilience/healing.py supervisor, before every
                 respawn of the training command (``raise`` aborts the
                 respawn policy, ``delay`` = slow scheduler)
+``io.read``     recordio.py MXRecordIO.read, per record read — a
+                ``raise`` is a torn frame mid-stream: strict readers
+                propagate it, resync readers skip to the next magic
+                boundary and report the gap
+``io.decode``   io/image_record_iter.py, per record unpack+decode — a
+                ``raise`` is one undecodable record the pipeline must
+                QUARANTINE (skip + manifest + counter), never an
+                epoch kill
+``io.worker``   io/image_record_iter.py worker pool, per claimed
+                batch, consumed via :func:`probe` — ``crash`` kills
+                the WORKER THREAD holding the batch (the pool's
+                SIGKILL analog: surviving a worker death is the whole
+                point, so the process must not die), ``raise`` is a
+                logged worker abort, ``delay`` a straggler/wedge the
+                per-batch deadline re-dispatches around
 ==============  =======================================================
 
 Spec grammar (env ``MXNET_FAULT_SPEC`` or ``faultsim.reset(spec)``)::
@@ -94,8 +109,9 @@ import time
 
 from ..base import MXNetError
 
-__all__ = ["FaultInjected", "inject", "reset", "hits", "armed",
-           "on_crash", "register_point", "points", "CRASH_EXIT_CODE"]
+__all__ = ["FaultInjected", "inject", "probe", "reset", "hits",
+           "armed", "on_crash", "register_point", "points",
+           "CRASH_EXIT_CODE"]
 
 #: exit status of an armed ``crash`` action — distinguishable from a
 #: real signal kill in subprocess tests
@@ -121,6 +137,13 @@ _POINTS = {
                   "atomic write of a save_async version",
     "heal.relaunch": "healing supervisor, before every respawn of the "
                      "training command",
+    "io.read": "MXRecordIO.read, per record — raise = a torn frame "
+               "(resync readers skip to the next magic boundary)",
+    "io.decode": "record iterator, per record unpack+decode — raise = "
+                 "one undecodable record (quarantined, never fatal)",
+    "io.worker": "data-plane worker pool, per claimed batch (probe "
+                 "semantics: crash kills the worker THREAD, not the "
+                 "process)",
 }
 
 
@@ -280,13 +303,12 @@ def armed(point):
         return point in _STATE["rules"]
 
 
-def inject(point):
-    """Count a hit at ``point`` and fire the armed action, if any.
-
-    Returns ``"nan"`` when the caller must poison its value, else
-    ``None``.  Thread-safe: producer threads and PS serve threads share
-    one counter per point, so hit numbering is global per process.
-    """
+def _fire(point):
+    """Count a hit at ``point``, match the armed rule and emit the
+    fault telemetry — the ONE core both :func:`probe` and
+    :func:`inject` build on (the two entry points must not drift).
+    Returns ``(rule, hit_number)``; rule is None when nothing armed
+    matches."""
     with _LOCK:
         _ensure_locked()
         n = _STATE["hits"].get(point, 0) + 1
@@ -296,23 +318,58 @@ def inject(point):
             if r.matches(n):
                 rule = r
                 break
+    if rule is not None:
+        try:
+            # armed hits are rare: telemetry cost only ever lands on
+            # the fault path, never on the per-call fast path above
+            from .. import telemetry
+
+            telemetry.count("faults")
+            telemetry.event("fault", point=point, action=rule.action,
+                            hit=n)
+        except Exception:
+            pass  # the harness must fire even if telemetry is broken
+    return rule, n
+
+
+def probe(point):
+    """Count a hit at ``point`` and return the armed action NAME
+    ('crash' / 'raise' / 'delay' / 'nan', or None) without executing
+    ``crash``/``raise``/``nan`` — for points whose CALLER owns the
+    blast radius.  The data-plane worker pool is the motivating case:
+    an ``io.worker:crash`` must kill the worker THREAD that hit it
+    (the pool's SIGKILL analog — surviving a worker death is the
+    feature under test), where :func:`inject`'s crash would
+    ``os._exit`` the whole training process.  ``delay`` is slept here
+    so straggler semantics stay uniform with inject(); telemetry
+    counts the fault the same way."""
+    rule, _ = _fire(point)
     if rule is None:
         return None
-    try:
-        # armed hits are rare: telemetry cost only ever lands on the
-        # fault path, never on the per-call fast path above
-        from .. import telemetry
+    if rule.action == "delay":
+        time.sleep(rule.value or 0.0)
+    return rule.action
 
-        telemetry.count("faults")
-        telemetry.event("fault", point=point, action=rule.action,
-                        hit=n)
-        if rule.action == "crash":
+
+def inject(point):
+    """Count a hit at ``point`` and fire the armed action, if any.
+
+    Returns ``"nan"`` when the caller must poison its value, else
+    ``None``.  Thread-safe: producer threads and PS serve threads share
+    one counter per point, so hit numbering is global per process.
+    """
+    rule, n = _fire(point)
+    if rule is None:
+        return None
+    if rule.action == "crash":
+        try:
             # os._exit skips atexit: the flight recorder is the ONLY
             # record the simulated power loss leaves behind
+            from .. import telemetry
+
             telemetry.flight_dump(f"fault_crash:{point}")
-    except Exception:
-        pass  # the harness must fire even if telemetry is broken
-    if rule.action == "crash":
+        except Exception:
+            pass
         # last-gasp flushers (bench partial JSON, ...): os._exit gives
         # no other thread a chance to finish a pending write, so
         # whatever must be parseable after the "power loss" flushes

@@ -26,6 +26,7 @@ from .runlog import (  # noqa: F401
     compile_fingerprint,
     count,
     current,
+    data_plane,
     describe_program,
     event,
     flight_dump,
@@ -41,7 +42,7 @@ from .watchdog import Watchdog, stack_path_for  # noqa: F401
 __all__ = [
     "RunLog", "current", "reset", "close", "compile_event",
     "compile_fingerprint", "event", "count", "gauge", "heal",
-    "checkpoint_event", "program_report", "flight_dump",
+    "data_plane", "checkpoint_event", "program_report", "flight_dump",
     "flight_path_for", "describe_program", "FitSession",
     "fit_session", "schema", "Watchdog", "stack_path_for",
     "numerics", "opstats",

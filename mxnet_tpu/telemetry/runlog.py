@@ -139,7 +139,9 @@ class RunLog:
                          "fleet_swaps": 0, "peer_deaths": 0,
                          "auto_reshards": 0, "ckpt_async_writes": 0,
                          "ckpt_async_errors": 0,
-                         "emergency_ckpts": 0, "heal_relaunches": 0}
+                         "emergency_ckpts": 0, "heal_relaunches": 0,
+                         "data_records_skipped": 0,
+                         "io_worker_respawns": 0, "io_resyncs": 0}
         self._gauges = {}       # name -> last value (textfile rows)
         self._fps = {}          # program -> last compile fingerprint
         self._programs = {}     # program -> last program_report body
@@ -501,6 +503,26 @@ class RunLog:
                 f"heal:{action}", "telemetry",
                 args=_jsonable(fields), tid=_TRACE_TID)
 
+    def data_plane(self, action, *, workers=0, **fields):
+        """One data-plane observation (io.ImageRecordIter and friends):
+        a quarantined record, a worker-pool respawn or an epoch
+        summary — stamped with the process's cumulative
+        records-skipped / worker-respawn counters so a single record
+        tells how shrunken the fed stream is so far."""
+        c = self.counters
+        self._write({"type": "data", "t": round(self._now(), 6),
+                     "action": str(action), "workers": int(workers),
+                     "skipped": int(c.get("data_records_skipped", 0)),
+                     "respawns": int(c.get("io_worker_respawns", 0)),
+                     **_jsonable(fields)})
+        from .. import profiler
+
+        if profiler.is_running():
+            self._trace_meta()
+            profiler.record_instant(
+                f"data:{action}", "telemetry",
+                args=_jsonable(fields), tid=_TRACE_TID)
+
     def opstats(self, rows, source="profiler"):
         """The aggregate per-op table (telemetry.opstats) as one
         ``program_report``-style record."""
@@ -731,6 +753,12 @@ def heal(action, **fields):
     rl = current()
     if rl is not None:
         rl.heal(action, **fields)
+
+
+def data_plane(action, *, workers=0, **fields):
+    rl = current()
+    if rl is not None:
+        rl.data_plane(action, workers=workers, **fields)
 
 
 def checkpoint_event(prefix, version, duration_s, nbytes, **extra):

@@ -26,6 +26,9 @@ heal      one self-healing runtime observation (peer_death /
           collective_abandon / emergency_ckpt / heal_exit / relaunch /
           resume) with the cumulative peer-death / emergency /
           relaunch counters
+data      one data-plane observation (quarantine / respawn /
+          epoch_end) with the cumulative records-skipped and
+          worker-respawn counters stamped on
 event     everything else (bad_step, ps_retry, fault, deadline, ...)
 run_end   final counters, written at close
 ========  =============================================================
@@ -35,7 +38,7 @@ from __future__ import annotations
 __all__ = ["STEP_FIELDS", "RECORD_TYPES", "COMPILE_CAUSES",
            "OPSTATS_ROW_FIELDS", "TENSOR_STATS_ROW_FIELDS",
            "SERVE_FIELDS", "FLEET_FIELDS", "HEAL_FIELDS",
-           "validate_record", "validate_lines"]
+           "DATA_FIELDS", "validate_record", "validate_lines"]
 
 #: step-record contract: field -> (types, required).  ``None`` is legal
 #: for optional measurements (loss on an unsampled step, feed stats
@@ -64,7 +67,7 @@ STEP_FIELDS = {
 
 RECORD_TYPES = ("run_start", "step", "compile", "program_report",
                 "checkpoint", "watchdog", "opstats", "tensor_stats",
-                "serve", "fleet", "heal", "event", "run_end")
+                "serve", "fleet", "heal", "data", "event", "run_end")
 
 #: per-batch contract of a ``serve`` record (serving.ModelServer)
 SERVE_FIELDS = {
@@ -109,6 +112,19 @@ HEAL_FIELDS = {
     "emergency_ckpts": (int, True),
     "heal_relaunches": (int, True),
     "auto_reshards": (int, True),
+}
+
+#: per-observation contract of a ``data`` record (io data plane):
+#: one quarantine / worker-respawn / epoch observation with the
+#: process's cumulative skip and respawn counters stamped on — the
+#: record chain that proves a shrunken epoch was DECLARED, not silent
+DATA_FIELDS = {
+    "type": (str, True),
+    "t": ((int, float), True),
+    "action": (str, True),        # quarantine|respawn|epoch_end|...
+    "workers": (int, True),       # pool size (0 = single producer)
+    "skipped": (int, True),       # cumulative data_records_skipped
+    "respawns": (int, True),      # cumulative io_worker_respawns
 }
 
 #: per-op row contract of an ``opstats`` record (telemetry.opstats)
@@ -220,6 +236,8 @@ def validate_record(rec):
         return _check_fields(rec, FLEET_FIELDS)
     if t == "heal":
         return _check_fields(rec, HEAL_FIELDS)
+    if t == "data":
+        return _check_fields(rec, DATA_FIELDS)
     if t == "event":
         return _check_fields(rec, {"t": ((int, float), True),
                                    "kind": (str, True)})

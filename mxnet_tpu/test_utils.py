@@ -21,7 +21,7 @@ __all__ = [
     "rand_shape_nd", "rand_ndarray", "random_arrays", "numeric_grad",
     "check_numeric_gradient", "check_symbolic_forward",
     "check_symbolic_backward", "check_consistency", "simple_forward",
-    "enable_x64",
+    "enable_x64", "write_rec_corpus", "corrupt_rec",
 ]
 
 
@@ -373,3 +373,64 @@ def check_consistency(sym, ctx_list=None, dtypes=("float64", "float32"),
 def _infer_arg_shapes(sym):
     shapes, _, _ = sym.infer_shape_partial()
     return shapes
+
+
+# ------------------------------------------ data-plane fault corpora
+def write_rec_corpus(path, n=32, size=16, seed=23, labels=None,
+                     quality=90):
+    """Write a deterministic .rec shard of random JPEGs for data-plane
+    drills (bench ``data_plane`` phase, ``tools/chaos.py`` rec
+    scenarios, corruption tests).  ``labels`` maps a record ordinal to
+    its float label (default: the ordinal itself).  Returns the
+    per-record byte offsets — what :func:`corrupt_rec` seeks by.
+
+    JPEGs are encoded via PIL, not ``pack_img`` — cv2 is absent from
+    the CI environment, and these corpora feed tier-1 tests, the bench
+    ``data_plane`` phase and the chaos rec scenarios."""
+    import io as _io
+
+    from PIL import Image
+
+    from . import recordio
+
+    w = recordio.MXRecordIO(path, "w")
+    offsets = []
+    rng = onp.random.RandomState(seed)
+    try:
+        for i in range(n):
+            img = (rng.rand(size, size, 3) * 255).astype("uint8")
+            bio = _io.BytesIO()
+            Image.fromarray(img).save(bio, format="JPEG",
+                                      quality=quality)
+            offsets.append(w.tell())
+            lab = float(labels(i)) if labels is not None else float(i)
+            w.write(recordio.pack(
+                recordio.IRHeader(0, lab, i, 0), bio.getvalue()))
+    finally:
+        w.close()
+    return offsets
+
+
+def corrupt_rec(path, offsets, torn=(), unpack=(), decode=()):
+    """Seed the three data-plane corruption shapes into a .rec written
+    by :func:`write_rec_corpus` (record indices per style):
+
+    * ``torn``   — garbled frame magic (framing-level; the resync
+      reader must skip to the next boundary);
+    * ``unpack`` — a 0xFFFFFFFF IRHeader flag (frame parses,
+      ``recordio.unpack`` raises);
+    * ``decode`` — the JPEG payload smeared with a non-magic pattern
+      (unpack succeeds, image decode fails).
+
+    ONE corruption recipe shared by every harness, so what chaos
+    injects and what bench measures cannot drift apart."""
+    with open(path, "r+b") as f:
+        for i in torn:
+            f.seek(offsets[i])
+            f.write(b"\xde\xad\xbe\xef")
+        for i in unpack:
+            f.seek(offsets[i] + 8)  # past magic+lrec, into the header
+            f.write(b"\xff\xff\xff\xff")
+        for i in decode:
+            f.seek(offsets[i] + 36)  # into the JPEG payload
+            f.write(b"\x55" * 48)

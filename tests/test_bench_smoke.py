@@ -138,6 +138,22 @@ def test_smoke_emits_valid_json_with_heartbeats():
                                      "new_world": 1}
     assert hl["fsck_clean"] is True
     assert hl["fsck_versions"] >= 1
+    # the data-plane phase (round 17): a multi-worker feed over a
+    # shard with 3 seeded-corrupt records — the epoch completes with
+    # every corruption quarantined and named, and the latency/
+    # throughput evidence lands in the JSON
+    dp = out["data_plane"]
+    assert dp["records"] > 0
+    assert dp["workers"] == 4
+    assert dp["skipped"] == dp["corrupt"] == 3
+    assert dp["manifest_entries"] == 3
+    assert dp["throughput_img_s"] > 0
+    # None only under deadline pressure (and then it says so)
+    assert dp["single_thread_img_s"] is None and "note" in dp \
+        or dp["single_thread_img_s"] > 0
+    assert dp["p99_batch_ms"] >= dp["p50_batch_ms"] > 0
+    assert dp["feed_wait_s"] >= 0
+    assert dp["respawns"] == 0  # no worker faults armed in the bench
     # the INFERENCE serving phase (round 13) stood the continuous-
     # batching model server in front of the net and drove bursty load
     srv = out["serving"]
@@ -179,8 +195,8 @@ def test_smoke_emits_valid_json_with_heartbeats():
     for phase in ("import", "device_init", "build", "autotune",
                   "compile", "K1", "K2", "trials", "feed",
                   "checkpoint", "collectives", "fused_kernels",
-                  "healing", "serving", "fleet", "telemetry",
-                  "conv_ab", "done"):
+                  "healing", "data_plane", "serving", "fleet",
+                  "telemetry", "conv_ab", "done"):
         assert f"phase={phase}" in r.stderr, f"missing phase {phase}"
 
 

@@ -1,0 +1,711 @@
+"""Fault-tolerant multi-worker data plane (round 17).
+
+The surface under test is the pipeline that FEEDS every hardened
+subsystem: a single bit-flipped record in a .rec file must no longer
+kill an epoch, a dead decode worker must no longer kill the feed, and
+none of that may perturb WHICH sample lands in WHICH batch row —
+
+* ``MXRecordIO`` resync-on-magic: a torn/garbled frame is skipped to
+  the next plausible magic boundary and reported (offset, bytes,
+  reason); strict mode (the default) still raises;
+* corrupt-record quarantine: unpack/decode failures skip the record,
+  count it (``data_records_skipped``), and name it (file / ordinal /
+  byte offset / reason) in an atomically-rewritten manifest; crossing
+  ``MXNET_IO_MAX_SKIP_FRAC`` fails loudly with the manifest attached;
+* the ``MXNET_IO_WORKERS`` pool: sequence-ordered emission means the
+  batch stream is IDENTICAL at any worker count; a worker killed by
+  ``io.worker:crash`` (the thread-level SIGKILL analog) or wedged past
+  the per-batch deadline is detected, its batch re-dispatched and the
+  pool respawned under ``MXNET_IO_WORKER_RESPAWN``;
+* THE drill: a corrupt shard trained under 4 workers with a worker
+  crash mid-epoch completes with ``data_records_skipped == k`` and the
+  respawn in the run log; a SIGTERM-drain + resume (at a DIFFERENT
+  worker count) is sample-exact vs the uninterrupted run; an
+  ``ElasticHostIter`` re-slice at another host count yields the
+  identical surviving-sample union.
+"""
+import json
+import os
+import signal
+import struct
+import subprocess
+import sys
+import tempfile
+import textwrap
+import threading
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.io import ImageDetRecordIter, ImageRecordIter
+from mxnet_tpu.resilience import faultsim
+from mxnet_tpu.resilience.elastic import ElasticHostIter
+from mxnet_tpu.telemetry import schema
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_MAGIC = struct.pack("<I", 0xCED7230A)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faultsim.reset("")
+    yield
+    faultsim.reset("")
+
+
+# ------------------------------------------------------ corpus builders
+# ONE corruption recipe (mxnet_tpu.test_utils) shared with bench's
+# data_plane phase and chaos's rec scenarios — the unit suite must
+# exercise the exact corruption shapes the harnesses inject
+from mxnet_tpu.test_utils import corrupt_rec, write_rec_corpus
+
+
+def _write_corpus(path, n=12, size=16, seed=5):
+    """A .rec of decodable JPEGs, label = record ordinal; returns the
+    per-record byte offsets (the corruption helpers seek by them)."""
+    return write_rec_corpus(path, n=n, size=size, seed=seed)
+
+
+def _corrupt_torn(path, offset):
+    """Garble a record's frame magic — framing-level damage the resync
+    reader must skip."""
+    corrupt_rec(path, [offset], torn=[0])
+
+
+def _corrupt_unpack(path, offset):
+    """Blow up the IRHeader flag field (0xFFFFFFFF label count) — the
+    frame parses but ``unpack`` raises."""
+    corrupt_rec(path, [offset], unpack=[0])
+
+
+def _corrupt_decode(path, offset):
+    """Overwrite the JPEG payload with a non-magic pattern — unpack
+    succeeds, image decode fails."""
+    corrupt_rec(path, [offset], decode=[0])
+
+
+def _embed_fake_magic(path, offset):
+    """Plant magic bytes + an insane length at a 4-byte-aligned spot
+    inside a record's payload region — a resync scan crossing it must
+    reject the false boundary (frame plausibility) and keep scanning."""
+    pos = offset + 40
+    pos += (-pos) % 4
+    with open(path, "r+b") as f:
+        f.seek(pos)
+        f.write(_MAGIC + struct.pack("<I", 0x1FFFFFFF))
+
+
+def _read_all(path, **kw):
+    r = recordio.MXRecordIO(path, "r", **kw)
+    out = []
+    try:
+        while True:
+            rec = r.read()
+            if rec is None:
+                break
+            out.append(rec)
+    finally:
+        r.close()
+    return out
+
+
+def _labels_of(batches):
+    """Non-pad label rows of a batch stream (the surviving samples)."""
+    out = []
+    for b in batches:
+        lab = b.label[0].asnumpy()
+        n = lab.shape[0] - (b.pad or 0)
+        out.extend(lab[:n].ravel().tolist())
+    return out
+
+
+# ------------------------------------------------------ recordio resync
+class TestRecordIOResync:
+    def test_strict_mode_still_raises(self, tmp_path):
+        path = str(tmp_path / "a.rec")
+        offs = _write_corpus(path, n=6)
+        _corrupt_torn(path, offs[2])
+        with pytest.raises(MXNetError):
+            _read_all(path)
+
+    def test_resync_recovers_every_intact_record(self, tmp_path):
+        path = str(tmp_path / "a.rec")
+        offs = _write_corpus(path, n=10)
+        clean = _read_all(path)
+        # torn frame with a decoy magic inside it, plus a truncated
+        # tail: the two framing-damage shapes that used to kill a
+        # whole dataset
+        _corrupt_torn(path, offs[3])
+        _embed_fake_magic(path, offs[3])
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(offs[9] + (size - offs[9]) // 2)
+        skips = []
+        recs = _read_all(path, resync=True,
+                         on_skip=lambda o, n, r: skips.append((o, n, r)))
+        want = [clean[i] for i in range(10) if i not in (3, 9)]
+        assert recs == want
+        # each skip names its byte offset and the gap it jumped
+        assert [s[0] for s in skips] == [offs[3], offs[9]]
+        assert skips[0][1] == offs[4] - offs[3]
+        assert all(s[2] for s in skips)  # a human-readable reason
+
+    def test_resync_recovers_multipart_record(self, tmp_path):
+        """A payload containing the magic bytes is written as split
+        continuation parts (the dmlc contract) — resync past a torn
+        neighbor must reassemble it whole."""
+        path = str(tmp_path / "m.rec")
+        w = recordio.MXRecordIO(path, "w")
+        payloads = [b"A" * 40,
+                    b"B" * 11 + _MAGIC + b"C" * 17,  # forces the split
+                    b"D" * 24]
+        offs = []
+        for p in payloads:
+            offs.append(w.tell())
+            w.write(p)
+        w.close()
+        _corrupt_torn(path, offs[0])
+        skips = []
+        recs = _read_all(path, resync=True,
+                         on_skip=lambda o, n, r: skips.append(o))
+        assert recs == payloads[1:]
+        assert skips == [offs[0]]
+
+    def test_resync_rejects_orphaned_continuation_tail(self, tmp_path):
+        """Tearing the BEGIN part of a multi-part chain must not let
+        resync resurrect the chain's middle as a bogus record."""
+        path = str(tmp_path / "o.rec")
+        w = recordio.MXRecordIO(path, "w")
+        p0 = b"E" * 21 + _MAGIC + b"F" * 33  # multi-part
+        p1 = b"G" * 18
+        offs = [w.tell()]
+        w.write(p0)
+        offs.append(w.tell())
+        w.write(p1)
+        w.close()
+        _corrupt_torn(path, offs[0])
+        skips = []
+        recs = _read_all(path, resync=True,
+                         on_skip=lambda o, n, r: skips.append((o, n)))
+        assert recs == [p1]
+        # the torn chain (begin + continuation parts) is ONE merged
+        # gap, not one event per rejected part — the skip ceiling
+        # weighs gaps, so event inflation would overstate corruption
+        assert skips == [(offs[0], offs[1] - offs[0])]
+
+    def test_io_read_fault_point(self, tmp_path):
+        path = str(tmp_path / "f.rec")
+        _write_corpus(path, n=5)
+        clean = _read_all(path)
+        faultsim.reset("io.read:raise@2")
+        with pytest.raises(faultsim.FaultInjected):
+            _read_all(path)
+        # the same fault under resync is one skipped record + a report
+        faultsim.reset("io.read:raise@2")
+        skips = []
+        recs = _read_all(path, resync=True,
+                         on_skip=lambda o, n, r: skips.append(r))
+        assert len(recs) == 4
+        assert recs == [clean[0]] + clean[2:]
+        assert len(skips) == 1 and "injected" in skips[0]
+
+
+# ------------------------------------------------- quarantine pipeline
+class TestQuarantine:
+    def _corrupt3(self, tmp_path, n=12):
+        path = str(tmp_path / "q.rec")
+        offs = _write_corpus(path, n=n)
+        _corrupt_torn(path, offs[3])
+        _corrupt_unpack(path, offs[5])
+        _corrupt_decode(path, offs[8])
+        return path
+
+    def test_epoch_completes_with_manifest(self, tmp_path):
+        path = self._corrupt3(tmp_path)
+        it = ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+                             batch_size=4, std_r=255.0, std_g=255.0,
+                             std_b=255.0, max_skip_frac=0.5)
+        batches = list(it)
+        stats = it.data_plane_stats()
+        it.close()
+        assert stats["skipped"] == 3
+        assert stats["parse_skips"] == 1       # the torn frame
+        assert stats["quarantined"] == 2       # unpack + decode
+        # the full surviving stream fed exactly once (9 = 12 - 3),
+        # wrap-fill rows accounted as pad
+        survivors = [float(i) for i in range(12) if i not in (3, 5, 8)]
+        assert sorted(_labels_of(batches)) == survivors
+        assert sum(b.pad or 0 for b in batches) == 3  # 12-slot plan
+        # the manifest names every skip: file, ordinal, offset, reason
+        man = json.load(open(stats["manifest"]))
+        assert man["skipped"] == 3
+        stages = sorted(e["stage"] for e in man["entries"])
+        assert stages == ["decode", "read", "unpack"]
+        for e in man["entries"]:
+            assert e["file"] == path
+            assert e["offset"] is not None
+            assert e["reason"]
+        by_stage = {e["stage"]: e for e in man["entries"]}
+        # ordinals are in the PARSED shard's numbering: the torn
+        # record never parsed, so 5 -> 4 and 8 -> 7
+        assert by_stage["unpack"]["record"] == 4
+        assert by_stage["decode"]["record"] == 7
+
+    def test_stream_identical_at_any_worker_count(self, tmp_path):
+        path = self._corrupt3(tmp_path)
+        kw = dict(path_imgrec=path, data_shape=(3, 16, 16),
+                  batch_size=4, std_r=255.0, std_g=255.0, std_b=255.0,
+                  max_skip_frac=0.5, rand_mirror=True, rand_crop=True)
+        it0 = ImageRecordIter(io_workers=0, **kw)
+        it4 = ImageRecordIter(io_workers=4, **kw)
+        for _ in range(2):  # two epochs: per-batch rng keys on epoch
+            b0, b4 = list(it0), list(it4)
+            assert len(b0) == len(b4)
+            for a, b in zip(b0, b4):
+                onp.testing.assert_array_equal(a.data[0].asnumpy(),
+                                               b.data[0].asnumpy())
+                onp.testing.assert_array_equal(a.label[0].asnumpy(),
+                                               b.label[0].asnumpy())
+                assert a.pad == b.pad
+            it0.reset()
+            it4.reset()
+        it0.close()
+        it4.close()
+
+    def test_manifest_offset_exact_after_resync_gap(self, tmp_path):
+        """A record that parses right AFTER a torn-frame gap starts at
+        the gap's END — its manifest row must name that offset, not
+        the pre-gap position (an operator seeks by it to inspect the
+        bad bytes)."""
+        path = str(tmp_path / "g.rec")
+        offs = _write_corpus(path, n=8)
+        _corrupt_torn(path, offs[2])
+        _corrupt_decode(path, offs[3])  # first record after the gap
+        it = ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+                             batch_size=4, max_skip_frac=0.6)
+        list(it)
+        man = json.load(open(it.data_plane_stats()["manifest"]))
+        it.close()
+        by_stage = {e["stage"]: e for e in man["entries"]}
+        assert by_stage["read"]["offset"] == offs[2]
+        assert by_stage["decode"]["offset"] == offs[3]
+
+    def test_assembly_order_cannot_perturb_aug_draws(self, tmp_path):
+        """White-box pin of the determinism contract: augmentation
+        draws are position-keyed, so assembling batch 1 BEFORE batch 0
+        (what a pool does inside its window) — and thereby quarantining
+        a wrap-filled corrupt record early — must produce bit-identical
+        batches to in-order assembly."""
+        path = str(tmp_path / "w.rec")
+        offs = _write_corpus(path, n=10)
+        _corrupt_decode(path, offs[2])  # in batch 0 AND batch 1's wrap
+        kw = dict(path_imgrec=path, data_shape=(3, 16, 16),
+                  batch_size=8, std_r=255.0, std_g=255.0, std_b=255.0,
+                  max_skip_frac=0.5, rand_crop=True, rand_mirror=True,
+                  device_feed=False)
+        fwd = ImageRecordIter(**kw)
+        rev = ImageRecordIter(**kw)
+        plan_f, plan_r = fwd._plan, rev._plan
+        assert len(plan_f) == 2
+        f0 = fwd._assemble(*plan_f[0])
+        f1 = fwd._assemble(*plan_f[1])
+        r1 = rev._assemble(*plan_r[1])  # out of order: wrap row first
+        r0 = rev._assemble(*plan_r[0])
+        for a, b in ((f0, r0), (f1, r1)):
+            onp.testing.assert_array_equal(a[0], b[0])
+            onp.testing.assert_array_equal(a[1], b[1])
+            assert a[2] == b[2]
+        fwd.close()
+        rev.close()
+
+    def test_skip_ceiling_fails_loudly(self, tmp_path):
+        path = self._corrupt3(tmp_path)
+        it = ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+                             batch_size=4, std_r=255.0, std_g=255.0,
+                             std_b=255.0, max_skip_frac=0.12,
+                             io_workers=2)
+        with pytest.raises(MXNetError, match="[Qq]uarantine manifest"):
+            list(it)
+        it.close()
+
+    def test_parse_stage_ceiling_raises_at_construction(self, tmp_path):
+        path = str(tmp_path / "p.rec")
+        offs = _write_corpus(path, n=8)
+        for i in (1, 3, 5):
+            _corrupt_torn(path, offs[i])
+        with pytest.raises(MXNetError, match="ceiling"):
+            ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+                            batch_size=4, max_skip_frac=0.1)
+
+    def test_ceiling_weighs_one_big_corrupt_extent_by_bytes(
+            self, tmp_path):
+        """A contiguous corrupt extent spanning many records produces
+        ONE resync event — the ceiling must estimate records lost from
+        the bytes jumped, not count events, or a zeroed disk extent
+        covering a third of the shard would sail under the limit."""
+        path = str(tmp_path / "x.rec")
+        offs = _write_corpus(path, n=8)
+        for i in (2, 3, 4):  # one extent: 3 consecutive torn frames
+            _corrupt_torn(path, offs[i])
+        # 3/8 records in one gap: event count (1/6) passes 0.25, the
+        # byte-weighted estimate (~3/8) must NOT
+        with pytest.raises(MXNetError, match="ceiling"):
+            ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+                            batch_size=4, max_skip_frac=0.25)
+
+    def test_stale_manifest_of_a_repaired_shard_is_rewritten(
+            self, tmp_path):
+        path = str(tmp_path / "r.rec")
+        offs = _write_corpus(path, n=8)
+        _corrupt_unpack(path, offs[3])
+        it = ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+                             batch_size=4, max_skip_frac=0.5)
+        list(it)
+        man_path = it.data_plane_stats()["manifest"]
+        it.close()
+        assert json.load(open(man_path))["skipped"] == 1
+        _write_corpus(path, n=8)  # the shard is repaired in place
+        it = ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+                             batch_size=4)
+        list(it)
+        it.close()
+        man = json.load(open(man_path))
+        assert man["skipped"] == 0 and man["entries"] == []
+
+    def test_det_iter_quarantines(self, tmp_path):
+        from tests.test_iterators import _make_det_rec
+
+        path = str(tmp_path / "det.rec")
+        _make_det_rec(path, n=8)
+        # offsets via a strict scan
+        offs = []
+        r = recordio.MXRecordIO(path, "r")
+        while True:
+            offs.append(r.tell())
+            if r.read() is None:
+                break
+        r.close()
+        _corrupt_decode(path, offs[2])
+        it = ImageDetRecordIter(path_imgrec=path,
+                                data_shape=(3, 32, 32), batch_size=4,
+                                max_skip_frac=0.5, io_workers=2)
+        batches = list(it)
+        stats = it.data_plane_stats()
+        it.close()
+        assert stats["quarantined"] == 1
+        assert len(batches) == 2
+        assert sum(b.pad or 0 for b in batches) == 1
+
+    def test_quarantine_data_records_schema_valid(self, tmp_path):
+        from mxnet_tpu import telemetry
+
+        path = self._corrupt3(tmp_path)
+        runlog = str(tmp_path / "run.jsonl")
+        telemetry.reset(runlog)
+        try:
+            it = ImageRecordIter(path_imgrec=path,
+                                 data_shape=(3, 16, 16), batch_size=4,
+                                 std_r=255.0, std_g=255.0,
+                                 std_b=255.0, max_skip_frac=0.5,
+                                 io_workers=2)
+            list(it)
+            it.close()
+        finally:
+            telemetry.close()
+        with open(runlog) as f:
+            records, problems = schema.validate_lines(f)
+        assert not problems, problems
+        data = [r for r in records if r["type"] == "data"]
+        assert len([r for r in data
+                    if r["action"] == "quarantine"]) == 3
+        assert data[-1]["skipped"] == 3
+        ends = [r for r in records if r["type"] == "run_end"]
+        assert ends[-1]["counters"]["data_records_skipped"] == 3
+        assert ends[-1]["counters"]["io_resyncs"] == 1
+
+
+# ------------------------------------------------------- worker faults
+class TestWorkerPool:
+    def _clean(self, tmp_path, n=12):
+        path = str(tmp_path / "w.rec")
+        _write_corpus(path, n=n)
+        return path
+
+    def _batches(self, path, **kw):
+        it = ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+                             batch_size=4, std_r=255.0, std_g=255.0,
+                             std_b=255.0, max_skip_frac=0.5, **kw)
+        try:
+            return list(it), it.data_plane_stats()
+        finally:
+            it.close()
+
+    def test_worker_crash_respawns_and_redispatches(self, tmp_path):
+        path = self._clean(tmp_path)
+        ref, _ = self._batches(path)
+        faultsim.reset("io.worker:crash@2")
+        got, stats = self._batches(path, io_workers=2,
+                                   worker_deadline_sec=1.0)
+        assert stats["respawns"] >= 1
+        assert len(got) == len(ref)
+        for a, b in zip(ref, got):
+            onp.testing.assert_array_equal(a.data[0].asnumpy(),
+                                           b.data[0].asnumpy())
+            assert a.pad == b.pad
+
+    def test_worker_raise_is_absorbed_without_respawn(self, tmp_path):
+        path = self._clean(tmp_path)
+        ref, _ = self._batches(path)
+        faultsim.reset("io.worker:raise@2")
+        got, stats = self._batches(path, io_workers=2,
+                                   worker_deadline_sec=2.0)
+        assert stats["respawns"] == 0
+        assert len(got) == len(ref)
+
+    def test_straggler_worker_redispatched(self, tmp_path):
+        path = self._clean(tmp_path)
+        ref, _ = self._batches(path)
+        faultsim.reset("io.worker:delay=1.5@1")
+        got, stats = self._batches(path, io_workers=2,
+                                   worker_deadline_sec=0.3)
+        assert stats["respawns"] >= 1
+        assert len(got) == len(ref)
+        for a, b in zip(ref, got):
+            onp.testing.assert_array_equal(a.data[0].asnumpy(),
+                                           b.data[0].asnumpy())
+
+    def test_open_ended_raise_fails_loudly_not_hangs(self, tmp_path):
+        """io.worker:raise@1+ (every claim aborts, a legal spec form)
+        must be a bounded loud failure, not an unbounded re-dispatch
+        loop that hangs the consumer forever."""
+        path = self._clean(tmp_path)
+        faultsim.reset("io.worker:raise@1+")
+        it = ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+                             batch_size=4, max_skip_frac=0.5,
+                             io_workers=2, worker_deadline_sec=5.0)
+        with pytest.raises(MXNetError, match="aborted"):
+            list(it)
+        it.close()
+
+    def test_slow_batches_survive_a_tiny_deadline(self, tmp_path):
+        """A healthy-but-slow pipeline (every batch slower than the
+        per-batch deadline) must COMPLETE: a poisoned worker that
+        still delivers is un-poisoned and hands its budget charge
+        back — slowness is not death."""
+        path = self._clean(tmp_path)
+        ref, _ = self._batches(path)
+        faultsim.reset("io.worker:delay=0.2@1+")  # every claim is slow
+        got, stats = self._batches(path, io_workers=2,
+                                   worker_respawn=2,
+                                   worker_deadline_sec=0.05)
+        assert len(got) == len(ref)
+        for a, b in zip(ref, got):
+            onp.testing.assert_array_equal(a.data[0].asnumpy(),
+                                           b.data[0].asnumpy())
+            assert a.pad == b.pad
+
+    def test_respawn_budget_exhaustion_fails_loudly(self, tmp_path):
+        path = self._clean(tmp_path)
+        faultsim.reset("io.worker:crash@1+")  # every claim dies
+        it = ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+                             batch_size=4, max_skip_frac=0.5,
+                             io_workers=2, worker_respawn=2,
+                             worker_deadline_sec=0.5)
+        with pytest.raises(MXNetError,
+                           match="respawn budget exhausted"):
+            list(it)
+        it.close()
+
+    def test_abandoned_iterator_leaks_no_thread(self, tmp_path):
+        """The satellite fix: a consumer that stops draining and never
+        resets must not leave a producer wedged in queue.put forever —
+        close() reaps it via the stop-aware put."""
+        path = self._clean(tmp_path)
+        for workers in (0, 2):
+            it = ImageRecordIter(path_imgrec=path,
+                                 data_shape=(3, 16, 16), batch_size=4,
+                                 prefetch_buffer=1, io_workers=workers,
+                                 max_skip_frac=0.5)
+            next(it)  # producer now blocks on the tiny full queue
+            it.close()
+            leaked = [t.name for t in threading.enumerate()
+                      if t.name.startswith("ImageRecordIter")
+                      and t.is_alive()]
+            assert not leaked, leaked
+
+
+# --------------------------------------------- elastic host re-slicing
+def test_elastic_reslice_yields_identical_surviving_union(tmp_path):
+    """Quarantined rows compact to tail pad inside the GLOBAL batch, so
+    an ElasticHostIter re-slice at any host count feeds the same
+    surviving-sample union — the resume/resize contract through data
+    faults."""
+    path = str(tmp_path / "e.rec")
+    offs = _write_corpus(path, n=16)
+    _corrupt_unpack(path, offs[4])
+    _corrupt_decode(path, offs[11])
+    kw = dict(path_imgrec=path, data_shape=(3, 16, 16), batch_size=8,
+              std_r=255.0, std_g=255.0, std_b=255.0, max_skip_frac=0.5)
+    base = ImageRecordIter(**kw)
+    reference = _labels_of(list(base))
+    base.close()
+    assert sorted(reference) == [float(i) for i in range(16)
+                                 if i not in (4, 11)]
+    for hosts in (2, 4):
+        union = []
+        for rank in range(hosts):
+            src = ImageRecordIter(io_workers=2, **kw)
+            host = ElasticHostIter(src, rank, hosts)
+            union.extend(_labels_of(list(host)))
+            src.close()
+        assert sorted(union) == sorted(reference), hosts
+
+
+# ----------------------------------------------------------- THE drill
+_DRILL_SCRIPT = """
+    import json, os, signal
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym, telemetry
+
+    mx.random.seed(11)
+    onp.random.seed(11)
+    it = mx.io.ImageRecordIter(
+        path_imgrec=REC_PATH, data_shape=(3, 16, 16), batch_size=4,
+        std_r=255.0, std_g=255.0, std_b=255.0)
+
+    d = sym.Variable("data")
+    fl = sym.Flatten(d)
+    fc1 = sym.FullyConnected(fl, num_hidden=8, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=4, name="fc2")
+    net = sym.SoftmaxOutput(fc2, sym.Variable("softmax_label"),
+                            name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+
+    callbacks = []
+    if KILL_AT is not None:
+        def killer(param):
+            if param.epoch == KILL_AT[0] and param.nbatch == KILL_AT[1]:
+                os.kill(os.getpid(), signal.SIGTERM)
+        callbacks.append(killer)
+
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.05),
+                              ("momentum", 0.9)),
+            initializer=mx.init.Xavier(), checkpoint=PREFIX,
+            resume_from=RESUME_FROM,
+            batch_end_callback=callbacks or None)
+    stats = it.data_plane_stats()
+    it.close()
+    telemetry.close()
+    arg_p, _ = mod.get_params()
+    print(json.dumps({
+        "final": {k: v.asnumpy().ravel().tolist()
+                  for k, v in sorted(arg_p.items())},
+        "stats": stats}))
+"""
+
+
+def _run_drill(rec, prefix, runlog, env_extra, kill_at=None,
+               resume_from=None, timeout=180):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["MXNET_RUNLOG"] = runlog
+    env.pop("MXNET_FAULT_SPEC", None)
+    env.update(env_extra)
+    prelude = textwrap.dedent(f"""\
+        import sys
+        sys.path.insert(0, {_REPO!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        """)
+    body = textwrap.dedent(_DRILL_SCRIPT) \
+        .replace("REC_PATH", repr(rec)) \
+        .replace("PREFIX", repr(prefix)) \
+        .replace("RESUME_FROM", repr(resume_from)) \
+        .replace("KILL_AT", repr(kill_at))
+    return subprocess.run([sys.executable, "-c", prelude + body],
+                          capture_output=True, text=True,
+                          timeout=timeout, env=env)
+
+
+def _drill_corpus(tmp_path):
+    path = str(tmp_path / "drill.rec")
+    offs = _write_corpus(path, n=32)
+    _corrupt_torn(path, offs[6])
+    _corrupt_unpack(path, offs[13])
+    _corrupt_decode(path, offs[22])
+    return path
+
+
+def _runlog_counters(runlog):
+    with open(runlog) as f:
+        records, problems = schema.validate_lines(f)
+    assert not problems, problems
+    ends = [r for r in records if r["type"] == "run_end"]
+    assert ends, "no run_end record"
+    return records, ends[-1]["counters"]
+
+
+def test_drill_corrupt_shard_worker_crash_drain_resume(tmp_path):
+    """THE round-17 acceptance drill (see module docstring)."""
+    rec = _drill_corpus(tmp_path)
+    fault_env = {"MXNET_IO_WORKERS": "4",
+                 "MXNET_FAULT_SPEC": "io.worker:crash@5"}
+
+    # ---- uninterrupted reference: corrupt shard + worker crash ----
+    log_a = str(tmp_path / "a.jsonl")
+    ra = _run_drill(rec, str(tmp_path / "ck_a"), log_a, fault_env)
+    assert ra.returncode == 0, ra.stderr[-2000:]
+    out_a = json.loads(ra.stdout.strip().splitlines()[-1])
+    assert out_a["stats"]["skipped"] == 3
+    assert out_a["stats"]["respawns"] >= 1
+    records, counters = _runlog_counters(log_a)
+    assert counters["data_records_skipped"] == 3
+    assert counters["io_worker_respawns"] >= 1
+    data = [r for r in records if r["type"] == "data"]
+    assert {r["action"] for r in data} >= {"quarantine", "respawn"}
+    man = json.load(open(rec + ".quarantine.json"))
+    assert man["skipped"] == 3 and len(man["entries"]) == 3
+
+    # ---- SIGTERM-drain mid-epoch, same faults armed ----
+    prefix_b = str(tmp_path / "ck_b")
+    rb = _run_drill(rec, prefix_b, str(tmp_path / "b.jsonl"),
+                    fault_env, kill_at=(1, 2))
+    assert rb.returncode == -signal.SIGTERM, (rb.returncode,
+                                              rb.stderr[-2000:])
+    from mxnet_tpu.resilience.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(prefix_b)
+    ep = mgr.latest_epoch()
+    drained = mgr.load(ep)
+    assert drained["epoch"] == 1
+    assert drained["batch_cursor"] == 3
+
+    # ---- resume at a DIFFERENT worker count, faults disarmed ----
+    rc = _run_drill(rec, prefix_b, str(tmp_path / "c.jsonl"),
+                    {"MXNET_IO_WORKERS": "2"}, resume_from=prefix_b)
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    out_c = json.loads(rc.stdout.strip().splitlines()[-1])
+    assert sorted(out_c["final"]) == sorted(out_a["final"])
+    for k in out_a["final"]:
+        onp.testing.assert_array_equal(
+            onp.asarray(out_a["final"][k]),
+            onp.asarray(out_c["final"][k]), err_msg=k)
+
+    # ---- the same stream re-sliced at 2 hosts: identical union ----
+    kw = dict(path_imgrec=rec, data_shape=(3, 16, 16), batch_size=4,
+              std_r=255.0, std_g=255.0, std_b=255.0)
+    whole = ImageRecordIter(**kw)
+    reference = _labels_of(list(whole))
+    whole.close()
+    union = []
+    for rank in range(2):
+        src = ImageRecordIter(io_workers=2, **kw)
+        union.extend(_labels_of(list(ElasticHostIter(src, rank, 2))))
+        src.close()
+    assert sorted(union) == sorted(reference)

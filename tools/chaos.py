@@ -43,6 +43,19 @@ Scenarios (round-robin over the schedule):
 ``ckpt_write_crash``  same for the synchronous writer (``ckpt.write``)
 ``collective_delay``  ``dist.collective:delay`` inside the dp(2)
                   sharded exchange — absorbed, the run completes
+``record_corrupt``  the training shard is a .rec with 3 seeded-
+                  corrupt records (torn frame / unpackable header /
+                  undecodable payload) fed through the
+                  MXNET_IO_WORKERS=4 pool: every corruption is
+                  QUARANTINED (run-log counter evidence), the run
+                  completes, and the final params match a
+                  single-producer reference over the same corpus —
+                  worker count and corruption perturb nothing
+``io_worker_kill``  ``io.worker:crash@K`` kills a decode worker
+                  thread mid-epoch (the pool's SIGKILL analog): the
+                  batch it held is re-dispatched, the pool respawns
+                  (run-log counter evidence), params still match the
+                  reference
 ================  ====================================================
 
 Usage::
@@ -69,7 +82,7 @@ sys.path.insert(0, _REPO)
 
 SCENARIOS = ("sigkill", "sigterm_drain", "peer_death",
              "heartbeat_delay", "ckpt_async_crash", "ckpt_write_crash",
-             "collective_delay")
+             "collective_delay", "record_corrupt", "io_worker_kill")
 
 #: scenarios that intentionally kill the victim (a relaunch+resume is
 #: expected); the others must complete on attempt 0
@@ -78,6 +91,20 @@ _LETHAL = {"sigkill", "sigterm_drain", "peer_death",
 
 
 # ======================================================= worker half
+def _build_rec_corpus(path, n=32):
+    """A deterministic .rec shard with 3 seeded-bad records (torn
+    frame / unpackable header / undecodable payload) via the SHARED
+    recipe in ``mxnet_tpu.test_utils``.  Every attempt AND the
+    reference build byte-identical corpora, so the surviving stream —
+    and therefore the final params — must match regardless of worker
+    count or worker faults."""
+    from mxnet_tpu.test_utils import corrupt_rec, write_rec_corpus
+
+    offsets = write_rec_corpus(path, n=n, labels=lambda i: i % 4)
+    corrupt_rec(path, offsets, torn=[6], unpack=[13], decode=[22])
+    return path
+
+
 def _worker(args):
     """One training run (the supervised command): attempt 0 arms the
     scenario's faults and may die; relaunch attempts scrub the faults
@@ -104,13 +131,23 @@ def _worker(args):
 
     mx.random.seed(11)
     onp.random.seed(11)
-    rng = onp.random.RandomState(7)
-    X = rng.randn(64, 10).astype("float32")
-    y = (X @ rng.randn(10, 4)).argmax(axis=1).astype("float32")
-    it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=False)
+    if args.ctx == "rec":
+        # the data-plane scenarios: train straight from a .rec shard
+        # with seeded-corrupt records through the record pipeline
+        rec_dir = tempfile.mkdtemp(prefix="chaos_rec_")
+        rec_path = _build_rec_corpus(os.path.join(rec_dir, "train.rec"))
+        it = mx.io.ImageRecordIter(
+            path_imgrec=rec_path, data_shape=(3, 16, 16),
+            batch_size=8, std_r=255.0, std_g=255.0, std_b=255.0)
+        top = sym.Flatten(sym.Variable("data"))
+    else:
+        rng = onp.random.RandomState(7)
+        X = rng.randn(64, 10).astype("float32")
+        y = (X @ rng.randn(10, 4)).argmax(axis=1).astype("float32")
+        it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=False)
+        top = sym.Variable("data")
 
-    d = sym.Variable("data")
-    fc1 = sym.FullyConnected(d, num_hidden=16, name="fc1")
+    fc1 = sym.FullyConnected(top, num_hidden=16, name="fc1")
     act = sym.Activation(fc1, act_type="relu", name="relu1")
     fc2 = sym.FullyConnected(act, num_hidden=4, name="fc2")
     net = sym.SoftmaxOutput(fc2, sym.Variable("softmax_label"),
@@ -204,6 +241,11 @@ def _worker(args):
         healing.heal_exit("peer_death")
     finally:
         healing.disarm()
+        if args.ctx == "rec":
+            import shutil
+
+            it.close()
+            shutil.rmtree(rec_dir, ignore_errors=True)
 
     import threading
 
@@ -258,6 +300,12 @@ def _schedule(seed, runs, scenarios):
                 f"dist.collective:delay="
                 f"{round(rng.uniform(0.05, 0.3), 2)}"
                 f"@{rng.randint(1, 6)}")
+        elif scen == "record_corrupt":
+            entry["io_workers"] = 4  # corruption IS the fault
+        elif scen == "io_worker_kill":
+            entry["io_workers"] = 4
+            entry["fault_spec"] = \
+                f"io.worker:crash@{rng.randint(2, 6)}"
         plan.append(entry)
     return plan
 
@@ -274,6 +322,8 @@ def _worker_env(base, entry, prefix):
         env["MXNET_PEER_TIMEOUT_SEC"] = "0.5"
     if entry.get("self_heal"):
         env["CHAOS_SELF_HEAL"] = "1"
+    if entry.get("io_workers"):
+        env["MXNET_IO_WORKERS"] = str(entry["io_workers"])
     if "kill_delay_s" in entry:
         # stretch the fit past the kill window so the seeded delay
         # lands mid-run (mid-step, mid-epoch-boundary, mid-ckpt-write)
@@ -307,7 +357,11 @@ def _kill_when_ready(pidfile, delay, sig, result, deadline=60.0):
 
 
 def _ctx_for(entry):
-    return "dp2" if entry["scenario"] == "collective_delay" else "cpu"
+    if entry["scenario"] == "collective_delay":
+        return "dp2"
+    if entry["scenario"] in ("record_corrupt", "io_worker_kill"):
+        return "rec"  # reference: same corrupt corpus, 0 workers
+    return "cpu"
 
 
 def _run_reference(ctx, outdir, env):
@@ -351,7 +405,8 @@ def campaign(args):
     for k in ("MXNET_FAULT_SPEC", "MXNET_RUNLOG",
               "MXNET_METRICS_TEXTFILE", "MXNET_HEARTBEAT_DIR",
               "MXNET_SNAPSHOT_EVERY", "CHAOS_GHOST_AT_BATCH",
-              "CHAOS_SELF_HEAL", "CHAOS_PACE_S", "MXNET_HEAL_ATTEMPT"):
+              "CHAOS_SELF_HEAL", "CHAOS_PACE_S", "MXNET_HEAL_ATTEMPT",
+              "MXNET_IO_WORKERS"):
         env.pop(k, None)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
@@ -487,6 +542,33 @@ def campaign(args):
         elif scen in ("peer_death", "ckpt_async_crash",
                       "ckpt_write_crash"):
             fault_landed = relaunched
+        elif scen in ("record_corrupt", "io_worker_kill"):
+            # data-plane evidence: the victim's run_end counters must
+            # show the quarantine (record_corrupt) or the worker
+            # respawn (io_worker_kill) actually happened
+            key = ("data_records_skipped" if scen == "record_corrupt"
+                   else "io_worker_respawns")
+            counters = {}
+            try:
+                with open(f"{prefix}.runlog.a0.jsonl") as f:
+                    ends = [json.loads(ln) for ln in f
+                            if '"type": "run_end"' in ln
+                            or '"type":"run_end"' in ln]
+                if ends:
+                    counters = ends[-1].get("counters", {})
+            except OSError:
+                pass
+            fault_landed = counters.get(key, 0) >= 1
+            if not fault_landed:
+                problems.append(
+                    f"{scen}: run_end counter {key} shows zero — the "
+                    "data-plane fault never landed")
+            elif scen == "record_corrupt" \
+                    and counters.get("data_records_skipped", 0) != 3:
+                problems.append(
+                    "record_corrupt: expected exactly 3 quarantined "
+                    f"records, counters say "
+                    f"{counters.get('data_records_skipped')}")
         else:  # delay scenarios: the armed spec's hits are in the log
             try:
                 with open(f"{prefix}.runlog.a0.jsonl") as f:
