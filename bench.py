@@ -78,6 +78,14 @@ the round its headline artifact):
   rolls a zero-downtime ``.mxje`` model swap across the fleet:
   replicas/requests/shed/failovers/swap_ms/p50/p99/slo land under
   ``"fleet"`` in the JSON;
+* the ``quantization`` INFERENCE phase (round 18) runs the int8
+  pipeline end to end — entropy calibration of a trained net,
+  ``quantization.quantize_net`` rewrite, the quantized_conv/
+  quantized_fc adoption race (winners persisted in autotune.json),
+  fp32 AND force-pinned int8 ``.mxje`` exports, both served AOT —
+  reporting top-1 agreement (accuracy delta vs the fp32 arm),
+  p50/p99/throughput per arm and the race verdicts under
+  ``"quantization"`` in the JSON;
 
 HARNESS PROTOCOL (round 11 — stall-proofing; r05's stall sat inside an
 uninterruptible XLA call where none of the above could run):
@@ -881,6 +889,181 @@ def _measure_healing(smoke, deadline):
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
     return report
+
+
+def _measure_quantization(smoke, deadline):
+    """Quantized-inference phase (round 18): the full calibrate ->
+    rewrite -> race -> export -> serve chain on a small TRAINED net.
+
+    A prototype-class synthetic task trains a conv net until its logit
+    margins dwarf the int8 grid, then: entropy calibration over a held
+    corpus, ``quantization.quantize_net`` rewrite, the
+    ``quantized_conv``/``quantized_fc`` adoption race (winners persist
+    in autotune.json — the per-op, per-shape, per-platform verdict),
+    both arms exported through ``deploy.export_model`` (the int8 arm
+    force-pinned so the comparison is honest even where the race said
+    fp32), and both ``.mxje`` artifacts served AOT through
+    ``ModelServer.from_artifact``.  Reports top-1 agreement (the
+    accuracy delta vs the fp32 arm) plus p50/p99/throughput per arm
+    into the headline JSON."""
+    import shutil
+    import tempfile
+
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autotune, deploy, gluon, nd
+    from mxnet_tpu import quantization as quant
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import DataParallelTrainer
+    from mxnet_tpu.serving import ModelServer, ServeRejected
+    from mxnet_tpu.telemetry.opstats import percentile
+
+    # the WHOLE phase is seeded: net init (Xavier draws from the
+    # global RNGs) plus the synthetic task — an unseeded init made
+    # the trained margins, and therefore the int8 agreement, vary
+    # run to run
+    mx.random.seed(42)
+    onp.random.seed(42)
+    rng = onp.random.RandomState(42)
+    nclass, item = 4, (3, 16, 16)
+    protos = rng.rand(nclass, *item).astype("float32")
+    train_steps = 60 if smoke else 150
+    n_req = 48 if smoke else 192
+    batch = 32
+
+    def make_batch(n):
+        # noise well inside the prototype separation: the logit
+        # margins must dwarf the int8 grid so the agreement verdict
+        # measures QUANTIZATION error, not boundary samples
+        y = rng.randint(0, nclass, n)
+        x = (protos[y]
+             + 0.15 * rng.rand(n, *item)).astype("float32")
+        return x, y.astype("float32")
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, 3, padding=1), nn.Activation("relu"),
+                nn.MaxPool2D(), nn.Flatten(), nn.Dense(nclass))
+    net.initialize(init=mx.init.Xavier())
+    net(nd.zeros((1,) + item))
+    # the training step shares the main bench step's autotune key
+    # (batch 32, fp32, cpu): pin the dtype ladder to fp32 so a cached
+    # bf16 winner from the MAIN step's race cannot leak into this
+    # phase's training numerics — the phase measures quantization,
+    # not the ladder
+    with autotune.force(dtype_ladder="fp32"):
+        trainer = DataParallelTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(),
+            optimizer="sgd", learning_rate=0.2)
+        for i in range(train_steps):
+            xb, yb = make_batch(batch)
+            trainer.fit_batch(xb, yb)
+            if i % 20 == 0 and deadline.exceeded():
+                deadline.note("quantization:train")
+                break
+        trainer.sync_to_block()
+    _heartbeat("quantization", trained=True)
+
+    corpus = [make_batch(batch)[0] for _ in range(4)]
+    calib = quant.calibrate(net, corpus, mode="entropy",
+                            num_batches=len(corpus))
+    qnet = quant.quantize_net(net, calib)
+    race = quant.tune_quantized(qnet, corpus[0], iters=4)
+    _heartbeat("quantization", raced=sorted(race))
+
+    tmpdir = tempfile.mkdtemp(prefix="mxnet_tpu_bench_quant_")
+    try:
+        p_int8 = os.path.join(tmpdir, "int8.mxje")
+        p_fp32 = os.path.join(tmpdir, "fp32.mxje")
+        # honest arms: the int8 export force-pins every quantized
+        # wrapper on, the fp32 export force-pins them all off — the
+        # RACE report (above) is where per-op adoption lives
+        with autotune.force(quantized_conv=True, quantized_fc=True):
+            deploy.export_model(qnet, corpus[0], p_int8,
+                                platforms=("cpu",) if smoke
+                                else ("cpu", "tpu"))
+        with autotune.force(quantized_conv=False, quantized_fc=False):
+            deploy.export_model(qnet, corpus[0], p_fp32,
+                                platforms=("cpu",) if smoke
+                                else ("cpu", "tpu"))
+        info = deploy.artifact_info(p_int8)
+
+        # accuracy delta: top-1 agreement of the int8 program vs the
+        # fp32 arm over the calibration corpus
+        f_int8 = deploy.load_model(p_int8)
+        f_fp32 = deploy.load_model(p_fp32)
+        agree = n_total = 0
+        for xb in corpus:
+            a = f_int8(xb).asnumpy().argmax(1)
+            b = f_fp32(xb).asnumpy().argmax(1)
+            agree += int((a == b).sum())
+            n_total += len(a)
+        agreement = agree / max(n_total, 1)
+
+        def serve_arm(path):
+            srv = ModelServer.from_artifact(
+                path, slo_ms=8000.0 if smoke else 2000.0,
+                coalesce_ms=1.0)
+            srv.start(warm=True)
+            lat, shed = [], 0
+            t0 = time.perf_counter()
+            try:
+                sample = corpus[0][0]
+                handles = []
+                for _ in range(n_req):
+                    try:
+                        handles.append(srv.submit(sample))
+                    except ServeRejected:
+                        shed += 1
+                for h in handles:
+                    try:
+                        h.result(timeout=60)
+                        lat.append(h.latency_ms)
+                    except ServeRejected:
+                        shed += 1
+            finally:
+                wall = time.perf_counter() - t0
+                srv.drain(timeout=10.0)
+                srv.close()
+            lat.sort()
+            return {
+                "p50_ms": round(percentile(lat, 0.50), 3),
+                "p99_ms": round(percentile(lat, 0.99), 3),
+                "throughput_req_s": round(len(lat) / wall, 2)
+                if wall > 0 else None,
+                "completed": len(lat), "shed": shed,
+            }
+
+        int8_arm = serve_arm(p_int8)
+        if deadline.exceeded():
+            deadline.note("quantization:fp32_arm")
+            fp32_arm = None
+        else:
+            fp32_arm = serve_arm(p_fp32)
+        speedup = None
+        if fp32_arm and int8_arm["p50_ms"] and fp32_arm["p50_ms"]:
+            speedup = round(fp32_arm["p50_ms"] / int8_arm["p50_ms"], 3)
+        return {
+            "calib_mode": calib.mode,
+            "calib_batches": calib.num_batches,
+            "layers_quantized": len(
+                [w for w in quant.quantized_layers(qnet)
+                 if w.variant_op is not None]),
+            "train_steps": train_steps,
+            "agreement_top1": round(agreement, 4),
+            "accuracy_delta": round(1.0 - agreement, 4),
+            "autotune": {op: {"winner": r["winner"],
+                              "cached": bool(r.get("cached"))}
+                         for op, r in race.items()},
+            "artifact": {"quantized": info["quantized"],
+                         "param_dtypes": info["param_dtypes"]},
+            "int8": int8_arm,
+            "fp32": fp32_arm,
+            "speedup_p50": speedup,
+        }
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
 
 
 def _measure_serving(net, smoke, deadline):
@@ -1783,6 +1966,27 @@ def main(argv=None):
             out["degraded"] = True
             reasons.append(f"serving phase failed: {exc!r}")
     _write_partial(out, "serving")
+
+    # quantization INFERENCE phase (round 18): the calibrate ->
+    # rewrite -> race -> export -> AOT-serve chain on a trained net —
+    # top-1 agreement (accuracy delta vs the fp32 arm), p50/p99 and
+    # throughput per arm, and the persisted adoption winners land in
+    # the headline JSON
+    if deadline.exceeded(margin=0.0 if args.smoke else 60.0):
+        out["quantization"] = "skipped (deadline)"
+        out["degraded"] = True
+        reasons.append("deadline: skipped quantization phase")
+        deadline.note("quantization")
+    else:
+        _heartbeat("quantization")
+        try:
+            out["quantization"] = _measure_quantization(args.smoke,
+                                                        deadline)
+        except Exception as exc:  # auxiliary metric: never kill the run
+            out["quantization"] = {"error": repr(exc)}
+            out["degraded"] = True
+            reasons.append(f"quantization phase failed: {exc!r}")
+    _write_partial(out, "quantization")
 
     # fleet INFERENCE phase (round 15): 2 replica serving processes
     # behind the fault-tolerant router — bursty load over HTTP, a

@@ -476,6 +476,12 @@ def _bench_extra_inputs():
     quant = {
         "_contrib_quantize": ([a, onp.float32([0.0]),
                                onp.float32([1.0])], {}),
+        # round 18: the calibrated-range entry point the quantized
+        # rewrite stitches in front of every int8 layer — timed beside
+        # dot/Convolution/FullyConnected so the int8-vs-fp32 per-op
+        # ratio is visible in the benchdiff table
+        "_contrib_quantize_v2": (
+            [a], dict(min_calib_range=-1.0, max_calib_range=1.0)),
         "_contrib_requantize": (
             [onp.random.randint(-2**20, 2**20, (n, n)).astype("int32"),
              onp.float32([-1.0]), onp.float32([1.0])], {}),

@@ -59,6 +59,10 @@ opperf_smoke() {
     # keeps producing a committed OPPERF_*.jsonl artifact instead of
     # silently lapsing.  One JSON line per op lands in
     # OPPERF_smoke.jsonl (diffable across PRs).
+    # round 18: the curated _contrib_quantized_{conv,fully_connected}
+    # + _contrib_quantize_v2/_contrib_requantize rows run beside their
+    # fp32 counterparts (Convolution, FullyConnected), so the
+    # int8-vs-fp32 per-op ratio is visible in the benchdiff table
     JAX_PLATFORMS=cpu python benchmark/opperf.py --runs 8 --ops \
 dot,Convolution,BatchNorm,FullyConnected,softmax,SyncBatchNorm,\
 _contrib_BNReluConv,sgd_update,adam_update,multi_lars,\
@@ -66,7 +70,9 @@ _fused_bucket_sgd_mom_update,_fused_bucket_adam_update,\
 _fused_bucket_lars_update,_pallas_bucket_sgd_mom_update,\
 _pallas_bucket_adam_update,_pallas_bucket_lars_update,\
 _random_uniform,\
-_npi_interp,_npi_full_like,_contrib_quantize,MultiBoxPrior \
+_npi_interp,_npi_full_like,_contrib_quantize,_contrib_quantize_v2,\
+_contrib_requantize,_contrib_quantized_conv,\
+_contrib_quantized_fully_connected,MultiBoxPrior \
         | tee OPPERF_smoke.jsonl
 }
 
@@ -209,6 +215,22 @@ io_smoke() {
     # Also collected by tier-1 (tests/test_dataplane.py), so a
     # regression turns the unit suite red between CI runs.
     JAX_PLATFORMS=cpu python -m pytest tests/test_dataplane.py -q
+}
+
+quantize_smoke() {
+    # quantized-inference gate (round 18) on CPU in seconds: the
+    # quantize/dequantize/requantize error-bound units (uint8 affine +
+    # int8 symmetric), quantized FC/conv vs fp32 within calibrated
+    # tolerance, entropy-vs-naive calibration on a skewed-activation
+    # distribution, the int8 avg-pool round-to-nearest regression,
+    # the calibrated-vs-on-the-fly range parity, the adoption-race
+    # winner persistence across processes, and THE drill — calibrate
+    # a trained net on a synthetic corpus, rewrite to int8, export
+    # the CRC+meta-framed .mxje, relaunch-serve it AOT (run-log
+    # retrace counter 0) with top-1 agreement >= 99% vs the fp32 arm.
+    # Also collected by tier-1 (tests/test_quantization.py), so a
+    # regression turns the unit suite red between CI runs.
+    JAX_PLATFORMS=cpu python -m pytest tests/test_quantization.py -q
 }
 
 chaos_smoke() {

@@ -82,6 +82,7 @@ _LAZY = {
     "rtc": ".rtc",
     "library": ".library",
     "deploy": ".deploy",
+    "quantization": ".quantization",
     "resilience": ".resilience",
     "serving": ".serving",
     "telemetry": ".telemetry",

@@ -75,6 +75,15 @@ VARIANT_OPS = {
     # consulted only when the MXNET_DTYPE_LADDER knob arms it (a dtype
     # change is not numerics-neutral, so adoption is opt-in)
     "dtype_ladder": {"fp32": "fp32", "bf16": "bf16"},
+    # round 18: the int8 quantized-inference arms — a rewritten net's
+    # QuantizedConv/QuantizedDense wrappers consult these at trace
+    # (mxnet_tpu.quantization.rewrite): True runs the calibrated int8
+    # program, False the wrapped fp32 layer.  quantization.
+    # tune_quantized races them inside a chained run of the real
+    # inference forward, so int8 is adopted per (op, shape, platform)
+    # only where it measures a win.
+    "quantized_conv": {"fp32": False, "int8": True},
+    "quantized_fc": {"fp32": False, "int8": True},
 }
 
 
@@ -107,6 +116,18 @@ def _parse_bnreluconv(raw):
     return lowered if lowered in ("stock", "jnp", "pallas") else None
 
 
+def _parse_quantize(raw):
+    """MXNET_QUANTIZE: 0/off/fp32 pins the fp32 fallback arm,
+    1/on/int8 pins the int8 program; anything else (e.g. 'auto')
+    carries no override — the measured winner decides."""
+    lowered = raw.lower()
+    if lowered in ("0", "false", "no", "off", "fp32", "float32"):
+        return False
+    if lowered in ("1", "true", "yes", "on", "int8"):
+        return True
+    return None
+
+
 #: env var that explicitly overrides each variant op (precedence 2),
 #: with a per-op parser from the raw env string to the forced value
 #: (None = this raw value carries no override)
@@ -117,6 +138,10 @@ _ENV_OVERRIDE = {
     "dtype_ladder": ("MXNET_DTYPE_LADDER", _parse_ladder),
     "pallas_bnreluconv": ("MXNET_BNRELUCONV_VARIANT",
                           _parse_bnreluconv),
+    # round 18: ONE knob hand-overrides both int8 arms (the operator
+    # story is "quantization on/off", not per-op)
+    "quantized_conv": ("MXNET_QUANTIZE", _parse_quantize),
+    "quantized_fc": ("MXNET_QUANTIZE", _parse_quantize),
 }
 
 

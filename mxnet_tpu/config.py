@@ -390,6 +390,24 @@ register_env("MXNET_FLEET_HBM_BUDGET_MB", 0.0, float,
              "bytes fit next to the resident models, else a "
              "structured ServeRejected(reason='hbm_budget').  "
              "0 = unlimited.")
+register_env("MXNET_QUANTIZE", "", str,
+             "Hand override of the int8 quantized-inference adoption "
+             "race (mxnet_tpu.quantization; autotune variant ops "
+             "quantized_conv/quantized_fc): 0/off/fp32 pins every "
+             "rewritten layer to its fp32 fallback arm, 1/on/int8 "
+             "pins the int8 program.  Unset/auto: the in-step race's "
+             "persisted winner decides per (op, shape, platform).")
+register_env("MXNET_QUANT_CALIB_MODE", "naive", str,
+             "Default calibration mode of quantization.calibrate: "
+             "'naive' (running min/max per observed tensor) or "
+             "'entropy' (KL-divergence-optimal symmetric threshold "
+             "over an absolute-value histogram — the reference's "
+             "calib_mode='entropy' contract, robust to rare "
+             "outliers).")
+register_env("MXNET_QUANT_CALIB_BATCHES", 10, int,
+             "Default number of calibration batches "
+             "quantization.calibrate folds through the range "
+             "collector when the caller does not pass num_batches.")
 register_env("MXNET_FLEET_SCALE_EWMA", 0.2, float,
              "EWMA smoothing factor of the fleet autoscaler's "
              "queue-depth signal (serving.FleetRouter): each health-"

@@ -20,9 +20,19 @@ server restart hits first) raises a clean :class:`MXNetError` naming
 the path instead of an opaque deserialization crash.  Headerless
 artifacts from earlier rounds still load (magic sniff falls back to
 treating the whole file as the payload).
+
+Artifact metadata (round 18): the v2 frame carries a small JSON
+metadata segment between the header and the payload — input signature,
+``quantized`` flag and ``param_dtypes`` histogram — so operators and
+the fleet admission path can tell an int8 artifact from fp32 by
+reading a few hundred header bytes, WITHOUT deserializing the
+StableHLO program.  v1 and headerless artifacts keep loading; their
+``artifact_info`` falls back to deserialization (with the new fields
+None).
 """
 from __future__ import annotations
 
+import json
 import struct
 import zlib
 
@@ -31,11 +41,17 @@ import numpy as onp
 from .base import MXNetError
 
 __all__ = ["export_model", "load_model", "load_exported",
-           "stablehlo_text", "artifact_info"]
+           "stablehlo_text", "artifact_info", "read_artifact_meta"]
 
-#: artifact header: magic, then ``<IQ`` = CRC32(payload), len(payload)
+#: v1 artifact header: magic, then ``<IQ`` = CRC32(payload),
+#: len(payload)
 _MAGIC = b"MXJE\x01\n"
 _HEADER = struct.Struct("<IQ")
+#: v2 artifact header (round 18): magic, then ``<IQI`` =
+#: CRC32(meta_json + payload), len(payload), len(meta_json); the JSON
+#: metadata segment follows the header, the payload follows it
+_MAGIC2 = b"MXJE\x02\n"
+_HEADER2 = struct.Struct("<IQI")
 
 
 def _functional_forward(net):
@@ -45,15 +61,83 @@ def _functional_forward(net):
     return params, apply_fn
 
 
+def _net_meta(net, x, platforms):
+    """The v2 header metadata of an export: input signature,
+    ``quantized`` (does the program run int8 quantized layers) and a
+    ``param_dtypes`` histogram of the weights the program actually
+    bakes.  Must be computed under the same autotune program scope as
+    the export trace: a wrapper whose adoption race picked fp32 bakes
+    its fp32 original, and the header must say so — the identity
+    describes the PROGRAM, not the net's potential."""
+    dtype_counts = {}
+
+    def _count(dt):
+        dt = str(dt)
+        dtype_counts[dt] = dtype_counts.get(dt, 0) + 1
+
+    quantized = False
+    q_layers = 0
+
+    def _walk(block):
+        nonlocal quantized, q_layers
+        if getattr(block, "_mxnet_quantized", False):
+            if block.variant_op is None:
+                return  # pooling/flatten pass-through: no weights
+            if block._use_int8():
+                quantized = True
+                q_layers += 1
+                for dt in block.export_dtypes():
+                    _count(dt)
+                return  # the shadowed fp32 original is dead here
+            _walk(block._orig)  # fp32-armed: its original's weights
+            return
+        for p in getattr(block, "_reg_params", {}).values():
+            try:
+                _count(p.dtype)
+            except Exception:
+                pass
+        for child in getattr(block, "_children", {}).values():
+            _walk(child)
+
+    try:
+        _walk(net)
+    except Exception:
+        quantized, q_layers, dtype_counts = False, 0, {}
+    return {
+        "batch": int(x.shape[0]) if x.ndim else 1,
+        "item_shape": [int(s) for s in x.shape[1:]],
+        "dtype": str(x.dtype),
+        "platforms": list(platforms),
+        "quantized": bool(quantized),
+        "quantized_layers": int(q_layers),
+        "param_dtypes": dtype_counts,
+    }
+
+
 def export_model(net, example_input, path, platforms=("cpu", "tpu")):
     """Serialize ``net``'s inference forward (weights baked in) to
     ``path`` via jax.export.  ``example_input`` fixes shapes/dtypes
     (ndarray / numpy).  The default multi-platform lowering makes one
     artifact loadable on CPU hosts and TPU workers alike.  Returns
-    ``path``."""
+    ``path``.
+
+    Round 18: a SINGLE-platform export traces under the autotune
+    ``program_scope`` keyed on that platform, so persisted variant
+    winners — the int8-vs-fp32 quantization race above all — bake
+    into the exported program exactly as they would into a live
+    CachedOp.  A multi-platform export gets ONE traced program, which
+    cannot honor per-platform verdicts: cached winners do NOT apply
+    there (the exporting host's CPU verdict must not pin the TPU
+    lowering of an AOT artifact forever) — only explicit force scopes
+    / ``MXNET_QUANTIZE``-style env overrides decide.  The v2 frame
+    records ``quantized``/``param_dtypes`` metadata readable without
+    deserialization."""
+    import contextlib
+
     import jax
     from jax import export as jexport
 
+    from . import autotune as _at
     from .ndarray import NDArray
 
     x = example_input._data if isinstance(example_input, NDArray) \
@@ -65,34 +149,76 @@ def export_model(net, example_input, path, platforms=("cpu", "tpu")):
 
     from .resilience.checkpoint import atomic_write_bytes
 
-    exp = jexport.export(
-        jax.jit(infer),
-        platforms=platforms)(jax.ShapeDtypeStruct(x.shape, x.dtype))
+    scope = _at.program_scope(x.shape, x.dtype,
+                              platform=platforms[0]) \
+        if len(platforms) == 1 else contextlib.nullcontext()
+    with scope:
+        exp = jexport.export(
+            jax.jit(infer),
+            platforms=platforms)(jax.ShapeDtypeStruct(x.shape, x.dtype))
+        # metadata under the SAME scope: the quantized/param_dtypes
+        # identity must describe what this trace actually baked
+        meta_doc = _net_meta(net, x, platforms)
     blob = exp.serialize()
+    meta = json.dumps(meta_doc, sort_keys=True).encode("utf-8")
     # the resilience atomic writer (temp + fsync + rename + dir
     # fsync, temp cleaned up on failure) so a crash mid-export can
     # never leave a half-written file at the published path; the
     # header lets the loader verify length+CRC before deserializing
     atomic_write_bytes(
         path,
-        _MAGIC + _HEADER.pack(zlib.crc32(blob) & 0xFFFFFFFF,
-                              len(blob)) + blob,
+        _MAGIC2 + _HEADER2.pack(zlib.crc32(meta + blob) & 0xFFFFFFFF,
+                                len(blob), len(meta)) + meta + blob,
         inject_point=None)
+    if meta_doc.get("quantized"):
+        try:
+            from . import telemetry
+
+            telemetry.quantize(
+                "export", mode="",
+                layers=int(meta_doc["quantized_layers"]))
+        except Exception:
+            pass  # telemetry must never kill an export
     return path
 
 
-def _read_payload(path):
-    """Read + integrity-check an artifact; returns the serialized
-    payload bytes.  Headered files verify length+CRC32; headerless
-    (pre-round-13) files pass through whole."""
+def _read_meta_payload(path):
+    """Read + integrity-check an artifact; returns ``(meta, payload)``
+    where ``meta`` is the v2 header metadata dict (None for v1 /
+    headerless files).  v2 verifies CRC32 over meta+payload, v1 over
+    the payload; headerless (pre-round-13) files pass through whole."""
     try:
         with open(path, "rb") as f:
             data = f.read()
     except OSError as e:
         raise MXNetError(
             f"cannot read deploy artifact {path!r}: {e}") from e
+    if data.startswith(_MAGIC2):
+        off = len(_MAGIC2)
+        if len(data) < off + _HEADER2.size:
+            raise MXNetError(
+                f"corrupt deploy artifact {path!r}: truncated header "
+                f"({len(data)} bytes)")
+        crc, length, meta_len = _HEADER2.unpack_from(data, off)
+        body = data[off + _HEADER2.size:]
+        if len(body) != meta_len + length:
+            raise MXNetError(
+                f"corrupt deploy artifact {path!r}: body is "
+                f"{len(body)} bytes, header says {meta_len} metadata "
+                f"+ {length} payload (truncated or partially written)")
+        if zlib.crc32(body) & 0xFFFFFFFF != crc:
+            raise MXNetError(
+                f"corrupt deploy artifact {path!r}: CRC32 mismatch "
+                "(bit rot or torn write)")
+        try:
+            meta = json.loads(body[:meta_len].decode("utf-8"))
+        except ValueError as e:
+            raise MXNetError(
+                f"corrupt deploy artifact {path!r}: unparseable "
+                f"metadata segment ({e})") from e
+        return meta, body[meta_len:]
     if not data.startswith(_MAGIC):
-        return data  # legacy headerless artifact: best-effort load
+        return None, data  # legacy headerless: best-effort load
     off = len(_MAGIC)
     if len(data) < off + _HEADER.size:
         raise MXNetError(
@@ -109,7 +235,39 @@ def _read_payload(path):
         raise MXNetError(
             f"corrupt deploy artifact {path!r}: CRC32 mismatch "
             "(bit rot or torn write)")
-    return blob
+    return None, blob
+
+
+def _read_payload(path):
+    return _read_meta_payload(path)[1]
+
+
+def read_artifact_meta(path):
+    """The v2 header metadata WITHOUT reading the payload: opens the
+    file, reads magic + header + the (small) metadata segment, and
+    stops.  No CRC verification — the caller is expected to have
+    loaded (and therefore verified) the artifact through
+    ``load_exported``/``from_artifact`` already; this is the cheap
+    identity probe for residency reports and admission logs.  Returns
+    None for v1/headerless artifacts or on any read problem."""
+    try:
+        with open(path, "rb") as f:
+            head = f.read(len(_MAGIC2) + _HEADER2.size)
+            if not head.startswith(_MAGIC2) \
+                    or len(head) < len(_MAGIC2) + _HEADER2.size:
+                return None
+            _, _, meta_len = _HEADER2.unpack_from(head, len(_MAGIC2))
+            if meta_len > (1 << 20):
+                return None  # implausible header: refuse to trust it
+            meta = f.read(meta_len)
+            if len(meta) != meta_len:
+                return None
+            doc = json.loads(meta.decode("utf-8"))
+            # consumers cache this and .get() into it: anything but
+            # an object is not artifact metadata
+            return doc if isinstance(doc, dict) else None
+    except (OSError, ValueError):
+        return None
 
 
 def load_exported(path):
@@ -134,13 +292,28 @@ def load_exported(path):
 def artifact_info(path):
     """Shape/dtype metadata of an artifact's input signature without
     building the runner: ``{"batch", "item_shape", "dtype",
-    "platforms"}`` — what a serving bucket plan needs."""
+    "platforms", "quantized", "param_dtypes"}`` — what a serving
+    bucket plan and the fleet admission path need.  A v2 artifact
+    answers from its verified header metadata alone (a few hundred
+    bytes, NO deserialization — an operator can tell an int8 artifact
+    from fp32 before any program builds); v1/headerless artifacts fall
+    back to deserializing, with the round-18 fields None."""
+    meta, _ = _read_meta_payload(path)
+    if meta is not None:
+        return {"batch": int(meta["batch"]),
+                "item_shape": tuple(int(s)
+                                    for s in meta["item_shape"]),
+                "dtype": str(meta["dtype"]),
+                "platforms": tuple(meta.get("platforms", ())),
+                "quantized": meta.get("quantized"),
+                "param_dtypes": meta.get("param_dtypes")}
     exp = load_exported(path)
     aval = exp.in_avals[0]
     return {"batch": int(aval.shape[0]),
             "item_shape": tuple(int(s) for s in aval.shape[1:]),
             "dtype": str(aval.dtype),
-            "platforms": tuple(getattr(exp, "platforms", ()) or ())}
+            "platforms": tuple(getattr(exp, "platforms", ()) or ()),
+            "quantized": None, "param_dtypes": None}
 
 
 def load_model(path):

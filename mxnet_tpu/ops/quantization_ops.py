@@ -161,10 +161,21 @@ def quantized_pooling(data, data_min, data_max, *, kernel=(),
     the quantization range."""
     from .conv import pooling as _pooling
 
-    out = _pooling(data.astype(jnp.int32), kernel=kernel,
-                   pool_type=pool_type, global_pool=global_pool,
-                   stride=stride, pad=pad,
-                   pooling_convention=pooling_convention)
+    if pool_type == "avg":
+        # the average accumulates in float; the cast back to the int8
+        # code domain must round to NEAREST (round-18 fix: astype alone
+        # truncates toward zero, biasing every averaged window toward 0
+        # vs the dequantized-fp32 reference)
+        out = _pooling(data.astype(jnp.float32), kernel=kernel,
+                       pool_type=pool_type, global_pool=global_pool,
+                       stride=stride, pad=pad,
+                       pooling_convention=pooling_convention)
+        out = jnp.rint(out)
+    else:
+        out = _pooling(data.astype(jnp.int32), kernel=kernel,
+                       pool_type=pool_type, global_pool=global_pool,
+                       stride=stride, pad=pad,
+                       pooling_convention=pooling_convention)
     return out.astype(data.dtype), data_min, data_max
 
 

@@ -1,0 +1,59 @@
+"""Quantized inference subsystem (round 18).
+
+Reference parity: ``mxnet.contrib.quantization`` (SURVEY:
+src/operator/quantization/, 6,057 LoC) — the calibrate -> graph-rewrite
+-> int8-execute pipeline, carried here all the way to a SERVED
+artifact:
+
+1. :func:`calibrate` runs a calibration iterator through the trained
+   Gluon block (forward hooks) or Module (symbol-internals taps),
+   collecting per-tensor ranges — ``naive`` min/max or ``entropy``
+   KL-optimal thresholds — with ``excluded_names`` as the per-layer
+   escape hatch.
+2. :func:`quantize_net` rewrites eligible layers into
+   ``quantized_conv`` / ``quantized_fully_connected`` /
+   ``quantized_pooling`` / ``quantized_flatten`` wrappers with
+   calibrated ``quantize_v2`` / ``requantize`` / ``dequantize``
+   stitching and fp32 fallback for everything else.
+3. :func:`tune_quantized` races the int8 arms against fp32 inside a
+   jitted chained run of the real forward (autotune VARIANT_OPS
+   ``quantized_fc`` / ``quantized_conv``); adoption is per
+   (op, shape, platform) by MEASUREMENT, winners persisted in
+   ``autotune.json``; ``MXNET_QUANTIZE`` is the hand override.
+4. ``deploy.export_model`` serializes the quantized program into the
+   CRC-framed ``.mxje`` format (now carrying ``quantized`` /
+   ``param_dtypes`` header metadata) and
+   ``serving.ModelServer.from_artifact`` serves it AOT —
+   load-not-retrace, retrace counter 0 — with ``fleet.rolling_swap``
+   upgrading a live fleet fp32 -> int8 under traffic.
+
+Env knobs (config.py): ``MXNET_QUANTIZE`` (hand override of the
+adoption race), ``MXNET_QUANT_CALIB_MODE``,
+``MXNET_QUANT_CALIB_BATCHES``.
+"""
+from .calibrate import (  # noqa: F401
+    QUANTIZABLE_OPS,
+    CalibrationResult,
+    TensorStats,
+    calibrate,
+    calibrate_block,
+    calibrate_module,
+    optimal_threshold,
+)
+from .rewrite import (  # noqa: F401
+    QuantizedConv,
+    QuantizedDense,
+    QuantizedFlatten,
+    QuantizedPooling,
+    quantize_net,
+    quantized_layers,
+    tune_quantized,
+)
+
+__all__ = [
+    "calibrate", "calibrate_block", "calibrate_module",
+    "CalibrationResult", "TensorStats", "optimal_threshold",
+    "QUANTIZABLE_OPS", "quantize_net", "tune_quantized",
+    "quantized_layers", "QuantizedDense", "QuantizedConv",
+    "QuantizedPooling", "QuantizedFlatten",
+]

@@ -1,0 +1,460 @@
+"""Net rewrite — a calibrated fp32 Gluon net becomes an int8 program.
+
+Reference parity: python/mxnet/contrib/quantization.py ``quantize_graph``
+— the graph pass that swaps eligible FullyConnected / Convolution /
+Pooling / Flatten nodes for ``quantized_*`` ops with
+``quantize_v2`` / ``requantize`` / ``dequantize`` stitching, leaving
+everything else fp32.  TPU-native shape: the pass runs over the Gluon
+block tree — each eligible leaf is replaced by a wrapper block that
+holds the ORIGINAL layer as a child (the fp32 fallback arm) plus its
+weights pre-quantized to symmetric int8, and whose forward either
+
+* runs the int8 program: calibrated ``quantize_v2`` on the input,
+  ``quantized_fully_connected`` / ``quantized_conv`` accumulating int32
+  on the MXU (``preferred_element_type``), calibrated ``requantize`` /
+  ``dequantize`` on the way out; or
+* falls back to the wrapped fp32 layer,
+
+decided at TRACE time by the autotune variant registry
+(``quantized_fc`` / ``quantized_conv`` in ``autotune.VARIANT_OPS``) —
+so quantization is adopted per (op, shape, platform) only where the
+in-step race measured a win, with ``MXNET_QUANTIZE`` as the hand
+override (round-9 precedence ladder).
+
+Stitching: inside a (Hybrid)Sequential, consecutive quantized layers
+pass the quantized triple ``(int8 data, min, max)`` straight through —
+no dequantize/quantize pair between them; Pooling/Flatten wrappers are
+range-preserving pass-throughs that only engage when their input
+arrives quantized.  A wrapper that receives a quantized triple while
+its own decision says fp32 dequantizes first, so MIXED per-layer
+decisions always compose correctly.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..gluon.block import HybridBlock
+
+__all__ = ["quantize_net", "tune_quantized", "QuantizedDense",
+           "QuantizedConv", "QuantizedPooling", "QuantizedFlatten",
+           "quantized_layers"]
+
+_INT8_RANGE = 127.0
+
+
+def _quantize_weight(arr):
+    """Symmetric per-tensor int8 of a weight array (host-side, once at
+    rewrite): returns (int8 numpy, min, max) with max = |w|_inf so the
+    quantized op's scale recovery is exact."""
+    w = onp.asarray(arr, dtype="float32")
+    amax = float(onp.abs(w).max()) or 1.0
+    q = onp.clip(onp.rint(w * (_INT8_RANGE / amax)),
+                 -127, 127).astype("int8")
+    return q, -amax, amax
+
+
+def _is_qtensor(x):
+    return isinstance(x, (list, tuple)) and len(x) == 3
+
+
+class _QuantizedLayer(HybridBlock):
+    """Shared wrapper machinery: the original layer rides as the
+    ``_orig`` child (its Parameters stay collectable — the fp32
+    fallback arm and checkpoint compatibility), int8 constants live as
+    plain NDArray attributes baked into the traced program, and the
+    int8-vs-fp32 decision is consulted per trace through the autotune
+    registry."""
+
+    #: name in autotune.VARIANT_OPS ("quantized_fc"/"quantized_conv");
+    #: None = structural (pooling/flatten follow their input's form)
+    variant_op = None
+    _mxnet_quantized = True
+
+    def __init__(self, orig, in_range=None, out_range=None,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._orig = orig
+        self._in_range = tuple(float(v) for v in in_range) \
+            if in_range else None
+        self._out_range = tuple(float(v) for v in out_range) \
+            if out_range else None
+        #: stitching flags set by quantize_net's Sequential pass
+        self.emit_q = False
+        self.accept_q = False
+
+    def _use_int8(self):
+        """Trace-time adoption decision: the autotune precedence ladder
+        (force scope > MXNET_QUANTIZE > cached per-program winner >
+        default int8 — the layer was rewritten on purpose)."""
+        if self.variant_op is None:
+            return True
+        from .. import autotune as _at
+
+        return bool(_at.variant_choice(self.variant_op, default=True))
+
+    def _dequant(self, F, q):
+        from .. import ndarray as nd
+
+        return nd.invoke("_contrib_dequantize", list(q))
+
+    def _quant_in(self, F, x):
+        """fp32 input -> calibrated (int8, min, max) triple."""
+        from .. import ndarray as nd
+
+        if self._in_range is None:
+            return nd.invoke("_contrib_quantize_v2", [x])
+        return nd.invoke("_contrib_quantize_v2", [x],
+                         min_calib_range=self._in_range[0],
+                         max_calib_range=self._in_range[1])
+
+    def export_dtypes(self):
+        """dtype strings of the weights THIS wrapper bakes into an
+        exported int8 program (deploy.export_model's param_dtypes
+        metadata reads these instead of the shadowed fp32 originals)."""
+        return []
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._orig!r})"
+
+
+class _QuantizedCompute(_QuantizedLayer):
+    """Shared machinery of the WEIGHTED wrappers (Dense/Conv): int8
+    weight+bias baking at construction, and the one forward skeleton —
+    adoption consult, q-triple/fp32 input adaptation, the int8 op
+    (subclass ``_invoke``), then requantize-to-triple or
+    dequantize(+activation) on the way out."""
+
+    def _bake_weights(self, w_param, b_param, n_out):
+        from .. import ndarray as nd
+
+        wq, wmin, wmax = _quantize_weight(w_param.data().asnumpy())
+        self._wq = nd.array(wq, dtype="int8")
+        self._wmin, self._wmax = nd.array([wmin]), nd.array([wmax])
+        self._no_bias = b_param is None
+        if self._no_bias:
+            bq, bmin, bmax = onp.zeros(n_out, "int8"), -1.0, 1.0
+        else:
+            bq, bmin, bmax = _quantize_weight(b_param.data().asnumpy())
+        self._bq = nd.array(bq, dtype="int8")
+        self._bmin, self._bmax = nd.array([bmin]), nd.array([bmax])
+
+    def _invoke(self, q):
+        """Run the int8 op on the quantized input triple ``q``;
+        returns the (int32 acc, min, max) triple."""
+        raise NotImplementedError
+
+    def hybrid_forward(self, F, x):
+        from .. import ndarray as nd
+
+        q_in = _is_qtensor(x)
+        if not self._use_int8():
+            return self._orig(self._dequant(F, x) if q_in else x)
+        q = tuple(x) if q_in else self._quant_in(F, x)
+        acc, omin, omax = self._invoke(q)
+        act = getattr(self._orig, "act", None)
+        if self.emit_q and act is None:
+            kw = {}
+            if self._out_range is not None:
+                kw = {"min_calib_range": self._out_range[0],
+                      "max_calib_range": self._out_range[1]}
+            return list(nd.invoke("_contrib_requantize",
+                                  [acc, omin, omax], **kw))
+        out = nd.invoke("_contrib_dequantize", [acc, omin, omax])
+        return act(out) if act is not None else out
+
+    def export_dtypes(self):
+        return ["int8"] if self._no_bias else ["int8", "int8"]
+
+
+class QuantizedDense(_QuantizedCompute):
+    """INT8 Dense: calibrated input quantize + int8 x int8 -> int32 FC
+    (``_contrib_quantized_fully_connected``), requantized to int8 when
+    the next layer consumes quantized data, dequantized to fp32
+    otherwise; the wrapped fp32 Dense is the fallback arm."""
+
+    variant_op = "quantized_fc"
+
+    def __init__(self, dense, in_range=None, out_range=None, **kw):
+        super().__init__(dense, in_range, out_range, **kw)
+        self._units = int(dense.weight.shape[0])
+        self._flatten = bool(dense._flatten)
+        self._bake_weights(dense.weight, dense.bias, self._units)
+
+    def _invoke(self, q):
+        from .. import ndarray as nd
+
+        return nd.invoke(
+            "_contrib_quantized_fully_connected",
+            [q[0], self._wq, self._bq, q[1], q[2],
+             self._wmin, self._wmax, self._bmin, self._bmax],
+            num_hidden=self._units, no_bias=self._no_bias,
+            flatten=self._flatten)
+
+
+class QuantizedConv(_QuantizedCompute):
+    """INT8 convolution (``_contrib_quantized_conv``): channel-first
+    layouts only (the int8 op's dimension numbers); same adoption /
+    stitching contract as :class:`QuantizedDense`."""
+
+    variant_op = "quantized_conv"
+
+    def __init__(self, conv, in_range=None, out_range=None, **kw):
+        super().__init__(conv, in_range, out_range, **kw)
+        if conv._channel_last:
+            raise MXNetError(
+                f"{conv.name}: channel-last convolutions are not "
+                "quantizable (int8 conv is NCHW/NCW)")
+        k = conv._kwargs
+        self._conv_kw = dict(
+            kernel=tuple(k["kernel"]), num_filter=int(k["num_filter"]),
+            stride=tuple(k["stride"]), pad=tuple(k["pad"]),
+            dilate=tuple(k["dilate"]), num_group=int(k["num_group"]))
+        self._bake_weights(conv.weight, conv.bias,
+                           self._conv_kw["num_filter"])
+
+    def _invoke(self, q):
+        from .. import ndarray as nd
+
+        return nd.invoke(
+            "_contrib_quantized_conv",
+            [q[0], self._wq, self._bq, q[1], q[2],
+             self._wmin, self._wmax, self._bmin, self._bmax],
+            no_bias=self._no_bias, **self._conv_kw)
+
+
+class QuantizedPooling(_QuantizedLayer):
+    """Range-preserving int8 pooling: engages only when the input
+    arrives as a quantized triple (a standalone quantize-pool-dequant
+    sandwich would only add error); fp32 inputs run the wrapped
+    layer."""
+
+    def __init__(self, pool, **kw):
+        super().__init__(pool, **kw)
+        k = pool._kwargs
+        self._pool_kw = dict(
+            kernel=tuple(k["kernel"]), pool_type=k["pool_type"],
+            global_pool=bool(k["global_pool"]),
+            stride=tuple(k["stride"]), pad=tuple(k["pad"]),
+            pooling_convention=k["pooling_convention"])
+
+    def hybrid_forward(self, F, x):
+        from .. import ndarray as nd
+
+        if not _is_qtensor(x):
+            return self._orig(x)
+        q = nd.invoke("_contrib_quantized_pooling", list(x),
+                      **self._pool_kw)
+        if self.emit_q:
+            return list(q)
+        return self._dequant(F, q)
+
+
+class QuantizedFlatten(_QuantizedLayer):
+    """int8 flatten — pure pass-through of the quantization range."""
+
+    def hybrid_forward(self, F, x):
+        from .. import ndarray as nd
+
+        if not _is_qtensor(x):
+            return self._orig(x)
+        q = nd.invoke("_contrib_quantized_flatten", list(x))
+        if self.emit_q:
+            return list(q)
+        return self._dequant(F, q)
+
+
+def _can_emit_q(wrapper):
+    """True when the wrapper can hand an int8 triple to its successor
+    (a fused activation forces the fp32 boundary)."""
+    if isinstance(wrapper, (QuantizedPooling, QuantizedFlatten)):
+        return True
+    return getattr(wrapper._orig, "act", None) is None
+
+
+def _eligible(child, calib, excluded):
+    """Which wrapper class (or None) this leaf swaps to under the
+    calibration result."""
+    from ..gluon.nn.basic_layers import Dense, Flatten
+    from ..gluon.nn.conv_layers import _Conv, _Pooling
+
+    if child.name in excluded:
+        return None
+    if isinstance(child, Dense):
+        return QuantizedDense if child.name in calib else None
+    if isinstance(child, _Conv):
+        if child._op_name != "Convolution" or child._channel_last:
+            return None
+        return QuantizedConv if child.name in calib else None
+    if isinstance(child, _Pooling):
+        kw = child._kwargs
+        if kw["pool_type"] not in ("max", "avg"):
+            return None
+        if kw.get("count_include_pad") is False:
+            return None  # the int8 pooling op has no exclude-pad path
+        return QuantizedPooling
+    if isinstance(child, Flatten):
+        return QuantizedFlatten
+    return None
+
+
+def quantized_layers(net):
+    """Every quantized wrapper under ``net`` (rewrite introspection /
+    the deploy metadata scan)."""
+    found = []
+
+    def _walk(block):
+        if getattr(block, "_mxnet_quantized", False):
+            found.append(block)
+            return  # never descend into the shadowed fp32 original
+        for child in block._children.values():
+            _walk(child)
+
+    _walk(net)
+    return found
+
+
+def quantize_net(net, calib, excluded_names=()):
+    """Rewrite ``net`` IN PLACE: every calibrated Dense/Conv leaf (and
+    every Pooling/Flatten adjacent to one inside a Sequential) becomes
+    its quantized wrapper; everything else — norms, activations,
+    embeddings, channel-last convs, excluded names — stays fp32.
+    Returns ``net``.
+
+    ``calib`` is the :class:`~.calibrate.CalibrationResult`;
+    ``excluded_names`` extends its exclusion set (union — either
+    escape hatch wins)."""
+    from ..gluon.nn.basic_layers import HybridSequential, Sequential
+
+    excluded = set(excluded_names) | set(calib.excluded)
+    swapped = []
+
+    def _swap_in(parent, name, child, cls):
+        if cls in (QuantizedPooling, QuantizedFlatten):
+            wrapper = cls(child)
+        else:
+            wrapper = cls(child, in_range=calib.range(child.name, "in"),
+                          out_range=calib.range(child.name, "out"))
+        parent._children[name] = wrapper
+        # attribute-style blocks (self.fc = Dense(...)) resolve
+        # children through __dict__, not _children — swap both
+        for attr, val in list(vars(parent).items()):
+            if val is child:
+                object.__setattr__(parent, attr, wrapper)
+        swapped.append(wrapper)
+        return wrapper
+
+    def _walk(parent):
+        seq = isinstance(parent, (Sequential, HybridSequential))
+        for name, child in list(parent._children.items()):
+            cls = _eligible(child, calib, excluded)
+            if cls in (QuantizedPooling, QuantizedFlatten) and not seq:
+                cls = None  # chain-only layers need a Sequential seam
+            if cls is not None:
+                _swap_in(parent, name, child, cls)
+            else:
+                _walk(child)
+        if seq:
+            _stitch(list(parent._children.values()))
+
+    def _stitch(children):
+        """Consecutive wrappers exchange int8 triples directly; a
+        pooling/flatten wrapper only counts once something upstream
+        actually produces int8 (a chain must START at a conv/fc)."""
+        for i, cur in enumerate(children[:-1]):
+            nxt = children[i + 1]
+            if not (getattr(cur, "_mxnet_quantized", False)
+                    and getattr(nxt, "_mxnet_quantized", False)):
+                continue
+            if not _can_emit_q(cur):
+                continue
+            if isinstance(cur, (QuantizedPooling, QuantizedFlatten)) \
+                    and not cur.accept_q:
+                continue  # nothing quantized flows into cur anyway
+            cur.emit_q = True
+            nxt.accept_q = True
+
+    _walk(net)
+    n_q = len([w for w in swapped
+               if not isinstance(w, (QuantizedPooling,
+                                     QuantizedFlatten))])
+    if n_q == 0:
+        raise MXNetError(
+            "quantize_net: no quantizable layer carries a calibrated "
+            "range (check excluded_names / the calibration data)")
+    try:
+        from .. import telemetry
+
+        telemetry.quantize("rewrite", mode=calib.mode,
+                           layers=len(swapped),
+                           excluded=len(excluded))
+    except Exception:
+        pass  # telemetry must never kill a rewrite
+    return net
+
+
+def tune_quantized(net, sample_x, iters=8, level=None):
+    """Adoption by measurement (round-9 contract): race the rewritten
+    net's int8 arms against fp32 INSIDE one jitted chained run of the
+    real inference forward — ``quantized_fc`` and ``quantized_conv``
+    race independently (greedy, earlier winners pinned), winners
+    persist in ``autotune.json`` keyed (op, input shape, dtype,
+    platform, mesh) and apply at every later trace through
+    ``program_scope`` (CachedOp, make_train_step, export_model).
+    A warm cache answers without compiling anything.
+
+    Returns the per-op report ``{op: {"winner", "cached"/"timings"}}``
+    (empty when autotune is off)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import autotune as _at
+    from ..parallel import functionalize
+
+    lvl = _at.autotune_level() if level is None else int(level)
+    if lvl < 1:
+        return {}
+    present = {w.variant_op for w in quantized_layers(net)
+               if w.variant_op is not None}
+    race = [op for op in ("quantized_conv", "quantized_fc")
+            if op in present]
+    if not race:
+        return {}
+    params, apply_fn = functionalize(net, train=False)
+    x = jnp.asarray(onp.asarray(
+        sample_x._data if hasattr(sample_x, "_data") else sample_x))
+    try:
+        plat = jax.local_devices()[0].platform
+    except Exception:
+        plat = None
+
+    def body(carry, i):
+        y = apply_fn(params, carry)
+        # thread a zero-valued dependency through the carry so the
+        # fori_loop iterations serialize (chain_time methodology)
+        return carry + (jnp.sum(y) * 0).astype(carry.dtype)
+
+    report = {}
+    decided = {}
+    for op in race:
+        def measure(_value, _decided=dict(decided)):
+            with _at.force(**_decided):
+                return _at.chain_time(body, x, iters=iters)
+
+        winner, info = _at.tune(
+            op, x.shape, x.dtype, _at.VARIANT_OPS[op], measure,
+            platform=plat, level=lvl)
+        if winner is not None:
+            decided[op] = _at.VARIANT_OPS[op][winner]
+            report[op] = {"winner": winner, **info}
+    try:
+        from .. import telemetry
+
+        telemetry.quantize(
+            "race", mode="",
+            layers=len([r for r in report.values()
+                        if r["winner"] == "int8"]),
+            excluded=0)
+    except Exception:
+        pass
+    return report

@@ -85,6 +85,22 @@ faultsim.register_point(
                   "(crash = mid-swap death)")
 
 
+def _artifact_identity(path):
+    """The v2 header's metadata (quantized / param_dtypes / signature)
+    for the residency report — strictly a header+metadata read (a few
+    hundred bytes), never the payload, never a deserialize: the load
+    path already read and CRC-verified the artifact through
+    ``from_artifact``, so a third full read here would sit on the
+    load/swap critical path for nothing.  Pre-round-18 artifacts
+    (no metadata segment) report None."""
+    try:
+        from .. import deploy
+
+        return deploy.read_artifact_meta(path)
+    except Exception:
+        return None
+
+
 def artifact_reserved_bytes(path):
     """Reserved device bytes of a ``.mxje`` artifact's program — the
     HBM-budget admission input.  Preferred source: the round-10
@@ -146,6 +162,7 @@ class ModelHost:
         self._models = {}     # name -> live ModelServer
         self._reserved = {}   # name -> reserved bytes
         self._paths = {}      # name -> artifact path
+        self._info = {}       # name -> artifact_info header metadata
         self._load_kw = {}    # name -> per-model load() overrides
         self._pending = {}    # name -> reserved bytes mid-load/swap
         self.stats = {"loads": 0, "hbm_rejected": 0, "swaps": 0,
@@ -170,9 +187,20 @@ class ModelHost:
             return {
                 "budget_bytes": self.budget_bytes or None,
                 "used_bytes": self.used_bytes(),
-                "models": {name: {"reserved_bytes": self._reserved[name],
-                                  "path": self._paths[name]}
-                           for name in sorted(self._models)},
+                "models": {
+                    name: {
+                        "reserved_bytes": self._reserved[name],
+                        "path": self._paths[name],
+                        # round 18: the artifact header's identity —
+                        # an operator (or the swap admission below)
+                        # tells an int8 artifact from fp32 without
+                        # deserializing any program
+                        "quantized": (self._info.get(name) or
+                                      {}).get("quantized"),
+                        "param_dtypes": (self._info.get(name) or
+                                         {}).get("param_dtypes"),
+                    }
+                    for name in sorted(self._models)},
             }
 
     def _admit_locked(self, name, reserved, exclude=None):
@@ -220,11 +248,13 @@ class ModelHost:
             with self._lock:
                 self._pending.pop(name, None)
             raise
+        info = _artifact_identity(path)
         with self._lock:
             self._pending.pop(name, None)
             self._models[name] = srv
             self._reserved[name] = reserved
             self._paths[name] = str(path)
+            self._info[name] = info
             self._load_kw[name] = dict(kw)  # swaps must keep these
             self.stats["loads"] += 1
         ModelServer._telemetry_event(
@@ -241,6 +271,7 @@ class ModelHost:
             srv = self._models.pop(name, None)
             self._reserved.pop(name, None)
             self._paths.pop(name, None)
+            self._info.pop(name, None)
             self._load_kw.pop(name, None)
         if srv is None:
             raise MXNetError(f"model {name!r} not resident "
@@ -352,11 +383,13 @@ class ModelHost:
         # cutover between batches: new submits route to the new
         # server the moment the pointer moves; the old server's
         # in-flight batches finish in its drain
+        info = _artifact_identity(path)
         with self._lock:
             self._pending.pop(name, None)
             self._models[name] = new
             self._reserved[name] = reserved
             self._paths[name] = str(path)
+            self._info[name] = info
             self.stats["swaps"] += 1
         old.drain(timeout=30.0)
         old.close()

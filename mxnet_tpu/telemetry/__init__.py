@@ -34,6 +34,7 @@ from .runlog import (  # noqa: F401
     gauge,
     heal,
     program_report,
+    quantize,
     reset,
 )
 from .session import FitSession, fit_session  # noqa: F401
@@ -42,7 +43,8 @@ from .watchdog import Watchdog, stack_path_for  # noqa: F401
 __all__ = [
     "RunLog", "current", "reset", "close", "compile_event",
     "compile_fingerprint", "event", "count", "gauge", "heal",
-    "data_plane", "checkpoint_event", "program_report", "flight_dump",
+    "data_plane", "quantize", "checkpoint_event", "program_report",
+    "flight_dump",
     "flight_path_for", "describe_program", "FitSession",
     "fit_session", "schema", "Watchdog", "stack_path_for",
     "numerics", "opstats",

@@ -50,8 +50,8 @@ import time
 
 __all__ = ["RunLog", "current", "reset", "close", "compile_event",
            "compile_fingerprint", "event", "count", "gauge", "heal",
-           "checkpoint_event", "program_report", "flight_dump",
-           "describe_program", "flight_path_for"]
+           "quantize", "checkpoint_event", "program_report",
+           "flight_dump", "describe_program", "flight_path_for"]
 
 _LOCK = threading.RLock()
 _STATE = {"log": None, "resolved": False}
@@ -523,6 +523,24 @@ class RunLog:
                 f"data:{action}", "telemetry",
                 args=_jsonable(fields), tid=_TRACE_TID)
 
+    def quantize(self, action, *, mode="", layers=0, excluded=0,
+                 **fields):
+        """One quantized-inference pipeline observation
+        (mxnet_tpu.quantization): a calibration pass, a net rewrite,
+        an adoption race or an export — which mode ran and how many
+        layers it touched."""
+        self._write({"type": "quantize", "t": round(self._now(), 6),
+                     "action": str(action), "mode": str(mode),
+                     "layers": int(layers), "excluded": int(excluded),
+                     **_jsonable(fields)})
+        from .. import profiler
+
+        if profiler.is_running():
+            self._trace_meta()
+            profiler.record_instant(
+                f"quantize:{action}", "telemetry",
+                args=_jsonable(fields), tid=_TRACE_TID)
+
     def opstats(self, rows, source="profiler"):
         """The aggregate per-op table (telemetry.opstats) as one
         ``program_report``-style record."""
@@ -759,6 +777,13 @@ def data_plane(action, *, workers=0, **fields):
     rl = current()
     if rl is not None:
         rl.data_plane(action, workers=workers, **fields)
+
+
+def quantize(action, *, mode="", layers=0, excluded=0, **fields):
+    rl = current()
+    if rl is not None:
+        rl.quantize(action, mode=mode, layers=layers,
+                    excluded=excluded, **fields)
 
 
 def checkpoint_event(prefix, version, duration_s, nbytes, **extra):

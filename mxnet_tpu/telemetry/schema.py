@@ -38,7 +38,8 @@ from __future__ import annotations
 __all__ = ["STEP_FIELDS", "RECORD_TYPES", "COMPILE_CAUSES",
            "OPSTATS_ROW_FIELDS", "TENSOR_STATS_ROW_FIELDS",
            "SERVE_FIELDS", "FLEET_FIELDS", "HEAL_FIELDS",
-           "DATA_FIELDS", "validate_record", "validate_lines"]
+           "DATA_FIELDS", "QUANT_FIELDS", "validate_record",
+           "validate_lines"]
 
 #: step-record contract: field -> (types, required).  ``None`` is legal
 #: for optional measurements (loss on an unsampled step, feed stats
@@ -67,7 +68,8 @@ STEP_FIELDS = {
 
 RECORD_TYPES = ("run_start", "step", "compile", "program_report",
                 "checkpoint", "watchdog", "opstats", "tensor_stats",
-                "serve", "fleet", "heal", "data", "event", "run_end")
+                "serve", "fleet", "heal", "data", "quantize", "event",
+                "run_end")
 
 #: per-batch contract of a ``serve`` record (serving.ModelServer)
 SERVE_FIELDS = {
@@ -125,6 +127,19 @@ DATA_FIELDS = {
     "workers": (int, True),       # pool size (0 = single producer)
     "skipped": (int, True),       # cumulative data_records_skipped
     "respawns": (int, True),      # cumulative io_worker_respawns
+}
+
+#: per-observation contract of a ``quantize`` record
+#: (mxnet_tpu.quantization): one calibrate / rewrite / race / export
+#: observation — which mode ran and how many layers the pass touched,
+#: so an armed run log names exactly what the int8 pipeline did
+QUANT_FIELDS = {
+    "type": (str, True),
+    "t": ((int, float), True),
+    "action": (str, True),        # calibrate|rewrite|race|export
+    "mode": (str, True),          # naive|entropy ('' when n/a)
+    "layers": (int, True),        # layers the action touched/adopted
+    "excluded": (int, True),      # layers fenced off by the caller
 }
 
 #: per-op row contract of an ``opstats`` record (telemetry.opstats)
@@ -238,6 +253,8 @@ def validate_record(rec):
         return _check_fields(rec, HEAL_FIELDS)
     if t == "data":
         return _check_fields(rec, DATA_FIELDS)
+    if t == "quantize":
+        return _check_fields(rec, QUANT_FIELDS)
     if t == "event":
         return _check_fields(rec, {"t": ((int, float), True),
                                    "kind": (str, True)})

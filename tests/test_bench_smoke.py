@@ -172,6 +172,35 @@ def test_smoke_emits_valid_json_with_heartbeats():
     # steady state re-pads to warmed buckets: no post-warm traces
     assert srv["steady_state_traces"] == 0
     assert srv["breaker"] == "closed"
+    # the quantization INFERENCE phase (round 18): the calibrate ->
+    # rewrite -> race -> export -> AOT-serve chain on a trained net
+    qt = out["quantization"]
+    assert qt["calib_mode"] == "entropy"
+    assert qt["calib_batches"] >= 1
+    assert qt["layers_quantized"] >= 2
+    # the acceptance bar: int8 answers agree with the fp32 arm
+    assert qt["agreement_top1"] >= 0.99, qt
+    assert qt["accuracy_delta"] <= 0.01
+    # the adoption race ran (or answered from cache) for both arms
+    assert set(qt["autotune"]) == {"quantized_conv", "quantized_fc"}
+    for op, rep in qt["autotune"].items():
+        assert rep["winner"] in ("fp32", "int8"), (op, rep)
+    # the exported artifact identifies itself as int8 from the header
+    assert qt["artifact"]["quantized"] is True
+    assert qt["artifact"]["param_dtypes"].get("int8", 0) >= 2
+    # both arms served AOT with latency/throughput measured (the fp32
+    # arm is legitimately None only when the phase deadline expired
+    # between arms — the data_plane precedent: degrade, don't crash)
+    arms = ["int8"] + (["fp32"] if qt["fp32"] is not None else [])
+    for arm in arms:
+        assert qt[arm]["p50_ms"] > 0
+        assert qt[arm]["p99_ms"] >= qt[arm]["p50_ms"]
+        assert qt[arm]["throughput_req_s"] > 0
+        assert qt[arm]["completed"] > 0
+    if qt["fp32"] is not None:
+        assert qt["speedup_p50"] is not None
+    else:
+        assert qt["speedup_p50"] is None
     # the fleet INFERENCE phase (round 15): 2 replica processes
     # behind the fault-tolerant router, bursty load over HTTP, a
     # rolling model swap, clean drain exits
@@ -195,8 +224,8 @@ def test_smoke_emits_valid_json_with_heartbeats():
     for phase in ("import", "device_init", "build", "autotune",
                   "compile", "K1", "K2", "trials", "feed",
                   "checkpoint", "collectives", "fused_kernels",
-                  "healing", "data_plane", "serving", "fleet",
-                  "telemetry", "conv_ab", "done"):
+                  "healing", "data_plane", "serving", "quantization",
+                  "fleet", "telemetry", "conv_ab", "done"):
         assert f"phase={phase}" in r.stderr, f"missing phase {phase}"
 
 

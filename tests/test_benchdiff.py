@@ -228,6 +228,66 @@ def test_fleet_regression_gates_with_fail_on_regression(tmp_path,
     assert rounds["r02"]["verdict"] == "ok"
 
 
+def _quant(agreement, p99=5.0, speedup=1.2):
+    return {"agreement_top1": agreement,
+            "accuracy_delta": round(1.0 - agreement, 4),
+            "int8": {"p99_ms": p99, "p50_ms": p99 / 2.0},
+            "fp32": {"p99_ms": p99 * 1.2, "p50_ms": p99 * 0.6},
+            "speedup_p50": speedup}
+
+
+def test_quantization_trend_verdicts_and_missing_metric(tmp_path):
+    """Round 18: the quantization INFERENCE phase trends like the
+    fleet's — baseline on first appearance, the int8 p99 rated
+    inverted, agreement below 0.99 an ABSOLUTE regression, and a
+    round that shipped the phase then lost it is 'missing
+    quantization metric'.  Pre-phase rounds carry no verdict."""
+    glob_b = _write_rounds(tmp_path, [
+        (1, 0, {"value": 1000.0}),                        # pre-phase
+        (2, 0, {"value": 1000.0, "quantization": _quant(1.0)}),
+        (3, 0, {"value": 1000.0,
+                "quantization": _quant(0.995, p99=5.2)}),     # ok
+        (4, 0, {"value": 1000.0,
+                "quantization": _quant(0.995, p99=20.0)}),  # p99 4x
+        (5, 0, {"value": 1000.0,
+                "quantization": _quant(0.9)}),  # accuracy floor
+        (6, 0, {"value": 1000.0}),                # lost the phase
+    ])
+    rounds = bd.quantization_verdicts(bd.load_bench(
+        sorted(__import__("glob").glob(glob_b))), 0.15)
+    assert rounds["r01"]["quant_verdict"] is None
+    assert rounds["r02"]["quant_verdict"] == "baseline"
+    assert rounds["r03"]["quant_verdict"] == "ok"
+    assert rounds["r04"]["quant_verdict"] == "regression"
+    assert "p99" in rounds["r04"]["quant_reason"]
+    assert rounds["r05"]["quant_verdict"] == "regression"
+    assert "0.99" in rounds["r05"]["quant_reason"]
+    assert rounds["r06"]["quant_verdict"] == "regression"
+    assert rounds["r06"]["quant_reason"] == \
+        "missing quantization metric"
+
+
+def test_quantization_regression_gates_with_fail_on_regression(
+        tmp_path, capsys):
+    """An int8 accuracy regression exits 2 under --fail-on-regression
+    even with a clean headline, and the table carries the
+    quantization section."""
+    glob_b = _write_rounds(tmp_path, [
+        (1, 0, {"value": 1000.0, "quantization": _quant(1.0)}),
+        (2, 0, {"value": 1010.0, "quantization": _quant(0.8)}),
+    ])
+    rc = bd.main(["--bench", glob_b, "--opperf",
+                  str(tmp_path / "none*.jsonl"),
+                  "--fail-on-regression"])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "quantization trend" in out
+    assert "quantization r02" in out
+    rounds = bd.headline_verdicts(bd.load_bench(
+        sorted(__import__("glob").glob(glob_b))), 0.15)
+    assert rounds["r02"]["verdict"] == "ok"
+
+
 def test_fleet_absent_everywhere_never_gates(tmp_path):
     """The committed pre-round-15 artifacts carry no fleet phase: the
     fleet gate must stay silent (the pinned r01–r05 CI window cannot

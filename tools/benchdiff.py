@@ -23,6 +23,12 @@ a machine-readable trend:
   jump, or an SLO flip is a REGRESSION; a round that HAD fleet data
   before and lost it is "missing fleet metric" — serving robustness
   regressions gate exactly like throughput ones.
+* **quantization trend** (round 18) — the ``quantization`` INFERENCE
+  phase's int8-arm metrics round-over-round: top-1 agreement with the
+  fp32 arm dropping below 0.99 regresses ABSOLUTELY (accuracy is a
+  floor, not a ratio), the int8 p99 rates like the fleet's (lower is
+  better), and a round that shipped the phase then lost it is
+  "missing quantization metric".
 
 Exit code: 0 by default (reporting tool); ``--fail-on-regression``
 exits 2 when the LATEST headline round regressed (or lost its metric)
@@ -69,7 +75,9 @@ def load_bench(paths):
                "mfu": None, "ms_per_step": None, "rc": None,
                "degraded": None, "error": None,
                "fleet_p99_ms": None, "fleet_shed_rate": None,
-               "fleet_within_slo": None}
+               "fleet_within_slo": None,
+               "quant_p99_ms": None, "quant_agreement": None,
+               "quant_speedup": None}
         try:
             with open(path) as f:
                 doc = json.load(f)
@@ -96,6 +104,14 @@ def load_bench(paths):
                 row["fleet_shed_rate"] = round(
                     (fl.get("shed") or 0) / req, 4) if req else None
                 row["fleet_within_slo"] = fl.get("p99_within_slo")
+            qt = parsed.get("quantization")
+            if isinstance(qt, dict) \
+                    and qt.get("agreement_top1") is not None:
+                row["quant_agreement"] = qt["agreement_top1"]
+                arm = qt.get("int8")
+                if isinstance(arm, dict):
+                    row["quant_p99_ms"] = arm.get("p99_ms")
+                row["quant_speedup"] = qt.get("speedup_p50")
         rounds[label] = row
     return rounds
 
@@ -190,6 +206,60 @@ def fleet_verdicts(rounds, threshold):
     return rounds
 
 
+def quantization_verdicts(rounds, threshold):
+    """Verdict the ``quantization`` INFERENCE phase round-over-round:
+    top-1 agreement with the fp32 arm below 0.99 regresses ABSOLUTELY
+    (the acceptance floor — quantization that changes answers is not
+    a speed win), an agreement drop past the threshold vs the
+    previous round regresses, and the int8 p99 rates inverted like
+    the fleet's (lower is better).  Rounds before the phase existed
+    carry no quantization verdict; once shipped, a later round
+    without it is "missing quantization metric"."""
+    seen = False
+    prev = None
+    for label in sorted(rounds):
+        row = rounds[label]
+        agreement = row["quant_agreement"]
+        if agreement is None:
+            if seen:
+                row["quant_verdict"] = "regression"
+                row["quant_reason"] = "missing quantization metric"
+            else:
+                row["quant_verdict"] = None
+                row["quant_reason"] = None
+            continue
+        p99 = row["quant_p99_ms"]
+        reasons = []
+        if agreement < 0.99:
+            reasons.append(
+                f"int8 agreement {agreement:.3f} < 0.99")
+        if not seen:
+            row["quant_verdict"] = "regression" if reasons \
+                else "baseline"
+            row["quant_reason"] = "; ".join(reasons) or None
+        else:
+            p_agree, p_p99 = prev
+            ratio = (p99 / p_p99) if (p99 and p_p99) else None
+            if p_agree - agreement > threshold:
+                reasons.append(
+                    f"agreement {p_agree:.3f} -> {agreement:.3f}")
+            if ratio is not None and ratio > 1.0 + threshold:
+                reasons.append(f"int8 p99 x{ratio:.2f}")
+            if reasons:
+                row["quant_verdict"] = "regression"
+                row["quant_reason"] = "; ".join(reasons)
+            elif ratio is not None and ratio < 1.0 / (1.0 + threshold):
+                row["quant_verdict"] = "improved"
+                row["quant_reason"] = f"int8 p99 x{ratio:.2f}"
+            else:
+                row["quant_verdict"] = "ok"
+                row["quant_reason"] = (f"int8 p99 x{ratio:.2f}"
+                                       if ratio is not None else None)
+        seen = True
+        prev = (agreement, p99)
+    return rounds
+
+
 def load_opperf(paths):
     """``{round: {op: row}}`` from the per-op JSONL artifacts; rows
     keep avg and (when the artifact has them) p50/p99."""
@@ -278,6 +348,25 @@ def render(bench, opperf, threshold):
             f"{('-' if r['rc'] is None else str(r['rc'])):>5s}"
             f"{('-' if r['degraded'] is None else str(r['degraded'])):>10s}"
             f"  {verdict}")
+    quant_rows = [label for label in sorted(bench)
+                  if bench[label].get("quant_verdict")]
+    if quant_rows:
+        lines.append("")
+        lines.append("== quantization trend ==")
+        lines.append(f"{'round':<10s}{'agree':>8s}{'p99_ms':>10s}"
+                     f"{'x_p50':>8s}  verdict")
+        for label in quant_rows:
+            r = bench[label]
+            verdict = r["quant_verdict"]
+            if r.get("quant_reason"):
+                verdict += f": {r['quant_reason']}"
+            ag = r["quant_agreement"]
+            lines.append(
+                f"{label:<10s}"
+                f"{('-' if ag is None else f'{ag:.3f}'):>8s}"
+                f"{_fmt(r['quant_p99_ms']):>10s}"
+                f"{_fmt(r['quant_speedup']):>8s}"
+                f"  {verdict}")
     fleet_rows = [label for label in sorted(bench)
                   if bench[label].get("fleet_verdict")]
     if fleet_rows:
@@ -352,8 +441,11 @@ def main(argv=None):
               f"{opperf_glob!r}", file=sys.stderr)
         return 1
 
-    bench = fleet_verdicts(
-        headline_verdicts(load_bench(bench_paths), args.threshold),
+    bench = quantization_verdicts(
+        fleet_verdicts(
+            headline_verdicts(load_bench(bench_paths),
+                              args.threshold),
+            args.threshold),
         args.threshold)
     opperf = opperf_diff(load_opperf(opperf_paths), args.threshold)
 
@@ -367,6 +459,10 @@ def main(argv=None):
         if bench[last].get("fleet_verdict") == "regression":
             failures.append(
                 f"fleet {last}: {bench[last]['fleet_reason']}")
+        # quantization gates the same way (round 18)
+        if bench[last].get("quant_verdict") == "regression":
+            failures.append(
+                f"quantization {last}: {bench[last]['quant_reason']}")
     if opperf.get("regressions"):
         failures.append(
             f"opperf {opperf['last']}: {len(opperf['regressions'])} "
