@@ -1351,6 +1351,62 @@ def _collectives_probe(n_devices):
     shd = out["sharded"]["counts"]
     out["launches_replicated"] = sum(rep.values())
     out["launches_sharded"] = sum(shd.values())
+    # ZeRO-stage block (round 16): stage-1 (state-only sharding, the
+    # replicated-param baseline) vs stage-3 (params live as flat bucket
+    # shards, forward all-gather prefetch) on the SAME net/mesh under
+    # adam — the optimizer whose 2x state makes the per-chip ratio
+    # meaningful (analytic floor 3/(N+2) of stage 1's param+state
+    # bytes).  Gates ride on three ratios benchdiff trends:
+    #   rs_ag_ratio  — measured RS+AG bytes / analytic_exchange_bytes
+    #                  minimum for the plan (<= 1.05: no hidden
+    #                  gathers, no double exchange)
+    #   mem_ratio    — stage-3 per-chip param+opt-state bytes / stage 1
+    #                  (<= analytic expectation * 1.15)
+    #   step_ratio   — stage-3 timed step / stage 1 (<= 1.10: the
+    #                  prefetch overlap pays for resharding)
+    from mxnet_tpu.parallel.zero import analytic_exchange_bytes
+    zero = {"optimizer": "adam"}
+    for zlabel, stg in (("stage1", 1), ("stage3", 3)):
+        step, p, s = make_train_step(
+            net, loss_fn, optimizer="adam", learning_rate=1e-3,
+            mesh=mesh, donate=False, autotune=False,
+            optimizer_sharding="ps", zero_stage=stg)
+        hlo = step.lower(p, s, x, y, key, 1.0).compile().as_text()
+        acc = collective_bytes(hlo)
+        per_chip = 0
+        for leaf in jax.tree_util.tree_leaves((p, s)):
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards:
+                per_chip += shards[0].data.nbytes
+        jax.block_until_ready(step(p, s, x, y, key, 1.0))  # warm
+        t0 = time.perf_counter()
+        iters = 5
+        for _ in range(iters):
+            jax.block_until_ready(step(p, s, x, y, key, 1.0))
+        ms = (time.perf_counter() - t0) * 1e3 / iters
+        arm = {"counts": acc["counts"], "bytes": acc["bytes"],
+               "per_chip_param_state_bytes": int(per_chip),
+               "step_ms": round(ms, 4)}
+        if stg == 3:
+            floor = analytic_exchange_bytes(step.zero_plan,
+                                            n_devices, 3)
+            measured = (acc["bytes"].get("reduce-scatter", 0)
+                        + acc["bytes"].get("all-gather", 0))
+            analytic = (floor["reduce-scatter"] + floor["all-gather"])
+            arm["analytic_rs_ag_bytes"] = int(analytic)
+            arm["rs_ag_ratio"] = round(measured / analytic, 4)
+        zero[zlabel] = arm
+    zero["mem_ratio"] = round(
+        zero["stage3"]["per_chip_param_state_bytes"]
+        / zero["stage1"]["per_chip_param_state_bytes"], 4)
+    # analytic floor for adam on an N-way mesh: stage 1 keeps params
+    # replicated (P bytes/chip) + m,v sharded (2P/N); stage 3 shards
+    # all three (3P/N) -> ratio 3/(N+2)
+    zero["mem_ratio_expected"] = round(
+        3.0 / (n_devices + 2.0), 4)
+    zero["step_ratio"] = round(
+        zero["stage3"]["step_ms"] / zero["stage1"]["step_ms"], 4)
+    out["zero"] = zero
     print(json.dumps(out), flush=True)
 
 

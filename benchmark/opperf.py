@@ -431,6 +431,14 @@ def _bench_extra_inputs():
         "_pallas_bucket_lars_update": (
             [flat, flat.copy(), flat.copy(), seg],
             dict(lr=0.1, momentum=0.9, num_segments=16)),
+        # round 16: the bucket WIRE beside the bucket update — the
+        # stage-2/3 backward reduce-scatter and stage-3 forward
+        # all-gather (ops/collective_ops.py) at the same 1M-element
+        # flat-bucket shape, so one jsonl round shows exchange and
+        # update cost on the same x-axis; on the 1-device smoke both
+        # degenerate to the copy floor (zero-communication baseline)
+        "reduce_scatter": ([flat], {}),
+        "all_gather": ([flat], {}),
     })
     scalar_cmp = {
         name: ([a], dict(scalar=0.5))

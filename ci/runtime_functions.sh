@@ -62,18 +62,36 @@ opperf_smoke() {
     # round 18: the curated _contrib_quantized_{conv,fully_connected}
     # + _contrib_quantize_v2/_contrib_requantize rows run beside their
     # fp32 counterparts (Convolution, FullyConnected), so the
-    # int8-vs-fp32 per-op ratio is visible in the benchdiff table
+    # int8-vs-fp32 per-op ratio is visible in the benchdiff table.
+    # round 16 (ZeRO stages): reduce_scatter/all_gather time the
+    # bucket WIRE at the same 1M-element flat shape as the
+    # _fused_bucket_* update rows (1-device copy floor on this smoke)
     JAX_PLATFORMS=cpu python benchmark/opperf.py --runs 8 --ops \
 dot,Convolution,BatchNorm,FullyConnected,softmax,SyncBatchNorm,\
 _contrib_BNReluConv,sgd_update,adam_update,multi_lars,\
 _fused_bucket_sgd_mom_update,_fused_bucket_adam_update,\
 _fused_bucket_lars_update,_pallas_bucket_sgd_mom_update,\
 _pallas_bucket_adam_update,_pallas_bucket_lars_update,\
+reduce_scatter,all_gather,\
 _random_uniform,\
 _npi_interp,_npi_full_like,_contrib_quantize,_contrib_quantize_v2,\
 _contrib_requantize,_contrib_quantized_conv,\
 _contrib_quantized_fully_connected,MultiBoxPrior \
         | tee OPPERF_smoke.jsonl
+}
+
+zero_smoke() {
+    # ZeRO stage-ladder gate on the virtual 8-dev CPU mesh, seconds:
+    # the stage 1/2/3 bit-identity drill over sgd/sgd-mom/adam/lars
+    # (stage 3's AD-transposed reduce-scatter must equal stage 2's
+    # explicit psum_scatter EXACTLY), the RS+AG bytes <= 1.05x
+    # analytic budget, per-chip param bytes = total/N, the compiled
+    # forward's per-bucket all-gather/compute interleave + Perfetto
+    # export, the stage-salted fingerprint refusing a stage-2 resume,
+    # and the parameter-shard checkpoint round-trip.  Also collected
+    # by tier-1 (tests/test_zero_stages.py), so a regression turns
+    # the unit suite red between CI runs.
+    JAX_PLATFORMS=cpu python -m pytest tests/test_zero_stages.py -q
 }
 
 telemetry_smoke() {
@@ -151,8 +169,12 @@ collectives_budget() {
     # dp_elastic (round 12) adds the reshard-plan verdict: a resume at
     # 16 -> 8 shards must re-plan (old plan != new plan) while both
     # plans honor the budget, and a same-N resume must be a no-op.
+    # dp_zero3 (ZeRO stages) adds the stage-3 structural A/B: one
+    # RS + one AG per bucket within the budget, RS+AG bytes <= 1.05x
+    # the analytic plan minimum, per-chip param bytes ~1/16 of the
+    # replicated stage-1 arm.
     JAX_PLATFORMS=cpu MXNET_DRYRUN_SCALING=0 \
-    MXNET_DRYRUN_CASES=dp,dp_elastic \
+    MXNET_DRYRUN_CASES=dp,dp_elastic,dp_zero3 \
         python -c "import __graft_entry__ as g; g.dryrun_multichip(16)"
 }
 
@@ -234,17 +256,19 @@ quantize_smoke() {
 }
 
 chaos_smoke() {
-    # the seeded chaos campaign (rounds 16-17): >=25 reproducible
-    # faults across all 9 scenario classes (SIGKILL at a seeded
-    # delay, mid-epoch record corruption and the io-worker kill
-    # included) on the CPU mesh, each run supervised by the healing
-    # respawn policy and gated on the three invariants — zero hangs,
-    # zero torn artifacts (tools/ckpt_fsck.py --all clean after every
-    # run), every healed run matching its uninterrupted reference
-    # allclose(1e-5).  The fixed --seed makes a CI failure exactly
-    # reproducible on a laptop.
-    JAX_PLATFORMS=cpu python tools/chaos.py --seed 1234 --runs 27 \
-        --min-faults 25 --out /tmp/chaos_ci
+    # the seeded chaos campaign (rounds 16-17): >=27 reproducible
+    # faults across all 10 scenario classes (SIGKILL at a seeded
+    # delay, mid-epoch record corruption, the io-worker kill and the
+    # ZeRO stage-3 mid-step ghost-peer death with its parameter-shard
+    # emergency checkpoint included) on the CPU mesh, each run
+    # supervised by the healing respawn policy and gated on the three
+    # invariants — zero hangs, zero torn artifacts
+    # (tools/ckpt_fsck.py --all clean after every run), every healed
+    # run matching its uninterrupted reference allclose(1e-5).  The
+    # fixed --seed makes a CI failure exactly reproducible on a
+    # laptop.
+    JAX_PLATFORMS=cpu python tools/chaos.py --seed 1234 --runs 30 \
+        --min-faults 27 --out /tmp/chaos_ci
 }
 
 elastic_smoke() {

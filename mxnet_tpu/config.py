@@ -123,6 +123,18 @@ register_env("MXNET_OPTIMIZER_SHARDING", "", str,
              "reduce-scatter in flat buckets, the optimizer updates "
              "only the locally-owned shard (state lives sharded), and "
              "the params all-gather back.")
+register_env("MXNET_ZERO_STAGE", "", str,
+             "ZeRO stage of the sharded-server exchange "
+             "(optimizer_sharding='ps', parallel.zero): '1' = classic "
+             "ZeRO-1 (per-bucket all-reduce, grads replicated, "
+             "optimizer state sharded), '2' = gradient shards "
+             "(per-bucket reduce-scatter — the default program when "
+             "unset), '3' = parameter shards (params live sharded as "
+             "flat buckets; the forward all-gathers each bucket with "
+             "bucket-wise prefetch and nothing gathers back).  Setting "
+             "a stage also opts the step into sharding under a mesh; "
+             "unset defers to the caller's zero_stage/optimizer_"
+             "sharding arguments.  Unknown values raise.")
 register_env("MXNET_COLLECTIVES_BUDGET", 8, int,
              "Per-step collective-launch budget the dp dryrun verdict "
              "gates against under optimizer_sharding='ps': at most "

@@ -22,6 +22,7 @@ from . import (  # noqa: F401  (import-for-registration)
     quantization_ops,
     control_flow_ops,
     optimizer_ops,
+    collective_ops,
     pallas_conv,
     pallas_opt,
 )

@@ -1,0 +1,83 @@
+"""Collective exchange operators — the ZeRO bucket wire as ops.
+
+The sharded-server train step (parallel.zero, ``optimizer_sharding=
+"ps"`` + ``MXNET_ZERO_STAGE``) moves gradients and parameters as flat
+dtype-homogeneous buckets: one ``reduce_scatter`` per bucket on the
+backward (stages 2/3), one ``all_gather`` per bucket on the forward
+prefetch (stage 3) or gather-back (stages 1/2).  These ops expose that
+wire standalone so the opperf harness can time the collectives at real
+bucket shapes beside the fused bucket-update rows they bracket — the
+launch-overhead-vs-bytes curve that picked MXNET_KVSTORE_BIGARRAY_BOUND.
+
+The reference has no collective ops at the NNVM surface (its exchange
+lives in KVStore/ps-lite, kvstore_dist.h); these are TPU-native
+additions.  Each op runs over EVERY local device via ``shard_map`` on
+a 1-D data mesh — on the single-device opperf smoke they degenerate to
+the identity data movement (a bucket-sized copy), which is exactly the
+zero-communication floor the jsonl rows document.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .registry import register_op
+
+
+def _data_mesh():
+    import jax
+    import numpy as onp
+    from jax.sharding import Mesh
+
+    return Mesh(onp.array(jax.devices()), ("data",))
+
+
+def _check_divisible(flat, n):
+    if flat.ndim != 1 or (n and flat.shape[0] % n):
+        raise MXNetError(
+            "collective ops take one FLAT bucket whose length divides "
+            f"the device count (got shape {tuple(flat.shape)} over "
+            f"{n} devices) — pad with zero.plan_buckets' padded size")
+
+
+@register_op("reduce_scatter", differentiable=False)
+def reduce_scatter(data):
+    """Flat-bucket gradient reduce-scatter over the local data mesh
+    (the stage-2/3 backward exchange): every device contributes the
+    whole replicated bucket, the sum scatters, and each device keeps
+    its owned shard.  Output has the input's shape with shards laid
+    out row-major (``zero.shard_slice`` order): slice ``k`` holds
+    ``n_devices *`` the input's slice ``k`` when inputs replicate."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel import compat_shard_map
+
+    mesh = _data_mesh()
+    n = mesh.devices.size
+    _check_divisible(data, n)
+    fn = compat_shard_map(
+        lambda x: jax.lax.psum_scatter(x, "data", scatter_dimension=0,
+                                       tiled=True),
+        mesh, in_specs=P(), out_specs=P("data"))
+    return fn(data)
+
+
+@register_op("all_gather", differentiable=False)
+def all_gather(data):
+    """Flat-bucket parameter all-gather over the local data mesh (the
+    stage-3 forward prefetch / stage-1-2 gather-back): the input is
+    the full flat bucket in row-major shard order, each device holds
+    its shard, and every device reassembles the whole bucket (tiled,
+    matching ``zero.gather_bucket``).  Identity by value — what it
+    times is the wire."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel import compat_shard_map
+
+    mesh = _data_mesh()
+    n = mesh.devices.size
+    _check_divisible(data, n)
+    fn = compat_shard_map(
+        lambda x: jax.lax.all_gather(x, "data", tiled=True),
+        mesh, in_specs=P("data"), out_specs=P())
+    return fn(data)

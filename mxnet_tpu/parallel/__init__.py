@@ -196,7 +196,8 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
                     loss_scale=None, sample_data=None, autotune=None,
                     variant_ops=None, nan_guard=None,
                     optimizer_sharding=None, bucket_bound=None,
-                    gradient_compression=None, **opt_kwargs):
+                    zero_stage=None, gradient_compression=None,
+                    **opt_kwargs):
     """Build ONE fully-fused jitted SPMD train step.
 
     Returns (step_fn, params, opt_state) where
@@ -271,6 +272,26 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
     (executor_group.py), vs the replicated path's SyncBatchNorm-style
     global stats.
 
+    zero_stage: the ZeRO stage of the sharded exchange (1, 2 or 3;
+    None follows MXNET_ZERO_STAGE, which overrides the argument, and
+    defaults to stage 2).  Setting a stage opts the step into
+    optimizer_sharding="ps" under a mesh.  Stage 1 is the classic
+    ZeRO-1 exchange for ablation: one all-reduce per bucket, the
+    owned shard sliced off the replicated reduced gradient.  Stage 2
+    (the default — bit-for-bit the program this step has always
+    traced) reduce-scatters each bucket so no device materializes
+    full gradients.  Stage 3 additionally shards the PARAMETERS: the
+    returned params pytree is ``{"_bucket<i>": flat padded bucket}``
+    sharded over the data axis (per-chip param+state bytes ~ total/N),
+    the forward all-gathers each bucket with all launches issued
+    up-front so bucket k+1's gather overlaps bucket k's compute
+    (prefetch), the backward's reduce-scatters fall out of
+    differentiating through those gathers (interleaved with backward
+    compute), and nothing gathers back.  Use
+    ``zero.gather_stage3_params(step_fn.zero_plan, params)`` to
+    reassemble the named tree; ``step_fn.zero_stage`` /
+    ``step_fn.zero_plan`` expose the layout.
+
     gradient_compression: ``{"type": "2bit", "threshold": t}`` —
     2-bit quantization (kvstore.GradientCompression math) applied
     per-bucket on the scattered gradient shard before the optimizer,
@@ -344,6 +365,16 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
         raise MXNetError(
             f"unknown optimizer_sharding {ps_mode!r} (only 'ps')")
     ps_mode = "ps" if ps_mode == "ps" else None
+    # ---- ZeRO stage resolution (env overrides the argument, same
+    # precedence as MXNET_OPTIMIZER_SHARDING; a stage implies the
+    # sharded exchange unless the env force-off already vetoed it)
+    env_stage = _zero.resolve_zero_stage()
+    stage = env_stage if env_stage is not None else zero_stage
+    if stage not in (None, 1, 2, 3):
+        raise MXNetError(
+            f"unknown zero_stage {stage!r} (use 1, 2 or 3)")
+    if stage is not None and ps_mode is None and env_ps is not False:
+        ps_mode = "ps"
     if ps_mode and mesh is None:
         import warnings
 
@@ -363,11 +394,15 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
 
     names = list(params)
     comp_threshold = None
+    if not ps_mode:
+        stage = None
+    elif stage is None:
+        stage = 2  # the default exchange: reduce-scattered gradients
     if ps_mode:
         n_sh = int(mesh.shape[data_axis])
         _zero.check_bucket_rule(opt)
         plan = _zero.plan_buckets(params, n_sh, capacity=bucket_bound)
-        bucket_keys = [f"_bucket{i}" for i in range(len(plan))]
+        bucket_keys = _zero.stage3_param_keys(plan)
         # optimizer state is created over the FLAT buckets and lives
         # sharded for the step's whole life (the server owning its key
         # shard's state) — per-chip state bytes ~ total/N
@@ -387,6 +422,14 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
                 # lose the feedback below threshold/256)
                 opt_state[f"_residual{i}"] = jnp.zeros((b.padded,),
                                                        jnp.float32)
+        if stage == 3:
+            # stage 3: the params move into their persistent layout —
+            # one flat padded bucket per plan entry, sharded over the
+            # data axis at jit wiring below (per-chip param bytes
+            # ~ total/N); the named tree only ever rematerializes
+            # transiently inside the step's per-bucket gathers
+            params = {bk: _zero.flatten_bucket(b, params)
+                      for bk, b in zip(bucket_keys, plan)}
     else:
         opt_state = {n: opt.fused_state(v) for n, v in params.items()}
     if dynamic_scaling:
@@ -545,12 +588,13 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
         # bucket race over the same plan — reaches this step too; None
         # (undecided) leaves the trace-time variant_choice consult in
         # charge, so force scopes and program-scope winners still work
-        ps_pallas = _zero.resolve_bucket_variant(opt, plan, mesh)
+        ps_pallas = _zero.resolve_bucket_variant(opt, plan, mesh, stage)
 
         def ps_local_step(params_, opt_state_, x, y, key, t):
-            # runs PER DEVICE under shard_map: params replicated in,
-            # x/y are the local batch shard, bucket states/residuals
-            # are the locally-owned shard
+            # runs PER DEVICE under shard_map: params replicated in
+            # (stages 1/2) or the locally-owned flat bucket shards
+            # (stage 3), x/y are the local batch shard, bucket
+            # states/residuals are the locally-owned shard
             idx = jax.lax.axis_index(data_axis)
             fkey = jax.random.fold_in(key, idx)
             if dynamic_scaling:
@@ -559,6 +603,19 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
                 scale = static_scale
 
             def local_loss(p, x_, y_, k_):
+                if stage == 3:
+                    # bucket-wise all-gather PREFETCH: every bucket's
+                    # gather is issued with no inter-bucket data
+                    # dependency, so the scheduler runs bucket k+1's
+                    # gather while the compute consuming bucket k
+                    # executes instead of serializing all gathers at
+                    # the step head
+                    named = {}
+                    for bk_, b_ in zip(bucket_keys, plan):
+                        named.update(_zero.unflatten_bucket(
+                            b_, jax.lax.all_gather(
+                                p[bk_], data_axis, tiled=True)))
+                    p = named
                 lv = loss_of(p, x_, y_, k_)
                 if dynamic_scaling or static_scale != 1.0:
                     lv = lv * scale
@@ -584,11 +641,29 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
                 finite = jnp.array(True)
             staged = []
             for i, (bk, b) in enumerate(zip(bucket_keys, plan)):
-                flat_g = _zero.flatten_bucket(b, lgrads)
-                # THE exchange: one reduce-scatter for the whole
-                # bucket replaces len(b.names) per-tensor all-reduces
-                g_sh = jax.lax.psum_scatter(
-                    flat_g, data_axis, scatter_dimension=0, tiled=True)
+                w_sh_in = None
+                if stage == 3:
+                    # differentiating through the tiled all-gather IS
+                    # the exchange: its transpose emitted one reduce-
+                    # scatter per bucket, interleaved with the rest of
+                    # the backward compute — the gradient arrives
+                    # already summed and scattered to the owned shard
+                    g_sh = lgrads[bk]
+                    w_sh_in = params_[bk]
+                elif stage == 1:
+                    # classic ZeRO-1 for the stage ladder: the whole
+                    # reduced bucket lands on every device (one
+                    # all-reduce) and the owned shard is sliced off it
+                    g_sh = _zero.shard_slice(
+                        jax.lax.psum(_zero.flatten_bucket(b, lgrads),
+                                     data_axis), n_sh, idx)
+                else:
+                    # THE stage-2 exchange: one reduce-scatter for the
+                    # whole bucket replaces len(b.names) per-tensor
+                    # all-reduces
+                    g_sh = jax.lax.psum_scatter(
+                        _zero.flatten_bucket(b, lgrads), data_axis,
+                        scatter_dimension=0, tiled=True)
                 g32 = g_sh.astype(jnp.float32) * inv
                 new_resid = None
                 if comp_threshold is not None:
@@ -615,7 +690,8 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
                     b, opt, params_, g32, opt_state_[bk], t,
                     n_shards=n_sh, idx=idx, axis=data_axis,
                     seg=seg_info[i] if needs_seg else None, key=sub,
-                    pallas=ps_pallas, want_finite=want_fin)
+                    pallas=ps_pallas, want_finite=want_fin,
+                    w_sh=w_sh_in)
                 if want_fin:
                     w_sh, uw, us, bfin = res
                     # finiteness verdict on the SCATTERED shard (each
@@ -648,7 +724,13 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
                 new_s[bk] = us
                 if new_resid is not None:
                     new_s[f"_residual{i}"] = new_resid
-                new_p.update(_zero.gather_bucket(b, uw, data_axis))
+                if stage == 3:
+                    # params stay sharded: the updated shard IS the
+                    # new param bucket — no gather-back (the next
+                    # forward's prefetch gathers it)
+                    new_p[bk] = uw
+                else:
+                    new_p.update(_zero.gather_bucket(b, uw, data_axis))
             loss = jax.lax.pmean(lval, data_axis)
             if dynamic_scaling:
                 new_s["_loss_scale"] = _scale_bookkeeping(finite, scale,
@@ -661,7 +743,10 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
                     finite, jnp.int32(0), opt_state_["_bad_steps"] + 1)
             return loss, new_p, new_s
 
-        ps_p_specs = {n: P() for n in params}
+        if stage == 3:
+            ps_p_specs = {bk: P(data_axis) for bk in bucket_keys}
+        else:
+            ps_p_specs = {n: P() for n in params}
         ps_s_specs = jax.tree_util.tree_map(
             lambda l: P(data_axis) if getattr(l, "ndim", 0) else P(),
             opt_state)
@@ -718,11 +803,14 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
         repl = NamedSharding(mesh, P())
         batch_sharding = NamedSharding(mesh, P(data_axis))
         if ps_mode:
-            # params replicate; bucket states + residuals live SHARDED
-            # over the data axis (the ZeRO-1 memory win); scalar
-            # entries (loss-scale, bad-step counters) replicate
+            # params replicate (stages 1/2) or live sharded as flat
+            # buckets (stage 3 — the parameter-memory win); bucket
+            # states + residuals live SHARDED over the data axis (the
+            # ZeRO-1 memory win); scalar entries (loss-scale, bad-step
+            # counters) replicate
             shard1 = NamedSharding(mesh, P(data_axis))
-            p_shard = jax.tree_util.tree_map(lambda _: repl, params)
+            p_shard = jax.tree_util.tree_map(
+                lambda _: shard1 if stage == 3 else repl, params)
             opt_shard = jax.tree_util.tree_map(
                 lambda l: shard1 if getattr(l, "ndim", 0) else repl,
                 opt_state)
@@ -769,7 +857,11 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
     _tm_hyper = {k: v for k, v in sorted(vars(opt).items())
                  if not k.startswith("_")
                  and isinstance(v, (int, float, bool, str, type(None)))}
-    _tm_sharding = "ps" if ps_mode else "none"
+    # stage 2 keeps the historic "ps" stamp (it IS that program);
+    # stages 1/3 trace different exchanges and must name themselves so
+    # the RunLog can blame a retrace on a stage flip
+    _tm_sharding = "none" if not ps_mode else (
+        "ps" if stage == 2 else f"zero{stage}")
     _tm_seen = set()
     _tm_last = [None]
     _nm_period = _nm.sample_period() if numerics_on else 0
@@ -856,6 +948,13 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
         # introspecting the program (bench.py, the multichip dryrun)
         # still need jit's lower() — same XLA program either way
         step_fn.lower = _jitted_step.lower
+    if ps_mode:
+        # the layout contract for checkpointing/eval callers: under
+        # stage 3 the params pytree is flat buckets, and
+        # zero.gather_stage3_params(step_fn.zero_plan, params)
+        # reassembles the named tree
+        step_fn.zero_stage = stage
+        step_fn.zero_plan = plan
 
     return step_fn, params, opt_state
 
@@ -895,10 +994,20 @@ class DataParallelTrainer:
     def sync_to_block(self):
         from ..gluon.block import _collect_all_params
 
+        params = self._params
+        if getattr(self._step_fn, "zero_stage", None) == 3:
+            # stage-3 params live as flat bucket shards: reassemble
+            # the named tree (host_gather handles the multi-process
+            # world where no single host holds a whole bucket)
+            from ..resilience.elastic import host_gather
+
+            params = zero.gather_stage3_params(
+                self._step_fn.zero_plan,
+                {k: host_gather(v) for k, v in params.items()})
         for p in _collect_all_params(self._block):
-            if p.name in self._params:
+            if p.name in params:
                 # gather off the mesh so eager single-device ops work
-                v = jnp.asarray(onp.asarray(self._params[p.name]))
+                v = jnp.asarray(onp.asarray(params[p.name]))
                 p.data()._adopt(v)
 
 

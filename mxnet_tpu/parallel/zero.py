@@ -45,9 +45,12 @@ from ..base import MXNetError
 
 __all__ = ["Bucket", "plan_buckets", "flatten_bucket", "unflatten_bucket",
            "bucket_segments", "shard_slice", "collective_bytes",
-           "resolve_sharding_env", "plan_fingerprint",
-           "flat_variant_key", "resolve_bucket_variant",
-           "ShardedBucketUpdater"]
+           "resolve_sharding_env", "resolve_zero_stage",
+           "plan_fingerprint", "flat_variant_key",
+           "resolve_bucket_variant", "analytic_exchange_bytes",
+           "stage3_param_keys", "shard_stage3_params",
+           "gather_stage3_params", "overlap_report",
+           "export_overlap_trace", "ShardedBucketUpdater"]
 
 
 # ------------------------------------------------------------ bucket plan
@@ -183,7 +186,7 @@ def shard_slice(flat, n_shards, idx):
 
 def bucket_shard_update(bucket, opt, params, g_sh, state, t, *, n_shards,
                         idx, axis, seg=None, key=None, pallas=None,
-                        want_finite=False):
+                        want_finite=False, w_sh=None):
     """The per-bucket owned-shard update core, shared by
     :meth:`ShardedBucketUpdater._build` and ``make_train_step``'s ps
     step — ONE copy, so the two arms' seg-id slicing and shard layout
@@ -210,7 +213,12 @@ def bucket_shard_update(bucket, opt, params, g_sh, state, t, *, n_shards,
     today's)."""
     import jax.numpy as jnp
 
-    w_sh = shard_slice(flatten_bucket(bucket, params), n_shards, idx)
+    if w_sh is None:
+        # stages 1/2: params arrive replicated as the named tree and
+        # the owned shard is sliced here; stage 3 already HOLDS the
+        # shard (params live sharded as flat buckets) and passes it in
+        # directly via ``w_sh=`` — same update math either way
+        w_sh = shard_slice(flatten_bucket(bucket, params), n_shards, idx)
     seg_sh = None
     if seg is not None:
         ids, nseg = seg
@@ -257,23 +265,33 @@ def gather_bucket(bucket, w_sh, axis):
         bucket, jax.lax.all_gather(w_sh, axis, tiled=True))
 
 
-def flat_variant_key(plan):
+def flat_variant_key(plan, stage=None):
     """The ``fused_bucket_opt`` autotune key for a bucket plan: the
     total padded element count + lead dtype — what the kernels
     actually stream, shared by the ps train step, the Module updater
     and the bench bucket race so a winner measured by one reaches the
-    others on the same plan."""
-    return ((sum(b.padded for b in plan),),
-            plan[0].dtype if plan else "float32")
+    others on the same plan.
+
+    ``stage`` (MXNET_ZERO_STAGE): stages None/2 share the legacy key —
+    stage 2 IS the program every winner so far was measured on, so the
+    Module updater's winner still reaches the default train step.
+    Stages 1 and 3 wrap the kernel in a different exchange (all-reduce
+    + slice / persistently-sharded params), so they get their own key
+    dimension rather than inheriting a winner measured elsewhere."""
+    shape = (sum(b.padded for b in plan),)
+    if stage not in (None, 2):
+        shape = shape + (int(stage),)
+    return (shape, plan[0].dtype if plan else "float32")
 
 
-def resolve_bucket_variant(optimizer, plan, mesh=None):
+def resolve_bucket_variant(optimizer, plan, mesh=None, stage=None):
     """Resolve the ``fused_bucket_opt`` lowering for a bucket plan at
     BUILD time: a force scope / MXNET_PALLAS_OPT override first, then
     kernel feasibility, then the cached winner under the flat-layout
-    key.  Returns True (Pallas), False (jnp), or None — undecided, so
-    the trace-time ``variant_choice`` consult still applies (force
-    scopes entered around a later trace keep working)."""
+    key (stage-distinguished for ZeRO stages 1/3).  Returns True
+    (Pallas), False (jnp), or None — undecided, so the trace-time
+    ``variant_choice`` consult still applies (force scopes entered
+    around a later trace keep working)."""
     from .. import autotune as _at
     from ..ops import pallas_opt
 
@@ -282,7 +300,7 @@ def resolve_bucket_variant(optimizer, plan, mesh=None):
         return bool(choice)
     if not _at.enabled():
         return False
-    shape, dtype = flat_variant_key(plan)
+    shape, dtype = flat_variant_key(plan, stage)
     if pallas_opt.supported(optimizer, dtype) is not None:
         return False
     cached = _at.lookup("fused_bucket_opt", shape, dtype,
@@ -293,18 +311,28 @@ def resolve_bucket_variant(optimizer, plan, mesh=None):
     return None
 
 
-def plan_fingerprint(plan, n_shards):
+def plan_fingerprint(plan, n_shards, stage=None):
     """Stable fingerprint of a bucket plan AT a shard count — the
     checkpoint manifest's ``topology.plan_fingerprint`` (resilience.
     elastic).  Two runs share a fingerprint iff their flat layouts are
     interchangeable: same buckets in the same order with the same
     member names/shapes/dtypes/padding, sharded the same number of
     ways.  A resume whose fingerprint differs must re-plan + re-shard;
-    one whose fingerprint matches is a same-topology no-op."""
+    one whose fingerprint matches is a same-topology no-op.
+
+    ``stage``: ZeRO stages None/1/2 hash identically — their params
+    (and so their checkpoint payloads) are the replicated named tree,
+    interchangeable across stages, and existing stamped checkpoints
+    must keep verifying.  Stage 3 persists PARAMETER shards in the
+    flat-bucket layout, a different on-disk world: its fingerprint is
+    stage-tagged so a cross-stage resume is flagged for re-shard
+    instead of silently misreading flat buckets as named tensors."""
     import hashlib
 
     h = hashlib.sha256()
     h.update(f"shards={int(n_shards)}".encode())
+    if stage == 3:
+        h.update(b"stage=3")
     for b in plan:
         h.update(repr((b.dtype, b.names, b.shapes, b.offsets,
                        b.size, b.padded, b.group)).encode())
@@ -330,6 +358,202 @@ def resolve_sharding_env():
             "value (use 'ps' to force sharding on, '0' to force it "
             "off, or unset)")
     return None
+
+
+def resolve_zero_stage():
+    """The MXNET_ZERO_STAGE knob: 1/2/3 select the exchange stage
+    (all-reduce grads / reduce-scatter grads / parameter shards), None
+    means unset (the caller's ``zero_stage`` argument decides, default
+    stage 2 under sharding).  Unknown values raise — a typo'd stage
+    silently training the wrong exchange is the same silent-green
+    failure mode MXNET_OPTIMIZER_SHARDING rejects."""
+    from ..config import get_env
+
+    raw = str(get_env("MXNET_ZERO_STAGE")).strip()
+    if not raw:
+        return None
+    if raw in ("1", "2", "3"):
+        return int(raw)
+    raise MXNetError(
+        f"MXNET_ZERO_STAGE={raw!r} is not a recognized stage (use 1, "
+        "2 or 3, or unset)")
+
+
+# ------------------------------------------------- stage-3 param layout
+def stage3_param_keys(plan):
+    """The pytree keys of the stage-3 parameter layout: one flat
+    padded bucket per plan entry, sharded over the data axis."""
+    return [f"_bucket{i}" for i in range(len(plan))]
+
+
+def shard_stage3_params(plan, named, mesh=None, data_axis="data"):
+    """Named ``{name: array}`` params -> the stage-3 persistent layout
+    ``{"_bucket<i>": flat padded array}``, placed sharded over the
+    data axis when a mesh is given (per-chip param bytes ~ total/N)."""
+    import jax
+    import jax.numpy as jnp
+
+    out = {k: flatten_bucket(b, {n: jnp.asarray(named[n])
+                                 for n in b.names})
+           for k, b in zip(stage3_param_keys(plan), plan)}
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        out = jax.device_put(out, NamedSharding(mesh, P(data_axis)))
+    return out
+
+
+def gather_stage3_params(plan, pshards):
+    """Inverse of :func:`shard_stage3_params`: reassemble the named
+    ``{name: array}`` tree from flat bucket arrays (host-side; for a
+    multi-process world pass buckets through
+    ``resilience.elastic.host_gather`` first)."""
+    named = {}
+    for k, b in zip(stage3_param_keys(plan), plan):
+        named.update(unflatten_bucket(b, onp.asarray(pshards[k])))
+    return named
+
+
+# ---------------------------------------------- analytic exchange bytes
+def analytic_exchange_bytes(plan, n_shards, stage):
+    """The analytic per-step minimum wire bytes of a bucket plan's
+    exchange, in the same accounting :func:`collective_bytes` reads
+    off compiled HLO (per-device OUTPUT bytes of each launch):
+
+    * stage 1 — one all-reduce per bucket (``padded`` elements out)
+      plus the gather-back all-gather of the updated params;
+    * stage 2 — one reduce-scatter per bucket (``padded/N`` out) plus
+      the gather-back all-gather (``padded`` out);
+    * stage 3 — the forward's per-bucket param all-gather plus the
+      backward's reduce-scatter; nothing gathers back.
+
+    The bench/benchdiff collectives-bytes budget gates the measured
+    RS+AG bytes at <= 1.05x this floor — anything above it is
+    duplicated traffic (a re-gather, an unfused pad) the schedule
+    snuck in."""
+    rs = ag = ar = 0
+    for b in plan:
+        item = onp.dtype(b.dtype).itemsize
+        full = b.padded * item
+        if stage == 1:
+            ar += full
+            ag += full
+        else:
+            rs += full // int(n_shards)
+            ag += full
+    return {"reduce-scatter": rs, "all-gather": ag, "all-reduce": ar}
+
+
+# -------------------------------------------- overlap proof (Perfetto)
+def overlap_report(hlo_text, plan, n_shards):
+    """Structural overlap evidence for the stage-3 prefetch, read off
+    the compiled step's HLO schedule: every per-bucket parameter
+    all-gather is located (matched by its per-device output element
+    count = the bucket's ``padded`` total), and for each launch the
+    report records how much non-collective compute the schedule placed
+    between it and the next bucket's gather (sync schedules, e.g. the
+    CPU dryrun) or between its ``-start``/``-done`` pair (async
+    schedules, the TPU latency-hiding scheduler).  Overlap is REAL
+    when that count is nonzero: bucket k+1's gather is in flight while
+    bucket k's consumers run, instead of all collectives serializing
+    at the step head.
+
+    Returns ``{"gathers": [{bucket, pos, done_pos, compute_between,
+    async}], "total_instructions": int, "overlapped": bool}``."""
+    sizes = {}
+    for i, b in enumerate(plan):
+        sizes.setdefault(b.padded, []).append(i)
+    lines = [ln for ln in hlo_text.splitlines() if " = " in ln]
+    shape_pat = re.compile(
+        r"(f32|bf16|f16|s32|u32|f64|s64|s8|u8|pred)\[([\d,]*)\]")
+    ag_pat = re.compile(r"=\s*[\w\[\],{}: /()]*all-gather"
+                        r"(-start)?[.\d]*\(")
+    done_pat = re.compile(r"all-gather-done")
+    gathers = []
+    for pos, ln in enumerate(lines):
+        m = ag_pat.search(ln)
+        if not m:
+            continue
+        sm = shape_pat.search(ln)
+        if not sm:
+            continue
+        n = 1
+        for d in sm.group(2).split(","):
+            if d:
+                n *= int(d)
+        if m.group(1):  # -start carries (operand, result) pairs
+            n //= 2
+        bucket = sizes.get(n)
+        if not bucket:
+            continue
+        gathers.append({"bucket": bucket[0], "pos": pos,
+                        "async": bool(m.group(1)), "done_pos": None,
+                        "compute_between": 0})
+    is_collective = [bool(re.search("|".join(_COLLECTIVES), ln))
+                     for ln in lines]
+    for gi, g in enumerate(gathers):
+        if g["async"]:
+            for pos in range(g["pos"] + 1, len(lines)):
+                if done_pat.search(lines[pos]):
+                    g["done_pos"] = pos
+                    break
+            end = g["done_pos"] if g["done_pos"] is not None \
+                else g["pos"] + 1
+        else:
+            end = gathers[gi + 1]["pos"] if gi + 1 < len(gathers) \
+                else len(lines)
+        g["compute_between"] = sum(
+            1 for pos in range(g["pos"] + 1, end)
+            if not is_collective[pos])
+    return {"gathers": gathers, "total_instructions": len(lines),
+            "overlapped": any(g["compute_between"] > 0
+                              for g in gathers[:-1] or gathers)}
+
+
+def export_overlap_trace(report, path, step_ms=1.0, label="zero3"):
+    """Render an :func:`overlap_report` onto the Perfetto timeline
+    (profiler.py trace-event JSON): a ``collectives`` lane carries one
+    span per bucket all-gather and a ``compute`` lane carries the
+    schedule segments that run while each gather is in flight —
+    schedule positions scaled into a ``step_ms`` window, so lane
+    geometry mirrors the compiled schedule even where wall-clock
+    per-instruction timing does not exist (inside one jitted program).
+    Returns the trace dict after writing it to ``path``."""
+    import json
+
+    total = max(1, report["total_instructions"])
+    scale = (step_ms * 1000.0) / total  # us per schedule slot
+    events = [
+        {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+         "args": {"name": f"{label} step (schedule-scaled)"}},
+        {"name": "thread_name", "ph": "M", "pid": 1, "tid": 1,
+         "args": {"name": "collectives (bucket all-gather)"}},
+        {"name": "thread_name", "ph": "M", "pid": 1, "tid": 2,
+         "args": {"name": "compute (hides the next gather)"}},
+    ]
+    gathers = report["gathers"]
+    for gi, g in enumerate(gathers):
+        start = g["pos"] * scale
+        end_pos = g["done_pos"] if g["done_pos"] is not None else (
+            gathers[gi + 1]["pos"] if gi + 1 < len(gathers)
+            else total)
+        events.append({
+            "name": f"all_gather:bucket{g['bucket']}", "ph": "X",
+            "cat": "collective", "pid": 1, "tid": 1, "ts": start,
+            "dur": max(scale, (end_pos - g["pos"]) * scale),
+            "args": {"bucket": g["bucket"], "async": g["async"],
+                     "compute_between": g["compute_between"]}})
+        if g["compute_between"]:
+            events.append({
+                "name": f"compute under bucket{g['bucket']} gather",
+                "ph": "X", "cat": "compute", "pid": 1, "tid": 2,
+                "ts": start + scale,
+                "dur": g["compute_between"] * scale,
+                "args": {"instructions": g["compute_between"]}})
+    trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return trace
 
 
 def check_bucket_rule(optimizer):

@@ -333,13 +333,19 @@ def host_gather(x):
 
 # ------------------------------------------------------------- topology
 def topology_block(world_size=None, num_processes=None, mesh=None,
-                   sharding="none", plan=None, global_batch=None):
+                   sharding="none", plan=None, global_batch=None,
+                   zero_stage=None):
     """The checkpoint manifest's ``topology`` stamp.
 
     ``world_size`` is the optimizer-shard count (the data-mesh width);
     ``plan`` (a ``parallel.zero`` bucket list) contributes its
     fingerprint so a resume can tell "same shard count, same packing"
-    from "must re-plan" without loading any state."""
+    from "must re-plan" without loading any state.  ``zero_stage``
+    rides both the stamp and the fingerprint: stage 3 persists
+    PARAMETER shards in the flat-bucket layout, so its checkpoints
+    must never silently resume into a replicated-param world (use
+    ``sharding="zero3"`` there; stages 1/2 keep the historic "ps"
+    stamp and fingerprint — their payloads are interchangeable)."""
     if mesh is not None:
         if world_size is None:
             world_size = int(mesh.shape.get("data", mesh.devices.size))
@@ -360,10 +366,13 @@ def topology_block(world_size=None, num_processes=None, mesh=None,
         "mesh_axes": list(mesh_axes),
         "sharding": str(sharding),
     }
+    if zero_stage is not None:
+        block["zero_stage"] = int(zero_stage)
     if plan is not None:
         from ..parallel.zero import plan_fingerprint
 
-        block["plan_fingerprint"] = plan_fingerprint(plan, world_size)
+        block["plan_fingerprint"] = plan_fingerprint(plan, world_size,
+                                                     zero_stage)
         block["n_buckets"] = len(plan)
     if global_batch is not None:
         block["global_batch"] = int(global_batch)

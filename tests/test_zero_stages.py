@@ -1,0 +1,250 @@
+"""ZeRO stage ladder (stages 1/2/3 over one bucket plan): the
+bit-identity drill and the structural acceptance gates.
+
+``MXNET_ZERO_STAGE`` / ``make_train_step(zero_stage=...)`` select how
+much of the sharded-server exchange shards:
+
+* stage 1 — per-bucket all-reduce, grads replicated, optimizer state
+  sharded (classic ZeRO-1);
+* stage 2 — per-bucket reduce-scatter (the historic ``ps`` default
+  program, bit-for-bit);
+* stage 3 — parameters live as flat bucket shards; the forward
+  all-gathers each bucket (prefetch, no inter-bucket dependency), the
+  backward's reduce-scatters fall out of differentiating through the
+  tiled gathers, and nothing gathers back.
+
+Acceptance invariants from the issue:
+
+* the three stages are BIT-IDENTICAL over >= 6 steps for sgd,
+  sgd-momentum, adam and lars (stage 3's AD-transposed reduce-scatter
+  is the same psum_scatter stage 2 emits explicitly);
+* stage-3 per-chip param bytes ~ total/N, and its RS+AG exchange
+  bytes stay within 1.05x the analytic plan minimum;
+* the compiled stage-3 forward shows one all-gather per bucket with
+  compute interleaved between gathers (``overlap_report``), and the
+  Perfetto export renders them on collectives/compute lanes;
+* stage-3 checkpoints stamp ``sharding="zero3"`` + a stage-salted
+  plan fingerprint, so a stage-2 world refuses them (reshard), and
+  the named round-trip through ``stage3_save_params`` /
+  ``stage3_load_params`` is bit-exact.
+"""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel import get_mesh, make_train_step, zero
+from mxnet_tpu.resilience.elastic import reshard_verdict, topology_block
+
+
+def _mlp_net():
+    mx.random.seed(0)
+    onp.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"),
+                nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(init=mx.init.Xavier())
+    net(mx.nd.zeros((1, 8)))
+    return net
+
+
+def _run_stage(optimizer, stage, n_steps=6, momentum=0.9, **kw):
+    """Train the seeded MLP for ``n_steps`` under the given ZeRO stage
+    (None = the caller's kw decide); returns (loss, step_fn, params,
+    opt_state) with params still in the stage's live layout."""
+    mesh = get_mesh((8,), ("data",))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    if stage is not None:
+        kw.update(optimizer_sharding="ps", zero_stage=stage)
+    step, p, s = make_train_step(
+        _mlp_net(), loss_fn, optimizer=optimizer, learning_rate=0.1,
+        momentum=momentum, mesh=mesh, donate=False, autotune=False,
+        bucket_bound=300, **kw)
+    rng = onp.random.RandomState(0)
+    X = jnp.asarray(rng.rand(32, 8).astype("float32"))
+    y = jnp.asarray(rng.randint(0, 4, (32,)).astype("float32"))
+    key = jax.random.key(0)
+    loss = None
+    for i in range(n_steps):
+        loss, p, s = step(p, s, X, y, key, float(i + 1))
+    return float(loss), step, p, s
+
+
+def _named(step, p):
+    """Named host params regardless of live layout (stage 3 gathers
+    its flat buckets back first); block auto-prefix differs between
+    builds, align by suffix."""
+    if getattr(step, "zero_stage", None) == 3:
+        p = zero.gather_stage3_params(
+            step.zero_plan, {k: onp.asarray(v) for k, v in p.items()})
+    return {k.split("_", 1)[-1]: onp.asarray(v) for k, v in p.items()}
+
+
+# ------------------------------------------------------ bit-identity
+@pytest.mark.parametrize("optimizer,momentum", [
+    ("sgd", 0.0),   # plain sgd
+    ("sgd", 0.9),   # sgd + momentum slot
+    ("adam", 0.9),  # two slots + bias correction
+    ("lars", 0.9),  # segment-wise trust ratios over the flat bucket
+])
+def test_stages_bit_identical(optimizer, momentum):
+    finals = {}
+    losses = {}
+    for stage in (1, 2, 3):
+        loss, step, p, _ = _run_stage(optimizer, stage,
+                                      momentum=momentum)
+        losses[stage] = loss
+        finals[stage] = _named(step, p)
+    assert losses[1] == losses[2] == losses[3]
+    for stage in (1, 3):
+        assert set(finals[stage]) == set(finals[2])
+        for k in finals[2]:
+            onp.testing.assert_array_equal(
+                finals[stage][k], finals[2][k],
+                err_msg=f"stage {stage} vs 2 at {k}")
+
+
+def test_stage2_is_the_unset_default_program():
+    # zero_stage unset under ps_mode must BE stage 2 (the historic
+    # program): same variant key, same fingerprint, same collectives
+    _, step_d, p_d, _ = _run_stage("sgd", None, n_steps=1,
+                                   optimizer_sharding="ps")
+    _, step_2, p_2, _ = _run_stage("sgd", 2, n_steps=1)
+    assert step_d.zero_stage == 2
+    plan = step_d.zero_plan
+    assert zero.flat_variant_key(plan) == \
+        zero.flat_variant_key(plan, stage=2)
+    assert zero.plan_fingerprint(plan, 8) == \
+        zero.plan_fingerprint(plan, 8, stage=2)
+    n_d, n_2 = _named(step_d, p_d), _named(step_2, p_2)
+    for k in n_d:
+        onp.testing.assert_array_equal(n_d[k], n_2[k], err_msg=k)
+
+
+# ------------------------------------------- structure: wire + memory
+def _stage3_compiled():
+    mesh = get_mesh((8,), ("data",))
+    step, p, s = make_train_step(
+        _mlp_net(), gluon.loss.SoftmaxCrossEntropyLoss(),
+        optimizer="sgd", learning_rate=0.1, momentum=0.9, mesh=mesh,
+        donate=False, autotune=False, bucket_bound=300,
+        optimizer_sharding="ps", zero_stage=3)
+    rng = onp.random.RandomState(0)
+    X = jnp.asarray(rng.rand(32, 8).astype("float32"))
+    y = jnp.asarray(rng.randint(0, 4, (32,)).astype("float32"))
+    hlo = step.lower(p, s, X, y, jax.random.key(0),
+                     1.0).compile().as_text()
+    return step, p, s, hlo
+
+
+def test_stage3_exchange_bytes_within_analytic_budget():
+    step, _, _, hlo = _stage3_compiled()
+    plan = step.zero_plan
+    assert len(plan) >= 2  # bucket_bound=300 splits the MLP
+    acc = zero.collective_bytes(hlo)
+    floor = zero.analytic_exchange_bytes(plan, 8, 3)
+    measured = acc["bytes"]["reduce-scatter"] + \
+        acc["bytes"]["all-gather"]
+    analytic = floor["reduce-scatter"] + floor["all-gather"]
+    assert analytic > 0
+    # the issue's collectives-bytes budget: within 5% of the analytic
+    # minimum (and never below it — that would mean a bucket is not
+    # being exchanged at all)
+    assert analytic <= measured <= 1.05 * analytic
+    # one RS and one AG per bucket, no replicated-param gather-back
+    assert acc["counts"]["reduce-scatter"] == len(plan)
+    assert acc["counts"]["all-gather"] == len(plan)
+
+
+def test_stage3_per_chip_param_bytes_one_nth():
+    step, p, _, _ = _stage3_compiled()
+    plan = step.zero_plan
+    total_padded = sum(
+        b.padded * onp.dtype(b.dtype).itemsize for b in plan)
+    per_chip = sum(v.addressable_shards[0].data.nbytes
+                   for v in p.values())
+    assert per_chip * 8 == total_padded
+    for v in p.values():
+        assert v.sharding.spec == jax.sharding.PartitionSpec("data")
+
+
+def test_stage3_overlap_report_and_trace(tmp_path):
+    step, _, _, hlo = _stage3_compiled()
+    plan = step.zero_plan
+    rep = zero.overlap_report(hlo, plan, 8)
+    assert len(rep["gathers"]) == len(plan)
+    # the prefetch contract: compute interleaves between bucket
+    # gathers instead of all gathers stacking at the program head
+    assert rep["overlapped"]
+    trace = tmp_path / "zero3_overlap.json"
+    zero.export_overlap_trace(rep, os.fspath(trace), step_ms=2.0)
+    doc = json.loads(trace.read_text())
+    events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert events
+    lanes = {e["tid"] for e in events}
+    assert lanes == {1, 2}  # collectives lane + compute lane
+    assert any(e.get("name", "").startswith("all_gather:bucket")
+               for e in events)
+
+
+# ---------------------------------------- fingerprints + checkpoints
+def test_stage3_fingerprint_and_topology_refuse_stage2():
+    _, step, _, _ = _run_stage("sgd", 3, n_steps=1)
+    plan = step.zero_plan
+    mesh = get_mesh((8,), ("data",))
+    # the stage salt: a stage-3 plan never fingerprints like stage 2
+    assert zero.plan_fingerprint(plan, 8, 3) != \
+        zero.plan_fingerprint(plan, 8, 2)
+    topo2 = topology_block(mesh=mesh, sharding="ps", plan=plan)
+    topo3 = topology_block(mesh=mesh, sharding="zero3", plan=plan,
+                           zero_stage=3)
+    assert topo3["zero_stage"] == 3
+    verdict = reshard_verdict(topo3, topo2)
+    assert verdict["reshard"]
+    # same stage-3 world on both sides: provably no reshard
+    assert not reshard_verdict(topo3, dict(topo3))["reshard"]
+
+
+def test_stage3_param_checkpoint_roundtrip_bit_exact():
+    from mxnet_tpu.resilience.checkpoint import (stage3_load_params,
+                                                 stage3_save_params)
+
+    _, step, p, _ = _run_stage("adam", 3, n_steps=3)
+    plan = step.zero_plan
+    mesh = get_mesh((8,), ("data",))
+    named = stage3_save_params(plan, p)  # host-gathered legacy layout
+    assert set(named) == {n for b in plan for n in b.names}
+    back = stage3_load_params(plan, named, mesh=mesh)
+    assert set(back) == set(p)
+    for bk in p:
+        onp.testing.assert_array_equal(onp.asarray(back[bk]),
+                                       onp.asarray(p[bk]), err_msg=bk)
+        assert back[bk].sharding.spec == \
+            jax.sharding.PartitionSpec("data")
+
+
+# ------------------------------------------------------- env plumbing
+def test_env_knob_selects_stage_and_rejects_unknown(monkeypatch):
+    monkeypatch.setenv("MXNET_ZERO_STAGE", "3")
+    _, step, p, _ = _run_stage("sgd", None, n_steps=1)
+    assert step.zero_stage == 3
+    assert set(p) == set(zero.stage3_param_keys(step.zero_plan))
+    monkeypatch.setenv("MXNET_ZERO_STAGE", "7")
+    with pytest.raises(MXNetError):
+        _run_stage("sgd", None, n_steps=1)
+
+
+def test_env_knob_overrides_caller_stage(monkeypatch):
+    monkeypatch.setenv("MXNET_ZERO_STAGE", "1")
+    _, step, p, _ = _run_stage("sgd", 3, n_steps=1)
+    assert step.zero_stage == 1
+    # stage 1 keeps the named replicated layout
+    assert not any(k.startswith("_bucket") for k in p)

@@ -29,6 +29,15 @@ a machine-readable trend:
   floor, not a ratio), the int8 p99 rates like the fleet's (lower is
   better), and a round that shipped the phase then lost it is
   "missing quantization metric".
+* **zero-stage trend** (round 16, ZeRO) — the collectives phase's
+  ``zero`` block (stage-1 vs stage-3 sharded step on the virtual
+  mesh): the per-step RS+AG bytes over the analytic plan minimum must
+  stay <= 1.05 (extra bytes = a hidden gather or double exchange),
+  the stage-3/stage-1 per-chip param+state ratio must stay within
+  1.15x of the analytic 3/(N+2) floor, and the stage-3/stage-1 step
+  time must stay <= 1.10 — each an ABSOLUTE budget, gated every round
+  once the block ships; a round that then loses the block is
+  "missing zero metric".
 
 Exit code: 0 by default (reporting tool); ``--fail-on-regression``
 exits 2 when the LATEST headline round regressed (or lost its metric)
@@ -77,7 +86,9 @@ def load_bench(paths):
                "fleet_p99_ms": None, "fleet_shed_rate": None,
                "fleet_within_slo": None,
                "quant_p99_ms": None, "quant_agreement": None,
-               "quant_speedup": None}
+               "quant_speedup": None,
+               "zero_rs_ag_ratio": None, "zero_mem_ratio": None,
+               "zero_mem_expected": None, "zero_step_ratio": None}
         try:
             with open(path) as f:
                 doc = json.load(f)
@@ -112,6 +123,15 @@ def load_bench(paths):
                 if isinstance(arm, dict):
                     row["quant_p99_ms"] = arm.get("p99_ms")
                 row["quant_speedup"] = qt.get("speedup_p50")
+            col = parsed.get("collectives")
+            zr = col.get("zero") if isinstance(col, dict) else None
+            if isinstance(zr, dict) \
+                    and zr.get("mem_ratio") is not None:
+                stage3 = zr.get("stage3") or {}
+                row["zero_rs_ag_ratio"] = stage3.get("rs_ag_ratio")
+                row["zero_mem_ratio"] = zr["mem_ratio"]
+                row["zero_mem_expected"] = zr.get("mem_ratio_expected")
+                row["zero_step_ratio"] = zr.get("step_ratio")
         rounds[label] = row
     return rounds
 
@@ -260,6 +280,63 @@ def quantization_verdicts(rounds, threshold):
     return rounds
 
 
+def zero_verdicts(rounds, threshold):
+    """Verdict the collectives phase's ``zero`` block (ZeRO stage-1 vs
+    stage-3 A/B) round-over-round.  Unlike the headline these are
+    ABSOLUTE budgets, re-asserted every round the block ships:
+
+    * ``rs_ag_ratio`` — measured per-step reduce-scatter+all-gather
+      bytes over the plan's analytic minimum; > 1.05 means a hidden
+      gather or a double exchange crept into the stage-3 program.
+    * ``mem_ratio`` — stage-3/stage-1 per-chip param+opt-state bytes;
+      more than 1.15x the analytic expectation (3/(N+2) for adam)
+      means parameters stopped being sharded.
+    * ``step_ratio`` — stage-3/stage-1 timed step; > 1.10 means the
+      bucket-wise prefetch stopped hiding the gathers (the <=10%%
+      step-time acceptance bound).
+
+    Rounds before the block existed carry no zero verdict; once a
+    round has shipped it, a later round without it is the r05 failure
+    shape — "missing zero metric"."""
+    seen = False
+    for label in sorted(rounds):
+        row = rounds[label]
+        mem = row["zero_mem_ratio"]
+        if mem is None:
+            if seen:
+                row["zero_verdict"] = "regression"
+                row["zero_reason"] = "missing zero metric"
+            else:
+                row["zero_verdict"] = None
+                row["zero_reason"] = None
+            continue
+        reasons = []
+        wire = row["zero_rs_ag_ratio"]
+        if wire is not None and wire > 1.05:
+            reasons.append(f"RS+AG bytes x{wire:.2f} the analytic "
+                           "minimum (> 1.05)")
+        expected = row["zero_mem_expected"]
+        if expected and mem > expected * 1.15:
+            reasons.append(f"per-chip mem ratio {mem:.3f} > "
+                           f"{expected:.3f} analytic x1.15")
+        sr = row["zero_step_ratio"]
+        if sr is not None and sr > 1.10:
+            reasons.append(f"stage-3 step x{sr:.2f} stage-1 (> 1.10)")
+        if reasons:
+            row["zero_verdict"] = "regression"
+            row["zero_reason"] = "; ".join(reasons)
+        elif not seen:
+            row["zero_verdict"] = "baseline"
+            row["zero_reason"] = None
+        else:
+            row["zero_verdict"] = "ok"
+            row["zero_reason"] = (
+                f"wire x{wire:.2f}, mem {mem:.3f}, step x{sr:.2f}"
+                if None not in (wire, sr) else None)
+        seen = True
+    return rounds
+
+
 def load_opperf(paths):
     """``{round: {op: row}}`` from the per-op JSONL artifacts; rows
     keep avg and (when the artifact has them) p50/p99."""
@@ -367,6 +444,25 @@ def render(bench, opperf, threshold):
                 f"{_fmt(r['quant_p99_ms']):>10s}"
                 f"{_fmt(r['quant_speedup']):>8s}"
                 f"  {verdict}")
+    zero_rows = [label for label in sorted(bench)
+                 if bench[label].get("zero_verdict")]
+    if zero_rows:
+        lines.append("")
+        lines.append("== zero-stage trend (stage-3 vs stage-1) ==")
+        lines.append(f"{'round':<10s}{'wire':>8s}{'mem':>8s}"
+                     f"{'mem_exp':>9s}{'step':>8s}  verdict")
+        for label in zero_rows:
+            r = bench[label]
+            verdict = r["zero_verdict"]
+            if r.get("zero_reason"):
+                verdict += f": {r['zero_reason']}"
+            lines.append(
+                f"{label:<10s}"
+                f"{_fmt(r['zero_rs_ag_ratio']):>8s}"
+                f"{_fmt(r['zero_mem_ratio'], '{:.3f}'):>8s}"
+                f"{_fmt(r['zero_mem_expected'], '{:.3f}'):>9s}"
+                f"{_fmt(r['zero_step_ratio']):>8s}"
+                f"  {verdict}")
     fleet_rows = [label for label in sorted(bench)
                   if bench[label].get("fleet_verdict")]
     if fleet_rows:
@@ -441,10 +537,12 @@ def main(argv=None):
               f"{opperf_glob!r}", file=sys.stderr)
         return 1
 
-    bench = quantization_verdicts(
-        fleet_verdicts(
-            headline_verdicts(load_bench(bench_paths),
-                              args.threshold),
+    bench = zero_verdicts(
+        quantization_verdicts(
+            fleet_verdicts(
+                headline_verdicts(load_bench(bench_paths),
+                                  args.threshold),
+                args.threshold),
             args.threshold),
         args.threshold)
     opperf = opperf_diff(load_opperf(opperf_paths), args.threshold)
@@ -463,6 +561,10 @@ def main(argv=None):
         if bench[last].get("quant_verdict") == "regression":
             failures.append(
                 f"quantization {last}: {bench[last]['quant_reason']}")
+        # the zero-stage collective/memory/step budgets too (ZeRO)
+        if bench[last].get("zero_verdict") == "regression":
+            failures.append(
+                f"zero {last}: {bench[last]['zero_reason']}")
     if opperf.get("regressions"):
         failures.append(
             f"opperf {opperf['last']}: {len(opperf['regressions'])} "

@@ -56,6 +56,17 @@ Scenarios (round-robin over the schedule):
                   batch it held is re-dispatched, the pool respawns
                   (run-log counter evidence), params still match the
                   reference
+``zero3_peer_death``  the ghost-peer death lands mid-run in a ZeRO
+                  STAGE-3 step (params live as flat bucket shards;
+                  Module.fit cannot drive it, so the worker runs
+                  make_train_step(zero_stage=3) directly on the dp(2)
+                  mesh): the survivor flushes an emergency PARAMETER-
+                  SHARD checkpoint — host-gathered through
+                  stage3_save_params into the legacy named layout,
+                  stamped sharding="zero3" + plan fingerprint — heal-
+                  exits rc 83, and the relaunch verifies the
+                  fingerprint, re-shards via stage3_load_params and
+                  finishes shard-exact vs the reference
 ================  ====================================================
 
 Usage::
@@ -82,12 +93,13 @@ sys.path.insert(0, _REPO)
 
 SCENARIOS = ("sigkill", "sigterm_drain", "peer_death",
              "heartbeat_delay", "ckpt_async_crash", "ckpt_write_crash",
-             "collective_delay", "record_corrupt", "io_worker_kill")
+             "collective_delay", "record_corrupt", "io_worker_kill",
+             "zero3_peer_death")
 
 #: scenarios that intentionally kill the victim (a relaunch+resume is
 #: expected); the others must complete on attempt 0
 _LETHAL = {"sigkill", "sigterm_drain", "peer_death",
-           "ckpt_async_crash", "ckpt_write_crash"}
+           "ckpt_async_crash", "ckpt_write_crash", "zero3_peer_death"}
 
 
 # ======================================================= worker half
@@ -105,6 +117,155 @@ def _build_rec_corpus(path, n=32):
     return path
 
 
+def _worker_zero3(args, attempt):
+    """The ZeRO stage-3 arm: the live params are flat bucket shards
+    (``make_train_step(zero_stage=3)``), which ``Module.fit`` cannot
+    drive, so the training loop is explicit.  Attempt 0 arms healing
+    against a fake 2-rank world, plants a live ghost beat, backdates
+    it at the scheduled step, and the PeerDeadError at the next
+    step-boundary poll flushes an emergency PARAMETER-SHARD
+    checkpoint (host-gathered via ``stage3_save_params``, stamped
+    ``sharding="zero3"``) before heal-exiting rc 83.  The relaunch
+    refuses a fingerprint mismatch (``reshard_verdict``), re-shards
+    via ``stage3_load_params`` and must finish shard-exact."""
+    import pickle
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, telemetry
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import get_mesh, make_train_step
+    from mxnet_tpu.resilience import healing
+    from mxnet_tpu.resilience.checkpoint import (
+        CheckpointManager, stage3_load_params, stage3_save_params)
+    from mxnet_tpu.resilience.elastic import (
+        host_gather, reshard_verdict, topology_block)
+
+    mx.random.seed(11)
+    onp.random.seed(11)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(init=mx.init.Xavier())
+    net(mx.nd.zeros((1, 10)))
+
+    mesh = get_mesh((2,), ("data",))
+    step, params, opt_state = make_train_step(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), optimizer="adam",
+        learning_rate=0.05, mesh=mesh, donate=False, autotune=False,
+        optimizer_sharding="ps", zero_stage=3, bucket_bound=200)
+    plan = step.zero_plan
+    topo = topology_block(mesh=mesh, sharding="zero3", plan=plan,
+                          zero_stage=3)
+
+    rng = onp.random.RandomState(7)
+    X = rng.randn(64, 10).astype("float32")
+    y = (X @ rng.randn(10, 4)).argmax(axis=1).astype("float32")
+    batches = [(jnp.asarray(X[o:o + 8]), jnp.asarray(y[o:o + 8]))
+               for o in range(0, 64, 8)]
+    total = int(args.epochs) * len(batches)
+    key = jax.random.key(3)
+
+    def _save(mgr, done):
+        # a fresh version id per save (the mid-epoch-drain rule: never
+        # rewrite an existing version in place); `step` carries the
+        # resume cursor
+        ver = (mgr.latest_epoch() or 0) + 1
+        mgr.save(ver, arg_params=stage3_save_params(plan, params),
+                 optimizer_states=pickle.dumps(jax.tree_util.tree_map(
+                     host_gather, opt_state)),
+                 step=done, epoch=done, topology=topo)
+
+    start = 0
+    mgr = CheckpointManager(args.prefix) if args.prefix else None
+    if attempt > 0 and mgr is not None \
+            and mgr.latest_epoch() is not None:
+        st = mgr.load()
+        verdict = reshard_verdict(st["topology"], topo)
+        if (st["topology"] or {}).get("sharding") != "zero3" \
+                or verdict["reshard"]:
+            raise RuntimeError(
+                "zero3 resume refused: checkpoint topology "
+                f"{st['topology']} does not match the live plan: "
+                f"{verdict}")
+        params = stage3_load_params(plan, st["arg_params"], mesh=mesh)
+        opt_state = jax.tree_util.tree_map(
+            jnp.asarray, pickle.loads(st["optimizer_states"]))
+        start = int(st["step"])
+        telemetry.heal("healed_resume", detail=f"step={start}",
+                       attempt=attempt)
+
+    ghost_at = int(os.environ.get("CHAOS_GHOST_AT_BATCH", "0"))
+    hb_dir = f"{args.prefix}.hb" if args.prefix else None
+    ghost = {"armed": False, "stale": False}
+
+    def _ghost_tick(t):
+        # same choreography as the fit-level peer_death scenario: arm
+        # + plant a live foreign-host ghost at the first boundary,
+        # keep it beating, backdate it past the timeout at the
+        # scheduled step
+        if not ghost["armed"]:
+            ghost["armed"] = True
+            healing.arm(hb_dir, rank=0, num_ranks=2, timeout=0.5)
+            healing._write_beat(hb_dir, 1)
+            _unhost(hb_dir)
+        elif not ghost["stale"] and t + 1 >= ghost_at:
+            ghost["stale"] = True
+            path = healing._hb_path(hb_dir, 1)
+            old = time.time() - 999.0
+            os.utime(path, (old, old))
+        elif not ghost["stale"]:
+            healing._write_beat(hb_dir, 1)
+            _unhost(hb_dir)
+
+    def _unhost(hb_dir):
+        path = healing._hb_path(hb_dir, 1)
+        with open(path) as f:
+            payload = json.load(f)
+        payload["host"] = "chaos-ghost"
+        with open(path, "w") as f:
+            f.write(json.dumps(payload))
+
+    done = start
+    try:
+        for t in range(start, total):
+            if attempt == 0 and ghost_at > 0 and hb_dir:
+                _ghost_tick(t)
+            healing.poll(step=t)
+            xb, yb = batches[t % len(batches)]
+            _, params, opt_state = step(params, opt_state, xb, yb,
+                                        key, float(t + 1))
+            done = t + 1
+            if mgr is not None and done % 5 == 0:
+                _save(mgr, done)
+    except healing.PeerDeadError as e:
+        print(f"chaos-worker: peer death detected ({e}); flushing "
+              "parameter shards and healing out", flush=True)
+        telemetry.heal("peer_death", detail=str(e))
+        if mgr is not None:
+            _save(mgr, done)
+        healing.heal_exit("peer_death")
+    finally:
+        healing.disarm()
+
+    import threading
+
+    telemetry.close()
+    stray = [t.name for t in threading.enumerate()
+             if t.is_alive() and not t.daemon
+             and t is not threading.main_thread()]
+    final = stage3_save_params(plan, params)
+    print(json.dumps({
+        "final": {k: onp.asarray(v).ravel().tolist()
+                  for k, v in sorted(final.items())},
+        "threads_ok": not stray, "stray_threads": stray,
+        "attempt": attempt}), flush=True)
+    return 0
+
+
 def _worker(args):
     """One training run (the supervised command): attempt 0 arms the
     scenario's faults and may die; relaunch attempts scrub the faults
@@ -118,6 +279,8 @@ def _worker(args):
     if attempt > 0:
         os.environ.pop("MXNET_FAULT_SPEC", None)
         os.environ.pop("CHAOS_GHOST_AT_BATCH", None)
+    if args.ctx == "zero3":
+        return _worker_zero3(args, attempt)
 
     import numpy as onp
 
@@ -281,6 +444,8 @@ def _schedule(seed, runs, scenarios):
             entry["signal"] = int(signal.SIGTERM)
         elif scen == "peer_death":
             entry["ghost_at_batch"] = rng.randint(2, 6)
+        elif scen == "zero3_peer_death":
+            entry["ghost_at_batch"] = rng.randint(2, 6)
         elif scen == "heartbeat_delay":
             entry["self_heal"] = 1
             # window pinned to start at hit 1: inline beats are
@@ -361,6 +526,8 @@ def _ctx_for(entry):
         return "dp2"
     if entry["scenario"] in ("record_corrupt", "io_worker_kill"):
         return "rec"  # reference: same corrupt corpus, 0 workers
+    if entry["scenario"] == "zero3_peer_death":
+        return "zero3"  # reference: same loop, no ghost, no faults
     return "cpu"
 
 
@@ -500,12 +667,13 @@ def campaign(args):
         # victim's log: a declared death and an emergency/fallback
         # checkpoint before the heal_exit
         relaunched = os.path.exists(f"{prefix}.runlog.a1.jsonl")
-        if scen in ("peer_death", "ckpt_async_crash",
+        if scen in ("peer_death", "zero3_peer_death",
+                    "ckpt_async_crash",
                     "ckpt_write_crash") and not relaunched:
             problems.append(
                 "scenario guarantees a death but no relaunch run log "
                 "exists — the fault never fired")
-        if scen == "peer_death" and relaunched:
+        if scen in ("peer_death", "zero3_peer_death") and relaunched:
             heals = []
             try:
                 with open(f"{prefix}.runlog.a0.jsonl") as f:
@@ -539,8 +707,8 @@ def campaign(args):
         fault_landed = False
         if "kill_delay_s" in entry:
             fault_landed = kill_result["delivered"] or relaunched
-        elif scen in ("peer_death", "ckpt_async_crash",
-                      "ckpt_write_crash"):
+        elif scen in ("peer_death", "zero3_peer_death",
+                      "ckpt_async_crash", "ckpt_write_crash"):
             fault_landed = relaunched
         elif scen in ("record_corrupt", "io_worker_kill"):
             # data-plane evidence: the victim's run_end counters must

@@ -81,6 +81,22 @@ def fsck(arg, check_all=False):
         report["prefixes"].append(entry)
         if not eps:
             continue
+        # parameter-shard recognition: a zero3-stamped topology means
+        # the run's LIVE params were flat bucket shards and the
+        # .params payload is the host-gathered named layout — worth
+        # naming in the report (informational; the CRC walk below is
+        # layout-agnostic)
+        try:
+            topo = (mgr._read_manifest(eps[-1]) or {}).get(
+                "topology") or {}
+        except Exception:
+            topo = {}
+        if topo.get("sharding"):
+            entry["sharding"] = topo["sharding"]
+            if topo.get("zero_stage") is not None:
+                entry["zero_stage"] = int(topo["zero_stage"])
+            if topo.get("plan_fingerprint"):
+                entry["plan_fingerprint"] = topo["plan_fingerprint"]
         to_check = eps if check_all else [eps[-1]]
         for e in to_check:
             report["versions_checked"] += 1
@@ -123,6 +139,14 @@ def main(argv=None):
             print(f"{entry['prefix']}: versions={entry['versions']} "
                   f"checked={entry['checked']} "
                   f"bad={[b['version'] for b in entry['bad']]}")
+            if entry.get("sharding") == "zero3":
+                print("  note: parameter-shard checkpoint (ZeRO stage "
+                      "3, plan "
+                      f"{entry.get('plan_fingerprint', '?')}): the "
+                      ".params payload is the host-gathered named "
+                      "layout; resuming sharded re-shards via "
+                      "stage3_load_params after a reshard_verdict "
+                      "fingerprint check")
             for t in entry["stray_temps"]:
                 print(f"  note: stray temp {t} (crash mid-write; "
                       "final artifact untouched)")
