@@ -1145,6 +1145,100 @@ def _measure_serving(net, smoke, deadline):
     }
 
 
+def _measure_generate(smoke, deadline):
+    """Generative decode INFERENCE phase (round 17): stand the paged-
+    KV continuous-batching server (mxnet_tpu.serving.generate) on the
+    toy decoder and drive BURSTY load — two bursts of ragged prompts
+    submitted at once, so token-budget admission, slot eviction and
+    the compile-once decode loop all execute for real.  Reports
+    tokens/s, TTFT p50/p99, max sequences in flight, eviction/shed
+    counts, the post-warm compile count (the zero-retrace proof) and
+    the int8-vs-fp32 capacity ratio from page-pool accounting into
+    the headline JSON."""
+    import numpy as onp
+
+    from mxnet_tpu.serving import (GenerativeServer, PagedKVPool,
+                                   ServeRejected)
+
+    rng = onp.random.default_rng(42)
+    vocab, layers, heads, head_dim = 32, 2, 2, 8
+    prompt_buckets = (4, 8) if smoke else (4, 8, 16)
+    max_new = 6 if smoke else 12
+    slots = 4 if smoke else 8
+    page_tokens = 4
+    pool_budget = 64 * 1024
+    n_req = 16 if smoke else 64
+    srv = GenerativeServer(
+        seed=0, vocab=vocab, layers=layers, heads=heads,
+        head_dim=head_dim, prompt_buckets=prompt_buckets,
+        max_new=max_new, slots=slots, page_tokens=page_tokens,
+        pool_budget=pool_budget, kv_dtype="int8",
+        evict_after_ms=25.0, name="bench-generate")
+    srv.start(warm=True)
+    shed = submitted = 0
+    try:
+        for _burst in range(2):
+            if deadline.exceeded():
+                deadline.note("generate:burst")
+                break
+            handles = []
+            for _ in range(n_req // 2):
+                submitted += 1
+                n = int(rng.integers(1, prompt_buckets[-1] + 1))
+                prompt = [int(t) for t in rng.integers(0, vocab, n)]
+                try:
+                    handles.append(srv.submit(prompt))
+                except ServeRejected:
+                    shed += 1
+            for h in handles:
+                try:
+                    h.result(timeout=60)
+                except ServeRejected:
+                    shed += 1
+        rep = srv.report()
+        st = dict(srv.stats)
+        agreement = srv.kv_agreement
+    finally:
+        srv.drain(timeout=10.0)
+        srv.close()
+    # the capacity acceptance ratio comes from page-pool ACCOUNTING
+    # alone (never wall clock): same byte budget, fp32 vs int8 pages,
+    # concurrent sequences of the campaign's full token budget
+    tokens_per_seq = prompt_buckets[-1] + max_new
+    cap = {}
+    for d in ("float32", "int8"):
+        pool = PagedKVPool(layers, heads, head_dim,
+                           page_tokens=page_tokens,
+                           budget_bytes=pool_budget, dtype=d)
+        cap[d] = pool.capacity_sequences(tokens_per_seq)
+    return {
+        # the ACTUAL offered load: a deadline break mid-phase must not
+        # overstate it (completed + shed == requests, smoke-asserted)
+        "requests": submitted,
+        "admitted": st["admitted"],
+        "completed": st["completed"],
+        "shed": shed,
+        "rejected_by_reason": st["rejected"],
+        "tokens": rep["tokens"],
+        "tokens_s": rep["tokens_s"],
+        "ttft_p50_ms": rep["ttft_p50_ms"],
+        "ttft_p99_ms": rep["ttft_p99_ms"],
+        "max_in_flight": rep["max_in_flight"],
+        "evictions": rep["evictions"],
+        "pages_in_use": rep["pages_in_use"],
+        # campaign stats were reset after warm start: any nonzero here
+        # is a retrace of the decode/prefill programs under load
+        "compiles_after_warm": st["compiles"],
+        "warm_traces": st["warm_traces"],
+        "kv_dtype": st["kv_dtype_effective"],
+        "kv_agreement": agreement,
+        "capacity_fp32_seqs": cap["float32"],
+        "capacity_int8_seqs": cap["int8"],
+        "capacity_ratio_int8": round(cap["int8"] /
+                                     max(cap["float32"], 1), 2),
+    }
+
+
 def _measure_fleet(smoke, deadline):
     """Fleet INFERENCE phase (round 15): stand the replicated serving
     fleet (mxnet_tpu.serving.FleetRouter) — 2 replica server
@@ -2043,6 +2137,25 @@ def main(argv=None):
             out["degraded"] = True
             reasons.append(f"quantization phase failed: {exc!r}")
     _write_partial(out, "quantization")
+
+    # generative decode INFERENCE phase (round 17): paged-KV-resident
+    # continuous batching under bursty ragged-prompt load — tokens/s,
+    # TTFT p50/p99, eviction/shed counts, the zero-retrace proof and
+    # the int8 capacity ratio land in the headline JSON
+    if deadline.exceeded(margin=0.0 if args.smoke else 60.0):
+        out["generate"] = "skipped (deadline)"
+        out["degraded"] = True
+        reasons.append("deadline: skipped generate phase")
+        deadline.note("generate")
+    else:
+        _heartbeat("generate")
+        try:
+            out["generate"] = _measure_generate(args.smoke, deadline)
+        except Exception as exc:  # auxiliary metric: never kill the run
+            out["generate"] = {"error": repr(exc)}
+            out["degraded"] = True
+            reasons.append(f"generate phase failed: {exc!r}")
+    _write_partial(out, "generate")
 
     # fleet INFERENCE phase (round 15): 2 replica serving processes
     # behind the fault-tolerant router — bursty load over HTTP, a

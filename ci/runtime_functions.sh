@@ -255,12 +255,35 @@ quantize_smoke() {
     JAX_PLATFORMS=cpu python -m pytest tests/test_quantization.py -q
 }
 
+generate_smoke() {
+    # generative decode serving gate (round 17) on CPU in seconds:
+    # the paged KV pool's token-budget admission accounting (int8
+    # pages >= 1.8x fp32 concurrency under the same byte budget), the
+    # paged-decode-attention variants vs the dense reference with the
+    # null-page masking contract, decode matching the autoregressive
+    # full-forward reference token-for-token, the bursty continuous-
+    # batching campaign with admits+evictions and ZERO post-warm
+    # compiles, eviction-resume exactness, the serve.decode breaker
+    # drill (pages reclaimed, model_error shed, recovery), the
+    # telemetry record/counter/textfile contract, and the per-bucket
+    # latency EWMA + causal ragged-tail units that ride along.  Also
+    # collected by tier-1 (tests/test_generate.py), so a regression
+    # turns the unit suite red between CI runs.
+    JAX_PLATFORMS=cpu python -m pytest tests/test_generate.py -q
+    # the bench's generative INFERENCE phase end to end in --smoke
+    # mode: tokens/s + TTFT p99 + capacity ratio smoke-asserted
+    JAX_PLATFORMS=cpu python -m pytest \
+        "tests/test_bench_smoke.py::test_smoke_emits_valid_json_with_heartbeats" \
+        -q
+}
+
 chaos_smoke() {
     # the seeded chaos campaign (rounds 16-17): >=27 reproducible
-    # faults across all 10 scenario classes (SIGKILL at a seeded
-    # delay, mid-epoch record corruption, the io-worker kill and the
+    # faults across all 11 scenario classes (SIGKILL at a seeded
+    # delay, mid-epoch record corruption, the io-worker kill, the
     # ZeRO stage-3 mid-step ghost-peer death with its parameter-shard
-    # emergency checkpoint included) on the CPU mesh, each run
+    # emergency checkpoint, and the round-17 generative decode-fault
+    # breaker drill included) on the CPU mesh, each run
     # supervised by the healing respawn policy and gated on the three
     # invariants — zero hangs, zero torn artifacts
     # (tools/ckpt_fsck.py --all clean after every run), every healed

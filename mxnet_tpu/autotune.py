@@ -84,6 +84,14 @@ VARIANT_OPS = {
     # only where it measures a win.
     "quantized_conv": {"fp32": False, "int8": True},
     "quantized_fc": {"fp32": False, "int8": True},
+    # round 17: decode-time attention over the PAGED kv cache
+    # (ops/flash_attention.paged_decode_attention) — "gather"
+    # materializes each slot's pages then runs one fused masked
+    # softmax (XLA's fusion, wins at small pools), "paged" walks the
+    # page list with an online-softmax accumulator (the vLLM-style
+    # schedule, wins when the page table is long).  Raced by the
+    # generative server's warmup on the real pool shapes.
+    "paged_decode_attention": {"gather": "gather", "paged": "paged"},
 }
 
 
@@ -116,6 +124,18 @@ def _parse_bnreluconv(raw):
     return lowered if lowered in ("stock", "jnp", "pallas") else None
 
 
+def _parse_paged(raw):
+    """MXNET_PAGED_ATTENTION: gather/0 pins the dense-gather decode
+    attention, paged/1 the online-softmax page walk; anything else
+    (e.g. 'auto') carries no override — the measured winner decides."""
+    lowered = raw.lower()
+    if lowered in ("0", "false", "no", "off", "gather", "dense"):
+        return "gather"
+    if lowered in ("1", "true", "yes", "on", "paged"):
+        return "paged"
+    return None
+
+
 def _parse_quantize(raw):
     """MXNET_QUANTIZE: 0/off/fp32 pins the fp32 fallback arm,
     1/on/int8 pins the int8 program; anything else (e.g. 'auto')
@@ -142,6 +162,7 @@ _ENV_OVERRIDE = {
     # story is "quantization on/off", not per-op)
     "quantized_conv": ("MXNET_QUANTIZE", _parse_quantize),
     "quantized_fc": ("MXNET_QUANTIZE", _parse_quantize),
+    "paged_decode_attention": ("MXNET_PAGED_ATTENTION", _parse_paged),
 }
 
 

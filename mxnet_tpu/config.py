@@ -420,6 +420,38 @@ register_env("MXNET_QUANT_CALIB_BATCHES", 10, int,
              "Default number of calibration batches "
              "quantization.calibrate folds through the range "
              "collector when the caller does not pass num_batches.")
+register_env("MXNET_KV_PAGE_TOKENS", 16, int,
+             "Tokens per KV-cache page of the generative decode "
+             "server (serving.kvcache.PagedKVPool): sequences hold "
+             "ceil(tokens/page_tokens) pages, so smaller pages waste "
+             "less tail HBM per sequence but grow the page table the "
+             "decode step walks.")
+register_env("MXNET_KV_POOL_BUDGET", 4194304, int,
+             "HBM byte budget of the paged KV-cache pool "
+             "(serving.kvcache.PagedKVPool), the generative analog of "
+             "MXNET_FLEET_HBM_BUDGET_MB: the pool sizes its physical "
+             "page count to fit under this many bytes and admission "
+             "is by TOKEN budget (prompt + max_new reserved up "
+             "front), not request count.")
+register_env("MXNET_DECODE_SLOTS", 8, int,
+             "Decode-slot capacity of the generative server "
+             "(serving.generate.GenerativeServer): the token-level "
+             "continuous-batching step is compiled ONCE over this "
+             "fixed slot tensor; sequences are admitted/evicted by "
+             "in-place slot updates, never by retrace.")
+register_env("MXNET_KV_DTYPE", "float32", str,
+             "KV-cache storage dtype of the generative server: "
+             "'float32' or 'int8' (per-(token, head) symmetric "
+             "scales riding the quantization/ machinery).  int8 is "
+             "adopted only if the warmup agreement probe clears the "
+             "output-agreement floor, else the pool falls back to "
+             "fp32 and stats['kv_dtype_effective'] says so.")
+register_env("MXNET_PAGED_ATTENTION", "", str,
+             "Hand override for the 'paged_decode_attention' autotune "
+             "variant (round 17): gather/0 (materialize the page "
+             "table's K/V then one fused softmax) or paged/1 (page-"
+             "blockwise online-softmax walk).  Unset: the cached "
+             "winner from the generative server's warmup race.")
 register_env("MXNET_FLEET_SCALE_EWMA", 0.2, float,
              "EWMA smoothing factor of the fleet autoscaler's "
              "queue-depth signal (serving.FleetRouter): each health-"

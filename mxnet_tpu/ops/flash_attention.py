@@ -380,6 +380,120 @@ def flash_attention(q, k, v, causal=False, sm_scale=None,
                   None, None)
 
 
+def _resolve_paged_variant(variant):
+    """Trace-time decision for the decode-cache attention: explicit
+    arg > the autotune registry's ``paged_decode_attention`` choice
+    (force > MXNET_PAGED_ATTENTION > cached winner) > gather."""
+    if variant is not None:
+        return variant
+    from ..autotune import variant_choice
+
+    return variant_choice("paged_decode_attention", default="gather")
+
+
+def _dequant_block(blk, scale):
+    """fp32 view of a gathered KV block; ``scale`` is the int8 cache's
+    per-(token, head) factor (quantization.kv contract), None = the
+    block is already a float dtype."""
+    if scale is None:
+        return blk.astype(jnp.float32)
+    return blk.astype(jnp.float32) * scale.astype(jnp.float32)[..., None]
+
+
+def paged_decode_attention(q, k_pages, v_pages, page_table, seq_lens,
+                           sm_scale=None, k_scale=None, v_scale=None,
+                           variant=None):
+    """Single-token decode attention over a PAGED KV cache (round 17).
+
+    The generative server's decode step calls this once per layer per
+    token: each decode slot's query attends to the keys its page-table
+    row maps into the physical page pool — never to another sequence's
+    pages, never to unwritten tail positions.
+
+    Operands::
+
+      q          (slots, heads, head_dim)   one query token per slot
+      k_pages    (pages, page_tokens, heads, head_dim)  physical pool
+      v_pages    (pages, page_tokens, heads, head_dim)
+      page_table (slots, max_pages) int32   logical -> physical pages
+      seq_lens   (slots,) int32             valid tokens per slot
+
+    ``k_scale``/``v_scale`` (pages, page_tokens, heads) mark an int8
+    pool: blocks dequantize AFTER the gather (per block in the paged
+    walk), so HBM holds int8 + scales only.  A slot with seq_len 0 is
+    inactive: every key masks out and the output row is exactly zero —
+    the same fully-masked-row guard as the flash kernel's l=0 path.
+
+    Variants (autotune op ``paged_decode_attention``): ``gather``
+    materializes the slot's K/V with one fancy-index gather then runs
+    a dense masked softmax; ``paged`` walks the page list with an
+    online-softmax accumulator (m/l/acc carry, one page live at a
+    time) — flash-attention's schedule transposed onto the page table.
+    Both are exact (no approximation), so the race is purely a speed
+    decision.
+    """
+    slots, heads, head_dim = q.shape
+    page_tokens = k_pages.shape[1]
+    max_pages = page_table.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / (head_dim ** 0.5)
+    variant = _resolve_paged_variant(variant)
+    qf = q.astype(jnp.float32)
+
+    if variant == "paged":
+        def body(i, carry):
+            m, l, acc = carry
+            phys = page_table[:, i]  # (slots,)
+            k_blk = _dequant_block(
+                k_pages[phys],
+                None if k_scale is None else k_scale[phys])
+            v_blk = _dequant_block(
+                v_pages[phys],
+                None if v_scale is None else v_scale[phys])
+            s = jnp.einsum("shd,sthd->sht", qf, k_blk) * sm_scale
+            pos = i * page_tokens + jnp.arange(page_tokens)
+            s = jnp.where(pos[None, None, :] < seq_lens[:, None, None],
+                          s, -jnp.inf)
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+            p = jnp.exp(s - m_safe[..., None])
+            p = jnp.where(jnp.isfinite(s), p, 0.0)
+            alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+            l = l * alpha + p.sum(axis=-1)
+            acc = acc * alpha[..., None] + \
+                jnp.einsum("sht,sthd->shd", p, v_blk)
+            return m_new, l, acc
+
+        m0 = jnp.full((slots, heads), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((slots, heads), jnp.float32)
+        acc0 = jnp.zeros((slots, heads, head_dim), jnp.float32)
+        m, l, acc = jax.lax.fori_loop(0, max_pages, body, (m0, l0, acc0))
+        return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+    # gather: one fancy-index gather materializes (slots, total, H, D)
+    k = _dequant_block(
+        k_pages[page_table],
+        None if k_scale is None else k_scale[page_table])
+    v = _dequant_block(
+        v_pages[page_table],
+        None if v_scale is None else v_scale[page_table])
+    total = max_pages * page_tokens
+    k = k.reshape(slots, total, heads, head_dim)
+    v = v.reshape(slots, total, heads, head_dim)
+    s = jnp.einsum("shd,sthd->sht", qf, k) * sm_scale
+    pos = jnp.arange(total)
+    s = jnp.where(pos[None, None, :] < seq_lens[:, None, None], s,
+                  -jnp.inf)
+    m = s.max(axis=-1, keepdims=True)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe)
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l = p.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("sht,sthd->shd", p, v) / jnp.maximum(l[..., 0],
+                                                          1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
 @register_op("_contrib_dot_product_attention",
              aliases=("dot_product_attention",))
 def dot_product_attention(q, k, v, *, num_heads=1, causal=False,

@@ -27,9 +27,15 @@ artifact:
    load-not-retrace, retrace counter 0 — with ``fleet.rolling_swap``
    upgrading a live fleet fp32 -> int8 under traffic.
 
+Round 17 adds the KV-cache arm of the same story: :mod:`.kv` holds the
+per-(token, head) symmetric int8 quantize/dequantize pair plus the
+page-byte accounting the generative server's paged cache
+(serving.kvcache) admits sequences against — gated, like the layer
+rewrites above, by a measured output-agreement floor.
+
 Env knobs (config.py): ``MXNET_QUANTIZE`` (hand override of the
 adoption race), ``MXNET_QUANT_CALIB_MODE``,
-``MXNET_QUANT_CALIB_BATCHES``.
+``MXNET_QUANT_CALIB_BATCHES``; the KV cache reads ``MXNET_KV_DTYPE``.
 """
 from .calibrate import (  # noqa: F401
     QUANTIZABLE_OPS,
@@ -39,6 +45,11 @@ from .calibrate import (  # noqa: F401
     calibrate_block,
     calibrate_module,
     optimal_threshold,
+)
+from .kv import (  # noqa: F401
+    kv_dequantize,
+    kv_page_bytes,
+    kv_quantize,
 )
 from .rewrite import (  # noqa: F401
     QuantizedConv,
@@ -56,4 +67,5 @@ __all__ = [
     "QUANTIZABLE_OPS", "quantize_net", "tune_quantized",
     "quantized_layers", "QuantizedDense", "QuantizedConv",
     "QuantizedPooling", "QuantizedFlatten",
+    "kv_quantize", "kv_dequantize", "kv_page_bytes",
 ]

@@ -1,0 +1,59 @@
+"""int8 KV-cache quantization (round 17).
+
+The generative decode server's HBM-capacity lever: KV-cache pages
+stored int8 with one symmetric scale per (token, head) — the same
+``_INT8_RANGE`` convention as the quantized-inference operators
+(ops/quantization_ops), applied along the head_dim axis that a single
+attention dot consumes.  Per-(token, head) granularity is the sweet
+spot for a cache: one fp32 scale amortizes over head_dim int8 values
+(head_dim >= 8 gives >= 2.6x the fp32 footprint), while per-tensor
+scales would let one outlier token crush every other token's
+resolution.
+
+Consumed by serving.kvcache.PagedKVPool (storage) and
+ops.flash_attention.paged_decode_attention (dequantize-on-gather
+inside the jitted decode step).  Adoption is gated like the PR-13
+int8 programs: the generative server's warmup probes per-token output
+agreement against an fp32-cache arm and falls back below the floor.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.quantization_ops import _INT8_RANGE, _minmax_scale
+
+__all__ = ["kv_quantize", "kv_dequantize", "kv_page_bytes"]
+
+
+def kv_quantize(x):
+    """Symmetric int8 quantization of ``(..., head_dim)`` KV vectors.
+
+    Returns ``(q, scale)`` — ``q`` int8 with x ~= q * scale, ``scale``
+    fp32 of shape ``x.shape[:-1]`` (one per (token, head) when fed the
+    cache's ``(..., tokens, heads, head_dim)`` layout).  An all-zero
+    vector quantizes to zeros with scale 0 and round-trips exactly.
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    inv, amax = _minmax_scale(-amax, amax)  # inv = 127/amax (1.0 at 0)
+    q = jnp.clip(jnp.round(xf * inv[..., None]), -127, 127).astype(
+        jnp.int8)
+    return q, amax / _INT8_RANGE
+
+
+def kv_dequantize(q, scale):
+    """Inverse of :func:`kv_quantize`: ``q * scale`` back to fp32."""
+    return q.astype(jnp.float32) * scale[..., None].astype(jnp.float32)
+
+
+def kv_page_bytes(layers, page_tokens, heads, head_dim, dtype):
+    """Bytes one physical KV page costs in the given storage dtype —
+    the page-pool accounting the token-budget admission (and the
+    int8-capacity acceptance ratio) is measured from.  K and V both
+    stored; int8 carries one fp32 scale per (token, head)."""
+    per_tok_head = {"int8": head_dim * 1 + 4,
+                    "float32": head_dim * 4,
+                    "bfloat16": head_dim * 2}.get(str(dtype))
+    if per_tok_head is None:
+        raise ValueError(f"unsupported KV-cache dtype {dtype!r}")
+    return 2 * layers * page_tokens * heads * per_tok_head

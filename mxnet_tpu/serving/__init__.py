@@ -33,10 +33,26 @@ Round 15 scales it out (:mod:`.fleet` / :mod:`.frontend`):
   queue-depth-EWMA autoscaling riding the round-12
   reshard-not-restart resize, and rolling fleet-wide swaps.
 
-Fault points ``serve.admit`` / ``serve.batch`` / ``serve.model`` and
-``fleet.route`` / ``fleet.replica`` / ``fleet.swap`` are registered
-with :mod:`mxnet_tpu.resilience.faultsim` when this package imports,
-so ``MXNET_FAULT_SPEC`` drills can target the serving path.
+Round 17 adds the GENERATIVE decode path (:mod:`.generate` /
+:mod:`.kvcache`) — the workload the stateless batcher cannot serve:
+
+* :class:`~mxnet_tpu.serving.kvcache.PagedKVPool` — fixed physical
+  KV-page pool under an HBM byte budget with token-budget admission
+  (pages for prompt+max_new reserved up front) and an optional int8
+  storage dtype (per-(token, head) scales) that multiplies concurrent
+  capacity, gated by a measured output-agreement floor.
+* :class:`~mxnet_tpu.serving.generate.GenerativeServer` —
+  prefill/decode disaggregation with token-level continuous batching:
+  bucketed prefill (compile events bounded and counted), a
+  fixed-capacity decode slot tensor whose step compiles ONCE
+  (admission/eviction are in-place slot updates, never retraces), and
+  the same breaker/shed/drain failure story as ModelServer.
+
+Fault points ``serve.admit`` / ``serve.batch`` / ``serve.model`` /
+``serve.prefill`` / ``serve.decode`` and ``fleet.route`` /
+``fleet.replica`` / ``fleet.swap`` are registered with
+:mod:`mxnet_tpu.resilience.faultsim` when this package imports, so
+``MXNET_FAULT_SPEC`` drills can target the serving path.
 """
 from .fleet import (  # noqa: F401
     FleetRouter,
@@ -45,6 +61,12 @@ from .fleet import (  # noqa: F401
     artifact_reserved_bytes,
 )
 from .frontend import ServeFrontend  # noqa: F401
+from .generate import (  # noqa: F401
+    GenerateHandle,
+    GenerativeServer,
+    toy_decoder_params,
+)
+from .kvcache import PagedKVPool  # noqa: F401
 from .server import (  # noqa: F401
     ModelServer,
     ServeHandle,
@@ -55,4 +77,5 @@ from .server import (  # noqa: F401
 __all__ = ["ModelServer", "ServeHandle", "ServeRejected",
            "default_buckets", "ModelHost", "FleetRouter",
            "ServeFrontend", "SwapRolledBack",
-           "artifact_reserved_bytes"]
+           "artifact_reserved_bytes", "GenerativeServer",
+           "GenerateHandle", "PagedKVPool", "toy_decoder_params"]

@@ -1,0 +1,958 @@
+"""Generative decode serving: paged-KV continuous batching (round 17).
+
+The ModelServer/fleet stack (rounds 8/10/13) batches STATELESS
+single-shot requests; this module serves the workload it cannot — an
+autoregressive transformer where every sequence carries per-request
+device state (the KV cache) across many steps.  Two canonical levers,
+built from parts the repo already has:
+
+**Paged KV cache** (serving.kvcache.PagedKVPool): per-sequence KV
+blocks allocated from a fixed physical page pool sized under an HBM
+byte budget (the ModelHost admission idea applied to decode state).
+Admission is by TOKEN budget — a sequence reserves pages for
+``prompt + max_new`` up front, so an admitted sequence can never OOM
+the pool mid-decode.  ``MXNET_KV_DTYPE=int8`` stores pages int8 with
+per-(token, head) scales (quantization.kv) — ~2.7x the concurrent
+sequences at head_dim 8 — gated by a warmup output-agreement probe
+against an fp32-cache arm, exactly like the round-13 int8 adoption
+floor.
+
+**Prefill/decode disaggregation** with token-level continuous
+batching (the ORCA schedule round 8's batcher cites): prompts prefill
+one at a time on BUCKETED lengths (compile events bounded by the
+bucket list and counted like ModelServer._note_shape), racing
+``flash_attention``'s pallas_pad variant on the ragged shapes; the
+decode loop then runs over a FIXED-capacity slot tensor
+(``MXNET_DECODE_SLOTS``) so the decode step compiles ONCE — sequences
+are admitted/evicted by in-place slot updates (page-table rows,
+seq_lens, last-token ids), never by retrace.  Decode attention walks
+the page table via ops.flash_attention.paged_decode_attention, whose
+gather/paged variants race through autotune like every other kernel.
+
+Failure story mirrors ModelServer: a ``serve.decode`` faultsim point
+fires inside every decode step; consecutive failures trip the breaker
+— in-flight sequences finish with structured
+``ServeRejected(reason="model_error")``, queued requests shed
+``breaker_open``, and EVERY pool page is reclaimed (the no-page-leak
+invariant the chaos campaign asserts) — then probe steps re-warm and
+close it.
+
+Telemetry: ``generate`` run-log records (tokens/s, TTFT p50/p99,
+sequences-in-flight, eviction/shed counts), counters
+``serve_tokens_total`` / ``kv_evictions_total`` and gauges
+``kv_pages_in_use`` / ``prefill_queue_depth`` — all in the Prometheus
+textfile.
+"""
+from __future__ import annotations
+
+import collections
+import functools
+import math
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..quantization.kv import kv_quantize
+from ..resilience import faultsim
+from .kvcache import PagedKVPool
+from .server import ServeRejected
+
+__all__ = ["GenerativeServer", "GenerateHandle", "toy_decoder_params"]
+
+faultsim.register_point(
+    "serve.decode",
+    "inside every generative decode step (delay=slow token, "
+    "raise=transient step failure, nan=poisoned logits, crash=hard "
+    "death)")
+faultsim.register_point(
+    "serve.prefill", "before each bucketed prefill dispatch")
+
+
+def toy_decoder_params(seed=0, vocab=32, layers=2, heads=2, head_dim=8,
+                       mlp_mult=2):
+    """Deterministic decoder-only transformer params (pre-norm rmsnorm
+    blocks, tied nothing) — the synthetic generative model the bench
+    phase and tests drive.  The attention output projection is scaled
+    DOWN so greedy argmax margins stay wide relative to int8
+    KV-cache noise while the cache path remains load-bearing (zeroing
+    it flips ~1/3 of generated tokens); agreement is still measured,
+    never assumed."""
+    embed = heads * head_dim
+    key = jax.random.PRNGKey(seed)
+    ks = jax.random.split(key, 2 + 6 * layers)
+
+    def init(k, shape, scale):
+        return jax.random.normal(k, shape, jnp.float32) * scale
+
+    params = {
+        "embed": init(ks[0], (vocab, embed), 1.0),
+        "head": init(ks[1], (embed, vocab), 3.0 / embed ** 0.5),
+        "lnf": jnp.ones((embed,), jnp.float32),
+        "layers": [],
+    }
+    i = 2
+    for _ in range(layers):
+        params["layers"].append({
+            "wq": init(ks[i + 0], (embed, embed), embed ** -0.5),
+            "wk": init(ks[i + 1], (embed, embed), embed ** -0.5),
+            "wv": init(ks[i + 2], (embed, embed), embed ** -0.5),
+            "wo": init(ks[i + 3], (embed, embed), 0.25 * embed ** -0.5),
+            "w1": init(ks[i + 4], (embed, mlp_mult * embed),
+                       embed ** -0.5),
+            "w2": init(ks[i + 5], (mlp_mult * embed, embed),
+                       (mlp_mult * embed) ** -0.5),
+            "ln1": jnp.ones((embed,), jnp.float32),
+            "ln2": jnp.ones((embed,), jnp.float32),
+        })
+        i += 6
+    return params
+
+
+def _rmsnorm(x, g):
+    return x * jax.lax.rsqrt(jnp.mean(x * x, -1, keepdims=True)
+                             + 1e-6) * g
+
+
+class GenerateHandle:
+    """Future for one generation request (ServeHandle's generative
+    sibling): resolves to the generated token list or raises the
+    structured ServeRejected the scheduler assigned."""
+
+    def __init__(self, seq_id):
+        self.seq_id = seq_id
+        self._done = threading.Event()
+        self._tokens = None
+        self._err = None
+        self.ttft_ms = None
+        self.latency_ms = None
+        self.evicted = 0
+
+    def _finish(self, tokens=None, err=None):
+        if self._done.is_set():
+            return
+        self._tokens = tokens
+        self._err = err
+        self._done.set()
+
+    def done(self):
+        return self._done.is_set()
+
+    def result(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"generation {self.seq_id} still running")
+        if self._err is not None:
+            raise self._err
+        return self._tokens
+
+
+class _Seq:
+    __slots__ = ("id", "handle", "prompt", "max_new", "generated",
+                 "slot", "t_submit", "t_first", "deadline", "evictions",
+                 "counted_admit")
+
+    def __init__(self, seq_id, handle, prompt, max_new, deadline):
+        self.id = seq_id
+        self.handle = handle
+        self.prompt = list(prompt)
+        self.max_new = int(max_new)
+        self.generated = []
+        self.slot = None
+        self.t_submit = time.monotonic()
+        self.t_first = None
+        self.deadline = deadline
+        self.evictions = 0
+        self.counted_admit = False
+
+    @property
+    def context(self):
+        """Tokens to (re)prefill: the prompt plus everything already
+        generated — an evicted sequence resumes EXACTLY where the
+        preemption cut it."""
+        return self.prompt + self.generated
+
+    @property
+    def budget_tokens(self):
+        """Pages are reserved for this many tokens at admission — the
+        token-budget admission unit."""
+        return len(self.prompt) + self.max_new
+
+
+class GenerativeServer:
+    """Token-level continuous-batching server over a paged KV cache.
+
+    ``submit(prompt_tokens, max_new=...)`` returns a
+    :class:`GenerateHandle`; a scheduler thread prefills queued
+    prompts into free decode slots (token-budget admission against
+    the page pool) and steps ALL active slots one token at a time
+    through the compile-once decode program, admitting and evicting
+    between tokens.
+    """
+
+    def __init__(self, params=None, seed=0, vocab=32, layers=2, heads=2,
+                 head_dim=8, prompt_buckets=(4, 8, 16), max_new=16,
+                 slots=None, page_tokens=None, pool_budget=None,
+                 kv_dtype=None, agreement_floor=0.99, slo_ms=5000.0,
+                 queue_depth=64, breaker_limit=3, evict_after_ms=100.0,
+                 eos_id=None, name="generate", kv_gate=True):
+        from ..config import get_env
+
+        self.name = name
+        self.vocab = int(vocab)
+        self.layers = int(layers)
+        self.heads = int(heads)
+        self.head_dim = int(head_dim)
+        self.params = params if params is not None else \
+            toy_decoder_params(seed=seed, vocab=vocab, layers=layers,
+                               heads=heads, head_dim=head_dim)
+        self.prompt_buckets = tuple(sorted(set(int(b)
+                                               for b in prompt_buckets)))
+        self.max_new = int(max_new)
+        self.slots = int(slots if slots is not None
+                         else get_env("MXNET_DECODE_SLOTS"))
+        self.slo_ms = float(slo_ms)
+        self.queue_depth = int(queue_depth)
+        self.breaker_limit = int(breaker_limit)
+        self.evict_after_ms = float(evict_after_ms)
+        self.eos_id = eos_id
+        self.agreement_floor = float(agreement_floor)
+        self._kv_gate = bool(kv_gate)
+        self._page_tokens = page_tokens
+        self._pool_budget = pool_budget
+        self._kv_dtype_requested = str(
+            kv_dtype if kv_dtype is not None
+            else get_env("MXNET_KV_DTYPE"))
+        self.kv_agreement = None
+
+        self.max_seq_tokens = self.prompt_buckets[-1] + self.max_new
+        self.pool = None
+        self.stats = {
+            "requests": 0, "admitted": 0, "completed": 0, "shed": 0,
+            "rejected": {}, "tokens": 0, "prefills": 0, "evictions": 0,
+            "decode_failures": 0, "breaker_trips": 0, "compiles": 0,
+            "warm_traces": 0, "max_in_flight": 0,
+            "kv_dtype_effective": None,
+        }
+        self._ttft_ms = []
+        self._latency_ms = []
+        self._lock = threading.RLock()
+        self._queue = collections.deque()
+        self._seq_counter = 0
+        self._stop = False
+        self._draining = False
+        self._started = False
+        self._breaker_open = False
+        self._fail_count = 0
+        self._rewarm_at = 0.0
+        self._rewarm_backoff = 0.05
+        self._thread = None
+        self._traced = set()
+        self._t_start = time.monotonic()
+        self._prefill_jits = {}
+        self._prefill_variants = {}
+        self._paged_variant = None
+        self._decode_jit = None
+        self._autotune_report = {}
+
+    # ------------------------------------------------------- lifecycle
+    def start(self, warm=True):
+        if self._started:
+            return self
+        self._build(self._kv_dtype_requested, warm=warm)
+        self._thread = threading.Thread(target=self._loop,
+                                        name=f"{self.name}-sched",
+                                        daemon=True)
+        self._started = True
+        self._thread.start()
+        if warm and self.pool.dtype == "int8" and self._kv_gate:
+            agreement = self._agreement_probe()
+            self.kv_agreement = agreement
+            if agreement < self.agreement_floor:
+                # the round-13 adoption contract: below the measured
+                # floor, int8 never ships — rebuild the pool fp32
+                self._build("float32", warm=warm)
+        self.stats["kv_dtype_effective"] = self.pool.dtype
+        self._reset_campaign_stats()
+        return self
+
+    def _build(self, kv_dtype, warm):
+        with self._lock:
+            if self.pool is not None:
+                self.pool.reset()
+            self.pool = PagedKVPool(
+                self.layers, self.heads, self.head_dim,
+                page_tokens=self._page_tokens,
+                budget_bytes=self._pool_budget, dtype=kv_dtype)
+            self.max_pages = self.pool.pages_needed(self.max_seq_tokens)
+            s = self.slots
+            self._slot_seq = [None] * s
+            self._page_table = onp.zeros((s, self.max_pages), onp.int32)
+            self._seq_lens = onp.zeros(s, onp.int32)
+            self._last_tokens = onp.zeros(s, onp.int32)
+            self._active = onp.zeros(s, bool)
+            self._prefill_jits = {}
+            self._decode_jit = None
+            self._race_variants()
+            if warm:
+                self._warmup()
+
+    def _race_variants(self):
+        """Warmup-time autotune races: flash_attention's pallas_pad
+        shim on each ragged prefill bucket shape, and the paged decode
+        attention's gather-vs-paged walk on the real pool shape.
+        Cached winners answer without re-measuring (tune's level-1
+        contract); winners bind STATICALLY into the jitted programs."""
+        from .. import autotune
+
+        report = {}
+        for bucket in self.prompt_buckets:
+            shape = (1, self.heads, bucket, self.head_dim)
+            winner, info = autotune.tune(
+                "flash_attention", shape, "float32",
+                {k: v for k, v in
+                 autotune.VARIANT_OPS["flash_attention"].items()
+                 if k in ("naive", "pallas_pad")},
+                functools.partial(self._measure_prefill, bucket))
+            self._prefill_variants[bucket] = winner
+            report[f"prefill_b{bucket}"] = {"winner": winner, **info}
+        pool_shape = (self.slots, self.pool.num_pages + 1,
+                      self.pool.page_tokens, self.heads, self.head_dim)
+        winner, info = autotune.tune(
+            "paged_decode_attention", pool_shape, self.pool.dtype,
+            autotune.VARIANT_OPS["paged_decode_attention"],
+            self._measure_paged)
+        self._paged_variant = winner
+        report["paged_decode_attention"] = {"winner": winner, **info}
+        self._autotune_report = report
+
+    def _measure_prefill(self, bucket, _value):
+        from ..autotune import chain_time
+
+        toks = jnp.zeros((1, bucket), jnp.int32)
+
+        def body(carry, i):
+            logits, _, _ = self._prefill_fn(self.params,
+                                            toks + carry.astype(jnp.int32)
+                                            % self.vocab)
+            return logits[0, -1, 0]
+
+        return chain_time(body, jnp.float32(0.0), iters=4)
+
+    def _measure_paged(self, _value):
+        from ..autotune import chain_time
+        from ..ops.flash_attention import paged_decode_attention
+
+        k_pages, v_pages, k_scale, v_scale = self.pool.arrays()
+        int8 = self.pool.dtype == "int8"
+        q = jnp.ones((self.slots, self.heads, self.head_dim),
+                     jnp.float32)
+        pt = jnp.zeros((self.slots, self.max_pages), jnp.int32)
+        sl = jnp.full((self.slots,), self.pool.page_tokens, jnp.int32)
+
+        def body(carry, i):
+            out = paged_decode_attention(
+                q + carry, k_pages[0], v_pages[0], pt, sl,
+                k_scale=k_scale[0] if int8 else None,
+                v_scale=v_scale[0] if int8 else None)
+            return out[0, 0, 0]
+
+        return chain_time(body, jnp.float32(0.0), iters=4)
+
+    def _warmup(self):
+        """Compile every program the campaign will need: one prefill
+        per bucket, the decode step, and the write paths — so a bursty
+        campaign with admits/evictions shows ZERO new compile events
+        (stats['compiles'] stays 0, the continuous-batching proof)."""
+        for bucket in self.prompt_buckets:
+            toks = jnp.zeros((1, bucket), jnp.int32)
+            logits, k, v = self._prefill(bucket)(self.params, toks)
+            self._note_program(("prefill", bucket), warm=True)
+            jax.block_until_ready(logits)
+        # decode over the all-inactive slot state compiles the ONE
+        # decode program; write paths compile via a scratch pool write
+        self._decode_state_step()
+        self._note_program(("decode", self.slots), warm=True)
+        scratch = "__warm__"
+        self.pool.alloc(scratch, self.pool.page_tokens)
+        zeros = jnp.zeros((self.layers, 1, self.heads, self.head_dim),
+                          jnp.float32)
+        self.pool.write_prompt(scratch, zeros, zeros)
+        self.pool.free(scratch)
+
+    def _reset_campaign_stats(self):
+        with self._lock:
+            keep_warm = self.stats["warm_traces"]
+            keep_dtype = self.stats["kv_dtype_effective"]
+            for k in ("requests", "admitted", "completed", "shed",
+                      "tokens", "prefills", "evictions",
+                      "decode_failures", "breaker_trips", "compiles",
+                      "max_in_flight"):
+                self.stats[k] = 0
+            self.stats["rejected"] = {}
+            self.stats["warm_traces"] = keep_warm
+            self.stats["kv_dtype_effective"] = keep_dtype
+            self._ttft_ms = []
+            self._latency_ms = []
+            self._t_start = time.monotonic()
+
+    def _agreement_probe(self, n_prompts=4, max_new=8):
+        """Per-token greedy agreement of THIS (int8-cache) server
+        against a throwaway fp32-cache sibling on deterministic probe
+        prompts — the measured gate deciding whether int8 ships."""
+        prompts = [[(3 * i + j) % self.vocab
+                    for j in range(2 + i % (self.prompt_buckets[0]))]
+                   for i in range(n_prompts)]
+        ref = GenerativeServer(
+            params=self.params, vocab=self.vocab, layers=self.layers,
+            heads=self.heads, head_dim=self.head_dim,
+            prompt_buckets=self.prompt_buckets, max_new=max_new,
+            slots=self.slots, page_tokens=self.pool.page_tokens,
+            pool_budget=self._pool_budget
+            if self._pool_budget is not None else None,
+            kv_dtype="float32", kv_gate=False, name=f"{self.name}-ref")
+        ref.start(warm=False)
+        try:
+            mine = [self.submit(p, max_new=max_new,
+                                deadline_ms=60000).result(timeout=60)
+                    for p in prompts]
+            theirs = [ref.submit(p, max_new=max_new,
+                                 deadline_ms=60000).result(timeout=60)
+                      for p in prompts]
+        finally:
+            ref.close()
+        agree = total = 0
+        for a, b in zip(mine, theirs):
+            for x, y in zip(a, b):
+                agree += int(x == y)
+                total += 1
+        return agree / max(total, 1)
+
+    # ------------------------------------------------------- the model
+    def _prefill_fn(self, params, tokens, variant=None):
+        from ..ops.flash_attention import flash_attention
+
+        b, seq = tokens.shape
+        heads, d = self.heads, self.head_dim
+        x = params["embed"][tokens]
+        k_all, v_all = [], []
+        for lyr in params["layers"]:
+            h = _rmsnorm(x, lyr["ln1"])
+            q = (h @ lyr["wq"]).reshape(b, seq, heads, d)
+            k = (h @ lyr["wk"]).reshape(b, seq, heads, d)
+            v = (h @ lyr["wv"]).reshape(b, seq, heads, d)
+            attn = flash_attention(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), causal=True, variant=variant)
+            x = x + attn.transpose(0, 2, 1, 3).reshape(b, seq,
+                                                       heads * d) \
+                @ lyr["wo"]
+            h2 = _rmsnorm(x, lyr["ln2"])
+            x = x + jax.nn.gelu(h2 @ lyr["w1"]) @ lyr["w2"]
+            k_all.append(k)
+            v_all.append(v)
+        x = _rmsnorm(x, params["lnf"])
+        logits = x @ params["head"]
+        return logits, jnp.stack(k_all), jnp.stack(v_all)
+
+    def _prefill(self, bucket):
+        jit = self._prefill_jits.get(bucket)
+        if jit is None:
+            jit = jax.jit(functools.partial(
+                self._prefill_fn,
+                variant=self._prefill_variants.get(bucket)))
+            self._prefill_jits[bucket] = jit
+        return jit
+
+    def _decode_fn(self, params, k_pages, v_pages, k_scale, v_scale,
+                   page_table, seq_lens, last_tokens, active,
+                   variant=None):
+        from ..ops.flash_attention import paged_decode_attention
+
+        s = last_tokens.shape[0]
+        heads, d = self.heads, self.head_dim
+        t = self.pool.page_tokens
+        int8 = self.pool.dtype == "int8"
+        x = params["embed"][last_tokens]
+        page_idx = page_table[jnp.arange(s), seq_lens // t]
+        offset = seq_lens % t
+        # the just-written token is attended in the same step; an
+        # inactive slot masks everything out (exact-zero output row)
+        eff_len = jnp.where(active, seq_lens + 1, 0)
+        for li, lyr in enumerate(params["layers"]):
+            h = _rmsnorm(x, lyr["ln1"])
+            q = (h @ lyr["wq"]).reshape(s, heads, d)
+            k_new = (h @ lyr["wk"]).reshape(s, heads, d)
+            v_new = (h @ lyr["wv"]).reshape(s, heads, d)
+            if int8:
+                kq, ksc = kv_quantize(k_new)
+                vq, vsc = kv_quantize(v_new)
+                k_pages = k_pages.at[li, page_idx, offset].set(kq)
+                v_pages = v_pages.at[li, page_idx, offset].set(vq)
+                k_scale = k_scale.at[li, page_idx, offset].set(ksc)
+                v_scale = v_scale.at[li, page_idx, offset].set(vsc)
+                attn = paged_decode_attention(
+                    q, k_pages[li], v_pages[li], page_table, eff_len,
+                    k_scale=k_scale[li], v_scale=v_scale[li],
+                    variant=variant)
+            else:
+                k_pages = k_pages.at[li, page_idx, offset].set(
+                    k_new.astype(k_pages.dtype))
+                v_pages = v_pages.at[li, page_idx, offset].set(
+                    v_new.astype(v_pages.dtype))
+                attn = paged_decode_attention(
+                    q, k_pages[li], v_pages[li], page_table, eff_len,
+                    variant=variant)
+            x = x + attn.reshape(s, heads * d) @ lyr["wo"]
+            h2 = _rmsnorm(x, lyr["ln2"])
+            x = x + jax.nn.gelu(h2 @ lyr["w1"]) @ lyr["w2"]
+        x = _rmsnorm(x, params["lnf"])
+        logits = x @ params["head"]
+        next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        next_tok = jnp.where(active, next_tok, last_tokens)
+        seq_lens = jnp.where(active, seq_lens + 1, seq_lens)
+        return k_pages, v_pages, k_scale, v_scale, seq_lens, next_tok
+
+    def _decode(self):
+        if self._decode_jit is None:
+            self._decode_jit = jax.jit(
+                functools.partial(self._decode_fn,
+                                  variant=self._paged_variant),
+                donate_argnums=(1, 2, 3, 4))
+        return self._decode_jit
+
+    def decode_cache_size(self):
+        """Compiled-program count of the decode step (None when jax
+        hides it) — the direct compile-once proof tests assert on."""
+        jit = self._decode_jit
+        size = getattr(jit, "_cache_size", None)
+        return size() if callable(size) else None
+
+    def _decode_state_step(self):
+        """One decode step over the CURRENT slot state; updates the
+        pool arrays and host mirrors, returns the per-slot next-token
+        row.  Raises on injected faults (the caller owns breaker
+        accounting)."""
+        poison = faultsim.inject("serve.decode")
+        if poison == "nan":
+            raise MXNetError(
+                "non-finite decode logits (poisoned by fault "
+                "injection)")
+        k_pages, v_pages, k_scale, v_scale = self.pool.arrays()
+        out = self._decode()(
+            self.params, k_pages, v_pages, k_scale, v_scale,
+            jnp.asarray(self._page_table), jnp.asarray(self._seq_lens),
+            jnp.asarray(self._last_tokens), jnp.asarray(self._active))
+        k_pages, v_pages, k_scale, v_scale, seq_lens, next_tok = out
+        self.pool.set_arrays(k_pages, v_pages, k_scale, v_scale)
+        self._seq_lens = onp.asarray(seq_lens).copy()
+        next_np = onp.asarray(next_tok).copy()
+        self._last_tokens = next_np
+        return next_np
+
+    # ------------------------------------------------------ accounting
+    def _note_program(self, key, warm=False):
+        if key in self._traced:
+            return
+        self._traced.add(key)
+        with self._lock:
+            self.stats["warm_traces" if warm else "compiles"] += 1
+        try:
+            from .. import telemetry
+
+            kind, size = key
+            telemetry.compile_event(
+                f"generate:{self.name}:{kind}",
+                telemetry.compile_fingerprint((size,), "float32",
+                                              train=False))
+        except Exception:
+            pass
+
+    @staticmethod
+    def _telemetry_count(name, n=1):
+        try:
+            from .. import telemetry
+
+            telemetry.count(name, n)
+        except Exception:
+            pass
+
+    @staticmethod
+    def _telemetry_gauge(name, value):
+        try:
+            from .. import telemetry
+
+            telemetry.gauge(name, value)
+        except Exception:
+            pass
+
+    def _reject(self, reason, detail=""):
+        with self._lock:
+            self.stats["shed"] += 1
+            self.stats["rejected"][reason] = \
+                self.stats["rejected"].get(reason, 0) + 1
+        return ServeRejected(reason, detail)
+
+    # ------------------------------------------------------- admission
+    def submit(self, prompt, max_new=None, deadline_ms=None):
+        """Queue a prompt (iterable of token ids) for generation.
+
+        Token-budget admission: the request is rejected outright
+        (``reason="token_budget"``) when ``prompt + max_new`` exceeds
+        what the whole pool could EVER hold, and queues otherwise —
+        the scheduler admits it into a decode slot once pages AND a
+        slot are free, evicting under pressure."""
+        faultsim.inject("serve.admit")
+        prompt = [int(x) for x in prompt]
+        max_new = self.max_new if max_new is None else int(max_new)
+        budget_ms = self.slo_ms if deadline_ms is None \
+            else float(deadline_ms)
+        with self._lock:
+            if not self._started or self._stop:
+                raise self._reject("shutdown", "server not running")
+            if self._draining:
+                raise self._reject("draining", "server is draining")
+            if self._breaker_open:
+                raise self._reject(
+                    "breaker_open",
+                    "circuit breaker open after consecutive decode "
+                    "failures")
+            if len(self._queue) >= self.queue_depth:
+                raise self._reject(
+                    "queue_full", f"{len(self._queue)} queued")
+            if not prompt or len(prompt) > self.prompt_buckets[-1]:
+                raise self._reject(
+                    "token_budget",
+                    f"prompt length {len(prompt)} outside (0, "
+                    f"{self.prompt_buckets[-1]}]")
+            total = len(prompt) + max_new
+            if self.pool.pages_needed(total) > self.pool.num_pages:
+                raise self._reject(
+                    "token_budget",
+                    f"{total} tokens exceed the pool's "
+                    f"{self.pool.capacity_tokens}-token budget")
+            self._seq_counter += 1
+            handle = GenerateHandle(self._seq_counter)
+            seq = _Seq(self._seq_counter, handle, prompt, max_new,
+                       time.monotonic() + budget_ms / 1e3)
+            self._queue.append(seq)
+            self.stats["requests"] += 1
+            self._telemetry_gauge("prefill_queue_depth",
+                                  len(self._queue))
+        return handle
+
+    def _bucket_for(self, n):
+        for b in self.prompt_buckets:
+            if n <= b:
+                return b
+        raise MXNetError(f"no prefill bucket holds {n} tokens")
+
+    def _free_slot(self):
+        for i, s in enumerate(self._slot_seq):
+            if s is None:
+                return i
+        return None
+
+    def _admit(self):
+        """Admit queued sequences into free slots — between TOKENS,
+        the continuous-batching schedule.  Under page/slot pressure
+        the head may preempt (evict) the most recently admitted
+        sequence after ``evict_after_ms``; an evicted sequence resumes
+        via re-prefill of prompt+generated and is never evicted
+        twice."""
+        while True:
+            with self._lock:
+                if not self._queue or self._stop or self._breaker_open:
+                    return
+                seq = self._queue[0]
+                now = time.monotonic()
+                if now > seq.deadline:
+                    self._queue.popleft()
+                    seq.handle._finish(err=self._reject(
+                        "expired", "deadline passed while queued"))
+                    self._telemetry_gauge("prefill_queue_depth",
+                                          len(self._queue))
+                    continue
+                slot = self._free_slot()
+                ok = slot is not None and \
+                    self.pool.can_admit(seq.budget_tokens)
+                if ok:
+                    self._queue.popleft()
+                    self._telemetry_gauge("prefill_queue_depth",
+                                          len(self._queue))
+                else:
+                    waited_ms = (now - seq.t_submit) * 1e3
+                    if waited_ms >= self.evict_after_ms:
+                        victim = self._evict_candidate()
+                        if victim is not None:
+                            self._evict(victim)
+                            continue
+                    return
+            if ok:
+                try:
+                    self._install(seq, slot)
+                except ServeRejected as err:
+                    seq.handle._finish(err=err)
+                except Exception as exc:
+                    self._model_failure(exc)
+                    seq.handle._finish(err=self._reject(
+                        "model_error", repr(exc)))
+                    return
+
+    def _evict_candidate(self):
+        """The most recently admitted active sequence that has never
+        been evicted (caller holds the lock); None = nobody evictable,
+        the head keeps waiting.  A victim must also still FIT a
+        prefill bucket on resume — once prompt+generated outgrows the
+        largest bucket the sequence can only finish in place."""
+        best = None
+        for seq in self._slot_seq:
+            if seq is None or seq.evictions > 0:
+                continue
+            if len(seq.context) > self.prompt_buckets[-1]:
+                continue
+            if best is None or seq.id > best.id:
+                best = seq
+        return best
+
+    def _evict(self, seq):
+        """Preempt a running sequence IN PLACE (no retrace): free its
+        pages, null its slot row, and requeue it right behind the head
+        so it resumes by re-prefilling prompt+generated."""
+        slot = seq.slot
+        self.pool.free(seq.id)
+        self._clear_slot(slot)
+        seq.slot = None
+        seq.evictions += 1
+        seq.handle.evicted += 1
+        self._queue.insert(1 if len(self._queue) >= 1 else 0, seq)
+        self.stats["evictions"] += 1
+        self._telemetry_count("kv_evictions_total")
+        self._telemetry_gauge("kv_pages_in_use", self.pool.pages_in_use)
+        self._telemetry_gauge("prefill_queue_depth", len(self._queue))
+
+    def _clear_slot(self, slot):
+        self._slot_seq[slot] = None
+        self._page_table[slot] = 0
+        self._seq_lens[slot] = 0
+        self._last_tokens[slot] = 0
+        self._active[slot] = False
+
+    def _install(self, seq, slot):
+        """Bucketed prefill + slot install: the prefill/decode
+        disaggregation boundary.  Prefill compiles once per bucket
+        (counted); the slot install is pure in-place data updates."""
+        faultsim.inject("serve.prefill")
+        context = seq.context
+        n = len(context)
+        bucket = self._bucket_for(n)
+        toks = onp.zeros((1, bucket), onp.int32)
+        toks[0, :n] = context
+        logits, k, v = self._prefill(bucket)(self.params,
+                                             jnp.asarray(toks))
+        self._note_program(("prefill", bucket))
+        with self._lock:
+            self.stats["prefills"] += 1
+            if not seq.counted_admit:
+                seq.counted_admit = True
+                self.stats["admitted"] += 1
+        first = int(onp.asarray(logits[0, n - 1]).argmax())
+        self.pool.alloc(seq.id, seq.budget_tokens)
+        self.pool.write_prompt(seq.id, k[:, 0, :n], v[:, 0, :n])
+        now = time.monotonic()
+        if seq.t_first is None:
+            seq.t_first = now
+            seq.handle.ttft_ms = (now - seq.t_submit) * 1e3
+            with self._lock:
+                self._ttft_ms.append(seq.handle.ttft_ms)
+        seq.generated.append(first)
+        with self._lock:
+            self.stats["tokens"] += 1
+        self._telemetry_count("serve_tokens_total")
+        self._telemetry_gauge("kv_pages_in_use", self.pool.pages_in_use)
+        if self._seq_done(seq):
+            self._finish_seq(seq, slot=None)
+            return
+        seq.slot = slot
+        self._slot_seq[slot] = seq
+        self._page_table[slot] = self.pool.page_table_row(
+            seq.id, self.max_pages)
+        self._seq_lens[slot] = n
+        self._last_tokens[slot] = first
+        self._active[slot] = True
+        with self._lock:
+            in_flight = int(self._active.sum())
+            self.stats["max_in_flight"] = max(
+                self.stats["max_in_flight"], in_flight)
+
+    def _seq_done(self, seq):
+        if len(seq.generated) >= seq.max_new:
+            return True
+        return self.eos_id is not None and \
+            seq.generated[-1] == self.eos_id
+
+    def _finish_seq(self, seq, slot):
+        self.pool.free(seq.id)
+        if slot is not None:
+            self._clear_slot(slot)
+        seq.handle.latency_ms = (time.monotonic() - seq.t_submit) * 1e3
+        with self._lock:
+            self.stats["completed"] += 1
+            self._latency_ms.append(seq.handle.latency_ms)
+        seq.handle._finish(tokens=list(seq.generated))
+        self._telemetry_gauge("kv_pages_in_use", self.pool.pages_in_use)
+
+    # ------------------------------------------------------ the loop
+    def _loop(self):
+        while not self._stop:
+            try:
+                if self._breaker_open:
+                    self._try_rewarm()
+                    time.sleep(0.002)
+                    continue
+                self._admit()
+                if self._active.any():
+                    self._step_once()
+                elif not self._queue:
+                    time.sleep(0.001)
+            except Exception:  # the loop must survive anything
+                time.sleep(0.005)
+
+    def _step_once(self):
+        try:
+            next_np = self._decode_state_step()
+        except Exception as exc:
+            self._model_failure(exc)
+            return
+        self._fail_count = 0
+        stepped = [(slot, seq)
+                   for slot, seq in enumerate(list(self._slot_seq))
+                   if seq is not None and self._active[slot]]
+        # count BEFORE finishing any handle: a caller woken by
+        # result() must never read a stats snapshot missing this step
+        if stepped:
+            with self._lock:
+                self.stats["tokens"] += len(stepped)
+            self._telemetry_count("serve_tokens_total", len(stepped))
+        for slot, seq in stepped:
+            seq.generated.append(int(next_np[slot]))
+            if self._seq_done(seq):
+                self._finish_seq(seq, slot)
+
+    def _model_failure(self, exc):
+        with self._lock:
+            self._fail_count += 1
+            self.stats["decode_failures"] += 1
+            trip = self._fail_count >= self.breaker_limit \
+                and not self._breaker_open
+            if trip:
+                self._breaker_open = True
+                self.stats["breaker_trips"] += 1
+                self._rewarm_at = time.monotonic() + \
+                    self._rewarm_backoff
+        if not trip:
+            return
+        self._telemetry_count("serve_breaker_trips")
+        # in-flight sequences fail STRUCTURED and every page comes
+        # back — the no-leak invariant chaos asserts
+        for slot, seq in enumerate(list(self._slot_seq)):
+            if seq is None:
+                continue
+            seq.handle._finish(err=self._reject("model_error",
+                                                repr(exc)))
+            self._clear_slot(slot)
+        with self._lock:
+            queued, self._queue = list(self._queue), \
+                collections.deque()
+        for seq in queued:
+            seq.handle._finish(err=self._reject(
+                "breaker_open", "breaker tripped while queued"))
+        self.pool.reset()
+        self._telemetry_gauge("kv_pages_in_use", self.pool.pages_in_use)
+        self._telemetry_gauge("prefill_queue_depth", 0)
+
+    def _try_rewarm(self):
+        if time.monotonic() < self._rewarm_at:
+            return
+        try:
+            self._decode_state_step()  # all slots inactive: a probe
+        except Exception:
+            self._rewarm_backoff = min(self._rewarm_backoff * 2, 2.0)
+            self._rewarm_at = time.monotonic() + self._rewarm_backoff
+            return
+        with self._lock:
+            self._breaker_open = False
+            self._fail_count = 0
+            self._rewarm_backoff = 0.05
+
+    # ------------------------------------------------------- reporting
+    def in_flight(self):
+        return int(self._active.sum())
+
+    def report(self):
+        """Snapshot + one ``generate`` run-log record: the telemetry
+        contract of the generative path (schema.GENERATE_FIELDS)."""
+        from ..telemetry.opstats import percentile
+
+        with self._lock:
+            st = {k: (dict(v) if isinstance(v, dict) else v)
+                  for k, v in self.stats.items()}
+            ttft = sorted(self._ttft_ms)
+            wall = max(time.monotonic() - self._t_start, 1e-9)
+        rep = {
+            "name": self.name,
+            "tokens": st["tokens"],
+            "tokens_s": round(st["tokens"] / wall, 2),
+            "ttft_p50_ms": round(percentile(ttft, 0.50), 3)
+            if ttft else None,
+            "ttft_p99_ms": round(percentile(ttft, 0.99), 3)
+            if ttft else None,
+            "in_flight": self.in_flight(),
+            "max_in_flight": st["max_in_flight"],
+            "evictions": st["evictions"],
+            "shed": st["shed"],
+            "pages_in_use": self.pool.pages_in_use,
+            "queue_depth": len(self._queue),
+            "kv_dtype": self.pool.dtype,
+            "compiles": st["compiles"],
+        }
+        try:
+            from .. import telemetry
+
+            telemetry.generate(**rep)
+        except Exception:
+            pass
+        return rep
+
+    def drain(self, timeout=10.0):
+        """Stop admission, let queued + in-flight sequences finish."""
+        with self._lock:
+            self._draining = True
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                idle = not self._queue and not self._active.any()
+            if idle:
+                return True
+            time.sleep(0.005)
+        return False
+
+    def close(self):
+        with self._lock:
+            self._stop = True
+            self._draining = True
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        with self._lock:
+            leftovers = list(self._queue)
+            self._queue.clear()
+            slot_seqs = [s for s in self._slot_seq if s is not None]
+        for seq in leftovers + slot_seqs:
+            seq.handle._finish(err=ServeRejected(
+                "shutdown", "server closed"))
+        if self.pool is not None:
+            self.pool.reset()
+        self._started = False

@@ -1,0 +1,230 @@
+"""Paged KV-cache pool for generative decode serving (round 17).
+
+vLLM-style paging on the repo's own parts: the pool owns a FIXED set
+of physical KV pages sized to fit under an HBM byte budget (the
+ModelHost admission idea, applied to per-sequence decode state), and
+sequences hold ``ceil(tokens / page_tokens)`` pages reserved UP FRONT
+for their whole token budget (prompt + max_new) — so admission control
+is by token budget, not request count, and a sequence admitted once
+can never OOM the pool mid-decode.
+
+Physical page 0 is reserved as the null page: inactive decode slots
+point their page-table rows at it and the decode step's unconditional
+writes land there harmlessly (the masked-attention contract in
+ops.flash_attention.paged_decode_attention guarantees nobody ever
+reads it).  Allocation never hands out page 0.
+
+Storage dtype is ``float32`` or ``int8`` — int8 pages carry one fp32
+scale per (token, head) (quantization.kv), cutting the per-page cost
+from ``2*L*T*H*D*4`` bytes to ``2*L*T*H*(D+4)``: at head_dim 8 the
+same budget holds 2.67x the pages, which is exactly the concurrency
+headroom the capacity acceptance ratio measures from this accounting.
+
+Host-side page bookkeeping is plain Python under the caller's lock
+(GenerativeServer serializes all access from its scheduler thread);
+the device arrays are plain jnp buffers the decode step donates and
+returns, re-installed via :meth:`set_arrays`.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..quantization.kv import kv_page_bytes, kv_quantize
+
+__all__ = ["PagedKVPool"]
+
+
+class PagedKVPool:
+    """Fixed pool of physical KV pages under a byte budget."""
+
+    def __init__(self, layers, heads, head_dim, page_tokens=None,
+                 budget_bytes=None, dtype=None):
+        from ..config import get_env
+
+        self.layers = int(layers)
+        self.heads = int(heads)
+        self.head_dim = int(head_dim)
+        self.page_tokens = int(page_tokens if page_tokens is not None
+                               else get_env("MXNET_KV_PAGE_TOKENS"))
+        budget = int(budget_bytes if budget_bytes is not None
+                     else get_env("MXNET_KV_POOL_BUDGET"))
+        dtype = str(dtype if dtype is not None
+                    else get_env("MXNET_KV_DTYPE"))
+        if dtype in ("fp32", "float32"):
+            dtype = "float32"
+        elif dtype != "int8":
+            raise MXNetError(
+                f"unsupported KV-cache dtype {dtype!r} "
+                "(float32 or int8)")
+        self.dtype = dtype
+        self.budget_bytes = budget
+        self.page_bytes = kv_page_bytes(self.layers, self.page_tokens,
+                                        self.heads, self.head_dim,
+                                        dtype)
+        self.num_pages = budget // self.page_bytes
+        if self.num_pages < 1:
+            raise MXNetError(
+                f"KV pool budget {budget} B fits no {dtype} page "
+                f"({self.page_bytes} B each) — raise "
+                "MXNET_KV_POOL_BUDGET or shrink MXNET_KV_PAGE_TOKENS")
+        # +1: physical page 0 is the reserved null page (see module doc)
+        phys = self.num_pages + 1
+        shape = (self.layers, phys, self.page_tokens, self.heads,
+                 self.head_dim)
+        store = jnp.int8 if dtype == "int8" else jnp.float32
+        self.k_pages = jnp.zeros(shape, store)
+        self.v_pages = jnp.zeros(shape, store)
+        if dtype == "int8":
+            sshape = shape[:-1]
+            self.k_scale = jnp.zeros(sshape, jnp.float32)
+            self.v_scale = jnp.zeros(sshape, jnp.float32)
+        else:
+            self.k_scale = None
+            self.v_scale = None
+        self._free = list(range(1, phys))
+        self._seqs = {}  # seq id -> [physical page ids]
+
+    # ------------------------------------------------------- accounting
+    @property
+    def pages_in_use(self):
+        return sum(len(p) for p in self._seqs.values())
+
+    @property
+    def free_pages(self):
+        return len(self._free)
+
+    @property
+    def capacity_tokens(self):
+        return self.num_pages * self.page_tokens
+
+    def pages_needed(self, tokens):
+        return max(1, math.ceil(int(tokens) / self.page_tokens))
+
+    def capacity_sequences(self, tokens_per_seq):
+        """Concurrent sequences of the given token budget this pool
+        admits — the page-pool-accounting number the int8-vs-fp32
+        capacity acceptance ratio is measured from."""
+        return self.num_pages // self.pages_needed(tokens_per_seq)
+
+    def can_admit(self, tokens):
+        return self.pages_needed(tokens) <= len(self._free)
+
+    # ------------------------------------------------------- allocation
+    def alloc(self, seq_id, tokens):
+        """Reserve pages for a sequence's WHOLE token budget; returns
+        the physical page list (logical order)."""
+        if seq_id in self._seqs:
+            raise MXNetError(f"sequence {seq_id!r} already holds pages")
+        need = self.pages_needed(tokens)
+        if need > len(self._free):
+            raise MXNetError(
+                f"pool exhausted: {need} pages needed, "
+                f"{len(self._free)} free")
+        pages = [self._free.pop() for _ in range(need)]
+        self._seqs[seq_id] = pages
+        return list(pages)
+
+    def free(self, seq_id):
+        """Return a sequence's pages to the free list (idempotent);
+        returns the number reclaimed."""
+        pages = self._seqs.pop(seq_id, None)
+        if not pages:
+            return 0
+        self._free.extend(pages)
+        return len(pages)
+
+    def reset(self):
+        """Reclaim EVERY page (breaker trip / drain): stale device
+        data stays in place — masked attention never reads it."""
+        n = self.pages_in_use
+        for seq_id in list(self._seqs):
+            self.free(seq_id)
+        return n
+
+    def page_table_row(self, seq_id, max_pages):
+        """The sequence's page list as a fixed-width int32 row, tail
+        padded with the null page."""
+        pages = self._seqs.get(seq_id, [])
+        if len(pages) > max_pages:
+            raise MXNetError(
+                f"sequence {seq_id!r} holds {len(pages)} pages, slot "
+                f"rows are {max_pages} wide")
+        row = onp.zeros(max_pages, onp.int32)
+        row[:len(pages)] = pages
+        return row
+
+    # ----------------------------------------------------- device state
+    def arrays(self):
+        """(k_pages, v_pages, k_scale, v_scale) — scales are zero-size
+        fp32 placeholders on an fp32 pool so the decode step's
+        signature (and its single compile) is dtype-uniform."""
+        if self.dtype == "int8":
+            return self.k_pages, self.v_pages, self.k_scale, self.v_scale
+        # two DISTINCT buffers: the decode step donates both slots
+        return (self.k_pages, self.v_pages,
+                jnp.zeros((0,), jnp.float32), jnp.zeros((0,), jnp.float32))
+
+    def set_arrays(self, k_pages, v_pages, k_scale=None, v_scale=None):
+        self.k_pages = k_pages
+        self.v_pages = v_pages
+        if self.dtype == "int8":
+            self.k_scale = k_scale
+            self.v_scale = v_scale
+
+    def write_prompt(self, seq_id, k, v):
+        """Write a prefilled prompt's K/V into the sequence's pages.
+
+        ``k``/``v``: (layers, tokens, heads, head_dim) float arrays —
+        only the VALID prompt tokens (bucket padding already sliced
+        off).  Page-granular jitted writes: one fixed-shape program
+        per pool config, compiled once however ragged the prompts."""
+        pages = self._seqs.get(seq_id)
+        if pages is None:
+            raise MXNetError(f"sequence {seq_id!r} holds no pages")
+        tokens = k.shape[1]
+        t = self.page_tokens
+        pad = (-tokens) % t
+        if pad:
+            widths = ((0, 0), (0, pad), (0, 0), (0, 0))
+            k = jnp.pad(k, widths)
+            v = jnp.pad(v, widths)
+        n_pages = k.shape[1] // t
+        for j in range(n_pages):
+            kp = jax.lax.dynamic_slice_in_dim(k, j * t, t, axis=1)
+            vp = jax.lax.dynamic_slice_in_dim(v, j * t, t, axis=1)
+            if self.dtype == "int8":
+                kq, ks = _quantize_page(kp)
+                vq, vs = _quantize_page(vp)
+                (self.k_pages, self.v_pages, self.k_scale,
+                 self.v_scale) = _write_page_int8(
+                    self.k_pages, self.v_pages, self.k_scale,
+                    self.v_scale, kq, ks, vq, vs,
+                    jnp.int32(pages[j]))
+            else:
+                self.k_pages, self.v_pages = _write_page(
+                    self.k_pages, self.v_pages,
+                    kp.astype(self.k_pages.dtype),
+                    vp.astype(self.v_pages.dtype), jnp.int32(pages[j]))
+
+
+@jax.jit
+def _quantize_page(x):
+    return kv_quantize(x)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _write_page(k_pages, v_pages, kp, vp, idx):
+    return (k_pages.at[:, idx].set(kp), v_pages.at[:, idx].set(vp))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+def _write_page_int8(k_pages, v_pages, k_scale, v_scale, kq, ks, vq, vs,
+                     idx):
+    return (k_pages.at[:, idx].set(kq), v_pages.at[:, idx].set(vq),
+            k_scale.at[:, idx].set(ks), v_scale.at[:, idx].set(vs))

@@ -537,10 +537,23 @@ class ModelServer:
             return None
         q = len(self._queue) + 1
         b = self._bucket_for(min(q, self.max_batch))
-        ew = self._ewma.get(b) or max(self._ewma.values())
+        ew = self._ewma_for_locked(b)
         batches = math.ceil(q / self.max_batch) + \
             (1 if self._batch_running else 0)
         return batches * ew
+
+    def _ewma_for_locked(self, bucket):
+        """Latency EWMA for a bucket the estimator may never have
+        dispatched: an observed bucket answers directly; otherwise the
+        NEAREST observed bucket's estimate is scaled by the row ratio.
+        The old fallback (max over every bucket) let one slow
+        large-batch probe poison small-bucket admission — a 1-request
+        estimate quoted the 64-row latency and the server over-shed."""
+        ew = self._ewma.get(bucket)
+        if ew is not None:
+            return ew
+        nearest = min(self._ewma, key=lambda b: abs(b - bucket))
+        return self._ewma[nearest] * (bucket / max(nearest, 1))
 
     def _bucket_for(self, n):
         for b in self.buckets:

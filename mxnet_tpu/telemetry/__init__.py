@@ -32,6 +32,7 @@ from .runlog import (  # noqa: F401
     flight_dump,
     flight_path_for,
     gauge,
+    generate,
     heal,
     program_report,
     quantize,
@@ -42,7 +43,8 @@ from .watchdog import Watchdog, stack_path_for  # noqa: F401
 
 __all__ = [
     "RunLog", "current", "reset", "close", "compile_event",
-    "compile_fingerprint", "event", "count", "gauge", "heal",
+    "compile_fingerprint", "event", "count", "gauge", "generate",
+    "heal",
     "data_plane", "quantize", "checkpoint_event", "program_report",
     "flight_dump",
     "flight_path_for", "describe_program", "FitSession",

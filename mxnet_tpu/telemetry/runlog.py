@@ -134,6 +134,8 @@ class RunLog:
                          "dist_init_retries": 0, "serve_requests": 0,
                          "serve_shed": 0, "serve_batches": 0,
                          "serve_breaker_trips": 0,
+                         "serve_tokens_total": 0,
+                         "kv_evictions_total": 0,
                          "fleet_requests": 0, "fleet_shed": 0,
                          "fleet_failovers": 0, "fleet_resizes": 0,
                          "fleet_swaps": 0, "peer_deaths": 0,
@@ -454,6 +456,39 @@ class RunLog:
                 tid=_TRACE_TID)
             profiler.record_counter("serve_queue_depth",
                                     int(queue_depth),
+                                    cat="telemetry", tid=_TRACE_TID)
+
+    def generate(self, *, name, tokens, tokens_s, ttft_p50_ms,
+                 ttft_p99_ms, in_flight, max_in_flight, evictions,
+                 shed, pages_in_use, queue_depth, kv_dtype, compiles):
+        """One generative-serving snapshot
+        (serving.generate.GenerativeServer.report): decode throughput,
+        time-to-first-token percentiles, continuous-batching occupancy,
+        paged-KV pool pressure and the cumulative eviction/shed
+        counters — plus the post-warm compile count whose expected
+        value under continuous batching is exactly zero."""
+        self._write({"type": "generate", "t": round(self._now(), 6),
+                     "name": str(name), "tokens": int(tokens),
+                     "tokens_s": round(float(tokens_s), 4),
+                     "ttft_p50_ms": round(float(ttft_p50_ms), 4)
+                     if ttft_p50_ms is not None else None,
+                     "ttft_p99_ms": round(float(ttft_p99_ms), 4)
+                     if ttft_p99_ms is not None else None,
+                     "in_flight": int(in_flight),
+                     "max_in_flight": int(max_in_flight),
+                     "evictions": int(evictions), "shed": int(shed),
+                     "pages_in_use": int(pages_in_use),
+                     "queue_depth": int(queue_depth),
+                     "kv_dtype": str(kv_dtype),
+                     "compiles": int(compiles)})
+        from .. import profiler
+
+        if profiler.is_running():
+            self._trace_meta()
+            profiler.record_counter("serve_tokens_total", int(tokens),
+                                    cat="telemetry", tid=_TRACE_TID)
+            profiler.record_counter("kv_pages_in_use",
+                                    int(pages_in_use),
                                     cat="telemetry", tid=_TRACE_TID)
 
     def fleet(self, *, action, replicas, ready, queue_depth,
@@ -784,6 +819,12 @@ def quantize(action, *, mode="", layers=0, excluded=0, **fields):
     if rl is not None:
         rl.quantize(action, mode=mode, layers=layers,
                     excluded=excluded, **fields)
+
+
+def generate(**fields):
+    rl = current()
+    if rl is not None:
+        rl.generate(**fields)
 
 
 def checkpoint_event(prefix, version, duration_s, nbytes, **extra):

@@ -19,6 +19,9 @@ opstats   aggregate per-op table folded from the profiler's op events
 tensor_stats  sampled numerics-monitor summary of named tensors
 serve     one dispatched serving microbatch (size, pad, latency,
           queue depth, cumulative shed, breaker state)
+generate  one generative-serving snapshot (tokens/s, TTFT p50/p99,
+          sequences in flight, KV pages in use, cumulative
+          eviction/shed counters, effective KV dtype)
 fleet     one fleet-router observation (replica counts, queue-depth
           EWMA, cumulative request/failover/shed counters) stamped
           with the action that produced it (probe/eject/resize/swap)
@@ -37,9 +40,9 @@ from __future__ import annotations
 
 __all__ = ["STEP_FIELDS", "RECORD_TYPES", "COMPILE_CAUSES",
            "OPSTATS_ROW_FIELDS", "TENSOR_STATS_ROW_FIELDS",
-           "SERVE_FIELDS", "FLEET_FIELDS", "HEAL_FIELDS",
-           "DATA_FIELDS", "QUANT_FIELDS", "validate_record",
-           "validate_lines"]
+           "SERVE_FIELDS", "GENERATE_FIELDS", "FLEET_FIELDS",
+           "HEAL_FIELDS", "DATA_FIELDS", "QUANT_FIELDS",
+           "validate_record", "validate_lines"]
 
 #: step-record contract: field -> (types, required).  ``None`` is legal
 #: for optional measurements (loss on an unsampled step, feed stats
@@ -68,8 +71,8 @@ STEP_FIELDS = {
 
 RECORD_TYPES = ("run_start", "step", "compile", "program_report",
                 "checkpoint", "watchdog", "opstats", "tensor_stats",
-                "serve", "fleet", "heal", "data", "quantize", "event",
-                "run_end")
+                "serve", "generate", "fleet", "heal", "data",
+                "quantize", "event", "run_end")
 
 #: per-batch contract of a ``serve`` record (serving.ModelServer)
 SERVE_FIELDS = {
@@ -83,6 +86,29 @@ SERVE_FIELDS = {
     "deadline_margin_ms": ((int, float, type(None)), True),
     "shed": (int, True),                  # cumulative shed count
     "breaker": (str, True),
+}
+
+#: per-snapshot contract of a ``generate`` record
+#: (serving.generate.GenerativeServer.report): the generative decode
+#: path's health at one moment — throughput, time-to-first-token
+#: percentiles, continuous-batching occupancy, paged-KV pool pressure
+#: and the cumulative eviction/shed counters
+GENERATE_FIELDS = {
+    "type": (str, True),
+    "t": ((int, float), True),
+    "name": (str, True),
+    "tokens": (int, True),                # cumulative generated tokens
+    "tokens_s": ((int, float), True),
+    "ttft_p50_ms": ((int, float, type(None)), True),
+    "ttft_p99_ms": ((int, float, type(None)), True),
+    "in_flight": (int, True),             # decode slots active now
+    "max_in_flight": (int, True),
+    "evictions": (int, True),             # cumulative KV preemptions
+    "shed": (int, True),                  # cumulative rejections
+    "pages_in_use": (int, True),          # paged-KV pool pressure
+    "queue_depth": (int, True),           # prefill queue now
+    "kv_dtype": (str, True),              # effective cache dtype
+    "compiles": (int, True),              # post-warm compiles (0 proof)
 }
 
 #: per-observation contract of a ``fleet`` record (serving.fleet):
@@ -247,6 +273,8 @@ def validate_record(rec):
         return problems
     if t == "serve":
         return _check_fields(rec, SERVE_FIELDS)
+    if t == "generate":
+        return _check_fields(rec, GENERATE_FIELDS)
     if t == "fleet":
         return _check_fields(rec, FLEET_FIELDS)
     if t == "heal":

@@ -235,3 +235,59 @@ def test_fallback_emits_autotune_event(tmp_path):
     assert ev["winner"] == "naive"
     assert "tile-aligned" in ev["reason"]
     assert "pallas_pad" in ev["reason"]
+
+
+# ------------------------------------- round 17: ragged-tail exactness
+_ALL_VARIANTS = ("naive", "pallas", "pallas_b256", "pallas_pad")
+
+
+@pytest.mark.parametrize("variant", _ALL_VARIANTS)
+@pytest.mark.parametrize("causal", [False, True])
+def test_ragged_tail_matches_reference_all_variants(causal, variant):
+    """Every registered flash_attention variant agrees with the fp32
+    reference on a RAGGED prompt shape (the generative prefill case:
+    s=10 inside a padded bucket).  Forced kernel variants that cannot
+    tile fall back to naive — the answer must still be exact."""
+    from mxnet_tpu.autotune import VARIANT_OPS
+
+    assert set(_ALL_VARIANTS) == set(VARIANT_OPS["flash_attention"]), \
+        "a new registered variant must join this exactness matrix"
+    rng = onp.random.RandomState(17)
+    q = jnp.asarray(rng.randn(1, 2, 10, 8).astype("float32") * 0.3)
+    k = jnp.asarray(rng.randn(1, 2, 10, 8).astype("float32") * 0.3)
+    v = jnp.asarray(rng.randn(1, 2, 10, 8).astype("float32") * 0.3)
+    ref = _naive_attention(q, k, v, causal, 8 ** -0.5)
+    out = flash_attention(q, k, v, causal=causal, variant=variant,
+                          interpret=True)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("variant", _ALL_VARIANTS)
+@pytest.mark.parametrize("causal", [False, True])
+def test_padded_rows_contribute_exactly_zero(causal, variant):
+    """The padding-mask proof: blocks-aligned inputs whose tail keys
+    hold 1e9 GARBAGE must reproduce the valid-slice reference — any
+    nonzero softmax mass on a padded row would swamp the output by
+    ~1e9, so agreement at 1e-5 means the tail's normalization weight
+    is exactly zero in every variant."""
+    from mxnet_tpu.ops.flash_attention import _flash
+
+    rng = onp.random.RandomState(23)
+    valid = 10
+    q = jnp.asarray(rng.randn(1, 2, valid, 8).astype("float32") * 0.3)
+    k = jnp.asarray(rng.randn(1, 2, valid, 8).astype("float32") * 0.3)
+    v = jnp.asarray(rng.randn(1, 2, valid, 8).astype("float32") * 0.3)
+    ref = _naive_attention(q, k, v, causal, 8 ** -0.5)
+    pad = 128 - valid
+    widths = ((0, 0), (0, 0), (0, pad), (0, 0))
+    qp = jnp.pad(q, widths)
+    kp = jnp.pad(k, widths, constant_values=1e9)
+    vp = jnp.pad(v, widths, constant_values=1e9)
+    out = _flash(qp, kp, vp, causal, 8 ** -0.5, True, variant,
+                 valid, valid)
+    got = onp.asarray(out[:, :, :valid, :])
+    assert onp.isfinite(got).all(), \
+        f"{variant}: padded garbage leaked into the output"
+    onp.testing.assert_allclose(got, onp.asarray(ref),
+                                rtol=1e-5, atol=1e-6)

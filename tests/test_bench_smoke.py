@@ -201,6 +201,30 @@ def test_smoke_emits_valid_json_with_heartbeats():
         assert qt["speedup_p50"] is not None
     else:
         assert qt["speedup_p50"] is None
+    # the generative decode INFERENCE phase (round 17): paged-KV
+    # continuous batching under bursty ragged-prompt load
+    gen = out["generate"]
+    assert gen["requests"] > 0
+    assert gen["completed"] + gen["shed"] == gen["requests"]
+    assert gen["tokens"] > 0 and gen["tokens_s"] > 0
+    assert gen["ttft_p99_ms"] >= gen["ttft_p50_ms"] > 0
+    assert gen["max_in_flight"] >= 1
+    # eviction/shed are always REPORTED (their values are load-shaped)
+    assert gen["evictions"] >= 0 and gen["shed"] >= 0
+    # the zero-retrace proof: the warm-started campaign, admits and
+    # evictions included, compiled NOTHING new
+    assert gen["compiles_after_warm"] == 0, gen
+    assert gen["warm_traces"] >= 1
+    # every page returned to the pool once the campaign drained
+    assert gen["pages_in_use"] == 0
+    # the int8 KV acceptance bar: >= 1.8x fp32 concurrent sequences
+    # under the same budget (page-pool accounting), per-token
+    # agreement at or above the adoption floor
+    assert gen["capacity_ratio_int8"] >= 1.8, gen
+    assert gen["capacity_int8_seqs"] >= gen["capacity_fp32_seqs"]
+    assert gen["kv_dtype"] in ("int8", "float32")
+    if gen["kv_dtype"] == "int8":
+        assert gen["kv_agreement"] >= 0.99, gen
     # the fleet INFERENCE phase (round 15): 2 replica processes
     # behind the fault-tolerant router, bursty load over HTTP, a
     # rolling model swap, clean drain exits
@@ -225,7 +249,7 @@ def test_smoke_emits_valid_json_with_heartbeats():
                   "compile", "K1", "K2", "trials", "feed",
                   "checkpoint", "collectives", "fused_kernels",
                   "healing", "data_plane", "serving", "quantization",
-                  "fleet", "telemetry", "conv_ab", "done"):
+                  "generate", "fleet", "telemetry", "conv_ab", "done"):
         assert f"phase={phase}" in r.stderr, f"missing phase {phase}"
 
 

@@ -288,6 +288,74 @@ def test_quantization_regression_gates_with_fail_on_regression(
     assert rounds["r02"]["verdict"] == "ok"
 
 
+def _gen(tokens_s, ttft_p99=50.0, agreement=1.0, compiles=0):
+    return {"tokens_s": tokens_s, "ttft_p50_ms": ttft_p99 / 4.0,
+            "ttft_p99_ms": ttft_p99, "kv_agreement": agreement,
+            "compiles_after_warm": compiles, "kv_dtype": "int8",
+            "evictions": 2, "shed": 0,
+            "capacity_ratio_int8": 2.62}
+
+
+def test_generate_trend_verdicts_and_missing_metric(tmp_path):
+    """Round 17: the generate INFERENCE phase trends like the fleet's
+    — baseline on first appearance, tokens/s rated like the headline
+    (higher is better), TTFT p99 inverted, int8 KV agreement below
+    0.99 and ANY post-warm compile ABSOLUTE regressions, and a round
+    that shipped the phase then lost it is 'missing generate
+    metric'.  Pre-phase rounds carry no verdict."""
+    glob_b = _write_rounds(tmp_path, [
+        (1, 0, {"value": 1000.0}),                         # pre-phase
+        (2, 0, {"value": 1000.0, "generate": _gen(200.0)}),
+        (3, 0, {"value": 1000.0,
+                "generate": _gen(190.0, ttft_p99=52.0)}),      # ok
+        (4, 0, {"value": 1000.0,
+                "generate": _gen(100.0)}),          # tokens/s halved
+        (5, 0, {"value": 1000.0,
+                "generate": _gen(200.0, ttft_p99=500.0)}),  # TTFT 10x
+        (6, 0, {"value": 1000.0,
+                "generate": _gen(200.0, agreement=0.9)}),  # KV floor
+        (7, 0, {"value": 1000.0,
+                "generate": _gen(200.0, compiles=3)}),     # retrace
+        (8, 0, {"value": 1000.0}),                 # lost the phase
+    ])
+    rounds = bd.generate_verdicts(bd.load_bench(
+        sorted(__import__("glob").glob(glob_b))), 0.15)
+    assert rounds["r01"]["gen_verdict"] is None
+    assert rounds["r02"]["gen_verdict"] == "baseline"
+    assert rounds["r03"]["gen_verdict"] == "ok"
+    assert rounds["r04"]["gen_verdict"] == "regression"
+    assert "tokens/s" in rounds["r04"]["gen_reason"]
+    assert rounds["r05"]["gen_verdict"] == "regression"
+    assert "TTFT" in rounds["r05"]["gen_reason"]
+    assert rounds["r06"]["gen_verdict"] == "regression"
+    assert "0.99" in rounds["r06"]["gen_reason"]
+    assert rounds["r07"]["gen_verdict"] == "regression"
+    assert "retrace" in rounds["r07"]["gen_reason"]
+    assert rounds["r08"]["gen_verdict"] == "regression"
+    assert rounds["r08"]["gen_reason"] == "missing generate metric"
+
+
+def test_generate_regression_gates_with_fail_on_regression(
+        tmp_path, capsys):
+    """A decode tokens/s regression exits 2 under --fail-on-regression
+    even with a clean headline, and the table carries the generate
+    section."""
+    glob_b = _write_rounds(tmp_path, [
+        (1, 0, {"value": 1000.0, "generate": _gen(200.0)}),
+        (2, 0, {"value": 1010.0, "generate": _gen(80.0)}),
+    ])
+    rc = bd.main(["--bench", glob_b, "--opperf",
+                  str(tmp_path / "none*.jsonl"),
+                  "--fail-on-regression"])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "generate serving trend" in out
+    assert "generate r02" in out
+    rounds = bd.headline_verdicts(bd.load_bench(
+        sorted(__import__("glob").glob(glob_b))), 0.15)
+    assert rounds["r02"]["verdict"] == "ok"
+
+
 def test_fleet_absent_everywhere_never_gates(tmp_path):
     """The committed pre-round-15 artifacts carry no fleet phase: the
     fleet gate must stay silent (the pinned r01–r05 CI window cannot

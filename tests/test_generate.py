@@ -1,0 +1,461 @@
+"""Generative decode serving (round 17): paged KV cache + continuous
+batching, drilled.
+
+The contract under test, end to end:
+
+* the paged pool: token-budget admission (pages for prompt+max_new
+  reserved up front), the reserved null page, idempotent free, full
+  reclaim on reset — and the int8 arm's >= 1.8x concurrent-sequence
+  capacity measured from the SAME page accounting;
+* paged decode attention: the ``gather`` and ``paged`` variants agree
+  with each other and with dense attention, a masked-out slot's row is
+  EXACTLY zero, and the int8 cache path dequantizes correctly;
+* prefill/decode disaggregation: decode tokens match an autoregressive
+  full-forward reference exactly (fp32), prefill compiles once per
+  bucket, and a bursty admit/evict campaign after warm start shows
+  ZERO new compile events with the decode jit holding ONE program;
+* continuous batching: eviction preempts in place and the evicted
+  sequence resumes exactly; token-budget violations reject structured;
+* the int8 KV gate: measured per-token agreement >= 0.99 adopts int8,
+  a floor it cannot meet falls back to fp32 — never silently;
+* failure: a ``serve.decode`` fault trips the breaker, in-flight
+  sequences get ``ServeRejected(reason="model_error")``, EVERY pool
+  page is reclaimed, and the probe re-warm recovers;
+* telemetry: ``generate`` records validate against the schema and the
+  serve_tokens_total / kv_pages_in_use / kv_evictions_total /
+  prefill_queue_depth rows land in the Prometheus textfile.
+"""
+import os
+
+import numpy as onp
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from mxnet_tpu.base import MXNetError  # noqa: E402
+from mxnet_tpu.ops.flash_attention import (  # noqa: E402
+    paged_decode_attention,
+)
+from mxnet_tpu.quantization import (  # noqa: E402
+    kv_dequantize,
+    kv_page_bytes,
+    kv_quantize,
+)
+from mxnet_tpu.resilience import faultsim  # noqa: E402
+from mxnet_tpu.serving import (  # noqa: E402
+    GenerativeServer,
+    PagedKVPool,
+    ServeRejected,
+)
+
+
+@pytest.fixture(autouse=True)
+def _quiet(monkeypatch):
+    """Races are exercised by their dedicated test; everything else
+    runs with autotune off (variant defaults) and faults disarmed."""
+    monkeypatch.setenv("MXNET_AUTOTUNE", "0")
+    faultsim.reset("")
+    yield
+    faultsim.reset("")
+
+
+def _server(**kw):
+    kw.setdefault("prompt_buckets", (4, 8))
+    kw.setdefault("max_new", 6)
+    kw.setdefault("slots", 4)
+    kw.setdefault("page_tokens", 4)
+    kw.setdefault("pool_budget", 1 << 16)
+    kw.setdefault("kv_dtype", "float32")
+    return GenerativeServer(**kw)
+
+
+# ------------------------------------------------------------ the pool
+def test_pool_token_budget_admission_and_null_page():
+    pool = PagedKVPool(2, 2, 8, page_tokens=4, budget_bytes=1 << 14,
+                       dtype="float32")
+    # fp32 page: 2 sides * 2 layers * 4 tok * 2 heads * 8 dim * 4 B
+    assert pool.page_bytes == kv_page_bytes(2, 4, 2, 8, "float32")
+    assert pool.num_pages == (1 << 14) // pool.page_bytes
+    pages = pool.alloc("a", tokens=10)  # ceil(10/4) = 3 pages
+    assert len(pages) == 3
+    assert 0 not in pages, "the null page must never be handed out"
+    assert pool.pages_in_use == 3
+    with pytest.raises(MXNetError):
+        pool.alloc("a", tokens=1)  # double alloc is loud
+    row = pool.page_table_row("a", max_pages=5)
+    assert list(row[:3]) == pages and list(row[3:]) == [0, 0]
+    assert pool.free("a") == 3
+    assert pool.free("a") == 0  # idempotent
+    assert pool.pages_in_use == 0 and pool.free_pages == pool.num_pages
+    # exhaustion is loud, reset reclaims everything
+    assert pool.can_admit(pool.capacity_tokens)
+    assert not pool.can_admit(pool.capacity_tokens + pool.page_tokens)
+    pool.alloc("b", pool.capacity_tokens)
+    with pytest.raises(MXNetError):
+        pool.alloc("c", tokens=1)
+    assert pool.reset() == pool.num_pages
+    assert pool.free_pages == pool.num_pages
+
+
+def test_int8_pool_admits_at_least_1p8x_sequences():
+    """The capacity acceptance: under the SAME byte budget the int8
+    cache admits >= 1.8x the concurrent sequences of fp32, measured
+    from page-pool accounting (at head_dim 8 the ratio is 8*4 / (8+4)
+    = 2.67x)."""
+    budget = 1 << 20
+    fp = PagedKVPool(2, 2, 8, page_tokens=16, budget_bytes=budget,
+                     dtype="float32")
+    q8 = PagedKVPool(2, 2, 8, page_tokens=16, budget_bytes=budget,
+                     dtype="int8")
+    tokens_per_seq = 24  # a typical prompt+max_new budget
+    cap_fp = fp.capacity_sequences(tokens_per_seq)
+    cap_q8 = q8.capacity_sequences(tokens_per_seq)
+    assert cap_fp > 0
+    assert cap_q8 / cap_fp >= 1.8, (cap_q8, cap_fp)
+    # and the accounting is real: int8 actually ADMITS that many
+    for i in range(cap_q8):
+        q8.alloc(("s", i), tokens_per_seq)
+    assert not q8.can_admit(tokens_per_seq)
+    q8.reset()
+    assert q8.free_pages == q8.num_pages
+
+
+def test_kv_quantize_roundtrip():
+    rng = onp.random.RandomState(7)
+    x = jnp.asarray(rng.randn(2, 5, 2, 8).astype("float32"))
+    q, scale = kv_quantize(x)
+    assert q.dtype == jnp.int8 and scale.shape == x.shape[:-1]
+    back = kv_dequantize(q, scale)
+    # worst-case symmetric int8 error is scale/2 per element
+    err = onp.abs(onp.asarray(back - x))
+    bound = onp.asarray(scale)[..., None] / 2 + 1e-7
+    assert (err <= bound).all()
+    # all-zero vectors round-trip exactly (scale 0, no NaN)
+    qz, sz = kv_quantize(jnp.zeros((3, 2, 8)))
+    assert onp.asarray(sz).max() == 0.0
+    assert onp.asarray(kv_dequantize(qz, sz)).max() == 0.0
+
+
+# ----------------------------------------- paged attention, both walks
+def _paged_fixture(dtype="float32"):
+    rng = onp.random.RandomState(11)
+    S, P, T, H, D = 3, 9, 4, 2, 8
+    q = jnp.asarray(rng.randn(S, H, D).astype("float32") * 0.5)
+    k = jnp.asarray(rng.randn(P, T, H, D).astype("float32") * 0.5)
+    v = jnp.asarray(rng.randn(P, T, H, D).astype("float32") * 0.5)
+    pt = jnp.asarray(
+        onp.array([[1, 2, 3, 0], [4, 5, 0, 0], [0, 0, 0, 0]], "int32"))
+    sl = jnp.asarray(onp.array([10, 6, 0], "int32"))
+    return q, k, v, pt, sl
+
+
+def test_paged_variants_agree_and_match_dense():
+    q, k, v, pt, sl = _paged_fixture()
+    got_g = paged_decode_attention(q, k, v, pt, sl, variant="gather")
+    got_p = paged_decode_attention(q, k, v, pt, sl, variant="paged")
+    onp.testing.assert_allclose(onp.asarray(got_g), onp.asarray(got_p),
+                                rtol=1e-5, atol=1e-6)
+    # dense reference: materialize each slot's valid tokens and run
+    # plain softmax attention
+    D = q.shape[-1]
+    for s, (row, n) in enumerate(zip(onp.asarray(pt), onp.asarray(sl))):
+        if n == 0:
+            continue
+        ks = onp.concatenate([onp.asarray(k)[p] for p in row])[:n]
+        vs = onp.concatenate([onp.asarray(v)[p] for p in row])[:n]
+        sc = onp.einsum("hd,thd->ht", onp.asarray(q)[s], ks) / D ** 0.5
+        w = onp.exp(sc - sc.max(-1, keepdims=True))
+        w = w / w.sum(-1, keepdims=True)
+        ref = onp.einsum("ht,thd->hd", w, vs)
+        onp.testing.assert_allclose(onp.asarray(got_g)[s], ref,
+                                    rtol=1e-5, atol=1e-6)
+
+
+def test_paged_masked_slot_is_exactly_zero():
+    """An inactive slot (seq_len 0, all-null page table) produces an
+    EXACTLY zero row in both variants — garbage in the null page can
+    never leak into a live sequence's residual stream."""
+    q, k, v, pt, sl = _paged_fixture()
+    # poison the null page with huge values
+    k = k.at[0].set(1e9)
+    v = v.at[0].set(1e9)
+    for variant in ("gather", "paged"):
+        out = paged_decode_attention(q, k, v, pt, sl, variant=variant)
+        arr = onp.asarray(out)
+        assert onp.isfinite(arr).all(), variant
+        assert (arr[2] == 0.0).all(), variant
+
+
+def test_paged_int8_dequantizes_inside_attention():
+    q, k, v, pt, sl = _paged_fixture()
+    kq, ks = kv_quantize(k)
+    vq, vs = kv_quantize(v)
+    ref = paged_decode_attention(q, k, v, pt, sl, variant="gather")
+    for variant in ("gather", "paged"):
+        got = paged_decode_attention(q, kq, vq, pt, sl, k_scale=ks,
+                                     v_scale=vs, variant=variant)
+        onp.testing.assert_allclose(onp.asarray(got), onp.asarray(ref),
+                                    rtol=0.1, atol=0.05)
+
+
+# --------------------------------------------- decode == the reference
+def test_decode_matches_autoregressive_reference():
+    """Prefill/decode disaggregation is EXACT in fp32: tokens from the
+    paged decode loop equal greedy argmax of the full forward re-run
+    at every step."""
+    srv = _server(prompt_buckets=(4, 8, 16), max_new=8)
+    srv.start(warm=True)
+    try:
+        for prompt in ([5], [1, 2, 3], [7, 3, 9, 2, 11]):
+            got = srv.submit(prompt, max_new=8).result(timeout=60)
+            toks, want = list(prompt), []
+            for _ in range(8):
+                n = len(toks)
+                bucket = next(b for b in srv.prompt_buckets if n <= b)
+                arr = onp.zeros((1, bucket), "int32")
+                arr[0, :n] = toks
+                logits, _, _ = srv._prefill_fn(srv.params,
+                                               jnp.asarray(arr))
+                t = int(onp.asarray(logits[0, n - 1]).argmax())
+                want.append(t)
+                toks.append(t)
+            assert got == want, (prompt, got, want)
+    finally:
+        srv.close()
+
+
+def test_bursty_campaign_zero_new_compiles_after_warm(tmp_path):
+    """The continuous-batching acceptance proof: a warm-started server
+    pushed through TWO bursts with admissions, evictions and ragged
+    prompt lengths logs ZERO new compile events — the decode jit holds
+    exactly ONE program and every slot change is an in-place update."""
+    import json
+
+    from mxnet_tpu import telemetry as tm
+
+    srv = _server(max_new=5, evict_after_ms=5.0)
+    srv.start(warm=True)
+    path = str(tmp_path / "run.jsonl")
+    tm.reset(path)  # armed AFTER warm: any campaign retrace would land
+    try:
+        for burst in range(2):
+            hs = [srv.submit([1 + burst, 2 + i % 3, 3][: 1 + i % 3],
+                             max_new=5) for i in range(8)]
+            for h in hs:
+                assert len(h.result(timeout=60)) == 5
+    finally:
+        srv.close()
+        tm.close()
+    assert srv.stats["compiles"] == 0, srv.stats
+    assert srv.stats["completed"] == 16
+    size = srv.decode_cache_size()
+    assert size in (None, 1), f"decode step retraced: {size} programs"
+    with open(path) as f:
+        gen_compiles = [json.loads(line) for line in f
+                        if '"type": "compile"' in line
+                        and "generate:" in line]
+    assert gen_compiles == [], gen_compiles
+    assert srv.pool.pages_in_use == 0
+
+
+def test_eviction_preempts_and_resumes_exactly():
+    """Page pressure: a pool that fits only two concurrent sequences
+    serves four — the preempted sequence is re-prefilled from
+    prompt+generated and its final tokens are IDENTICAL to the
+    uncontended run."""
+    quiet = _server(prompt_buckets=(4,), max_new=5, slots=4,
+                    pool_budget=1 << 16)
+    quiet.start(warm=True)
+    try:
+        want = quiet.submit([1, 2, 3], max_new=5).result(timeout=60)
+    finally:
+        quiet.close()
+    # fp32 page = 2 sides * 2 layers * 4 tok * 2 heads * 32 B = 1024 B;
+    # 4 KiB -> 4 pages; each sequence needs ceil((3+5)/4) = 2 pages ->
+    # two concurrent, four queued
+    srv = _server(prompt_buckets=(4,), max_new=5, slots=4,
+                  pool_budget=4 * 1024, evict_after_ms=2.0)
+    srv.start(warm=True)
+    assert srv.pool.num_pages == 4
+    try:
+        hs = [srv.submit([1, 2, 3], max_new=5) for _ in range(4)]
+        outs = [h.result(timeout=60) for h in hs]
+    finally:
+        srv.close()
+    assert all(out == want for out in outs), (outs, want)
+    assert srv.stats["evictions"] >= 1
+    assert srv.stats["compiles"] == 0
+    assert srv.pool.pages_in_use == 0
+
+
+def test_token_budget_rejections_are_structured():
+    srv = _server()
+    srv.start(warm=True)
+    try:
+        with pytest.raises(ServeRejected) as e:
+            srv.submit(list(range(9)))  # > largest bucket (8)
+        assert e.value.reason == "token_budget"
+        with pytest.raises(ServeRejected) as e:
+            srv.submit([1], max_new=10 ** 6)  # > whole pool
+        assert e.value.reason == "token_budget"
+        # a legal request still flows
+        assert len(srv.submit([1, 2]).result(timeout=60)) == 6
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------------- the int8 gate
+def test_int8_gate_adopts_on_measured_agreement():
+    """The int8-KV acceptance: the warmup probe measures per-token
+    agreement against an fp32-cache arm; >= 0.99 adopts int8."""
+    srv = _server(kv_dtype="int8", max_new=8)
+    srv.start(warm=True)
+    try:
+        assert srv.kv_agreement is not None
+        assert srv.kv_agreement >= 0.99, srv.kv_agreement
+        assert srv.stats["kv_dtype_effective"] == "int8"
+        out = srv.submit([1, 2, 3], max_new=6).result(timeout=60)
+        assert len(out) == 6
+    finally:
+        srv.close()
+
+
+def test_int8_gate_falls_back_below_floor():
+    """A floor the measurement cannot meet (> 1.0) must fall back to
+    the fp32 cache — adoption is by measurement, never by assumption."""
+    srv = _server(kv_dtype="int8", agreement_floor=1.01)
+    srv.start(warm=True)
+    try:
+        assert srv.stats["kv_dtype_effective"] == "float32"
+        assert srv.pool.dtype == "float32"
+        out = srv.submit([1, 2, 3], max_new=6).result(timeout=60)
+        assert len(out) == 6
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------------------ failure
+def test_decode_fault_trips_breaker_reclaims_pages_and_recovers():
+    """The ``serve.decode`` chaos drill inline: consecutive injected
+    step failures trip the breaker, in-flight sequences fail with
+    ``ServeRejected(reason='model_error')``, EVERY page returns to the
+    pool, and the probe re-warm serves again after disarm."""
+    import time
+
+    srv = _server(breaker_limit=2)
+    srv.start(warm=True)
+    try:
+        faultsim.reset("serve.decode:raise@1-2")
+        hs = [srv.submit([1, 2, 3], max_new=6) for _ in range(3)]
+        reasons = []
+        for h in hs:
+            with pytest.raises(ServeRejected) as e:
+                h.result(timeout=15)
+            reasons.append(e.value.reason)
+        assert "model_error" in reasons, reasons
+        assert srv.stats["breaker_trips"] == 1
+        assert srv.pool.pages_in_use == 0, "page leak through the trip"
+        # breaker open: new work sheds structured
+        with pytest.raises(ServeRejected) as e:
+            srv.submit([1], max_new=2)
+        assert e.value.reason == "breaker_open"
+        faultsim.reset("")
+        deadline = time.monotonic() + 10
+        out = None
+        while time.monotonic() < deadline:
+            try:
+                out = srv.submit([1, 2, 3], max_new=6).result(timeout=15)
+                break
+            except ServeRejected:
+                time.sleep(0.05)
+        assert out is not None and len(out) == 6
+        assert srv.pool.pages_in_use == 0
+    finally:
+        faultsim.reset("")
+        srv.close()
+
+
+# ---------------------------------------------------------- telemetry
+def test_generate_records_counters_and_textfile(tmp_path, monkeypatch):
+    from mxnet_tpu import telemetry as tm
+    from mxnet_tpu.telemetry import schema as tm_schema
+
+    textfile = str(tmp_path / "metrics.prom")
+    monkeypatch.setenv("MXNET_METRICS_TEXTFILE", textfile)
+    path = str(tmp_path / "run.jsonl")
+    tm.reset(path)
+    srv = _server(max_new=5, evict_after_ms=5.0,
+                  pool_budget=4 * 1024, prompt_buckets=(4,))
+    srv.start(warm=True)
+    try:
+        hs = [srv.submit([1, 2, 3], max_new=5) for _ in range(4)]
+        for h in hs:
+            h.result(timeout=60)
+        rep = srv.report()
+    finally:
+        srv.close()
+        tm.close()
+    assert rep["tokens"] == 20 and rep["tokens_s"] > 0
+    assert rep["ttft_p50_ms"] > 0 and rep["ttft_p99_ms"] > 0
+    assert rep["evictions"] >= 1 and rep["compiles"] == 0
+    with open(path) as f:
+        recs, problems = tm_schema.validate_lines(f)
+    assert not problems, problems[:5]
+    gens = [r for r in recs if r["type"] == "generate"]
+    assert gens, "generate records must land in the run log"
+    assert gens[-1]["tokens"] == 20
+    assert gens[-1]["kv_dtype"] == "float32"
+    assert gens[-1]["max_in_flight"] >= 1
+    end = next(r for r in recs if r["type"] == "run_end")
+    assert end["counters"]["serve_tokens_total"] == 20
+    assert end["counters"]["kv_evictions_total"] >= 1
+    text = open(textfile).read()
+    assert "mxnet_tpu_serve_tokens_total 20" in text
+    assert "mxnet_tpu_kv_evictions_total" in text
+    assert "mxnet_tpu_kv_pages_in_use" in text
+    assert "mxnet_tpu_prefill_queue_depth" in text
+
+
+# ------------------------------------------------------------ autotune
+def test_variant_races_run_and_cache(tmp_path, monkeypatch):
+    """Warmup races flash_attention's pallas_pad shim per prefill
+    bucket and the paged decode walk; the second build answers from
+    the persisted cache without re-measuring."""
+    from mxnet_tpu import autotune as at
+
+    monkeypatch.setenv("MXNET_AUTOTUNE", "1")
+    monkeypatch.setenv("MXNET_AUTOTUNE_CACHE_DIR",
+                       str(tmp_path / "atc"))
+    at.cache_clear()
+    srv = _server(prompt_buckets=(4,), max_new=4)
+    srv.start(warm=True)
+    try:
+        rep = srv._autotune_report
+        assert rep["prefill_b4"]["winner"] in ("naive", "pallas_pad")
+        assert rep["paged_decode_attention"]["winner"] in ("gather",
+                                                           "paged")
+        assert rep["prefill_b4"]["cached"] is False
+    finally:
+        srv.close()
+    srv2 = _server(prompt_buckets=(4,), max_new=4)
+    srv2.start(warm=True)
+    try:
+        assert srv2._autotune_report["prefill_b4"]["cached"] is True
+    finally:
+        srv2.close()
+    at.cache_clear()
+
+
+def test_paged_attention_env_override(monkeypatch):
+    from mxnet_tpu.autotune import variant_choice
+
+    monkeypatch.setenv("MXNET_PAGED_ATTENTION", "paged")
+    assert variant_choice("paged_decode_attention",
+                          default="gather") == "paged"
+    monkeypatch.setenv("MXNET_PAGED_ATTENTION", "gather")
+    assert variant_choice("paged_decode_attention",
+                          default="paged") == "gather"

@@ -752,3 +752,36 @@ def test_kill_mid_traffic_flight_dump_then_warm_relaunch(tmp_path):
     assert end["counters"]["compiles"] == 0, \
         "AOT relaunch must be load-not-retrace"
     assert end["counters"]["serve_batches"] >= 1
+
+
+# ---------------------------------------- round 17: per-bucket EWMA fix
+def test_wait_estimate_is_per_bucket_not_max():
+    """Regression (round 17): the wait estimator's fallback for a
+    bucket with NO latency observation was ``max(self._ewma.values())``
+    — one slow large-bucket probe poisoned the estimate every
+    single-request admission used, and the server shed work its small
+    bucket would have served well inside the SLO.  The fix answers
+    from the nearest OBSERVED bucket scaled by the row ratio."""
+    srv = ModelServer(_np_model(), (2,), max_batch=64, slo_ms=200.0,
+                      coalesce_ms=0.5)
+    srv.start(warm=False)
+    try:
+        with srv._cond:
+            # two bucket sizes, only the LARGE one observed (a warm
+            # probe of the 64-row shape took a full second)
+            srv._ewma = {64: 1.0}
+            small = srv._ewma_for_locked(1)
+            large = srv._ewma_for_locked(64)
+        assert large == pytest.approx(1.0)
+        # nearest observed bucket scaled by the row ratio, NOT the max
+        assert small == pytest.approx(1.0 / 64)
+        # end to end: a single request inside a 200 ms SLO must ADMIT
+        # (the old max() fallback quoted 1 s and shed it immediately)
+        out = srv.submit(onp.zeros((2,), "float32")).result(timeout=5)
+        assert out.shape == (2,)
+        # an observed bucket still answers directly
+        with srv._cond:
+            srv._ewma[1] = 0.004
+            assert srv._ewma_for_locked(1) == pytest.approx(0.004)
+    finally:
+        srv.close()

@@ -29,6 +29,14 @@ a machine-readable trend:
   floor, not a ratio), the int8 p99 rates like the fleet's (lower is
   better), and a round that shipped the phase then lost it is
   "missing quantization metric".
+* **generate serving trend** (round 17) — the ``generate`` INFERENCE
+  phase's paged-KV decode metrics round-over-round: decode tokens/s
+  drops past the threshold or a TTFT-p99 blow-up regresses (lower
+  TTFT is better, the fleet inversion), an int8 KV per-token
+  agreement below 0.99 regresses ABSOLUTELY (the adoption floor),
+  any post-warm compile regresses ABSOLUTELY (the zero-retrace
+  contract), and a round that shipped the phase then lost it is
+  "missing generate metric".
 * **zero-stage trend** (round 16, ZeRO) — the collectives phase's
   ``zero`` block (stage-1 vs stage-3 sharded step on the virtual
   mesh): the per-step RS+AG bytes over the analytic plan minimum must
@@ -87,6 +95,8 @@ def load_bench(paths):
                "fleet_within_slo": None,
                "quant_p99_ms": None, "quant_agreement": None,
                "quant_speedup": None,
+               "gen_tokens_s": None, "gen_ttft_p99_ms": None,
+               "gen_agreement": None, "gen_compiles": None,
                "zero_rs_ag_ratio": None, "zero_mem_ratio": None,
                "zero_mem_expected": None, "zero_step_ratio": None}
         try:
@@ -123,6 +133,13 @@ def load_bench(paths):
                 if isinstance(arm, dict):
                     row["quant_p99_ms"] = arm.get("p99_ms")
                 row["quant_speedup"] = qt.get("speedup_p50")
+            gen = parsed.get("generate")
+            if isinstance(gen, dict) \
+                    and gen.get("tokens_s") is not None:
+                row["gen_tokens_s"] = gen["tokens_s"]
+                row["gen_ttft_p99_ms"] = gen.get("ttft_p99_ms")
+                row["gen_agreement"] = gen.get("kv_agreement")
+                row["gen_compiles"] = gen.get("compiles_after_warm")
             col = parsed.get("collectives")
             zr = col.get("zero") if isinstance(col, dict) else None
             if isinstance(zr, dict) \
@@ -277,6 +294,68 @@ def quantization_verdicts(rounds, threshold):
                                        if ratio is not None else None)
         seen = True
         prev = (agreement, p99)
+    return rounds
+
+
+def generate_verdicts(rounds, threshold):
+    """Verdict the ``generate`` INFERENCE phase round-over-round:
+    decode tokens/s rates like the headline (higher is better), TTFT
+    p99 rates inverted like the fleet's (lower is better), an int8 KV
+    per-token agreement below 0.99 regresses ABSOLUTELY (the adoption
+    floor — a KV cache that changes tokens is not a capacity win) and
+    so does ANY post-warm compile (the zero-retrace contract of the
+    compile-once decode loop).  Rounds before the phase existed carry
+    no generate verdict; once shipped, a later round without it is
+    "missing generate metric"."""
+    seen = False
+    prev = None
+    for label in sorted(rounds):
+        row = rounds[label]
+        tok_s = row["gen_tokens_s"]
+        if tok_s is None:
+            if seen:
+                row["gen_verdict"] = "regression"
+                row["gen_reason"] = "missing generate metric"
+            else:
+                row["gen_verdict"] = None
+                row["gen_reason"] = None
+            continue
+        ttft = row["gen_ttft_p99_ms"]
+        agreement = row["gen_agreement"]
+        compiles = row["gen_compiles"]
+        reasons = []
+        if agreement is not None and agreement < 0.99:
+            reasons.append(
+                f"int8 KV agreement {agreement:.3f} < 0.99")
+        if compiles:
+            reasons.append(
+                f"{compiles} post-warm compile(s) (retrace)")
+        if not seen:
+            row["gen_verdict"] = "regression" if reasons \
+                else "baseline"
+            row["gen_reason"] = "; ".join(reasons) or None
+        else:
+            p_tok, p_ttft = prev
+            tok_ratio = (tok_s / p_tok) if p_tok else None
+            ttft_ratio = (ttft / p_ttft) if (ttft and p_ttft) else None
+            if tok_ratio is not None \
+                    and tok_ratio < 1.0 / (1.0 + threshold):
+                reasons.append(f"tokens/s x{tok_ratio:.2f}")
+            if ttft_ratio is not None and ttft_ratio > 1.0 + threshold:
+                reasons.append(f"TTFT p99 x{ttft_ratio:.2f}")
+            if reasons:
+                row["gen_verdict"] = "regression"
+                row["gen_reason"] = "; ".join(reasons)
+            elif tok_ratio is not None \
+                    and tok_ratio > 1.0 + threshold:
+                row["gen_verdict"] = "improved"
+                row["gen_reason"] = f"tokens/s x{tok_ratio:.2f}"
+            else:
+                row["gen_verdict"] = "ok"
+                row["gen_reason"] = (f"tokens/s x{tok_ratio:.2f}"
+                                     if tok_ratio is not None else None)
+        seen = True
+        prev = (tok_s, ttft)
     return rounds
 
 
@@ -444,6 +523,27 @@ def render(bench, opperf, threshold):
                 f"{_fmt(r['quant_p99_ms']):>10s}"
                 f"{_fmt(r['quant_speedup']):>8s}"
                 f"  {verdict}")
+    gen_rows = [label for label in sorted(bench)
+                if bench[label].get("gen_verdict")]
+    if gen_rows:
+        lines.append("")
+        lines.append("== generate serving trend ==")
+        lines.append(f"{'round':<10s}{'tok/s':>10s}{'ttft_p99':>10s}"
+                     f"{'agree':>8s}{'retrace':>9s}  verdict")
+        for label in gen_rows:
+            r = bench[label]
+            verdict = r["gen_verdict"]
+            if r.get("gen_reason"):
+                verdict += f": {r['gen_reason']}"
+            ag = r["gen_agreement"]
+            comp = r["gen_compiles"]
+            lines.append(
+                f"{label:<10s}"
+                f"{_fmt(r['gen_tokens_s']):>10s}"
+                f"{_fmt(r['gen_ttft_p99_ms']):>10s}"
+                f"{('-' if ag is None else f'{ag:.3f}'):>8s}"
+                f"{('-' if comp is None else str(comp)):>9s}"
+                f"  {verdict}")
     zero_rows = [label for label in sorted(bench)
                  if bench[label].get("zero_verdict")]
     if zero_rows:
@@ -538,10 +638,12 @@ def main(argv=None):
         return 1
 
     bench = zero_verdicts(
-        quantization_verdicts(
-            fleet_verdicts(
-                headline_verdicts(load_bench(bench_paths),
-                                  args.threshold),
+        generate_verdicts(
+            quantization_verdicts(
+                fleet_verdicts(
+                    headline_verdicts(load_bench(bench_paths),
+                                      args.threshold),
+                    args.threshold),
                 args.threshold),
             args.threshold),
         args.threshold)
@@ -561,6 +663,10 @@ def main(argv=None):
         if bench[last].get("quant_verdict") == "regression":
             failures.append(
                 f"quantization {last}: {bench[last]['quant_reason']}")
+        # generative decode gates the same way (round 17)
+        if bench[last].get("gen_verdict") == "regression":
+            failures.append(
+                f"generate {last}: {bench[last]['gen_reason']}")
         # the zero-stage collective/memory/step budgets too (ZeRO)
         if bench[last].get("zero_verdict") == "regression":
             failures.append(

@@ -67,6 +67,15 @@ Scenarios (round-robin over the schedule):
                   exits rc 83, and the relaunch verifies the
                   fingerprint, re-shards via stage3_load_params and
                   finishes shard-exact vs the reference
+``decode_fault``  ``serve.decode:raise@K`` kills generative decode
+                  steps mid-campaign (round 17): the breaker trips at
+                  the consecutive-failure limit, every in-flight
+                  sequence is shed ``ServeRejected(model_error)``,
+                  EVERY page returns to the pool (the no-leak
+                  invariant), and after the fault window drains the
+                  SAME server recovers — the final fault-free
+                  generation must match the fault-free reference
+                  token-for-token
 ================  ====================================================
 
 Usage::
@@ -94,7 +103,7 @@ sys.path.insert(0, _REPO)
 SCENARIOS = ("sigkill", "sigterm_drain", "peer_death",
              "heartbeat_delay", "ckpt_async_crash", "ckpt_write_crash",
              "collective_delay", "record_corrupt", "io_worker_kill",
-             "zero3_peer_death")
+             "zero3_peer_death", "decode_fault")
 
 #: scenarios that intentionally kill the victim (a relaunch+resume is
 #: expected); the others must complete on attempt 0
@@ -266,6 +275,99 @@ def _worker_zero3(args, attempt):
     return 0
 
 
+def _worker_generate(args, attempt):
+    """The generative-serving arm (round 17, ``decode_fault``): a
+    warm-started GenerativeServer takes a burst of prompts while the
+    seeded ``serve.decode:raise`` spec kills decode steps — the
+    breaker must trip, in-flight sequences must shed
+    ``ServeRejected(reason="model_error")`` and EVERY page must return
+    to the pool (the no-leak invariant).  Then the faults are
+    disarmed and the SAME server must recover: the final fault-free
+    generation is the run's ``final`` payload, compared
+    token-for-token against the fault-free reference."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.resilience import faultsim
+    from mxnet_tpu.serving import GenerativeServer, ServeRejected
+
+    spec = os.environ.get("MXNET_FAULT_SPEC", "")
+    # warm start fault-free: the warm-up probe steps the decode
+    # program too, and a spec hit-window indexed from process start
+    # would land there instead of mid-campaign
+    faultsim.reset("")
+    srv = GenerativeServer(
+        seed=0, vocab=32, prompt_buckets=(4, 8), max_new=6, slots=4,
+        page_tokens=4, pool_budget=64 * 1024, kv_dtype="float32",
+        breaker_limit=2, name="chaos-generate")
+    srv.start(warm=True)
+    if attempt == 0 and spec:
+        faultsim.reset(spec)  # hit 1 = the campaign's first decode
+    prompts = [[(7 * i + j) % srv.vocab for j in range(2 + i % 6)]
+               for i in range(6)]
+    problems = []
+    final = {}
+    try:
+        # storm phase: the armed fault lands on the decode loop
+        reasons = {}
+        handles = []
+        for p in prompts:
+            try:
+                handles.append(srv.submit(p))
+            except ServeRejected as e:
+                reasons[e.reason] = reasons.get(e.reason, 0) + 1
+        for h in handles:
+            try:
+                h.result(timeout=60)
+            except ServeRejected as e:
+                reasons[e.reason] = reasons.get(e.reason, 0) + 1
+        if attempt == 0 and spec:
+            if srv.stats["breaker_trips"] < 1:
+                problems.append(
+                    "breaker never tripped under the armed decode "
+                    "fault")
+            if reasons.get("model_error", 0) < 1:
+                problems.append(
+                    "no in-flight sequence was shed ServeRejected"
+                    f"(model_error); shed reasons: {reasons}")
+        if srv.pool.pages_in_use != 0:
+            problems.append(
+                f"page leak: {srv.pool.pages_in_use} page(s) still "
+                "held after the storm")
+        # recovery phase: disarm, the SAME server must serve again
+        faultsim.reset("")
+        give_up = time.monotonic() + 30.0
+        for i, p in enumerate(prompts):
+            toks = None
+            while toks is None and time.monotonic() < give_up:
+                try:
+                    toks = srv.submit(p).result(timeout=30)
+                except ServeRejected:
+                    time.sleep(0.02)  # breaker still re-warming
+            if toks is None:
+                problems.append(
+                    f"no recovery: prompt {i} never served after the "
+                    "faults were disarmed")
+                break
+            final[f"prompt{i}"] = [int(t) for t in toks]
+    finally:
+        srv.drain(timeout=10.0)
+        srv.close()
+
+    import threading
+
+    telemetry.close()
+    stray = [t.name for t in threading.enumerate()
+             if t.is_alive() and not t.daemon
+             and t is not threading.main_thread()]
+    if problems:
+        print("chaos-worker(generate): " + "; ".join(problems),
+              file=sys.stderr, flush=True)
+        return 1
+    print(json.dumps({"final": final, "threads_ok": not stray,
+                      "stray_threads": stray, "attempt": attempt}),
+          flush=True)
+    return 0
+
+
 def _worker(args):
     """One training run (the supervised command): attempt 0 arms the
     scenario's faults and may die; relaunch attempts scrub the faults
@@ -281,6 +383,8 @@ def _worker(args):
         os.environ.pop("CHAOS_GHOST_AT_BATCH", None)
     if args.ctx == "zero3":
         return _worker_zero3(args, attempt)
+    if args.ctx == "generate":
+        return _worker_generate(args, attempt)
 
     import numpy as onp
 
@@ -471,6 +575,12 @@ def _schedule(seed, runs, scenarios):
             entry["io_workers"] = 4
             entry["fault_spec"] = \
                 f"io.worker:crash@{rng.randint(2, 6)}"
+        elif scen == "decode_fault":
+            # the worker re-arms AFTER its warm start, so hit 1 is
+            # the campaign's first decode step; breaker_limit is 2
+            start = rng.randint(1, 3)
+            entry["fault_spec"] = \
+                f"serve.decode:raise@{start}-{start + 1}"
         plan.append(entry)
     return plan
 
@@ -528,6 +638,8 @@ def _ctx_for(entry):
         return "rec"  # reference: same corrupt corpus, 0 workers
     if entry["scenario"] == "zero3_peer_death":
         return "zero3"  # reference: same loop, no ghost, no faults
+    if entry["scenario"] == "decode_fault":
+        return "generate"  # reference: same campaign, no faults
     return "cpu"
 
 
