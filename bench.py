@@ -78,6 +78,13 @@ the round its headline artifact):
   rolls a zero-downtime ``.mxje`` model swap across the fleet:
   replicas/requests/shed/failovers/swap_ms/p50/p99/slo land under
   ``"fleet"`` in the JSON;
+* the ``freshness`` phase (round 18) runs the supervised online
+  learning loop (mxnet_tpu.online.OnlineLoop) — continuously-updating
+  trainer, stamped ``.mxje`` exports, zero-downtime rolling swaps
+  into a 2-replica fleet — and reports the sample-to-served
+  freshness distribution vs ``MXNET_FRESHNESS_SLO_MS``:
+  swaps/shed/rollbacks, the served-version monotonicity verdict and
+  p50/p99 land under ``"freshness"`` in the JSON;
 * the ``quantization`` INFERENCE phase (round 18) runs the int8
   pipeline end to end — entropy calibration of a trained net,
   ``quantization.quantize_net`` rewrite, the quantized_conv/
@@ -1353,6 +1360,73 @@ def _measure_fleet(smoke, deadline):
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def _measure_freshness(smoke, deadline):
+    """Online-learning freshness phase (round 18): run the supervised
+    trainer→export→rolling-swap loop (mxnet_tpu.online.OnlineLoop)
+    against a 2-replica CPU fleet and report the sample-to-served
+    freshness distribution — how stale the fleet's newest committed
+    model is relative to the live stream — against
+    ``MXNET_FRESHNESS_SLO_MS``.  swaps/shed/rollbacks/relaunches and
+    the served-version monotonicity verdict land in the headline JSON
+    next to the p50/p99; the SLO gate judges the fault-free p99 (the
+    tainted post-heal samples stay visible, excluded not hidden).
+
+    Like the fleet phase this measures the MACHINERY — export cost,
+    swap commit latency, supervisor scheduling — on compact CPU
+    artifacts; chip-level inference latency belongs to ``serving``."""
+    import shutil
+    import tempfile
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.online import OnlineLoop
+    from mxnet_tpu.serving import FleetRouter
+
+    tmpdir = tempfile.mkdtemp(prefix="mxnet_tpu_bench_fresh_")
+    steps = 12 if smoke else 30
+    export_every = 4 if smoke else 5
+    try:
+        mx.random.seed(11)
+        net = gluon.nn.Dense(1, in_units=4)
+        net.initialize(init=mx.init.Xavier())
+        base = os.path.join(tmpdir, "base.mxje")
+        mx.deploy.export_model(net, nd.zeros((8, 4)), base,
+                               platforms=("cpu",))
+        router = FleetRouter.spawn(
+            base, replicas=2, env={"JAX_PLATFORMS": "cpu"},
+            coalesce_ms=1.0,
+            ready_timeout=min(120.0, max(20.0, deadline.remaining())))
+        try:
+            loop = OnlineLoop(os.path.join(tmpdir, "loop"), router,
+                              steps=steps, export_every=export_every,
+                              seed=11, pace_s=0.02)
+            rep = loop.run(timeout=min(
+                300.0, max(60.0, deadline.remaining())))
+        finally:
+            router.close()
+        fr = rep["freshness"]
+        _heartbeat("freshness", swaps=rep["swaps"],
+                   shed=rep["swaps_shed"])
+        return {
+            "steps": rep["steps"],
+            "exports": rep["exports_seen"],
+            "swaps": rep["swaps"],
+            "swaps_shed": rep["swaps_shed"],
+            "swap_rollbacks": rep["swap_rollbacks"],
+            "relaunches": rep["relaunches"],
+            "versions_served": rep["served_versions"],
+            "monotonic": rep["monotonic"],
+            "slo_ms": fr["slo_ms"],
+            "violations": fr["violations"],
+            "p50_ms": fr["all"]["p50_ms"],
+            "p99_ms": fr["all"]["p99_ms"],
+            "fault_free_p99_ms": fr["fault_free"]["p99_ms"],
+            "p99_within_slo": fr["fault_free"]["within_slo"],
+        }
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def _ckpt_save(prefix, epoch, params, opt_state):
     """Atomic checkpoint of the trained params/opt state
     (resilience.checkpoint); returns the timed write duration so the
@@ -2175,6 +2249,26 @@ def main(argv=None):
             out["degraded"] = True
             reasons.append(f"fleet phase failed: {exc!r}")
     _write_partial(out, "fleet")
+
+    # online-learning freshness phase (round 18): the supervised
+    # trainer→export→rolling-swap loop against a 2-replica fleet —
+    # sample-to-served freshness p50/p99 vs MXNET_FRESHNESS_SLO_MS,
+    # swap/shed/rollback counts and the served-version monotonicity
+    # verdict land in the headline JSON
+    if deadline.exceeded(margin=0.0 if args.smoke else 60.0):
+        out["freshness"] = "skipped (deadline)"
+        out["degraded"] = True
+        reasons.append("deadline: skipped freshness phase")
+        deadline.note("freshness")
+    else:
+        _heartbeat("freshness")
+        try:
+            out["freshness"] = _measure_freshness(args.smoke, deadline)
+        except Exception as exc:  # auxiliary metric: never kill the run
+            out["freshness"] = {"error": repr(exc)}
+            out["degraded"] = True
+            reasons.append(f"freshness phase failed: {exc!r}")
+    _write_partial(out, "freshness")
 
     # run-telemetry dogfood (round 10): the bench arms a run log,
     # reports its own steps into it, re-reads the JSONL and folds the

@@ -278,12 +278,14 @@ generate_smoke() {
 }
 
 chaos_smoke() {
-    # the seeded chaos campaign (rounds 16-17): >=27 reproducible
-    # faults across all 11 scenario classes (SIGKILL at a seeded
+    # the seeded chaos campaign (rounds 16-18): >=27 reproducible
+    # faults across all 13 scenario classes (SIGKILL at a seeded
     # delay, mid-epoch record corruption, the io-worker kill, the
     # ZeRO stage-3 mid-step ghost-peer death with its parameter-shard
-    # emergency checkpoint, and the round-17 generative decode-fault
-    # breaker drill included) on the CPU mesh, each run
+    # emergency checkpoint, the round-17 generative decode-fault
+    # breaker drill, plus the round-18 online-trainer mid-stream
+    # death with its sample-exact resume and the rolling-swap
+    # probe-failure rollback drill) on the CPU mesh, each run
     # supervised by the healing respawn policy and gated on the three
     # invariants — zero hangs, zero torn artifacts
     # (tools/ckpt_fsck.py --all clean after every run), every healed
@@ -292,6 +294,29 @@ chaos_smoke() {
     # laptop.
     JAX_PLATFORMS=cpu python tools/chaos.py --seed 1234 --runs 30 \
         --min-faults 27 --out /tmp/chaos_ci
+}
+
+online_smoke() {
+    # online learning gate (round 18) on CPU: the deterministic
+    # replay stream purity unit, the faultsim-crash + relaunch
+    # sample-exact-resume contract (healed params bit-equal the
+    # uninterrupted run), checkpoint retention under every-step
+    # exports (keep_n pruning + torn-latest + corrupt-newest
+    # fallbacks), the rolling-swap partial-failure rollback
+    # (probe fault on host 2 of 2 rolls host 1 back, ONE identity,
+    # version regression refused), the generative host swap draining
+    # in-flight decodes, and THE drill — 60-step trainer SIGKILL'd
+    # between swaps under live load: relaunch, sample-exact resume,
+    # monotonic served versions, shed swaps counted loudly, and the
+    # fault-free freshness p99 within MXNET_FRESHNESS_SLO_MS.  Also
+    # collected by tier-1 (tests/test_online.py), so a regression
+    # turns the unit suite red between CI runs.
+    JAX_PLATFORMS=cpu python -m pytest tests/test_online.py -q
+    # the bench's freshness phase end to end in --smoke mode: swap
+    # count + freshness p99-vs-SLO smoke-asserted
+    JAX_PLATFORMS=cpu python -m pytest \
+        "tests/test_bench_smoke.py::test_smoke_emits_valid_json_with_heartbeats" \
+        -q
 }
 
 elastic_smoke() {

@@ -459,6 +459,19 @@ register_env("MXNET_FLEET_SCALE_EWMA", 0.2, float,
              "with this weight; crossing scale_up_depth/"
              "scale_down_depth triggers the reshard-not-restart "
              "resize.")
+register_env("MXNET_ONLINE_EXPORT_STEPS", 10, int,
+             "Export cadence of the online learning loop "
+             "(online.OnlineLoop): every N trainer steps the loop "
+             "checkpoints, exports a v2 .mxje artifact stamped with "
+             "the monotonic model version + stream cursor, and "
+             "rolling-swaps it into the serving fleet.")
+register_env("MXNET_FRESHNESS_SLO_MS", 60000.0, float,
+             "Freshness SLO of the online loop: maximum allowed "
+             "stream-sample-to-served-model latency.  Each committed "
+             "swap measures newest-ingested-sample-time -> fleet-"
+             "commit-time; p99 over the fault-free windows must stay "
+             "under this bound (gated in benchdiff, violations "
+             "counted loudly in telemetry).")
 register_env("DMLC_NUM_WORKER", 1, int,
              "Distributed worker count (tools/launch.py contract).")
 register_env("DMLC_WORKER_ID", 0, int, "This worker's rank.")

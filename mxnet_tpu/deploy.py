@@ -40,8 +40,9 @@ import numpy as onp
 
 from .base import MXNetError
 
-__all__ = ["export_model", "load_model", "load_exported",
-           "stablehlo_text", "artifact_info", "read_artifact_meta"]
+__all__ = ["export_model", "export_generative", "load_model",
+           "load_exported", "load_generative", "stablehlo_text",
+           "artifact_info", "read_artifact_meta"]
 
 #: v1 artifact header: magic, then ``<IQ`` = CRC32(payload),
 #: len(payload)
@@ -114,12 +115,20 @@ def _net_meta(net, x, platforms):
     }
 
 
-def export_model(net, example_input, path, platforms=("cpu", "tpu")):
+def export_model(net, example_input, path, platforms=("cpu", "tpu"),
+                 extra_meta=None):
     """Serialize ``net``'s inference forward (weights baked in) to
     ``path`` via jax.export.  ``example_input`` fixes shapes/dtypes
     (ndarray / numpy).  The default multi-platform lowering makes one
     artifact loadable on CPU hosts and TPU workers alike.  Returns
     ``path``.
+
+    ``extra_meta`` (round 18, the online loop): extra JSON-able keys
+    merged into the v2 header metadata — ``model_version`` (monotonic)
+    and ``stream_cursor`` above all — so ``read_artifact_meta`` can
+    answer "which version is this, trained through which sample?"
+    from a few hundred header bytes.  Reserved structural keys
+    (``batch``/``item_shape``/...) cannot be overridden.
 
     Round 18: a SINGLE-platform export traces under the autotune
     ``program_scope`` keyed on that platform, so persisted variant
@@ -159,6 +168,10 @@ def export_model(net, example_input, path, platforms=("cpu", "tpu")):
         # metadata under the SAME scope: the quantized/param_dtypes
         # identity must describe what this trace actually baked
         meta_doc = _net_meta(net, x, platforms)
+    if extra_meta:
+        for k, v in dict(extra_meta).items():
+            if k not in meta_doc:
+                meta_doc[k] = v
     blob = exp.serialize()
     meta = json.dumps(meta_doc, sort_keys=True).encode("utf-8")
     # the resilience atomic writer (temp + fsync + rename + dir
@@ -180,6 +193,118 @@ def export_model(net, example_input, path, platforms=("cpu", "tpu")):
         except Exception:
             pass  # telemetry must never kill an export
     return path
+
+
+def _flatten_params(tree, prefix=""):
+    """Flatten a nested dict/list param pytree into ``{"a/0/b": array}``
+    — the npz-friendly shape of a generative artifact payload."""
+    flat = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            flat.update(_flatten_params(v, f"{prefix}{k}/"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            flat.update(_flatten_params(v, f"{prefix}{i}/"))
+    else:
+        flat[prefix[:-1]] = onp.asarray(tree)
+    return flat
+
+
+def _unflatten_params(flat):
+    root = {}
+    for key in sorted(flat):
+        parts = key.split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = flat[key]
+
+    def fix(node):
+        if isinstance(node, dict):
+            if node and all(k.isdigit() for k in node):
+                return [fix(node[str(i)]) for i in range(len(node))]
+            return {k: fix(v) for k, v in node.items()}
+        return node
+
+    return fix(root)
+
+
+def export_generative(params, path, *, vocab, layers, heads, head_dim,
+                      prompt_buckets=(4, 8, 16), max_new=16,
+                      extra_meta=None):
+    """Serialize a generative (decoder-only) model into a v2 ``.mxje``
+    artifact: the param pytree as the npz payload, the decode
+    configuration under a ``"gen"`` metadata key, and
+    ``"generative": true`` in the header so ``read_artifact_meta``
+    identifies the artifact class without touching the payload.  The
+    fleet's :class:`~mxnet_tpu.serving.fleet.ModelHost` builds a
+    :class:`~mxnet_tpu.serving.generate.GenerativeServer` from it;
+    ``extra_meta`` stamps the same ``model_version``/``stream_cursor``
+    identity as :func:`export_model`."""
+    import io
+
+    from .resilience.checkpoint import atomic_write_bytes
+
+    flat = _flatten_params(params)
+    buf = io.BytesIO()
+    onp.savez(buf, **flat)
+    blob = buf.getvalue()
+    meta_doc = {
+        "generative": True,
+        # token-stream input signature: what admission/residency
+        # reports show for a generative artifact
+        "batch": 1,
+        "item_shape": [int(max(prompt_buckets))],
+        "dtype": "int32",
+        "platforms": ["cpu", "tpu"],
+        "quantized": False,
+        "param_dtypes": _dtype_histogram(flat),
+        "gen": {"vocab": int(vocab), "layers": int(layers),
+                "heads": int(heads), "head_dim": int(head_dim),
+                "prompt_buckets": [int(b) for b in prompt_buckets],
+                "max_new": int(max_new)},
+    }
+    if extra_meta:
+        for k, v in dict(extra_meta).items():
+            if k not in meta_doc:
+                meta_doc[k] = v
+    meta = json.dumps(meta_doc, sort_keys=True).encode("utf-8")
+    atomic_write_bytes(
+        path,
+        _MAGIC2 + _HEADER2.pack(zlib.crc32(meta + blob) & 0xFFFFFFFF,
+                                len(blob), len(meta)) + meta + blob,
+        inject_point=None)
+    return path
+
+
+def _dtype_histogram(flat):
+    counts = {}
+    for arr in flat.values():
+        dt = str(arr.dtype)
+        counts[dt] = counts.get(dt, 0) + 1
+    return counts
+
+
+def load_generative(path):
+    """Load + verify a generative artifact; returns ``(params, gen)``
+    where ``params`` is the decoder param pytree and ``gen`` the
+    decode-configuration dict the exporter stamped.  Refuses
+    non-generative artifacts with a clean :class:`MXNetError`."""
+    import io
+
+    meta, payload = _read_meta_payload(path)
+    if not (meta or {}).get("generative"):
+        raise MXNetError(
+            f"deploy artifact {path!r} is not a generative export "
+            "(load it with deploy.load_model / load_exported)")
+    try:
+        with onp.load(io.BytesIO(payload)) as z:
+            flat = {k: z[k] for k in z.files}
+    except Exception as e:  # noqa: BLE001 — name the artifact, always
+        raise MXNetError(
+            f"failed to deserialize generative artifact {path!r}: "
+            f"{e!r}") from e
+    return _unflatten_params(flat), dict(meta.get("gen") or {})
 
 
 def _read_meta_payload(path):
@@ -276,7 +401,12 @@ def load_exported(path):
     the model server warm-starts from without retracing."""
     from jax import export as jexport
 
-    blob = _read_payload(path)
+    meta, blob = _read_meta_payload(path)
+    if (meta or {}).get("generative"):
+        raise MXNetError(
+            f"deploy artifact {path!r} is a generative export — load "
+            "it with deploy.load_generative (the fleet's ModelHost "
+            "does this automatically)")
     try:
         return jexport.deserialize(blob)
     except MXNetError:

@@ -1,0 +1,21 @@
+"""Online learning loop (round 18): continuously-updating trainer ->
+v2 ``.mxje`` export -> zero-downtime rolling swap, supervised for a
+fault-proof sample-to-served freshness SLO.
+
+* :class:`OnlineTrainer` — subprocess worker: deterministic replay
+  stream through the data plane, cursor-bearing checkpoints, stamped
+  artifact exports, atomic publish manifests.
+* :class:`OnlineLoop` — supervisor: heals trainer deaths
+  (relaunch + sample-exact resume), swaps each published version into
+  a :class:`~mxnet_tpu.serving.FleetRouter` fleet, sheds superseded
+  versions loudly, tracks freshness per commit.
+* :class:`FreshnessTracker` — p50/p99 + SLO verdicts over committed
+  swaps, fault-free-window filtering for the gate.
+
+Knobs: ``MXNET_ONLINE_EXPORT_STEPS``, ``MXNET_FRESHNESS_SLO_MS``.
+"""
+from .freshness import FreshnessTracker  # noqa: F401
+from .loop import OnlineLoop, OnlineTrainer, stream_batch  # noqa: F401
+
+__all__ = ["OnlineLoop", "OnlineTrainer", "FreshnessTracker",
+           "stream_batch"]

@@ -1,0 +1,98 @@
+"""Freshness-SLO accounting for the online learning loop.
+
+**Freshness** of a served model is the *sample-to-served* latency: the
+age of the newest stream sample the model was trained on, measured at
+the instant the serving fleet COMMITS the rolling swap::
+
+    freshness_ms = (t_swap_commit - t_newest_sample) * 1e3
+
+It bounds how stale the fleet's answers can be relative to the live
+stream — the quantity an online-learning deployment actually promises
+(``MXNET_FRESHNESS_SLO_MS``), as opposed to export cadence or swap
+latency which are only its ingredients.
+
+:class:`FreshnessTracker` collects one sample per committed swap and
+answers the two questions the SLO gate asks:
+
+* **p50/p99 over all samples** — the raw distribution, violations
+  counted loudly against the SLO;
+* **p99 over fault-free windows only** — a swap that lands right after
+  a trainer crash/heal carries the healing latency by construction;
+  the supervisor marks it ``fault_free=False`` and the gate excludes
+  it, so the SLO judges the steady state while the tainted samples
+  stay visible in the report (excluded, not hidden).
+
+Percentiles use :func:`mxnet_tpu.telemetry.opstats.percentile`
+(nearest-rank) so bench, opperf and the freshness gate share one rank
+convention.
+"""
+from __future__ import annotations
+
+__all__ = ["FreshnessTracker"]
+
+
+class FreshnessTracker:
+    """Per-swap freshness samples + SLO verdicts.
+
+    ``slo_ms`` defaults to the ``MXNET_FRESHNESS_SLO_MS`` knob.  Each
+    :meth:`record` returns whether THAT sample met the SLO and bumps
+    ``violations`` when it did not; :meth:`report` folds the samples
+    into the dict the bench ``freshness`` phase and the online drill
+    assert on.
+    """
+
+    def __init__(self, slo_ms=None):
+        if slo_ms is None:
+            from ..config import get_env
+
+            slo_ms = get_env("MXNET_FRESHNESS_SLO_MS")
+        self.slo_ms = float(slo_ms)
+        self._samples = []  # (version, freshness_ms, fault_free)
+        self.violations = 0
+
+    def record(self, version, freshness_ms, fault_free=True):
+        """Record one committed swap; returns True when within SLO."""
+        ms = float(freshness_ms)
+        self._samples.append((int(version), ms, bool(fault_free)))
+        ok = ms <= self.slo_ms
+        if not ok:
+            self.violations += 1
+        return ok
+
+    def __len__(self):
+        return len(self._samples)
+
+    @property
+    def versions(self):
+        return [v for v, _, _ in self._samples]
+
+    @property
+    def monotonic(self):
+        """Served versions never went backwards (the one-identity /
+        no-regression contract, as seen from the commit stream)."""
+        vs = self.versions
+        return all(b >= a for a, b in zip(vs, vs[1:]))
+
+    @staticmethod
+    def _stats(vals):
+        from ..telemetry.opstats import percentile
+
+        s = sorted(vals)
+        return {"count": len(s),
+                "p50_ms": round(percentile(s, 0.50), 3),
+                "p99_ms": round(percentile(s, 0.99), 3)}
+
+    def report(self):
+        all_ms = [ms for _, ms, _ in self._samples]
+        clean = [ms for _, ms, ff in self._samples if ff]
+        clean_stats = self._stats(clean)
+        # vacuously met with zero clean samples: an all-tainted run has
+        # no steady state to judge (the drill separately requires >=1)
+        clean_stats["within_slo"] = (not clean or
+                                     clean_stats["p99_ms"] <= self.slo_ms)
+        return {"slo_ms": self.slo_ms,
+                "violations": int(self.violations),
+                "monotonic": self.monotonic,
+                "versions": self.versions,
+                "all": self._stats(all_ms),
+                "fault_free": clean_stats}

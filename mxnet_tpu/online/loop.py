@@ -1,0 +1,560 @@
+"""Online learning loop: continuously-updating trainer -> export ->
+rolling-swap, supervised for fault-proof freshness.
+
+Two halves, one file:
+
+* :class:`OnlineTrainer` — the WORKER half.  Runs in its own process
+  (``python -m mxnet_tpu.online.loop --dir D ...``) so the supervisor
+  can heal a SIGKILL or faultsim crash without dying itself.  It
+  consumes a deterministic replay/live stream through the data plane
+  (:class:`~mxnet_tpu.io.DeviceFeedIter` double-buffering), trains a
+  gluon net step by step, and every ``MXNET_ONLINE_EXPORT_STEPS``
+  steps (a) checkpoints params + stream cursor through
+  :class:`~mxnet_tpu.resilience.checkpoint.CheckpointManager`, (b)
+  exports a v2 ``.mxje`` artifact stamped (``extra_meta``) with the
+  monotonic ``model_version`` and the ``stream_cursor`` /
+  ``t_newest_sample`` it was trained through, and (c) publishes an
+  atomic per-version JSON manifest the supervisor watches.  The
+  ordering is load-bearing: **checkpoint first, artifact second,
+  manifest last** — a death at any point leaves either nothing or a
+  resumable prefix, and a version number can never be re-issued for
+  different params (``allocate_version`` scans the checkpoint dir).
+
+* :class:`OnlineLoop` — the SUPERVISOR half.  Spawns/relaunches the
+  trainer (healable exits: signals, peer-death 83, faultsim 87 —
+  the :mod:`~mxnet_tpu.resilience.healing` convention, with
+  ``MXNET_HEAL_ATTEMPT`` exported and the fault spec scrubbed on
+  relaunch), watches the publish dir, and rolling-swaps each new
+  version into a :class:`~mxnet_tpu.serving.FleetRouter` fleet with
+  zero downtime.  When the trainer outruns the swap pipeline the
+  supervisor swaps only the NEWEST pending version and **sheds** the
+  older ones loudly (``online_swaps_shed`` counter + ``swap_shed``
+  freshness records) — freshness is about serving the newest model,
+  not about serving every model.  Every committed swap records one
+  sample-to-served freshness measurement
+  (:class:`~mxnet_tpu.online.freshness.FreshnessTracker`); the first
+  commit after a relaunch is marked fault-tainted so the SLO gate
+  judges steady-state windows.
+
+Robustness contract (drilled in ``tests/test_online.py`` and the
+``trainer_death_midstream`` / ``swap_rollback`` chaos scenarios):
+
+* trainer death mid-stream is healed via the cursor-bearing
+  checkpoint; the resumed run replays the exact remaining samples
+  (the stream is a pure function of ``(seed, cursor)``) so the final
+  params are bit-identical to an uninterrupted run, and swaps never
+  stall while the trainer is down;
+* a failed swap rolls back (``FleetRouter.rolling_swap``) leaving
+  every host on ONE version, and the router's ``model_version`` stamp
+  check refuses any swap that would regress below the last committed
+  version;
+* sample-to-served freshness is tracked per commit and p99-gated in
+  ``tools/benchdiff.py`` (``freshness`` bench phase).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..resilience import faultsim
+
+__all__ = ["OnlineTrainer", "OnlineLoop", "stream_batch"]
+
+faultsim.register_point(
+    "online.step", "one online-trainer step (crash = trainer death "
+    "mid-stream, healed by the OnlineLoop supervisor)")
+faultsim.register_point(
+    "online.publish", "the atomic publish-manifest write (crash = "
+    "death between artifact and manifest: the version stays invisible "
+    "and is never half-served)")
+
+
+# ------------------------------------------------------------ the stream
+def stream_batch(seed, cursor, batch, features):
+    """Batch ``cursor`` of the replay stream: a PURE function of
+    ``(seed, cursor)`` — that determinism IS the sample-exact resume
+    contract (replaying from a checkpointed cursor reproduces the
+    exact remaining samples; no buffered tail to lose).  A linear
+    teacher keyed by ``seed`` makes the loss trajectory meaningful."""
+    rng = onp.random.RandomState((seed * 1000003 + cursor) % (2**31 - 1))
+    x = rng.uniform(-1.0, 1.0, size=(batch, features)).astype("float32")
+    w = onp.random.RandomState(seed).uniform(
+        -1.0, 1.0, size=(features, 1)).astype("float32")
+    y = x @ w
+    return x, y
+
+
+def _stream(seed, start, batch, features):
+    cursor = int(start)
+    while True:
+        x, y = stream_batch(seed, cursor, batch, features)
+        yield [x, y]
+        cursor += 1
+
+
+# -------------------------------------------------------------- trainer
+class OnlineTrainer:
+    """Worker half of the online loop (see module docstring).
+
+    ``run()`` trains ``steps`` total steps — *total*, not additional:
+    a relaunch resumes from the newest checkpoint's cursor and trains
+    only the remainder.  ``pace_s`` stretches the loop so drills can
+    land kills/swaps between export boundaries.
+    """
+
+    def __init__(self, workdir, *, steps=60, export_every=None, seed=7,
+                 batch=8, features=4, lr=0.05, pace_s=0.0,
+                 device_feed=True, keep_n=None):
+        from ..config import get_env
+
+        self.workdir = os.fspath(workdir)
+        self.steps = int(steps)
+        self.export_every = int(get_env("MXNET_ONLINE_EXPORT_STEPS")
+                                if export_every is None else export_every)
+        if self.export_every <= 0:
+            raise MXNetError("export_every must be >= 1")
+        self.seed = int(seed)
+        self.batch = int(batch)
+        self.features = int(features)
+        self.lr = float(lr)
+        self.pace_s = float(pace_s)
+        self.device_feed = bool(device_feed)
+        self.publish_dir = os.path.join(self.workdir, "publish")
+        os.makedirs(self.publish_dir, exist_ok=True)
+        ckpt_dir = os.path.join(self.workdir, "ckpt")
+        os.makedirs(ckpt_dir, exist_ok=True)
+        from ..resilience.checkpoint import CheckpointManager
+
+        self.ckpt = CheckpointManager(os.path.join(ckpt_dir, "online"),
+                                      keep_n=keep_n)
+        self.pidfile = os.path.join(self.workdir, "trainer.pid")
+        self.final_path = os.path.join(self.workdir, "final.json")
+
+    # ------------------------------------------------------------- net
+    def _build(self):
+        import mxnet_tpu as mx
+        from .. import gluon, nd
+
+        mx.random.seed(self.seed)
+        net = gluon.nn.Dense(1, in_units=self.features,
+                             prefix="online_dense_")
+        net.initialize(init=mx.init.Xavier())
+        net(nd.zeros((1, self.features)))  # resolve shapes
+        # explicit seeded init: run-to-run identity (and therefore the
+        # sample-exact-resume comparison) must not depend on any
+        # process-global RNG stream another subsystem may have advanced
+        rng = onp.random.RandomState(self.seed + 1)
+        net.weight.set_data(nd.array(rng.uniform(
+            -0.5, 0.5, size=(1, self.features)).astype("float32")))
+        net.bias.set_data(nd.zeros((1,)))
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": self.lr})
+        return net, trainer
+
+    @staticmethod
+    def _canon(name):
+        """Canonical param name: strip the gluon block-scope prefix
+        (``dense0_weight`` -> ``weight``) so checkpoints and final
+        params compare across processes regardless of how many blocks
+        the process happened to name before ours."""
+        return name.split("_", 1)[1] if "_" in name else name
+
+    def _params(self, net):
+        return {self._canon(k): p.data()
+                for k, p in net.collect_params().items()}
+
+    def _resume(self, net):
+        """Restore params + cursor from the newest good checkpoint.
+        Returns the step already completed (0 = fresh start)."""
+        if self.ckpt.latest_epoch() is None:
+            return 0
+        state = self.ckpt.load()
+        arg = state["arg_params"]
+        for k, p in net.collect_params().items():
+            ck = self._canon(k)
+            if ck in arg:
+                p.set_data(arg[ck])
+        return int(state["step"] or 0)
+
+    # ---------------------------------------------------------- export
+    def _export(self, net, step, cursor, t_newest):
+        """checkpoint -> artifact -> manifest, in that order (see
+        module docstring for why the order is load-bearing)."""
+        from .. import deploy, nd
+        from ..resilience.checkpoint import atomic_write_bytes
+
+        v = self.ckpt.allocate_version()
+        extra = {"stream_cursor": int(cursor),
+                 "t_newest_sample": float(t_newest),
+                 "model_version": int(v)}
+        self.ckpt.save(v, arg_params=self._params(net), step=int(step),
+                       batch_cursor=int(cursor), extra=extra)
+        path = os.path.join(self.publish_dir, f"model-v{v:04d}.mxje")
+        deploy.export_model(net, nd.zeros((self.batch, self.features)),
+                            path, platforms=("cpu",), extra_meta=extra)
+        man = dict(extra, path=path, step=int(step),
+                   t_published=time.time())
+        atomic_write_bytes(
+            os.path.join(self.publish_dir, f"v{v:04d}.json"),
+            (json.dumps(man, sort_keys=True) + "\n").encode(),
+            inject_point="online.publish")
+        return v
+
+    # ------------------------------------------------------------- run
+    def run(self):
+        """Train to ``steps``, exporting every ``export_every`` steps
+        and at the end; returns ``{step, cursor, versions, params}``
+        (also written atomically to ``final.json`` for cross-process
+        sample-exactness checks)."""
+        from .. import autograd, gluon
+        from ..resilience.checkpoint import atomic_write_bytes
+
+        with open(self.pidfile, "w") as f:
+            f.write(str(os.getpid()))
+        net, trainer = self._build()
+        done = self._resume(net)
+        loss_fn = gluon.loss.L2Loss()
+        src = _stream(self.seed, done, self.batch, self.features)
+        if self.device_feed:
+            from ..io.device_feed import DeviceFeedIter
+
+            src = DeviceFeedIter(src, depth=2)
+        it = iter(src)
+        versions = []
+        cursor, t_newest = done, time.time()
+        for step in range(done + 1, self.steps + 1):
+            faultsim.inject("online.step")
+            xb, yb = next(it)
+            t_newest = time.time()
+            with autograd.record():
+                loss = loss_fn(net(xb), yb)
+            loss.backward()
+            trainer.step(self.batch)
+            cursor = step
+            if step % self.export_every == 0 or step == self.steps:
+                versions.append(
+                    self._export(net, step, cursor, t_newest))
+            if self.pace_s:
+                time.sleep(self.pace_s)
+        final = {"step": int(cursor), "cursor": int(cursor),
+                 "versions": [int(v) for v in versions],
+                 "attempt": int(os.environ.get("MXNET_HEAL_ATTEMPT",
+                                               "0")),
+                 "params": {k: onp.asarray(v.asnumpy(),
+                                           dtype="float64").ravel()
+                            .tolist()
+                            for k, v in self._params(net).items()}}
+        atomic_write_bytes(self.final_path,
+                           (json.dumps(final, sort_keys=True)
+                            + "\n").encode(), inject_point=None)
+        return final
+
+
+# ----------------------------------------------------------- supervisor
+def _healable(rc):
+    """The healing convention: signals (negative), peer-death 83,
+    faultsim crash 87."""
+    from ..resilience import healing
+
+    return (rc < 0 or rc == healing.PEER_DEATH_EXIT_CODE
+            or rc == faultsim.CRASH_EXIT_CODE)
+
+
+class OnlineLoop:
+    """Supervisor half of the online loop (see module docstring).
+
+    ``run()`` blocks until the trainer finishes and every published
+    version is swapped or shed, then returns the report dict.  Live
+    progress is visible on the instance (``served_versions``,
+    ``relaunches``, ``shed``, ``proc``) so drills can act mid-run —
+    e.g. SIGKILL the trainer after the first committed swap.
+    """
+
+    def __init__(self, workdir, router, *, model=None, steps=60,
+                 export_every=None, seed=7, batch=8, features=4,
+                 lr=0.05, pace_s=0.0, slo_ms=None, max_relaunch=3,
+                 probe_timeout=120.0, poll_s=0.05, worker_env=None):
+        from .freshness import FreshnessTracker
+
+        self.workdir = os.fspath(workdir)
+        os.makedirs(self.workdir, exist_ok=True)
+        self.router = router
+        self.model = model
+        self.steps = int(steps)
+        self.export_every = export_every
+        self.seed = int(seed)
+        self.batch = int(batch)
+        self.features = int(features)
+        self.lr = float(lr)
+        self.pace_s = float(pace_s)
+        self.max_relaunch = int(max_relaunch)
+        self.probe_timeout = float(probe_timeout)
+        self.poll_s = float(poll_s)
+        self.worker_env = dict(worker_env or {})
+        self.publish_dir = os.path.join(self.workdir, "publish")
+        self.pidfile = os.path.join(self.workdir, "trainer.pid")
+        self.tracker = FreshnessTracker(slo_ms)
+        self.served_versions = []
+        self.shed = []
+        self.rollbacks = 0
+        self.last_rollback = None
+        self.relaunches = 0
+        self.proc = None
+        self._seen = set()
+        self._tainted = False  # next commit carries healing latency
+        self._retry = None     # (version, manifest, tries) after rollback
+        self._retry_after = 0.0
+        self.max_swap_retries = 5
+
+    # ---------------------------------------------------------- worker
+    def _worker_cmd(self):
+        cmd = [sys.executable, "-m", "mxnet_tpu.online.loop",
+               "--dir", self.workdir, "--steps", str(self.steps),
+               "--seed", str(self.seed), "--batch", str(self.batch),
+               "--features", str(self.features), "--lr", str(self.lr),
+               "--pace-s", str(self.pace_s)]
+        if self.export_every is not None:
+            cmd += ["--export-every", str(self.export_every)]
+        return cmd
+
+    def _spawn(self, attempt):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in [os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+                env.get("PYTHONPATH")] if p)
+        # the supervisor's own telemetry sink must not be shared with
+        # the child (one-run-per-file contract)
+        env.pop("MXNET_RUNLOG", None)
+        env.update(self.worker_env)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["MXNET_HEAL_ATTEMPT"] = str(attempt)
+        if attempt:
+            # the chaos convention: an armed one-shot fault must not
+            # re-fire on the healed attempt
+            env.pop("MXNET_FAULT_SPEC", None)
+        # the worker's stdout (its final-state JSON line) must not
+        # interleave with the supervisor's own stdout contract (bench
+        # emits ONE JSON line); keep it per-attempt for post-mortems
+        log = open(os.path.join(self.workdir,
+                                f"trainer.a{attempt}.log"), "wb")
+        try:
+            self.proc = subprocess.Popen(self._worker_cmd(), env=env,
+                                         stdout=log,
+                                         stderr=subprocess.STDOUT)
+        finally:
+            log.close()  # the child holds its own fd
+        return self.proc
+
+    # --------------------------------------------------------- publish
+    def _pending(self):
+        """New publish manifests, version-sorted: ``[(v, man), ...]``.
+        A manifest is atomic (written last by the trainer), so seeing
+        it means artifact + checkpoint are durable."""
+        out = []
+        try:
+            names = os.listdir(self.publish_dir)
+        except OSError:
+            return out
+        for name in sorted(names):
+            if not (name.startswith("v") and name.endswith(".json")):
+                continue
+            try:
+                v = int(name[1:-5])
+            except ValueError:
+                continue
+            if v in self._seen:
+                continue
+            try:
+                with open(os.path.join(self.publish_dir, name)) as f:
+                    man = json.load(f)
+            except (OSError, ValueError):
+                continue  # racing a writer that is not atomic-renamed
+            out.append((v, man))
+        return out
+
+    # ------------------------------------------------------------ swap
+    def _swap(self, version, man):
+        """Returns ``"committed"``, ``"rollback"`` (retryable) or
+        ``"refused"`` (version-regression guard; shed, not retried)."""
+        from .. import telemetry
+
+        try:
+            res = self.router.rolling_swap(
+                man["path"], model=self.model,
+                probe_timeout=self.probe_timeout)
+        except MXNetError:
+            # the router's no-regression guard refused it — shed, loud
+            self._shed(version, reason="refused")
+            return "refused"
+        if not res.get("committed"):
+            self.rollbacks += 1
+            self.last_rollback = dict(res, version=int(version))
+            telemetry.freshness("swap_rollback", version=version,
+                                errors=res.get("errors"))
+            return "rollback"
+        t_commit = time.time()
+        ms = max(0.0,
+                 (t_commit - float(man["t_newest_sample"])) * 1000.0)
+        fault_free = not self._tainted
+        ok = self.tracker.record(version, ms, fault_free=fault_free)
+        self._tainted = False
+        self.served_versions.append(int(version))
+        telemetry.count("online_swaps")
+        telemetry.freshness("swap_commit", version=version,
+                            freshness_ms=ms,
+                            stream_cursor=man.get("stream_cursor"),
+                            fault_free=fault_free)
+        if not ok:
+            telemetry.count("freshness_violations")
+            telemetry.freshness("violation", version=version,
+                                freshness_ms=ms)
+        return "committed"
+
+    def _shed(self, version, reason="superseded"):
+        from .. import telemetry
+
+        self.shed.append(int(version))
+        telemetry.count("online_swaps_shed")
+        telemetry.freshness("swap_shed", version=version, reason=reason)
+
+    def _drain_publishes(self):
+        """Swap the newest pending version; shed the rest (freshness
+        wants the newest model serving, not every model served).  A
+        rolled-back swap is RETRIED (bounded, paced) until it commits
+        or a newer version supersedes it — swaps must not stall on a
+        transient probe failure, and must not spin on a permanent
+        one."""
+        from .. import telemetry
+
+        pending = self._pending()
+        for v, _ in pending:
+            self._seen.add(v)
+            telemetry.count("online_exports")
+            telemetry.freshness("publish", version=v)
+        tries = 0
+        if pending:
+            newest_v, newest_man = pending[-1]
+            for v, _ in pending[:-1]:
+                self._shed(v)
+            if self._retry is not None:
+                self._shed(self._retry[0], reason="superseded")
+            self._retry = None
+        elif self._retry is not None:
+            if time.monotonic() < self._retry_after:
+                return
+            newest_v, newest_man, tries = self._retry
+            self._retry = None
+        else:
+            return
+        if self._swap(newest_v, newest_man) == "rollback":
+            if tries + 1 >= self.max_swap_retries:
+                self._shed(newest_v, reason="rollback_budget")
+            else:
+                self._retry = (newest_v, newest_man, tries + 1)
+                self._retry_after = time.monotonic() + 0.25
+
+    @property
+    def _swap_backlog(self):
+        return self._retry is not None
+
+    # ------------------------------------------------------------- run
+    def run(self, timeout=600.0):
+        from .. import telemetry
+
+        deadline = time.monotonic() + float(timeout)
+        self._spawn(0)
+        worker_rc = None
+        while True:
+            if time.monotonic() > deadline:
+                self.proc.kill()
+                raise MXNetError(
+                    f"online loop timed out after {timeout}s "
+                    f"(served={self.served_versions})")
+            self._drain_publishes()
+            rc = self.proc.poll()
+            if rc is not None:
+                if rc == 0:
+                    worker_rc = 0
+                    break
+                if (_healable(rc)
+                        and self.relaunches < self.max_relaunch):
+                    self.relaunches += 1
+                    self._tainted = True
+                    telemetry.count("online_relaunches")
+                    telemetry.freshness("relaunch", rc=rc,
+                                        attempt=self.relaunches)
+                    self._spawn(self.relaunches)
+                else:
+                    raise MXNetError(
+                        f"online trainer died rc={rc} "
+                        f"(relaunches={self.relaunches}/"
+                        f"{self.max_relaunch}) — not healable")
+            time.sleep(self.poll_s)
+        # the final exports land after the worker exits; keep draining
+        # until nothing is pending and no rolled-back swap awaits retry
+        while True:
+            self._drain_publishes()
+            if not self._pending() and not self._swap_backlog:
+                break
+            if time.monotonic() > deadline:
+                raise MXNetError(
+                    f"online loop timed out draining publishes "
+                    f"(served={self.served_versions})")
+            time.sleep(self.poll_s)
+        return self.report(worker_rc)
+
+    def report(self, worker_rc=None):
+        return {"steps": self.steps,
+                "worker_rc": worker_rc,
+                "relaunches": int(self.relaunches),
+                "exports_seen": len(self._seen),
+                "swaps": len(self.served_versions),
+                "served_versions": list(self.served_versions),
+                "swaps_shed": len(self.shed),
+                "shed_versions": list(self.shed),
+                "swap_rollbacks": int(self.rollbacks),
+                "monotonic": all(
+                    b >= a for a, b in zip(self.served_versions,
+                                           self.served_versions[1:])),
+                "freshness": self.tracker.report()}
+
+
+# -------------------------------------------------------------- worker CLI
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="online-trainer worker (spawned by OnlineLoop)")
+    ap.add_argument("--dir", required=True)
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--export-every", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--features", type=int, default=4)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--pace-s", type=float, default=0.0)
+    args = ap.parse_args(argv)
+    attempt = int(os.environ.get("MXNET_HEAL_ATTEMPT", "0"))
+    if attempt:
+        os.environ.pop("MXNET_FAULT_SPEC", None)
+        faultsim.reset("")
+    trainer = OnlineTrainer(
+        args.dir, steps=args.steps, export_every=args.export_every,
+        seed=args.seed, batch=args.batch, features=args.features,
+        lr=args.lr, pace_s=args.pace_s)
+    final = trainer.run()
+    print(json.dumps({"final_step": final["step"],
+                      "versions": final["versions"],
+                      "attempt": attempt}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

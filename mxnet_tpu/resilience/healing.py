@@ -708,7 +708,16 @@ def supervise(cmd, max_relaunch=None, env=None, healable=None):
                     pass
             return rc
         attempt += 1
-        faultsim.inject("heal.relaunch")
+        try:
+            faultsim.inject("heal.relaunch")
+        except MXNetError:
+            # the inherited spec names a point only the CHILD's
+            # subsystem registers (e.g. online.step): it is aimed at
+            # the child, which validates the full spec at its own arm
+            # time — a typo still fails loudly where the point lives.
+            # FaultInjected is not an MXNetError, so an armed
+            # heal.relaunch:raise fault still propagates.
+            pass
         try:
             from .. import telemetry
 

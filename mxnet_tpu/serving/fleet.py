@@ -64,7 +64,8 @@ from .frontend import ServeFrontend, http_call
 from .server import ModelServer, ServeRejected
 
 __all__ = ["ModelHost", "FleetRouter", "SwapRolledBack",
-           "artifact_reserved_bytes", "replica_main"]
+           "GenerativeHostServer", "artifact_reserved_bytes",
+           "replica_main"]
 
 
 class SwapRolledBack(MXNetError):
@@ -135,6 +136,103 @@ def artifact_reserved_bytes(path):
             int(onp.prod([int(s) for s in a.shape]) or 1)
             * onp.dtype(a.dtype).itemsize for a in avals)
     return int(reserved), exp
+
+
+class GenerativeHostServer:
+    """The ModelServer-shaped adapter a :class:`ModelHost` wraps
+    around a *generative* ``.mxje`` artifact (round 18 — PR 17's
+    fleet-swap leftover): builds a
+    :class:`~mxnet_tpu.serving.generate.GenerativeServer` from the
+    artifact's param payload + ``gen`` header config and exposes the
+    submit / health / drain / close surface the host, the HTTP
+    frontend and the rolling swap drive.
+
+    Requests are rows of token ids (the swap's zeros warm probe is a
+    legal all-``<token 0>`` prompt of the smallest bucket); results
+    are generated token lists.  A swap cuts the routing pointer
+    between SEQUENCES and drains this server: in-flight decode
+    sequences finish on the old version — never a mid-sequence
+    version change — and any sequence outliving the drain budget is
+    finished with the structured shutdown rejection at close
+    (evict-and-resubmit on the new version is the caller's move);
+    both counts are reported on the swap event.
+    """
+
+    #: host/server kwargs that map onto the GenerativeServer (the
+    #: dense-server knobs like coalesce_ms are dropped, not errors:
+    #: one replica process serves both artifact classes)
+    _GEN_KW = ("slots", "page_tokens", "pool_budget", "kv_dtype",
+               "agreement_floor", "slo_ms", "queue_depth",
+               "breaker_limit", "evict_after_ms", "eos_id", "max_new",
+               "kv_gate")
+
+    generative = True
+
+    def __init__(self, path, name="model", **kw):
+        from .. import deploy
+        from .generate import GenerativeServer
+
+        params, gen = deploy.load_generative(path)
+        # the npz payload deserializes to numpy; the decode programs
+        # index the embed table with traced token ids, so params must
+        # live as device arrays
+        import jax
+
+        params = jax.tree_util.tree_map(jax.numpy.asarray, params)
+        srv_kw = {k: v for k, v in kw.items() if k in self._GEN_KW}
+        buckets = tuple(int(b) for b in
+                        (gen.get("prompt_buckets") or (4, 8, 16)))
+        max_new = int(srv_kw.pop("max_new", gen.get("max_new", 16)))
+        self._srv = GenerativeServer(
+            params=params, vocab=int(gen["vocab"]),
+            layers=int(gen["layers"]), heads=int(gen["heads"]),
+            head_dim=int(gen["head_dim"]), prompt_buckets=buckets,
+            max_new=max_new, name=name, **srv_kw)
+        self.name = name
+        #: warm-probe signature (ModelHost.swap probes
+        #: ``zeros(item_shape, dtype)``)
+        self.item_shape = (buckets[0],)
+        self.dtype = onp.int32
+        self._suppress_health_gauges = True
+
+    def start(self, warm=True):
+        self._srv.start(warm=warm)
+        return self
+
+    def submit(self, x, deadline_ms=None):
+        toks = [int(t) for t in onp.asarray(x).reshape(-1)]
+        return self._srv.submit(toks, deadline_ms=deadline_ms)
+
+    def in_flight(self):
+        return self._srv.in_flight()
+
+    def report(self):
+        return self._srv.report()
+
+    @property
+    def stats(self):
+        st = {k: (dict(v) if isinstance(v, dict) else v)
+              for k, v in self._srv.stats.items()}
+        # the host's metrics aggregation reads the dense counter
+        # names; a generative "batch" is one prefill dispatch
+        st.setdefault("batches", st.get("prefills", 0))
+        return st
+
+    def health(self):
+        s = self._srv
+        with s._lock:
+            live = bool(s._started and not s._stop)
+            ready = bool(live and not s._draining
+                         and not s._breaker_open)
+            return {"ready": ready, "live": live,
+                    "queue_depth": len(s._queue),
+                    "inflight": s.in_flight()}
+
+    def drain(self, timeout=30.0):
+        return self._srv.drain(timeout=timeout)
+
+    def close(self):
+        self._srv.close()
 
 
 class ModelHost:
@@ -220,16 +318,42 @@ class ModelHost:
                 f"{self.budget_bytes}-byte host budget")
         self._pending[name] = reserved
 
+    def _size_artifact(self, path, info):
+        """Reserved-bytes sizing for admission: the exported-program
+        memory analysis for a dense artifact, the summed param bytes
+        for a generative one (its programs only build at start).
+        With the budget unlimited (the default) the sizing read gates
+        nothing — skipped entirely, admit at 0 bytes."""
+        if not self.budget_bytes:
+            return 0, None
+        if (info or {}).get("generative"):
+            from .. import deploy
+
+            params, _ = deploy.load_generative(path)
+            flat = deploy._flatten_params(params)
+            return sum(int(onp.asarray(a).nbytes)
+                       for a in flat.values()), None
+        return artifact_reserved_bytes(path)
+
+    def _make_server(self, name, path, info, exp, kw):
+        """Construct (not started) the server class the artifact's
+        header identity asks for — a GenerativeServer adapter for a
+        ``"generative": true`` export, the dense ModelServer
+        otherwise.  One replica process serves both classes."""
+        if (info or {}).get("generative"):
+            return GenerativeHostServer(path, name=name,
+                                        **{**self._server_kw, **kw})
+        return ModelServer.from_artifact(
+            path, exported=exp, name=name,
+            **{**self._server_kw, **kw})
+
     def load(self, name, path, **kw):
         """Admit + start one artifact (budget-gated); returns the live
         server.  The admission read doubles as the warm handle: the
         server below re-verifies the CRC on its own load, so a torn
         artifact fails HERE, before anything is evicted or started."""
-        # the sizing pass jit-compiles the exported call purely for
-        # memory stats: with the budget unlimited (the default) that
-        # compile would gate nothing — skip it and admit at 0 bytes
-        reserved, exp = artifact_reserved_bytes(path) \
-            if self.budget_bytes else (0, None)
+        info = _artifact_identity(path)
+        reserved, exp = self._size_artifact(path, info)
         with self._lock:
             # name-claim + budget reservation in ONE lock scope: two
             # concurrent loads of the same name (or two models racing
@@ -239,16 +363,13 @@ class ModelHost:
                                  "(use swap for an upgrade)")
             self._admit_locked(name, reserved)
         try:
-            srv = ModelServer.from_artifact(
-                path, exported=exp, name=name,
-                **{**self._server_kw, **kw})
+            srv = self._make_server(name, path, info, exp, kw)
             srv._suppress_health_gauges = True  # the host aggregates
             srv.start(warm=True)
         except BaseException:
             with self._lock:
                 self._pending.pop(name, None)
             raise
-        info = _artifact_identity(path)
         with self._lock:
             self._pending.pop(name, None)
             self._models[name] = srv
@@ -328,13 +449,13 @@ class ModelHost:
             # resurrected by the cutover below
             self._pending[name] = 0
             kw = dict(self._load_kw.get(name, {}))
+        info = _artifact_identity(path)
         new = None
         try:
             # unlimited budget skips the sizing compile — it would sit
             # on the critical path of exactly the swap latency this
             # feature exists to minimize, gating nothing
-            reserved, exp = artifact_reserved_bytes(path) \
-                if self.budget_bytes else (0, None)
+            reserved, exp = self._size_artifact(path, info)
             with self._lock:
                 # exclude=name: the swapped model's old and new
                 # programs briefly co-reside by design (module
@@ -345,9 +466,7 @@ class ModelHost:
             # per-model load() overrides (slo_ms, queue bounds, ...)
             # survive the upgrade — a swap changes the ARTIFACT, not
             # the model's admission contract
-            new = ModelServer.from_artifact(
-                path, exported=exp, name=name,
-                **{**self._server_kw, **kw})
+            new = self._make_server(name, path, info, exp, kw)
             new._suppress_health_gauges = True  # the host aggregates
             new.start(warm=True)
             probe = onp.zeros(new.item_shape, new.dtype)
@@ -383,7 +502,6 @@ class ModelHost:
         # cutover between batches: new submits route to the new
         # server the moment the pointer moves; the old server's
         # in-flight batches finish in its drain
-        info = _artifact_identity(path)
         with self._lock:
             self._pending.pop(name, None)
             self._models[name] = new
@@ -391,7 +509,19 @@ class ModelHost:
             self._paths[name] = str(path)
             self._info[name] = info
             self.stats["swaps"] += 1
-        old.drain(timeout=30.0)
+        gen_extra = {}
+        if getattr(old, "generative", False):
+            # the satellite-2 contract: in-flight decode sequences at
+            # cutover ride out on the OLD version (no mid-sequence
+            # version change); whether they all finished inside the
+            # drain budget is REPORTED, never assumed — stragglers
+            # are finished with the structured shutdown rejection at
+            # close and may re-prefill on the new version
+            gen_extra["gen_inflight_at_cutover"] = old.in_flight()
+        drained = old.drain(timeout=30.0)
+        if gen_extra:
+            gen_extra["gen_drained"] = bool(drained)
+            gen_extra["gen_inflight_at_close"] = old.in_flight()
         old.close()
         swap_ms = (time.perf_counter() - t0) * 1e3
         try:
@@ -402,7 +532,7 @@ class ModelHost:
             pass
         ModelServer._telemetry_event(
             "fleet_swap", model=name, path=str(path),
-            swap_ms=round(swap_ms, 3), reserved=reserved)
+            swap_ms=round(swap_ms, 3), reserved=reserved, **gen_extra)
         return swap_ms
 
     # -------------------------------------------------------- health
@@ -550,9 +680,15 @@ class FleetRouter:
         self._probe_n = 0
         self._last_scale = 0.0
         self.queue_ewma = 0.0
+        #: last artifact the WHOLE fleet committed to (rollback
+        #: target of a refused rolling swap) and its header
+        #: model_version (the freshness-monotonicity floor) — None
+        #: until a spawn/swap stamps them
+        self._prev_artifact = None
+        self._committed_version = None
         self.stats = {"requests": 0, "completed": 0, "shed": 0,
                       "failovers": 0, "ejected": 0, "resizes": 0,
-                      "swaps": 0}
+                      "swaps": 0, "swap_rollbacks": 0}
         for addr, port in endpoints:
             self._replicas.append(_Replica(self._next_idx, addr=addr,
                                            port=int(port)))
@@ -593,6 +729,10 @@ class FleetRouter:
             "coalesce_ms": float(coalesce_ms),
             "drain_timeout": float(drain_timeout),
         }
+        router._prev_artifact = str(artifact)
+        v = (_artifact_identity(artifact) or {}).get("model_version")
+        if v is not None:
+            router._committed_version = int(v)
         try:
             for _ in range(n):
                 router._spawn_replica()
@@ -1094,17 +1234,63 @@ class FleetRouter:
         return rep
 
     # --------------------------------------------------- rolling swap
+    def _served_identity(self, rep, model=None, timeout=5.0):
+        """One replica's served artifact path (via ``/v1/models``) —
+        what the post-swap consistency assertion compares across the
+        fleet.  None when the replica cannot answer."""
+        try:
+            status, body = http_call(rep.addr, rep.port, "GET",
+                                     "/v1/models", timeout=timeout)
+        except Exception:
+            return None
+        if status != 200 or not isinstance(body, dict):
+            return None
+        models = body.get("models") or {}
+        if model is None and len(models) == 1:
+            entry = next(iter(models.values()))
+        else:
+            entry = models.get(model or "model")
+        return entry.get("path") if isinstance(entry, dict) else None
+
     def rolling_swap(self, path, model=None, probe_timeout=120.0):
         """Upgrade the whole fleet to the artifact at ``path`` one
         replica at a time — each replica loads the new program beside
         the live one, warm-probes it, and cuts over between batches
-        while its siblings keep serving.  A replica that fails its
-        swap (rollback, or a mid-swap death the ``fleet.swap`` fault
-        injects) is reported in ``errors`` — the rest of the fleet
-        still upgrades; a dead one is ejected by the probe loop and
-        its traffic flows to siblings."""
+        while its siblings keep serving.
+
+        Commit/rollback protocol (round 18): a replica that REFUSES
+        its swap while alive (bad artifact / failed warm probe — the
+        frontend's non-200 answer) aborts the rollout and rolls the
+        already-swapped replicas BACK to the previous artifact, so a
+        partial failure can never leave the fleet straddling two
+        versions.  A replica that dies mid-swap (connection-level
+        failure) is ejected and the rollout continues — its siblings
+        upgrade and its traffic fails over, exactly as before.  When
+        the new artifact's header carries a ``model_version``, a swap
+        below the last fully-committed version is refused outright
+        (freshness monotonicity).  The result reports per-replica
+        timings/errors plus ``committed`` / ``rolled_back`` and the
+        post-rollout ``identities`` consistency check (every live
+        replica must answer with ONE artifact path)."""
         t0 = time.perf_counter()
+        version = (_artifact_identity(path) or {}).get("model_version")
+        with self._lock:
+            committed_version = self._committed_version
+            prev_path = self._prev_artifact
+        if version is not None and committed_version is not None \
+                and int(version) < int(committed_version):
+            self._telemetry_event(
+                "fleet_swap_refused", path=str(path),
+                version=int(version),
+                committed_version=int(committed_version),
+                reason="version_regression")
+            raise MXNetError(
+                f"rolling swap to {path!r} (model_version {version}) "
+                f"would regress the fleet below the last committed "
+                f"version {committed_version} — refused")
         per, errors = {}, {}
+        rolled_back = []
+        abort = False
         with self._lock:
             # future spawns (autoscale, resize) must serve the NEW
             # artifact — the rolling swap changes the fleet's desired
@@ -1139,15 +1325,77 @@ class FleetRouter:
             if status == 200:
                 per[rep.idx] = body["swap_ms"]
             else:
+                # the replica is ALIVE and refused: the artifact is
+                # bad for every sibling too — abort the rollout and
+                # roll the swapped prefix back to one version
                 errors[rep.idx] = f"{status}: {body}"
+                abort = True
+                break
+        if abort:
+            with self._lock:
+                if self._spawn_spec is not None and prev_path:
+                    self._spawn_spec["artifact"] = str(prev_path)
+                self.stats["swap_rollbacks"] += 1
+            self._telemetry_count("fleet_swap_rollbacks")
+            for rep in targets:
+                if rep.idx not in per or not prev_path:
+                    continue
+                try:
+                    status, body = http_call(
+                        rep.addr, rep.port, "POST", "/admin/swap",
+                        body={"model": model, "path": str(prev_path)},
+                        timeout=probe_timeout)
+                except Exception as exc:
+                    errors[rep.idx] = f"rollback failed: {exc!r}"
+                    continue
+                if status == 200:
+                    rolled_back.append(rep.idx)
+                    del per[rep.idx]
+                else:
+                    errors[rep.idx] = (f"rollback failed: {status}: "
+                                       f"{body}")
+            self._telemetry_event(
+                "fleet_rolling_swap_rollback", path=str(path),
+                prev=str(prev_path), rolled_back=sorted(rolled_back),
+                errors=errors)
+            self._fleet_record("swap_rollback")
+        committed = not abort
+        if committed:
+            with self._lock:
+                self._prev_artifact = str(path)
+                if version is not None:
+                    self._committed_version = int(version)
+        # consistency assertion: after a commit OR a rollback every
+        # live replica must report ONE artifact identity — a fleet
+        # straddling two versions is the exact bug this protocol
+        # exists to prevent, so check it, loudly
+        with self._lock:
+            live = [r for r in self._replicas
+                    if r.live and r.state != "draining"
+                    and r.port is not None]
+        identities = {}
+        for rep in live:
+            ident = self._served_identity(rep, model=model)
+            if ident is not None:
+                identities[rep.idx] = ident
+        consistent = len(set(identities.values())) <= 1
+        if not consistent:
+            self._telemetry_event(
+                "fleet_swap_inconsistent", path=str(path),
+                identities=identities)
         with self._lock:
             self.stats["swaps"] += 1
         self._telemetry_count("fleet_swaps")
         self._telemetry_event(
             "fleet_rolling_swap", path=str(path),
-            swapped=sorted(per), errors=errors)
+            swapped=sorted(per), errors=errors,
+            committed=committed, version=version)
         self._fleet_record("swap")
         return {"per_replica": per, "errors": errors,
+                "committed": committed,
+                "rolled_back": sorted(rolled_back),
+                "identities": identities, "consistent": consistent,
+                "version": version,
                 "swap_ms": round((time.perf_counter() - t0) * 1e3, 3)}
 
     # ------------------------------------------------------ lifecycle

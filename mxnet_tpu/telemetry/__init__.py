@@ -31,6 +31,7 @@ from .runlog import (  # noqa: F401
     event,
     flight_dump,
     flight_path_for,
+    freshness,
     gauge,
     generate,
     heal,
@@ -44,7 +45,7 @@ from .watchdog import Watchdog, stack_path_for  # noqa: F401
 __all__ = [
     "RunLog", "current", "reset", "close", "compile_event",
     "compile_fingerprint", "event", "count", "gauge", "generate",
-    "heal",
+    "heal", "freshness",
     "data_plane", "quantize", "checkpoint_event", "program_report",
     "flight_dump",
     "flight_path_for", "describe_program", "FitSession",

@@ -50,8 +50,9 @@ import time
 
 __all__ = ["RunLog", "current", "reset", "close", "compile_event",
            "compile_fingerprint", "event", "count", "gauge", "heal",
-           "quantize", "checkpoint_event", "program_report",
-           "flight_dump", "describe_program", "flight_path_for"]
+           "quantize", "freshness", "checkpoint_event",
+           "program_report", "flight_dump", "describe_program",
+           "flight_path_for"]
 
 _LOCK = threading.RLock()
 _STATE = {"log": None, "resolved": False}
@@ -138,12 +139,17 @@ class RunLog:
                          "kv_evictions_total": 0,
                          "fleet_requests": 0, "fleet_shed": 0,
                          "fleet_failovers": 0, "fleet_resizes": 0,
-                         "fleet_swaps": 0, "peer_deaths": 0,
+                         "fleet_swaps": 0, "fleet_swap_rollbacks": 0,
+                         "peer_deaths": 0,
                          "auto_reshards": 0, "ckpt_async_writes": 0,
                          "ckpt_async_errors": 0,
                          "emergency_ckpts": 0, "heal_relaunches": 0,
                          "data_records_skipped": 0,
-                         "io_worker_respawns": 0, "io_resyncs": 0}
+                         "io_worker_respawns": 0, "io_resyncs": 0,
+                         "online_exports": 0, "online_swaps": 0,
+                         "online_swaps_shed": 0,
+                         "online_relaunches": 0,
+                         "freshness_violations": 0}
         self._gauges = {}       # name -> last value (textfile rows)
         self._fps = {}          # program -> last compile fingerprint
         self._programs = {}     # program -> last program_report body
@@ -576,6 +582,36 @@ class RunLog:
                 f"quantize:{action}", "telemetry",
                 args=_jsonable(fields), tid=_TRACE_TID)
 
+    def freshness(self, action, *, version=0, freshness_ms=None,
+                  **fields):
+        """One online-learning loop observation (mxnet_tpu.online): a
+        trainer export published, a rolling swap committed / shed /
+        rolled back, a freshness-SLO violation or a supervisor
+        relaunch — stamped with the artifact's monotonic model
+        version, the measured sample-to-served latency and the loop's
+        cumulative counters, so the run log alone proves version
+        monotonicity and names every shed swap."""
+        c = self.counters
+        self._write({"type": "freshness", "t": round(self._now(), 6),
+                     "action": str(action), "version": int(version),
+                     "freshness_ms": (round(float(freshness_ms), 3)
+                                      if freshness_ms is not None
+                                      else None),
+                     "exports": int(c.get("online_exports", 0)),
+                     "swaps": int(c.get("online_swaps", 0)),
+                     "swaps_shed": int(c.get("online_swaps_shed", 0)),
+                     "violations": int(c.get("freshness_violations",
+                                             0)),
+                     "relaunches": int(c.get("online_relaunches", 0)),
+                     **_jsonable(fields)})
+        from .. import profiler
+
+        if profiler.is_running():
+            self._trace_meta()
+            profiler.record_instant(
+                f"freshness:{action}", "telemetry",
+                args=_jsonable(fields), tid=_TRACE_TID)
+
     def opstats(self, rows, source="profiler"):
         """The aggregate per-op table (telemetry.opstats) as one
         ``program_report``-style record."""
@@ -825,6 +861,13 @@ def generate(**fields):
     rl = current()
     if rl is not None:
         rl.generate(**fields)
+
+
+def freshness(action, *, version=0, freshness_ms=None, **fields):
+    rl = current()
+    if rl is not None:
+        rl.freshness(action, version=version,
+                     freshness_ms=freshness_ms, **fields)
 
 
 def checkpoint_event(prefix, version, duration_s, nbytes, **extra):

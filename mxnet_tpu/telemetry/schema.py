@@ -32,6 +32,11 @@ heal      one self-healing runtime observation (peer_death /
 data      one data-plane observation (quarantine / respawn /
           epoch_end) with the cumulative records-skipped and
           worker-respawn counters stamped on
+freshness one online-learning loop observation (publish / swap_commit
+          / swap_shed / swap_rollback / violation / relaunch) carrying
+          the artifact's monotonic model version, the measured
+          sample-to-served freshness and the loop's cumulative
+          export/swap/shed/violation counters
 event     everything else (bad_step, ps_retry, fault, deadline, ...)
 run_end   final counters, written at close
 ========  =============================================================
@@ -42,7 +47,7 @@ __all__ = ["STEP_FIELDS", "RECORD_TYPES", "COMPILE_CAUSES",
            "OPSTATS_ROW_FIELDS", "TENSOR_STATS_ROW_FIELDS",
            "SERVE_FIELDS", "GENERATE_FIELDS", "FLEET_FIELDS",
            "HEAL_FIELDS", "DATA_FIELDS", "QUANT_FIELDS",
-           "validate_record", "validate_lines"]
+           "FRESHNESS_FIELDS", "validate_record", "validate_lines"]
 
 #: step-record contract: field -> (types, required).  ``None`` is legal
 #: for optional measurements (loss on an unsampled step, feed stats
@@ -72,7 +77,7 @@ STEP_FIELDS = {
 RECORD_TYPES = ("run_start", "step", "compile", "program_report",
                 "checkpoint", "watchdog", "opstats", "tensor_stats",
                 "serve", "generate", "fleet", "heal", "data",
-                "quantize", "event", "run_end")
+                "quantize", "freshness", "event", "run_end")
 
 #: per-batch contract of a ``serve`` record (serving.ModelServer)
 SERVE_FIELDS = {
@@ -166,6 +171,27 @@ QUANT_FIELDS = {
     "mode": (str, True),          # naive|entropy ('' when n/a)
     "layers": (int, True),        # layers the action touched/adopted
     "excluded": (int, True),      # layers fenced off by the caller
+}
+
+#: per-observation contract of a ``freshness`` record
+#: (mxnet_tpu.online): one online-loop event — a trainer export
+#: published, a rolling swap committed/shed/rolled back, an SLO
+#: violation or a supervisor relaunch — stamped with the artifact's
+#: monotonic model version and the loop's cumulative counters, so a
+#: run log alone proves the served version never regressed and names
+#: every swap that was shed instead of silently skipped
+FRESHNESS_FIELDS = {
+    "type": (str, True),
+    "t": ((int, float), True),
+    "action": (str, True),        # publish|swap_commit|swap_shed|
+                                  # swap_rollback|violation|relaunch
+    "version": (int, True),       # monotonic model version (0 = n/a)
+    "freshness_ms": ((int, float, type(None)), True),
+    "exports": (int, True),       # cumulative loop counters
+    "swaps": (int, True),
+    "swaps_shed": (int, True),
+    "violations": (int, True),
+    "relaunches": (int, True),
 }
 
 #: per-op row contract of an ``opstats`` record (telemetry.opstats)
@@ -283,6 +309,8 @@ def validate_record(rec):
         return _check_fields(rec, DATA_FIELDS)
     if t == "quantize":
         return _check_fields(rec, QUANT_FIELDS)
+    if t == "freshness":
+        return _check_fields(rec, FRESHNESS_FIELDS)
     if t == "event":
         return _check_fields(rec, {"t": ((int, float), True),
                                    "kind": (str, True)})

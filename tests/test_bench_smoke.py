@@ -241,6 +241,21 @@ def test_smoke_emits_valid_json_with_heartbeats():
     assert fl["swap_ms"] > 0 and fl["swap_errors"] == 0
     # every replica exited as a clean SIGTERM drain
     assert sorted(fl["drain_rcs"].values()) == [-15, -15]
+    # the online-learning freshness phase (round 18): the supervised
+    # trainer→export→rolling-swap loop against a 2-replica fleet —
+    # every export was swapped or shed (never silently dropped), the
+    # served versions only moved forward, and the fault-free
+    # sample-to-served p99 met the SLO
+    fr = out["freshness"]
+    assert fr["exports"] > 0
+    assert fr["swaps"] > 0
+    assert fr["exports"] == fr["swaps"] + fr["swaps_shed"]
+    assert fr["relaunches"] == 0
+    assert fr["monotonic"] is True
+    assert fr["versions_served"] == sorted(fr["versions_served"])
+    assert fr["p50_ms"] > 0 and fr["p99_ms"] >= fr["p50_ms"]
+    assert fr["slo_ms"] > 0
+    assert fr["p99_within_slo"] is True
     # the hang watchdog was armed (bench defaults it on) and quiet
     assert out["watchdog_sec"] > 0
     assert out["watchdog_stalls"] == 0
@@ -249,7 +264,8 @@ def test_smoke_emits_valid_json_with_heartbeats():
                   "compile", "K1", "K2", "trials", "feed",
                   "checkpoint", "collectives", "fused_kernels",
                   "healing", "data_plane", "serving", "quantization",
-                  "generate", "fleet", "telemetry", "conv_ab", "done"):
+                  "generate", "fleet", "freshness", "telemetry",
+                  "conv_ab", "done"):
         assert f"phase={phase}" in r.stderr, f"missing phase {phase}"
 
 

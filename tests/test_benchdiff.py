@@ -356,6 +356,82 @@ def test_generate_regression_gates_with_fail_on_regression(
     assert rounds["r02"]["verdict"] == "ok"
 
 
+def _fresh(p99, slo=True, mono=True, swaps=5, shed=1):
+    return {"steps": 30, "exports": swaps + shed, "swaps": swaps,
+            "swaps_shed": shed, "swap_rollbacks": 0, "relaunches": 0,
+            "versions_served": list(range(1, swaps + 1)),
+            "monotonic": mono, "slo_ms": 60000.0, "violations": 0,
+            "p50_ms": p99 / 2.0, "p99_ms": p99 * 1.2,
+            "fault_free_p99_ms": p99, "p99_within_slo": slo}
+
+
+def test_freshness_trend_verdicts_and_missing_metric(tmp_path):
+    """Round 18: the freshness phase trends like the fleet's — the
+    fault-free sample-to-served p99 inverted (lower is better), a
+    served-version monotonicity violation and an SLO miss ABSOLUTE
+    regressions (baseline round included), and a round that shipped
+    the phase then lost it is 'missing freshness metric'.  Pre-phase
+    rounds carry no verdict."""
+    glob_b = _write_rounds(tmp_path, [
+        (1, 0, {"value": 1000.0}),                         # pre-phase
+        (2, 0, {"value": 1000.0, "freshness": _fresh(500.0)}),
+        (3, 0, {"value": 1000.0, "freshness": _fresh(520.0)}),   # ok
+        (4, 0, {"value": 1000.0, "freshness": _fresh(900.0)}),  # p99 x1.7
+        (5, 0, {"value": 1000.0,
+                "freshness": _fresh(500.0, mono=False)}),  # BACKWARDS
+        (6, 0, {"value": 1000.0,
+                "freshness": _fresh(500.0, slo=False)}),   # SLO miss
+        (7, 0, {"value": 1000.0}),                 # lost the phase
+    ])
+    rounds = bd.freshness_verdicts(bd.load_bench(
+        sorted(__import__("glob").glob(glob_b))), 0.15)
+    assert rounds["r01"]["fresh_verdict"] is None
+    assert rounds["r02"]["fresh_verdict"] == "baseline"
+    assert rounds["r03"]["fresh_verdict"] == "ok"
+    assert rounds["r04"]["fresh_verdict"] == "regression"
+    assert "p99" in rounds["r04"]["fresh_reason"]
+    assert rounds["r05"]["fresh_verdict"] == "regression"
+    assert "BACKWARDS" in rounds["r05"]["fresh_reason"]
+    assert rounds["r06"]["fresh_verdict"] == "regression"
+    assert "SLO" in rounds["r06"]["fresh_reason"]
+    assert rounds["r07"]["fresh_verdict"] == "regression"
+    assert rounds["r07"]["fresh_reason"] == "missing freshness metric"
+
+
+def test_freshness_monotonicity_regresses_at_baseline(tmp_path):
+    """The absolute verdicts fire on the FIRST round that ships the
+    phase too — a version-regressing fleet is broken at any speed."""
+    glob_b = _write_rounds(tmp_path, [
+        (1, 0, {"value": 1000.0, "freshness": _fresh(500.0,
+                                                     mono=False)}),
+    ])
+    rounds = bd.freshness_verdicts(bd.load_bench(
+        sorted(__import__("glob").glob(glob_b))), 0.15)
+    assert rounds["r01"]["fresh_verdict"] == "regression"
+    assert "BACKWARDS" in rounds["r01"]["fresh_reason"]
+
+
+def test_freshness_regression_gates_with_fail_on_regression(
+        tmp_path, capsys):
+    """A freshness p99 blow-up exits 2 under --fail-on-regression even
+    with a clean headline, and the table carries the freshness
+    section."""
+    glob_b = _write_rounds(tmp_path, [
+        (1, 0, {"value": 1000.0, "freshness": _fresh(500.0)}),
+        (2, 0, {"value": 1010.0, "freshness": _fresh(2000.0)}),
+    ])
+    rc = bd.main(["--bench", glob_b, "--opperf",
+                  str(tmp_path / "none*.jsonl"),
+                  "--fail-on-regression"])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "freshness trend" in out
+    assert "freshness r02" in out
+    rounds = bd.headline_verdicts(bd.load_bench(
+        sorted(__import__("glob").glob(glob_b))), 0.15)
+    assert rounds["r02"]["verdict"] == "ok"
+
+
 def test_fleet_absent_everywhere_never_gates(tmp_path):
     """The committed pre-round-15 artifacts carry no fleet phase: the
     fleet gate must stay silent (the pinned r01–r05 CI window cannot

@@ -1,0 +1,394 @@
+"""Online learning loop tests (round 18).
+
+The contract under test, end to end:
+
+* **Sample-exact resume** — the replay stream is a pure function of
+  ``(seed, cursor)`` and every export checkpoints the cursor first, so
+  a trainer killed mid-stream and relaunched by the supervisor lands
+  on EXACTLY the params an uninterrupted run produces (bit-identical,
+  not allclose).
+* **THE online drill** (tier-1, subprocess like the fleet drill): a
+  60-step online loop exporting every 10 steps rolling-swaps >=3
+  versions into a 2-replica fleet under concurrent serving load while
+  the trainer is SIGKILLed between swaps 1 and 2; the supervisor
+  relaunches it, every published version is committed, the served
+  version stream (asserted from the run log) is monotonically
+  non-decreasing, and freshness p99 is within SLO for fault-free
+  windows.
+* **Partial-failure rollback** (satellite): a swap probe failing on
+  replica k rolls back replicas 1..k-1 — every host ends on ONE
+  identity — and the router's ``model_version`` stamp check refuses
+  swaps that would regress below the last committed version.
+* **Generative swap** (satellite): ``ModelHost.swap`` accepts a
+  ``GenerativeServer``-backed artifact; in-flight decode sequences at
+  cutover finish on the OLD version (drained and REPORTED, never
+  assumed) — no mid-sequence version change.
+* **Retention under rapid exports** (satellite): back-to-back
+  export-cadence checkpoints honor ``keep_n`` with no torn latest
+  pointer; a corrupted newest version falls back to the previous good
+  one.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon, nd, telemetry  # noqa: E402
+from mxnet_tpu.base import MXNetError  # noqa: E402
+from mxnet_tpu.online import (  # noqa: E402
+    OnlineLoop,
+    OnlineTrainer,
+    stream_batch,
+)
+from mxnet_tpu.resilience import faultsim  # noqa: E402
+from mxnet_tpu.serving import FleetRouter, ModelHost  # noqa: E402
+from mxnet_tpu.serving.generate import toy_decoder_params  # noqa: E402
+from mxnet_tpu.telemetry import schema as tm_schema  # noqa: E402
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ENV = dict(os.environ, JAX_PLATFORMS="cpu",
+            PYTHONPATH=os.pathsep.join(
+                p for p in [_REPO, os.environ.get("PYTHONPATH")] if p))
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faultsim.reset("")
+    yield
+    faultsim.reset("")
+
+
+def _worker(workdir, steps=12, export_every=4, seed=7, env=None):
+    return subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.online.loop", "--dir",
+         str(workdir), "--steps", str(steps), "--export-every",
+         str(export_every), "--seed", str(seed)],
+        env=dict(_ENV, **(env or {})), capture_output=True, text=True,
+        timeout=240)
+
+
+def _export_dense(tmp_path, name, version=None, batch=8, features=4,
+                  seed=3):
+    """One Dense(1, in=features) artifact, optionally version-stamped
+    the way the online trainer stamps its exports."""
+    mx.random.seed(seed)
+    net = gluon.nn.Dense(1, in_units=features)
+    net.initialize(init=mx.init.Xavier())
+    net(nd.zeros((1, features)))
+    path = os.path.join(str(tmp_path), f"{name}.mxje")
+    extra = None if version is None else {"model_version": int(version)}
+    mx.deploy.export_model(net, nd.zeros((batch, features)), path,
+                           platforms=("cpu",), extra_meta=extra)
+    return path
+
+
+# ------------------------------------------------------- fault registry
+def test_online_fault_points_registered():
+    pts = faultsim.points()
+    assert {"online.step", "online.publish"} <= set(pts)
+    faultsim.reset("online.step:crash@999;online.publish:raise@999")
+    faultsim.reset("")
+
+
+# ------------------------------------------------------------ the stream
+def test_stream_is_pure_function_of_seed_and_cursor():
+    x1, y1 = stream_batch(7, 13, 8, 4)
+    x2, y2 = stream_batch(7, 13, 8, 4)
+    assert onp.array_equal(x1, x2) and onp.array_equal(y1, y2)
+    x3, _ = stream_batch(7, 14, 8, 4)
+    assert not onp.array_equal(x1, x3)
+
+
+# -------------------------------------------------- sample-exact resume
+def test_trainer_crash_heal_resumes_sample_exact(tmp_path):
+    """faultsim-crash mid-stream + relaunch == uninterrupted run,
+    bit for bit (the cursor-bearing checkpoint contract)."""
+    ref = OnlineTrainer(str(tmp_path / "ref"), steps=12,
+                        export_every=4, seed=7).run()
+    wd = str(tmp_path / "int")
+    first = _worker(wd, env={"MXNET_FAULT_SPEC": "online.step:crash@6"})
+    assert first.returncode == faultsim.CRASH_EXIT_CODE, first.stderr
+    second = _worker(wd, env={"MXNET_HEAL_ATTEMPT": "1"})
+    assert second.returncode == 0, second.stderr
+    with open(os.path.join(wd, "final.json")) as f:
+        fin = json.load(f)
+    assert fin["attempt"] == 1
+    assert fin["step"] == 12
+    for k in ref["params"]:
+        assert onp.array_equal(onp.array(ref["params"][k]),
+                               onp.array(fin["params"][k])), k
+    # the healed run re-exported only the versions past its resume
+    # point; every published version number is unique and stamped
+    meta = mx.deploy.read_artifact_meta(
+        os.path.join(wd, "publish", "model-v0003.mxje"))
+    assert meta["model_version"] == 3
+    assert meta["stream_cursor"] == 12
+
+
+# ------------------------------------------------------------ THE drill
+def test_online_drill_kill_heal_swaps_fresh(tmp_path):
+    """60-step loop, exports every 10, >=3 rolling swaps under load,
+    SIGKILL between swaps 1 and 2, sample-exact resume, monotonic
+    served versions (from the run log), fault-free freshness p99
+    within SLO."""
+    ref = OnlineTrainer(str(tmp_path / "ref"), steps=60,
+                        export_every=10, seed=7).run()
+    base = _export_dense(tmp_path, "base")
+    runlog = str(tmp_path / "online.jsonl")
+    router = FleetRouter.spawn(base, replicas=2,
+                               env={"JAX_PLATFORMS": "cpu"},
+                               coalesce_ms=1.0)
+    try:
+        telemetry.reset(runlog)
+        loop = OnlineLoop(str(tmp_path / "loop"), router, steps=60,
+                          export_every=10, seed=7, pace_s=0.1,
+                          slo_ms=30000.0)
+        stop = threading.Event()
+        served, rejected, hung = [0], [0], []
+        from mxnet_tpu.serving import ServeRejected
+
+        def load():
+            x = onp.ones((4,), dtype="float32")
+            while not stop.is_set():
+                try:
+                    out = router.submit(x, deadline_ms=3000)
+                    assert out.shape == (1,)
+                    served[0] += 1
+                except ServeRejected:
+                    rejected[0] += 1  # structured shed, never a hang
+                except Exception as exc:
+                    hung.append(repr(exc))
+                time.sleep(0.02)
+
+        lt = threading.Thread(target=load)
+        lt.start()
+        out = {}
+
+        def run():
+            out["rep"] = loop.run(timeout=480)
+
+        rt = threading.Thread(target=run)
+        rt.start()
+        # SIGKILL the trainer after the first committed swap (between
+        # swaps 1 and 2), via the pidfile it wrote
+        deadline = time.monotonic() + 240
+        while not loop.served_versions and rt.is_alive() \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert loop.served_versions, "no swap committed before timeout"
+        time.sleep(0.2)
+        with open(loop.pidfile) as f:
+            os.kill(int(f.read()), signal.SIGKILL)
+        rt.join(timeout=480)
+        assert not rt.is_alive()
+        stop.set()
+        lt.join(timeout=30)
+        rep = out["rep"]
+    finally:
+        telemetry.close()
+        router.close()
+    # the kill was healed, every published version served or shed loud
+    assert rep["worker_rc"] == 0
+    assert rep["relaunches"] == 1
+    assert rep["swaps"] >= 3
+    assert rep["monotonic"]
+    assert rep["exports_seen"] == rep["swaps"] + rep["swaps_shed"]
+    # the NEWEST version always ends up serving — sheds may skip
+    # intermediates, never the head
+    assert rep["served_versions"][-1] == max(
+        rep["served_versions"] + rep["shed_versions"])
+    # zero requests silently hung; sheds are structured and bounded
+    assert hung == []
+    assert served[0] > 0
+    assert rejected[0] <= max(5, served[0] // 10)
+    # freshness: fault-free windows within SLO, >=1 clean sample
+    fr = rep["freshness"]
+    assert fr["fault_free"]["count"] >= 1
+    assert fr["fault_free"]["within_slo"]
+    # sample-exact resume vs the uninterrupted reference
+    with open(os.path.join(str(tmp_path / "loop"), "final.json")) as f:
+        fin = json.load(f)
+    assert fin["attempt"] == 1
+    for k in ref["params"]:
+        assert onp.array_equal(onp.array(ref["params"][k]),
+                               onp.array(fin["params"][k])), k
+    # run-log evidence: schema-valid freshness records, commit stream
+    # monotonically non-decreasing, the relaunch recorded
+    with open(runlog) as f:
+        recs, problems = tm_schema.validate_lines(f)
+    assert problems == []
+    fresh = [r for r in recs if r.get("type") == "freshness"]
+    commits = [r["version"] for r in fresh
+               if r["action"] == "swap_commit"]
+    assert len(commits) == rep["swaps"]
+    assert all(b >= a for a, b in zip(commits, commits[1:]))
+    assert any(r["action"] == "relaunch" for r in fresh)
+    assert fresh[-1]["exports"] == rep["exports_seen"]
+
+
+# ------------------------------------- satellite: rollback to ONE version
+def test_rolling_swap_partial_failure_rolls_back_all(tmp_path):
+    """Probe failure on replica k rolls back replicas 1..k-1: every
+    host ends on ONE identity; a later clean swap commits; a
+    version-regressing swap is refused outright."""
+    base = _export_dense(tmp_path, "base", version=1)
+    v2 = _export_dense(tmp_path, "v2", version=2, seed=4)
+    v1_again = _export_dense(tmp_path, "v1b", version=1, seed=5)
+    router = FleetRouter.spawn(
+        base, replicas=2, env={"JAX_PLATFORMS": "cpu"},
+        coalesce_ms=1.0,
+        # replica 1's FIRST model batch is the swap warm probe (load
+        # warmup bypasses the inject point; health probes are
+        # /healthz-only) — so the swap fails on host 2 of 2 AFTER
+        # host 1 already cut over.  hits 1-3: the server retries
+        # FaultInjected 3x inside the batch deadline, so a single-hit
+        # fault would be healed by the retry instead of failing the
+        # probe; hits 4+ stay clean so the later swap can commit
+        replica_env={1: {"MXNET_FAULT_SPEC": "serve.model:raise@1-3"}})
+    try:
+        res = router.rolling_swap(v2, probe_timeout=60.0)
+        assert res["committed"] is False
+        assert res["rolled_back"] == [0]
+        assert 1 in res["errors"]
+        # one identity across the fleet, and it is the OLD artifact
+        assert res["consistent"], res["identities"]
+        assert set(res["identities"].values()) == {base}
+        assert router.stats["swap_rollbacks"] == 1
+        # still serving after the rollback
+        out = router.submit(onp.ones((4,), dtype="float32"),
+                            deadline_ms=5000)
+        assert out.shape == (1,)
+        # the fault was one-shot: the retried swap commits everywhere
+        res2 = router.rolling_swap(v2, probe_timeout=60.0)
+        assert res2["committed"] and res2["consistent"]
+        assert set(res2["identities"].values()) == {v2}
+        # regression guard: last committed is now 2 — a v1 artifact
+        # is refused before any replica is touched
+        with pytest.raises(MXNetError, match="regress"):
+            router.rolling_swap(v1_again, probe_timeout=60.0)
+    finally:
+        router.close()
+
+
+# --------------------------------------- satellite: generative host swap
+def _export_gen(tmp_path, name, seed, version):
+    params = toy_decoder_params(seed=seed, vocab=17, layers=1, heads=2,
+                                head_dim=4)
+    path = os.path.join(str(tmp_path), f"{name}.mxje")
+    mx.deploy.export_generative(
+        params, path, vocab=17, layers=1, heads=2, head_dim=4,
+        prompt_buckets=(4,), max_new=4,
+        extra_meta={"model_version": int(version)})
+    return path
+
+
+def test_generative_host_swap_drains_inflight(tmp_path):
+    """ModelHost.swap of a generative artifact: sequences in flight at
+    cutover finish on the OLD server (no mid-sequence version change)
+    and the drain outcome is REPORTED in the swap event."""
+    p1 = _export_gen(tmp_path, "g1", seed=1, version=1)
+    p2 = _export_gen(tmp_path, "g2", seed=2, version=2)
+    runlog = str(tmp_path / "swap.jsonl")
+    telemetry.reset(runlog)
+    host = ModelHost(hbm_budget_mb=0)
+    try:
+        host.load("gen", p1)
+        prompt = onp.array([1, 2, 3, 4], dtype=onp.int32)
+        # keep decodes in flight across the cutover
+        handles = [host.submit(prompt, model="gen") for _ in range(4)]
+        swap_ms = host.swap("gen", p2, probe_timeout=60.0)
+        assert swap_ms > 0
+        # every pre-swap sequence completes (tokens from the old
+        # server's drain — never a silent drop, never a hang)
+        for h in handles:
+            toks = h.result(timeout=30)
+            assert len(toks) >= 1
+        # post-swap submits run on the new artifact
+        out = host.submit(prompt, model="gen").result(timeout=30)
+        assert len(out) >= 1
+        assert host.residency()["models"]["gen"]["path"] == p2
+    finally:
+        host.close_all()
+        telemetry.close()
+    with open(runlog) as f:
+        recs, problems = tm_schema.validate_lines(f)
+    assert problems == []
+    swaps = [r for r in recs if r.get("type") == "event"
+             and r.get("kind") == "fleet_swap"]
+    assert len(swaps) == 1
+    assert swaps[0]["gen_inflight_at_cutover"] >= 0
+    assert swaps[0]["gen_drained"] is True
+
+
+@pytest.mark.slow
+def test_generative_fleet_rolling_swap(tmp_path):
+    """Fleet-level rolling swap of a generative model across spawned
+    replicas: decode requests keep completing, the swap commits on
+    every host."""
+    p1 = _export_gen(tmp_path, "g1", seed=1, version=1)
+    p2 = _export_gen(tmp_path, "g2", seed=2, version=2)
+    router = FleetRouter.spawn(p1, replicas=2,
+                               env={"JAX_PLATFORMS": "cpu"},
+                               ready_timeout=240.0)
+    try:
+        prompt = onp.array([1, 2, 3, 4], dtype=onp.int32)
+        out = router.submit(prompt, deadline_ms=60000)
+        assert onp.asarray(out).size >= 1
+        res = router.rolling_swap(p2, probe_timeout=120.0)
+        assert res["committed"] and res["consistent"]
+        assert set(res["identities"].values()) == {p2}
+        out = router.submit(prompt, deadline_ms=60000)
+        assert onp.asarray(out).size >= 1
+    finally:
+        router.close()
+
+
+# ------------------------------------ satellite: retention under cadence
+def test_checkpoint_retention_under_rapid_exports(tmp_path):
+    """Back-to-back export-cadence checkpoints honor keep_n: no torn
+    latest pointer, newest-good fallback after corruption, and resume
+    still lands sample-exact off the retained tail."""
+    wd = str(tmp_path / "fast")
+    tr = OnlineTrainer(wd, steps=10, export_every=1, seed=7, keep_n=2)
+    tr.run()
+    mgr = tr.ckpt
+    eps = mgr.epochs()
+    assert eps == [9, 10], eps  # newest keep_n survive, older pruned
+    assert mgr.latest_epoch() == 10
+    # every retained version loads and carries its stream cursor
+    st = mgr.load()
+    assert st["version"] == 10
+    assert st["extra"]["stream_cursor"] == 10
+    # torn latest pointer: garbage in the pointer file must not break
+    # resolution (fallback scans newest-first)
+    with open(mgr.latest_path(), "w") as f:
+        f.write("{torn")
+    assert mgr.latest_epoch() == 10
+    # corrupt the newest payload: newest-good fallback to version 9
+    with open(mgr.params_path(10), "r+b") as f:
+        f.seek(0)
+        f.write(b"\x00" * 16)
+    assert mgr.latest_epoch() == 9
+    st = mgr.load()
+    assert st["version"] == 9
+    assert st["extra"]["stream_cursor"] == 9
+    # and the trainer resumes off the fallback version, replaying
+    # batch 10 deterministically to the same final params
+    ref = OnlineTrainer(str(tmp_path / "ref"), steps=10,
+                        export_every=5, seed=7).run()
+    fin = OnlineTrainer(wd, steps=10, export_every=5, seed=7,
+                        keep_n=2).run()
+    for k in ref["params"]:
+        assert onp.array_equal(onp.array(ref["params"][k]),
+                               onp.array(fin["params"][k])), k

@@ -37,6 +37,14 @@ a machine-readable trend:
   any post-warm compile regresses ABSOLUTELY (the zero-retrace
   contract), and a round that shipped the phase then lost it is
   "missing generate metric".
+* **freshness trend** (round 18) — the ``freshness`` phase's online-
+  learning metrics round-over-round: the fault-free sample-to-served
+  p99 rates inverted like the fleet's (lower is better, past the
+  threshold = regression), a served-version MONOTONICITY violation or
+  a fault-free p99 over the SLO regresses ABSOLUTELY (a fleet that
+  ever serves an older model, or misses its freshness promise, is
+  broken at any speed — baseline rounds included), and a round that
+  shipped the phase then lost it is "missing freshness metric".
 * **zero-stage trend** (round 16, ZeRO) — the collectives phase's
   ``zero`` block (stage-1 vs stage-3 sharded step on the virtual
   mesh): the per-step RS+AG bytes over the analytic plan minimum must
@@ -93,6 +101,8 @@ def load_bench(paths):
                "degraded": None, "error": None,
                "fleet_p99_ms": None, "fleet_shed_rate": None,
                "fleet_within_slo": None,
+               "fresh_p99_ms": None, "fresh_shed_rate": None,
+               "fresh_within_slo": None, "fresh_monotonic": None,
                "quant_p99_ms": None, "quant_agreement": None,
                "quant_speedup": None,
                "gen_tokens_s": None, "gen_ttft_p99_ms": None,
@@ -125,6 +135,19 @@ def load_bench(paths):
                 row["fleet_shed_rate"] = round(
                     (fl.get("shed") or 0) / req, 4) if req else None
                 row["fleet_within_slo"] = fl.get("p99_within_slo")
+            fr = parsed.get("freshness")
+            if isinstance(fr, dict) and fr.get("p99_ms") is not None:
+                # the gate judges the fault-free p99 (tainted
+                # post-heal samples are excluded, not hidden)
+                row["fresh_p99_ms"] = (fr.get("fault_free_p99_ms")
+                                       or fr["p99_ms"])
+                row["fresh_within_slo"] = fr.get("p99_within_slo")
+                row["fresh_monotonic"] = fr.get("monotonic")
+                total = ((fr.get("swaps") or 0)
+                         + (fr.get("swaps_shed") or 0))
+                row["fresh_shed_rate"] = round(
+                    (fr.get("swaps_shed") or 0) / total, 4) \
+                    if total else None
             qt = parsed.get("quantization")
             if isinstance(qt, dict) \
                     and qt.get("agreement_top1") is not None:
@@ -359,6 +382,59 @@ def generate_verdicts(rounds, threshold):
     return rounds
 
 
+def freshness_verdicts(rounds, threshold):
+    """Verdict the ``freshness`` phase round-over-round: the
+    fault-free sample-to-served p99 rates inverted like the fleet's
+    (LOWER is better; past the threshold = regression).  Two verdicts
+    are ABSOLUTE and fire even on the baseline round: a served-version
+    monotonicity violation (a fleet that ever served an older model
+    is broken at any speed — the no-regression contract of the
+    rolling swap) and a fault-free p99 over the SLO (the promise the
+    online loop exists to keep).  Rounds before the phase existed
+    carry no verdict; once shipped, a later round without it is
+    "missing freshness metric"."""
+    seen = False
+    prev = None
+    for label in sorted(rounds):
+        row = rounds[label]
+        p99 = row["fresh_p99_ms"]
+        if p99 is None:
+            if seen:
+                row["fresh_verdict"] = "regression"
+                row["fresh_reason"] = "missing freshness metric"
+            else:
+                row["fresh_verdict"] = None
+                row["fresh_reason"] = None
+            continue
+        reasons = []
+        if row["fresh_monotonic"] is False:
+            reasons.append("served versions went BACKWARDS")
+        if row["fresh_within_slo"] is False:
+            reasons.append("fault-free p99 over the freshness SLO")
+        if not seen:
+            row["fresh_verdict"] = "regression" if reasons \
+                else "baseline"
+            row["fresh_reason"] = "; ".join(reasons) or None
+        else:
+            ratio = (p99 / prev) if prev else None
+            if ratio is not None and ratio > 1.0 + threshold:
+                reasons.append(f"freshness p99 x{ratio:.2f}")
+            if reasons:
+                row["fresh_verdict"] = "regression"
+                row["fresh_reason"] = "; ".join(reasons)
+            elif ratio is not None \
+                    and ratio < 1.0 / (1.0 + threshold):
+                row["fresh_verdict"] = "improved"
+                row["fresh_reason"] = f"freshness p99 x{ratio:.2f}"
+            else:
+                row["fresh_verdict"] = "ok"
+                row["fresh_reason"] = (f"freshness p99 x{ratio:.2f}"
+                                       if ratio is not None else None)
+        seen = True
+        prev = p99
+    return rounds
+
+
 def zero_verdicts(rounds, threshold):
     """Verdict the collectives phase's ``zero`` block (ZeRO stage-1 vs
     stage-3 A/B) round-over-round.  Unlike the headline these are
@@ -582,6 +658,26 @@ def render(bench, opperf, threshold):
                 f"{('-' if shed is None else f'{shed:.0%}'):>8s}"
                 f"{('-' if r['fleet_within_slo'] is None else str(r['fleet_within_slo'])):>8s}"
                 f"  {verdict}")
+    fresh_rows = [label for label in sorted(bench)
+                  if bench[label].get("fresh_verdict")]
+    if fresh_rows:
+        lines.append("")
+        lines.append("== freshness trend (online learning) ==")
+        lines.append(f"{'round':<10s}{'p99_ms':>10s}{'shed':>8s}"
+                     f"{'in_slo':>8s}{'mono':>7s}  verdict")
+        for label in fresh_rows:
+            r = bench[label]
+            verdict = r["fresh_verdict"]
+            if r.get("fresh_reason"):
+                verdict += f": {r['fresh_reason']}"
+            shed = r["fresh_shed_rate"]
+            lines.append(
+                f"{label:<10s}"
+                f"{_fmt(r['fresh_p99_ms']):>10s}"
+                f"{('-' if shed is None else f'{shed:.0%}'):>8s}"
+                f"{('-' if r['fresh_within_slo'] is None else str(r['fresh_within_slo'])):>8s}"
+                f"{('-' if r['fresh_monotonic'] is None else str(r['fresh_monotonic'])):>7s}"
+                f"  {verdict}")
     if opperf.get("compared_ops"):
         lines.append("")
         lines.append(f"== opperf trend {opperf['prev']} -> "
@@ -637,12 +733,14 @@ def main(argv=None):
               f"{opperf_glob!r}", file=sys.stderr)
         return 1
 
-    bench = zero_verdicts(
-        generate_verdicts(
-            quantization_verdicts(
-                fleet_verdicts(
-                    headline_verdicts(load_bench(bench_paths),
-                                      args.threshold),
+    bench = freshness_verdicts(
+        zero_verdicts(
+            generate_verdicts(
+                quantization_verdicts(
+                    fleet_verdicts(
+                        headline_verdicts(load_bench(bench_paths),
+                                          args.threshold),
+                        args.threshold),
                     args.threshold),
                 args.threshold),
             args.threshold),
@@ -671,6 +769,10 @@ def main(argv=None):
         if bench[last].get("zero_verdict") == "regression":
             failures.append(
                 f"zero {last}: {bench[last]['zero_reason']}")
+        # online-learning freshness gates the same way (round 18)
+        if bench[last].get("fresh_verdict") == "regression":
+            failures.append(
+                f"freshness {last}: {bench[last]['fresh_reason']}")
     if opperf.get("regressions"):
         failures.append(
             f"opperf {opperf['last']}: {len(opperf['regressions'])} "

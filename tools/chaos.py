@@ -76,6 +76,22 @@ Scenarios (round-robin over the schedule):
                   SAME server recovers — the final fault-free
                   generation must match the fault-free reference
                   token-for-token
+``trainer_death_midstream``  ``online.step:crash@K`` kills the online
+                  trainer (round 18) between export boundaries, after
+                  at least one stamped artifact was published: the
+                  healing supervisor relaunches, the cursor-bearing
+                  checkpoint resumes SAMPLE-EXACT (final params match
+                  the fault-free reference), every published manifest
+                  still points at a live stamp-matching artifact, and
+                  the published version sequence stays strictly
+                  increasing across the death
+``swap_rollback``  a seeded ``serve.model:raise`` window is armed in
+                  ONE fleet replica so its post-swap warm probe fails
+                  mid-rollout: the swap must abort, roll every
+                  already-cut-over replica back, leave the fleet on
+                  ONE artifact identity (run-log counter evidence),
+                  and the retried swap after the window drains must
+                  commit everywhere with the reference's prediction
 ================  ====================================================
 
 Usage::
@@ -103,12 +119,14 @@ sys.path.insert(0, _REPO)
 SCENARIOS = ("sigkill", "sigterm_drain", "peer_death",
              "heartbeat_delay", "ckpt_async_crash", "ckpt_write_crash",
              "collective_delay", "record_corrupt", "io_worker_kill",
-             "zero3_peer_death", "decode_fault")
+             "zero3_peer_death", "decode_fault",
+             "trainer_death_midstream", "swap_rollback")
 
 #: scenarios that intentionally kill the victim (a relaunch+resume is
 #: expected); the others must complete on attempt 0
 _LETHAL = {"sigkill", "sigterm_drain", "peer_death",
-           "ckpt_async_crash", "ckpt_write_crash", "zero3_peer_death"}
+           "ckpt_async_crash", "ckpt_write_crash", "zero3_peer_death",
+           "trainer_death_midstream"}
 
 
 # ======================================================= worker half
@@ -368,6 +386,169 @@ def _worker_generate(args, attempt):
     return 0
 
 
+def _worker_online(args, attempt):
+    """The online-learning arm (round 18, ``trainer_death_midstream``):
+    the :class:`OnlineTrainer` consumes its deterministic replay
+    stream, exporting a stamped ``.mxje`` every few steps, while the
+    seeded ``online.step:crash`` spec kills the process mid-stream —
+    after the first export, before the last.  The healing supervisor
+    relaunches; the resume must be SAMPLE-EXACT (final params match
+    the fault-free reference bit-for-bit), every published manifest
+    must point at a live artifact whose stamp agrees (no torn
+    publishes), and the version sequence must stay strictly
+    increasing across the death."""
+    from mxnet_tpu import deploy, telemetry
+    from mxnet_tpu.online import OnlineTrainer
+    from mxnet_tpu.resilience import faultsim
+
+    if attempt > 0:
+        faultsim.reset("")
+    workdir = (f"{args.prefix}.online" if args.prefix
+               else tempfile.mkdtemp(prefix="chaos_online_"))
+    tr = OnlineTrainer(workdir, steps=12, export_every=4, seed=5)
+    if args.pidfile and attempt == 0:
+        with open(args.pidfile, "w") as f:
+            f.write(str(os.getpid()))
+    final = tr.run()  # attempt 0 may os._exit(87) mid-stream here
+
+    problems = []
+    versions = []
+    for name in sorted(os.listdir(tr.publish_dir)):
+        if not (name.startswith("v") and name.endswith(".json")):
+            continue
+        with open(os.path.join(tr.publish_dir, name)) as f:
+            man = json.load(f)
+        versions.append(int(man["model_version"]))
+        try:
+            meta = deploy.read_artifact_meta(man["path"])
+        except Exception as e:
+            problems.append(f"manifest {name} points at an unreadable "
+                            f"artifact: {e}")
+            continue
+        if int(meta.get("model_version", -1)) != versions[-1]:
+            problems.append(
+                f"manifest {name} stamp mismatch: artifact says "
+                f"{meta.get('model_version')}")
+    if not versions:
+        problems.append("no artifact was ever published")
+    elif versions != sorted(set(versions)):
+        problems.append(
+            f"published versions not strictly increasing: {versions}")
+
+    import threading
+
+    telemetry.close()
+    stray = [t.name for t in threading.enumerate()
+             if t.is_alive() and not t.daemon
+             and t is not threading.main_thread()]
+    if problems:
+        print("chaos-worker(online): " + "; ".join(problems),
+              file=sys.stderr, flush=True)
+        return 1
+    print(json.dumps({"final": final["params"],
+                      "threads_ok": not stray, "stray_threads": stray,
+                      "attempt": attempt}), flush=True)
+    return 0
+
+
+def _worker_swap(args, attempt):
+    """The rolling-swap arm (round 18, ``swap_rollback``): a 2-replica
+    fleet serves v1 and the seeded ``serve.model:raise`` window is
+    armed in ONE replica's env, so its post-swap warm probe fails
+    after its sibling already cut over — the rollout must abort, roll
+    the cut-over replica back and leave the fleet on ONE artifact
+    identity.  Once the window is consumed the retried swap must
+    commit v2 everywhere, and the final routed prediction is the
+    run's ``final`` payload, compared against the fault-free
+    reference (which swaps cleanly first try)."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import deploy, gluon, nd, telemetry
+    from mxnet_tpu.resilience import faultsim
+    from mxnet_tpu.serving import FleetRouter
+
+    spec = os.environ.get("MXNET_FAULT_SPEC", "")
+    # the spec targets a REPLICA's probe path, not this client process
+    faultsim.reset("")
+    workdir = (f"{args.prefix}.swap" if args.prefix
+               else tempfile.mkdtemp(prefix="chaos_swap_"))
+    os.makedirs(workdir, exist_ok=True)
+
+    def _artifact(version, seed):
+        net = gluon.nn.Dense(1, in_units=4,
+                             prefix=f"chaos_swap{version}_")
+        net.initialize(init=mx.init.Xavier())
+        net(nd.zeros((1, 4)))
+        rng = onp.random.RandomState(seed)
+        net.weight.set_data(nd.array(rng.uniform(
+            -0.5, 0.5, size=(1, 4)).astype("float32")))
+        net.bias.set_data(nd.zeros((1,)))
+        path = os.path.join(workdir, f"model-v{version}.mxje")
+        deploy.export_model(net, nd.zeros((8, 4)), path,
+                            platforms=("cpu",),
+                            extra_meta={"model_version": version})
+        return path
+
+    v1, v2 = _artifact(1, 31), _artifact(2, 32)
+    replica_env = ({1: {"MXNET_FAULT_SPEC": spec}}
+                   if attempt == 0 and spec else None)
+    problems = []
+    final = {}
+    router = FleetRouter.spawn(v1, replicas=2,
+                               env={"JAX_PLATFORMS": "cpu"},
+                               coalesce_ms=1.0,
+                               replica_env=replica_env or {})
+    try:
+        first = router.rolling_swap(v2, probe_timeout=60.0)
+        if replica_env:
+            if first["committed"]:
+                problems.append(
+                    "armed probe fault but the rollout committed")
+            elif not first["consistent"]:
+                problems.append(
+                    "fleet straddles two identities after rollback: "
+                    f"{first['identities']}")
+            elif set(first["identities"].values()) != {v1}:
+                problems.append(
+                    "rollback left the fleet off the previous "
+                    f"artifact: {first['identities']}")
+        res = first
+        give_up = time.monotonic() + 30.0
+        while not res["committed"] and time.monotonic() < give_up:
+            time.sleep(0.1)
+            res = router.rolling_swap(v2, probe_timeout=60.0)
+        if not res["committed"]:
+            problems.append(
+                f"retried swap never committed: {res['errors']}")
+        elif not res["consistent"] \
+                or set(res["identities"].values()) != {v2}:
+            problems.append(
+                f"post-retry identities inconsistent: "
+                f"{res['identities']}")
+        out = router.submit(onp.ones((4,), dtype="float32"),
+                            deadline_ms=10000)
+        final = {"probe": onp.asarray(out, dtype="float64")
+                 .ravel().tolist()}
+    finally:
+        router.close()
+
+    import threading
+
+    telemetry.close()
+    stray = [t.name for t in threading.enumerate()
+             if t.is_alive() and not t.daemon
+             and t is not threading.main_thread()]
+    if problems:
+        print("chaos-worker(swap): " + "; ".join(problems),
+              file=sys.stderr, flush=True)
+        return 1
+    print(json.dumps({"final": final, "threads_ok": not stray,
+                      "stray_threads": stray, "attempt": attempt}),
+          flush=True)
+    return 0
+
+
 def _worker(args):
     """One training run (the supervised command): attempt 0 arms the
     scenario's faults and may die; relaunch attempts scrub the faults
@@ -385,6 +566,10 @@ def _worker(args):
         return _worker_zero3(args, attempt)
     if args.ctx == "generate":
         return _worker_generate(args, attempt)
+    if args.ctx == "online":
+        return _worker_online(args, attempt)
+    if args.ctx == "online_swap":
+        return _worker_swap(args, attempt)
 
     import numpy as onp
 
@@ -581,6 +766,19 @@ def _schedule(seed, runs, scenarios):
             start = rng.randint(1, 3)
             entry["fault_spec"] = \
                 f"serve.decode:raise@{start}-{start + 1}"
+        elif scen == "trainer_death_midstream":
+            # the online worker exports every 4 of 12 steps: a crash
+            # in hits 5..11 always lands AFTER the first publish and
+            # BEFORE the final export
+            entry["fault_spec"] = \
+                f"online.step:crash@{rng.randint(5, 11)}"
+        elif scen == "swap_rollback":
+            # armed in ONE replica's env; hit 1 is its post-swap warm
+            # probe and the server retries FaultInjected 3x per
+            # batch, so the window must span all 3 attempts — hits
+            # past it stay clean for the retried swap
+            entry["fault_spec"] = \
+                f"serve.model:raise@1-{rng.randint(3, 4)}"
         plan.append(entry)
     return plan
 
@@ -640,6 +838,10 @@ def _ctx_for(entry):
         return "zero3"  # reference: same loop, no ghost, no faults
     if entry["scenario"] == "decode_fault":
         return "generate"  # reference: same campaign, no faults
+    if entry["scenario"] == "trainer_death_midstream":
+        return "online"  # reference: same stream, no crash
+    if entry["scenario"] == "swap_rollback":
+        return "online_swap"  # reference: clean first-try swap
     return "cpu"
 
 
@@ -780,8 +982,8 @@ def campaign(args):
         # checkpoint before the heal_exit
         relaunched = os.path.exists(f"{prefix}.runlog.a1.jsonl")
         if scen in ("peer_death", "zero3_peer_death",
-                    "ckpt_async_crash",
-                    "ckpt_write_crash") and not relaunched:
+                    "ckpt_async_crash", "ckpt_write_crash",
+                    "trainer_death_midstream") and not relaunched:
             problems.append(
                 "scenario guarantees a death but no relaunch run log "
                 "exists — the fault never fired")
@@ -820,7 +1022,8 @@ def campaign(args):
         if "kill_delay_s" in entry:
             fault_landed = kill_result["delivered"] or relaunched
         elif scen in ("peer_death", "zero3_peer_death",
-                      "ckpt_async_crash", "ckpt_write_crash"):
+                      "ckpt_async_crash", "ckpt_write_crash",
+                      "trainer_death_midstream"):
             fault_landed = relaunched
         elif scen in ("record_corrupt", "io_worker_kill"):
             # data-plane evidence: the victim's run_end counters must
@@ -849,6 +1052,26 @@ def campaign(args):
                     "record_corrupt: expected exactly 3 quarantined "
                     f"records, counters say "
                     f"{counters.get('data_records_skipped')}")
+        elif scen == "swap_rollback":
+            # the rollout runs IN the victim process: its run_end
+            # counters must show the aborted+rolled-back swap
+            counters = {}
+            try:
+                with open(f"{prefix}.runlog.a0.jsonl") as f:
+                    ends = [json.loads(ln) for ln in f
+                            if '"type": "run_end"' in ln
+                            or '"type":"run_end"' in ln]
+                if ends:
+                    counters = ends[-1].get("counters", {})
+            except OSError:
+                pass
+            fault_landed = \
+                counters.get("fleet_swap_rollbacks", 0) >= 1
+            if not fault_landed:
+                problems.append(
+                    "swap_rollback: run_end counter "
+                    "fleet_swap_rollbacks shows zero — the probe "
+                    "fault never forced a rollback")
         else:  # delay scenarios: the armed spec's hits are in the log
             try:
                 with open(f"{prefix}.runlog.a0.jsonl") as f:
