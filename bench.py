@@ -85,14 +85,17 @@ the round its headline artifact):
   freshness distribution vs ``MXNET_FRESHNESS_SLO_MS``:
   swaps/shed/rollbacks, the served-version monotonicity verdict and
   p50/p99 land under ``"freshness"`` in the JSON;
-* the ``quantization`` INFERENCE phase (round 18) runs the int8
-  pipeline end to end — entropy calibration of a trained net,
-  ``quantization.quantize_net`` rewrite, the quantized_conv/
-  quantized_fc adoption race (winners persisted in autotune.json),
-  fp32 AND force-pinned int8 ``.mxje`` exports, both served AOT —
-  reporting top-1 agreement (accuracy delta vs the fp32 arm),
+* the ``quantization`` INFERENCE phase (round 18; fp8 arm round 19)
+  runs the quantized pipeline end to end — entropy calibration of a
+  trained net, ``quantization.quantize_net`` rewrite, the
+  quantized_conv/quantized_fc adoption race (three arms since round
+  19; winners persisted in autotune.json), fp32 AND force-pinned int8
+  AND force-pinned fp8 ``.mxje`` exports, all served AOT — reporting
+  top-1 agreement per quantized arm (accuracy delta vs the fp32 arm),
   p50/p99/throughput per arm and the race verdicts under
-  ``"quantization"`` in the JSON;
+  ``"quantization"`` in the JSON; the main step's dtype-ladder race
+  carries the fp8 rung (roster ``fp32,bf16,fp8``) and its verdict is
+  lifted into the ``"dtype_ladder"`` sub-report;
 
 HARNESS PROTOCOL (round 11 — stall-proofing; r05's stall sat inside an
 uninterruptible XLA call where none of the above could run):
@@ -912,7 +915,9 @@ def _measure_quantization(smoke, deadline):
     fp32), and both ``.mxje`` artifacts served AOT through
     ``ModelServer.from_artifact``.  Reports top-1 agreement (the
     accuracy delta vs the fp32 arm) plus p50/p99/throughput per arm
-    into the headline JSON."""
+    into the headline JSON.  Round 19 adds the fp8 arm alongside:
+    force-pinned fp8 export, its own agreement_top1_fp8 (held to the
+    same ≥0.99 benchdiff floor as int8) and served metrics."""
     import shutil
     import tempfile
 
@@ -983,30 +988,37 @@ def _measure_quantization(smoke, deadline):
     try:
         p_int8 = os.path.join(tmpdir, "int8.mxje")
         p_fp32 = os.path.join(tmpdir, "fp32.mxje")
+        p_fp8 = os.path.join(tmpdir, "fp8.mxje")
         # honest arms: the int8 export force-pins every quantized
-        # wrapper on, the fp32 export force-pins them all off — the
-        # RACE report (above) is where per-op adoption lives
+        # wrapper on, the fp8 export pins the fp8 program, the fp32
+        # export force-pins them all off — the RACE report (above) is
+        # where per-op adoption lives
+        plats = ("cpu",) if smoke else ("cpu", "tpu")
         with autotune.force(quantized_conv=True, quantized_fc=True):
             deploy.export_model(qnet, corpus[0], p_int8,
-                                platforms=("cpu",) if smoke
-                                else ("cpu", "tpu"))
+                                platforms=plats)
         with autotune.force(quantized_conv=False, quantized_fc=False):
             deploy.export_model(qnet, corpus[0], p_fp32,
-                                platforms=("cpu",) if smoke
-                                else ("cpu", "tpu"))
+                                platforms=plats)
+        with autotune.force(quantized_conv="fp8", quantized_fc="fp8"):
+            deploy.export_model(qnet, corpus[0], p_fp8,
+                                platforms=plats)
         info = deploy.artifact_info(p_int8)
+        info_fp8 = deploy.artifact_info(p_fp8)
 
-        # accuracy delta: top-1 agreement of the int8 program vs the
-        # fp32 arm over the calibration corpus
+        # accuracy delta: top-1 agreement of the int8 and fp8 programs
+        # vs the fp32 arm over the calibration corpus
         f_int8 = deploy.load_model(p_int8)
         f_fp32 = deploy.load_model(p_fp32)
-        agree = n_total = 0
+        f_fp8 = deploy.load_model(p_fp8)
+        agree = agree_fp8 = n_total = 0
         for xb in corpus:
-            a = f_int8(xb).asnumpy().argmax(1)
             b = f_fp32(xb).asnumpy().argmax(1)
-            agree += int((a == b).sum())
-            n_total += len(a)
+            agree += int((f_int8(xb).asnumpy().argmax(1) == b).sum())
+            agree_fp8 += int((f_fp8(xb).asnumpy().argmax(1) == b).sum())
+            n_total += len(b)
         agreement = agree / max(n_total, 1)
+        agreement_fp8 = agree_fp8 / max(n_total, 1)
 
         def serve_arm(path):
             srv = ModelServer.from_artifact(
@@ -1044,13 +1056,22 @@ def _measure_quantization(smoke, deadline):
 
         int8_arm = serve_arm(p_int8)
         if deadline.exceeded():
+            deadline.note("quantization:fp8_arm")
+            fp8_arm = None
+        else:
+            fp8_arm = serve_arm(p_fp8)
+        if deadline.exceeded():
             deadline.note("quantization:fp32_arm")
             fp32_arm = None
         else:
             fp32_arm = serve_arm(p_fp32)
-        speedup = None
+        speedup = speedup_fp8 = None
         if fp32_arm and int8_arm["p50_ms"] and fp32_arm["p50_ms"]:
             speedup = round(fp32_arm["p50_ms"] / int8_arm["p50_ms"], 3)
+        if fp32_arm and fp8_arm and fp8_arm["p50_ms"] \
+                and fp32_arm["p50_ms"]:
+            speedup_fp8 = round(
+                fp32_arm["p50_ms"] / fp8_arm["p50_ms"], 3)
         return {
             "calib_mode": calib.mode,
             "calib_batches": calib.num_batches,
@@ -1060,14 +1081,20 @@ def _measure_quantization(smoke, deadline):
             "train_steps": train_steps,
             "agreement_top1": round(agreement, 4),
             "accuracy_delta": round(1.0 - agreement, 4),
+            "agreement_top1_fp8": round(agreement_fp8, 4),
+            "accuracy_delta_fp8": round(1.0 - agreement_fp8, 4),
             "autotune": {op: {"winner": r["winner"],
                               "cached": bool(r.get("cached"))}
                          for op, r in race.items()},
             "artifact": {"quantized": info["quantized"],
                          "param_dtypes": info["param_dtypes"]},
+            "artifact_fp8": {"quantized": info_fp8["quantized"],
+                             "param_dtypes": info_fp8["param_dtypes"]},
             "int8": int8_arm,
+            "fp8": fp8_arm,
             "fp32": fp32_arm,
             "speedup_p50": speedup,
+            "speedup_p50_fp8": speedup_fp8,
         }
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
@@ -1933,12 +1960,13 @@ def main(argv=None):
                     "device_init")
     _write_partial(out, "device_init")
 
-    # the bf16 dtype-ladder arm (round 14) races in the main step's
-    # autotune when no explicit compute_dtype pins the answer (smoke
-    # runs fp32 nets; full mode pins bfloat16, so the ladder race is
-    # a smoke/registry proof there).  Opt-in by knob; respect a
-    # caller's explicit setting.
-    os.environ.setdefault("MXNET_DTYPE_LADDER", "1")
+    # the dtype-ladder arms (bf16 round 14, fp8 round 19) race in the
+    # main step's autotune when no explicit compute_dtype pins the
+    # answer (smoke runs fp32 nets; full mode pins bfloat16, so the
+    # ladder race is a smoke/registry proof there).  Opt-in by knob;
+    # the bench names the full three-rung roster — fp8 never joins a
+    # roster implicitly — but respects a caller's explicit setting.
+    os.environ.setdefault("MXNET_DTYPE_LADDER", "fp32,bf16,fp8")
 
     _heartbeat("build")
     t_build0 = time.monotonic()
@@ -1956,6 +1984,16 @@ def main(argv=None):
 
     out["autotune"] = _at.last_report() if do_tune else {
         "skipped": "disabled" if args.no_autotune else "deadline"}
+    # dtype-ladder sub-report (round 19): which rungs raced and which
+    # won, lifted out of the autotune report so benchdiff can gate the
+    # fp8 arm's presence without digging through per-op entries
+    _lad = out["autotune"].get("dtype_ladder") \
+        if isinstance(out["autotune"], dict) else None
+    out["dtype_ladder"] = {
+        "rungs": list(_at.ladder_rungs()),
+        "winner": _lad.get("winner") if _lad else None,
+        "cached": bool(_lad.get("cached")) if _lad else None,
+    }
     if deadline.exceeded():
         return bail("deadline exceeded during model build", "build")
     _write_partial(out, "build")

@@ -255,6 +255,23 @@ quantize_smoke() {
     JAX_PLATFORMS=cpu python -m pytest tests/test_quantization.py -q
 }
 
+fp8_smoke() {
+    # fp8 end-to-end gate (round 19) on CPU in seconds: the delayed-
+    # scaling amax-history recurrence units (overflow halves the next
+    # scale, growth re-expands it), the e4m3/e5m2 qdq straight-through
+    # pair, the fp8 dtype-ladder rung — three-rung in-step race,
+    # pinned-fp8 training with loss parity vs bf16 over >=6 steps,
+    # scale backoff under injected overflow WITHOUT corrupting
+    # opt_state, unarmed builds HLO bit-identical to round 18 — plus
+    # the inference arm: fp8-pinned forward >=0.99 top-1 agreement vs
+    # fp32, fp8 .mxje export identified by float8_e4m3fn in the
+    # header's param_dtypes (no deserialization) and served AOT, and
+    # the amp-lists/ladder eligibility agreement.  Also collected by
+    # tier-1 (tests/test_fp8.py), so a regression turns the unit
+    # suite red between CI runs.
+    JAX_PLATFORMS=cpu python -m pytest tests/test_fp8.py -q
+}
+
 generate_smoke() {
     # generative decode serving gate (round 17) on CPU in seconds:
     # the paged KV pool's token-budget admission accounting (int8

@@ -49,7 +49,8 @@ import time
 __all__ = ["variant_choice", "force", "program_scope", "lookup",
            "record", "tune", "tune_train_step", "mesh_desc",
            "cache_path", "cache_clear", "last_report",
-           "dtype_ladder_armed", "chain_time", "VARIANT_OPS"]
+           "dtype_ladder_armed", "ladder_rungs", "chain_time",
+           "VARIANT_OPS", "op_variants"]
 
 #: op -> {variant name: forced value}.  The forced value is what the
 #: op's trace-time ``variant_choice`` consumer receives.
@@ -73,17 +74,23 @@ VARIANT_OPS = {
     # round 14: the bf16 dtype-ladder arm — make_train_step's compute
     # dtype raced fp32 vs bf16 (amp_cast_params) per program signature;
     # consulted only when the MXNET_DTYPE_LADDER knob arms it (a dtype
-    # change is not numerics-neutral, so adoption is opt-in)
-    "dtype_ladder": {"fp32": "fp32", "bf16": "bf16"},
+    # change is not numerics-neutral, so adoption is opt-in).
+    # round 19 adds the fp8 rung (e4m3 fwd / e5m2 grad with delayed
+    # per-tensor scaling, ops/pallas_opt.fp8_qdq) — raced only when
+    # the knob's roster names it (ladder_rungs), never implied by a
+    # bare MXNET_DTYPE_LADDER=1
+    "dtype_ladder": {"fp32": "fp32", "bf16": "bf16", "fp8": "fp8"},
     # round 18: the int8 quantized-inference arms — a rewritten net's
     # QuantizedConv/QuantizedDense wrappers consult these at trace
     # (mxnet_tpu.quantization.rewrite): True runs the calibrated int8
     # program, False the wrapped fp32 layer.  quantization.
     # tune_quantized races them inside a chained run of the real
     # inference forward, so int8 is adopted per (op, shape, platform)
-    # only where it measures a win.
-    "quantized_conv": {"fp32": False, "int8": True},
-    "quantized_fc": {"fp32": False, "int8": True},
+    # only where it measures a win.  round 19 adds the fp8 arm
+    # (e4m3 operands, f32 accumulation, calibrated amax scales) to
+    # the same per-op race.
+    "quantized_conv": {"fp32": False, "int8": True, "fp8": "fp8"},
+    "quantized_fc": {"fp32": False, "int8": True, "fp8": "fp8"},
     # round 17: decode-time attention over the PAGED kv cache
     # (ops/flash_attention.paged_decode_attention) — "gather"
     # materializes each slot's pages then runs one fused masked
@@ -110,13 +117,50 @@ def _parse_flash(raw):
     return None  # unknown value: no override
 
 
+#: MXNET_DTYPE_LADDER rung spellings -> canonical rung name
+_LADDER_TOKENS = {
+    "fp32": "fp32", "float32": "fp32",
+    "bf16": "bf16", "bfloat16": "bf16",
+    "fp8": "fp8", "float8": "fp8", "e4m3": "fp8",
+}
+
+
 def _parse_ladder(raw):
     lowered = raw.lower()
+    if "," in lowered:
+        return None  # a roster ("fp32,bf16,fp8"): armed, race decides
     if lowered in ("bf16", "bfloat16"):
         return "bf16"
+    if lowered in ("fp8", "float8", "e4m3"):
+        return "fp8"
     if lowered in ("0", "off", "fp32", "float32"):
         return "fp32"
     return None  # "1"/"auto": armed, but no hand override
+
+
+def ladder_rungs():
+    """The dtype-ladder rungs this process may race/apply, parsed from
+    MXNET_DTYPE_LADDER: a comma roster ("fp32,bf16,fp8") names them
+    explicitly, a single rung pins it (and is the only rung), and the
+    legacy arming values ("1"/"auto"/...) keep the round-14 pair —
+    fp8 NEVER joins implicitly, because its delayed-scaling state must
+    be provisioned in opt_state at build time and its numerics are a
+    bigger departure than bf16's.  () when the ladder is unarmed."""
+    raw = os.environ.get("MXNET_DTYPE_LADDER")
+    if raw is None or not dtype_ladder_armed():
+        return ()
+    lowered = raw.lower()
+    if "," in lowered:
+        out = []
+        for tok in lowered.split(","):
+            rung = _LADDER_TOKENS.get(tok.strip())
+            if rung is not None and rung not in out:
+                out.append(rung)
+        return tuple(out)
+    single = _LADDER_TOKENS.get(lowered)
+    if single is not None:
+        return (single,)
+    return ("fp32", "bf16")  # "1"/"auto": the round-14 race pair
 
 
 def _parse_bnreluconv(raw):
@@ -138,13 +182,16 @@ def _parse_paged(raw):
 
 def _parse_quantize(raw):
     """MXNET_QUANTIZE: 0/off/fp32 pins the fp32 fallback arm,
-    1/on/int8 pins the int8 program; anything else (e.g. 'auto')
-    carries no override — the measured winner decides."""
+    1/on/int8 pins the int8 program, fp8 pins the fp8 program
+    (round 19); anything else (e.g. 'auto') carries no override —
+    the measured winner decides."""
     lowered = raw.lower()
     if lowered in ("0", "false", "no", "off", "fp32", "float32"):
         return False
     if lowered in ("1", "true", "yes", "on", "int8"):
         return True
+    if lowered in ("fp8", "float8", "e4m3"):
+        return "fp8"
     return None
 
 
@@ -238,7 +285,11 @@ def program_scope(shape, dtype, platform=None, mesh=None):
     entries = _load(cache_path())  # one stat/load for all variant ops
     choices = {}
     if entries:
-        for op, variants in VARIANT_OPS.items():
+        for op in VARIANT_OPS:
+            # op_variants narrows the ladder to the armed rungs: a
+            # cached fp8 winner never applies to a program whose
+            # roster (and opt_state provisioning) did not opt into it
+            variants = op_variants(op)
             entry = entries.get(_key(op, shape, dtype, platform, mesh))
             winner = entry.get("winner") if entry else None
             if winner is not None and winner in variants:
@@ -405,6 +456,21 @@ def last_report():
     return dict(_last_report)
 
 
+def op_variants(op):
+    """The variant roster ``op`` actually races: VARIANT_OPS[op], with
+    the dtype ladder narrowed to the rungs MXNET_DTYPE_LADDER names
+    (a "fp32,bf16" roster must not spend a compile measuring an fp8
+    arm the caller did not opt into; a cached winner outside the
+    roster is ignored by the same rule and simply re-races)."""
+    variants = VARIANT_OPS[op]
+    if op == "dtype_ladder":
+        rungs = ladder_rungs()
+        narrowed = {k: v for k, v in variants.items() if k in rungs}
+        if narrowed:
+            return narrowed
+    return variants
+
+
 # ------------------------------------------------------------- the tuner
 def chain_time(fn, init, iters=8):
     """Marginal sec/iteration of ``fn(carry, i) -> carry`` measured
@@ -517,7 +583,7 @@ def tune_train_step(step, params, opt_state, x, y, key,
     report = {}
     decided = {}  # earlier winners pinned while later ops race
     for op in variant_ops:
-        variants = VARIANT_OPS[op]
+        variants = op_variants(op)
 
         def measure(_value, _decided=dict(decided)):
             with force(**_decided):

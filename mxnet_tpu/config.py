@@ -185,12 +185,24 @@ register_env("MXNET_FLASH_ATTENTION", "", str,
              "+ masked keys).  Unset: cached winner, then the "
              "TPU+tiling heuristic.")
 register_env("MXNET_DTYPE_LADDER", "", str,
-             "The bf16 dtype-ladder knob (round 14).  Unset/0: the "
-             "ladder never races or applies (a dtype change is not "
-             "numerics-neutral, so it is opt-in).  1/auto: "
-             "make_train_step races fp32 vs bf16 compute in-step "
-             "(compute_dtype=None steps only) and applies the cached "
-             "per-program winner.  bf16/fp32: hand-pin the arm.")
+             "The dtype-ladder knob (round 14; fp8 rung round 19). "
+             "Unset/0: the ladder never races or applies (a dtype "
+             "change is not numerics-neutral, so it is opt-in).  "
+             "1/auto: make_train_step races fp32 vs bf16 compute "
+             "in-step (compute_dtype=None steps only) and applies the "
+             "cached per-program winner.  A comma roster like "
+             "'fp32,bf16,fp8' races exactly those rungs — fp8 (e4m3 "
+             "fwd / e5m2 grad, delayed per-tensor scaling in "
+             "opt_state) only ever joins by being named.  "
+             "bf16/fp32/fp8: hand-pin the arm.")
+register_env("MXNET_FP8_AMAX_HISTORY", 16, int,
+             "Length of the rolling amax history behind the fp8 "
+             "rung's delayed scaling (round 19): each quantized "
+             "tensor class (input / weights / grads) carries this "
+             "many steps of observed |t|_inf in opt_state['_fp8'], "
+             "and the next step's scale is fp8_max / (2 * max "
+             "(history)) — in-graph, no host sync "
+             "(ops/pallas_opt.fp8_delayed_scale).")
 register_env("MXNET_BNRELUCONV_VARIANT", "", str,
              "Hand override for the 'pallas_bnreluconv' autotune "
              "variant: stock (unfused layer path), jnp (fused op, jnp "
@@ -403,12 +415,14 @@ register_env("MXNET_FLEET_HBM_BUDGET_MB", 0.0, float,
              "structured ServeRejected(reason='hbm_budget').  "
              "0 = unlimited.")
 register_env("MXNET_QUANTIZE", "", str,
-             "Hand override of the int8 quantized-inference adoption "
+             "Hand override of the quantized-inference adoption "
              "race (mxnet_tpu.quantization; autotune variant ops "
              "quantized_conv/quantized_fc): 0/off/fp32 pins every "
              "rewritten layer to its fp32 fallback arm, 1/on/int8 "
-             "pins the int8 program.  Unset/auto: the in-step race's "
-             "persisted winner decides per (op, shape, platform).")
+             "pins the int8 program, fp8 pins the fp8 program "
+             "(e4m3 operands, f32 accumulation — round 19).  "
+             "Unset/auto: the in-step race's persisted winner "
+             "decides per (op, shape, platform).")
 register_env("MXNET_QUANT_CALIB_MODE", "naive", str,
              "Default calibration mode of quantization.calibrate: "
              "'naive' (running min/max per observed tensor) or "

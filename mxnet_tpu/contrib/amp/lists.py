@@ -40,6 +40,20 @@ WIDEST_TYPE_CASTS = [
     "power", "Concat", "concat", "stack", "add_n", "where",
 ]
 
+# fp8-eligible ops (round 19): ONLY the MXU matmul/conv family — the
+# same eligibility rule the dtype ladder's fp8 rung applies in
+# make_train_step (weights of ndim >= 2 feeding matmul/conv get the
+# e4m3 qdq; norms, softmax and reductions never drop below bf16, so
+# every FP32_OPS entry stays out by construction).  A strict subset of
+# TARGET_DTYPE_OPS: RNN gates and the linalg kernels carry recurrences
+# / long accumulation chains that e4m3's ~2 significant digits cannot
+# hold, so they cap at the bf16 rung.
+FP8_OPS = [
+    "Convolution", "Convolution_v1", "Deconvolution", "FullyConnected",
+    "dot", "batch_dot", "_npi_matmul",
+]
+
 # reference-compat aliases
 FP16_FUNCS = TARGET_DTYPE_OPS
 FP32_FUNCS = FP32_OPS
+FP8_FUNCS = FP8_OPS

@@ -24,9 +24,9 @@ treating the whole file as the payload).
 Artifact metadata (round 18): the v2 frame carries a small JSON
 metadata segment between the header and the payload — input signature,
 ``quantized`` flag and ``param_dtypes`` histogram — so operators and
-the fleet admission path can tell an int8 artifact from fp32 by
-reading a few hundred header bytes, WITHOUT deserializing the
-StableHLO program.  v1 and headerless artifacts keep loading; their
+the fleet admission path can tell an int8 (or, round 19, fp8) artifact
+from fp32 by reading a few hundred header bytes, WITHOUT deserializing
+the StableHLO program.  v1 and headerless artifacts keep loading; their
 ``artifact_info`` falls back to deserialization (with the new fields
 None).
 """
@@ -64,9 +64,11 @@ def _functional_forward(net):
 
 def _net_meta(net, x, platforms):
     """The v2 header metadata of an export: input signature,
-    ``quantized`` (does the program run int8 quantized layers) and a
-    ``param_dtypes`` histogram of the weights the program actually
-    bakes.  Must be computed under the same autotune program scope as
+    ``quantized`` (does the program run int8 or fp8 quantized layers)
+    and a ``param_dtypes`` histogram of the weights the program
+    actually bakes — an fp8 artifact is identified by
+    ``float8_e4m3fn`` entries in that histogram, again without any
+    deserialization.  Must be computed under the same autotune program scope as
     the export trace: a wrapper whose adoption race picked fp32 bakes
     its fp32 original, and the header must say so — the identity
     describes the PROGRAM, not the net's potential."""
@@ -84,7 +86,7 @@ def _net_meta(net, x, platforms):
         if getattr(block, "_mxnet_quantized", False):
             if block.variant_op is None:
                 return  # pooling/flatten pass-through: no weights
-            if block._use_int8():
+            if block._arm() != "fp32":  # int8 OR fp8 (round 19)
                 quantized = True
                 q_layers += 1
                 for dt in block.export_dtypes():

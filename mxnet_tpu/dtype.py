@@ -21,27 +21,65 @@ TYPE_FLAG_TO_NP = {
 }
 NP_TO_TYPE_FLAG = {v: k for k, v in TYPE_FLAG_TO_NP.items()}
 # bfloat16 has no reference flag; saved as float32 on disk.
+# float8_e4m3fn / float8_e5m2 (round 19) likewise: no mshadow flag,
+# saved as float32 on disk (ndarray._save_one's not-in-NP_TO_TYPE_FLAG
+# widening), full-precision in-memory via ml_dtypes.
 
 _STR_ALIASES = {
     "float": "float32",
     "double": "float64",
     "half": "float16",
     "bfloat16": "bfloat16",
+    # fp8 spellings; bare "fp8"/"float8" means the forward/weight
+    # format e4m3 (e5m2 is the gradient format and is always named)
+    "fp8": "float8_e4m3fn",
+    "float8": "float8_e4m3fn",
+    "e4m3": "float8_e4m3fn",
+    "fp8_e4m3": "float8_e4m3fn",
+    "float8_e4m3": "float8_e4m3fn",
+    "e5m2": "float8_e5m2",
+    "fp8_e5m2": "float8_e5m2",
 }
+
+_FLOAT8_NAMES = ("float8_e4m3fn", "float8_e5m2")
+
+
+def float8_supported() -> bool:
+    """True when this jax/ml_dtypes build carries the float8 types."""
+    return hasattr(jnp, "float8_e4m3fn") and hasattr(jnp, "float8_e5m2")
+
+
+def _float8(name):
+    """The jnp float8 scalar type, or a loud MXNetError — never a
+    silent fp32 fallback — when this build lacks ml_dtypes float8."""
+    if not float8_supported():
+        from .base import MXNetError
+
+        raise MXNetError(
+            f"dtype {name!r} requires ml_dtypes float8 support, which "
+            f"this jax build does not provide; install a jax/ml_dtypes "
+            f"with float8_e4m3fn/float8_e5m2 or use bfloat16")
+    return getattr(jnp, name)
 
 
 def normalize_dtype(dtype, default="float32"):
     """Accept str / numpy dtype / jnp dtype / None -> canonical numpy dtype
-    object (bfloat16 handled via jnp)."""
+    object (bfloat16/float8 handled via jnp)."""
     if dtype is None:
         dtype = default
     if isinstance(dtype, str):
         dtype = _STR_ALIASES.get(dtype, dtype)
     if dtype in ("bfloat16", jnp.bfloat16):
         return jnp.bfloat16
+    for name in _FLOAT8_NAMES:
+        if dtype == name or (float8_supported()
+                             and dtype == getattr(jnp, name)):
+            return _float8(name)
     return onp.dtype(dtype)
 
 
 def dtype_name(dtype) -> str:
     d = normalize_dtype(dtype)
+    # bfloat16/float8 are jnp scalar types; numpy names them correctly
+    # via the ml_dtypes dtype registration
     return "bfloat16" if d == jnp.bfloat16 else onp.dtype(d).name

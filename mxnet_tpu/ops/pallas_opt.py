@@ -459,6 +459,99 @@ def _lars_bucket(opt, w, g, state, seg, axis_name, rescale, clip,
     return (new_w2.reshape(-1)[:n], (new_m2.reshape(-1)[:n],), nf)
 
 
+# ---------------------------------------------- scale-verdict machinery
+# The loss-scale bookkeeping and the fp8 delayed-scaling bookkeeping
+# live SIDE BY SIDE here on purpose (round 19): both consume the same
+# kind of in-graph finiteness/amax evidence the fused kernels above
+# surface (``with_finite``), and both answer "what scale does the NEXT
+# step use" — keeping the two verdict rules in one module is what
+# stops dynamic loss scaling and fp8 tensor scaling from drifting
+# apart (same backoff shape, same floor discipline).
+
+#: largest finite value of each fp8 format (ml_dtypes): e4m3fn is the
+#: forward/weight format, e5m2 the gradient format (reference: the
+#: FP8 training recipe every MXU-class stack converged on)
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+
+
+def scale_bookkeeping(finite, scale, good, growth_interval=2000):
+    """Dynamic-loss-scale update shared by make_train_step's replicated
+    and sharded arms — ONE copy, because the two must stay
+    bit-identical for the sharded-vs-replicated parity contract:
+    overflow halves the scale (floor 1.0); ``growth_interval``
+    consecutive finite steps double it and reset the counter
+    (reference amp scaler, contrib/amp loss_scaler.py)."""
+    good = jnp.where(finite, good + 1, 0)
+    new_scale = jnp.where(
+        finite,
+        jnp.where(good >= growth_interval, scale * 2.0, scale),
+        jnp.maximum(scale * 0.5, 1.0))
+    good = jnp.where(good >= growth_interval, 0, good)
+    return new_scale.astype(jnp.float32), good
+
+
+def fp8_delayed_scale(hist, new_amax, fmax=E4M3_MAX, margin=2.0):
+    """One in-graph step of the fp8 delayed-scaling recipe: roll
+    ``new_amax`` (this step's observed |t|_inf) into the rolling amax
+    history and derive the scale the NEXT step quantizes with —
+    ``fmax / (margin * max(history))`` — so the scale always lags the
+    observation by one step (no data dependency of a step on its own
+    amax, no host sync).
+
+    Overflow verdict, same shape as :func:`scale_bookkeeping`'s
+    halving: a non-finite observed amax (an overflowed/poisoned cast)
+    enters the history as DOUBLE the previous rolling max — the next
+    scale backs off by half — instead of poisoning the history with
+    inf/nan.  Returns ``(new_hist, next_scale)``, both float32."""
+    hist = hist.astype(jnp.float32)
+    new_amax = jnp.asarray(new_amax, jnp.float32)
+    finite = jnp.isfinite(new_amax)
+    prev = jnp.max(hist)
+    safe = jnp.where(finite, new_amax, jnp.maximum(prev, 1.0) * 2.0)
+    new_hist = jnp.concatenate([hist[1:], safe[None]])
+    amax = jnp.maximum(jnp.max(new_hist), 1e-12)
+    next_scale = (fmax / (margin * amax)).astype(jnp.float32)
+    return new_hist, next_scale
+
+
+def _fp8_qdq_cast(v, scale, fmax, f8):
+    """Quantize-dequantize through an fp8 grid: the values take the
+    fp8 representable set (clip to ±fmax first — an out-of-range e4m3
+    cast lands on NaN, and range excursions are the delayed scale's
+    job to absorb, not the matmul's), the dtype returns to the input's
+    so the surrounding program is unchanged."""
+    wide = v.astype(jnp.float32) * scale
+    q = jnp.clip(wide, -fmax, fmax).astype(f8)
+    return (q.astype(jnp.float32) / scale).astype(v.dtype)
+
+
+@jax.custom_vjp
+def fp8_qdq(v, scale, gscale):
+    """The dtype ladder's fp8 rung primitive: forward snaps ``v`` to
+    the ``float8_e4m3fn`` grid at ``scale`` (activations/weights), the
+    backward snaps the incoming gradient to the ``float8_e5m2`` grid
+    at ``gscale`` (the wider-exponent gradient format) — a
+    straight-through estimator in both directions, so matmul/conv see
+    exactly fp8-valued operands while norms/softmax/reductions around
+    them stay in the wide dtype.  Scales are traced scalars read from
+    ``opt_state['_fp8']`` (delayed scaling, :func:`fp8_delayed_scale`);
+    neither receives a gradient."""
+    return _fp8_qdq_cast(v, scale, E4M3_MAX, jnp.float8_e4m3fn)
+
+
+def _fp8_qdq_fwd(v, scale, gscale):
+    return fp8_qdq(v, scale, gscale), gscale
+
+
+def _fp8_qdq_bwd(gscale, g):
+    gv = _fp8_qdq_cast(g, gscale, E5M2_MAX, jnp.float8_e5m2)
+    return gv, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)
+
+
+fp8_qdq.defvjp(_fp8_qdq_fwd, _fp8_qdq_bwd)
+
+
 # ----------------------------------------------------- opperf registry ops
 from .registry import register_op  # noqa: E402
 

@@ -1,10 +1,13 @@
-"""INT8 quantization operators.
+"""INT8 + FP8 quantization operators.
 
 Reference parity: src/operator/quantization/ (6,057 LoC — quantize.cc,
 quantize_v2.cc, dequantize.cc, requantize.cc, quantized_conv/fc/pooling/
 flatten).  TPU-native: int8 matmul/conv accumulate in int32 on the MXU
 via ``preferred_element_type`` — the same int8→int32 contract the
-reference gets from cuDNN/MKLDNN int8 kernels.
+reference gets from cuDNN/MKLDNN int8 kernels.  Round 19 adds the fp8
+family (``_contrib_quantize_fp8`` / ``_contrib_fp8_fully_connected`` /
+``_contrib_fp8_conv``): e4m3 operands accumulating f32, real-domain
+f32 output — no requantize stage, since fp8 needs only an amax.
 """
 from __future__ import annotations
 
@@ -150,6 +153,79 @@ def quantized_conv(data, weight, bias, data_min, data_max, weight_min,
         acc = acc + b_q.reshape((1, -1) + (1,) * nd_)
     omax = out_scale * jnp.float32(2 ** 31 - 1)
     return acc, (-omax).reshape(1), omax.reshape(1)
+
+
+# ----- fp8 (round 19): e4m3 operands, f32 accumulation -----------------
+# The fp8 inference arm mirrors the int8 shape — per-tensor symmetric
+# scaling off a calibrated range — but needs only ONE statistic (amax)
+# and NO requantize: the matmul/conv accumulates f32 on the MXU
+# (preferred_element_type) and the output stays real-domain f32, so the
+# q-triple stitching machinery never engages for fp8.
+_FP8_MAX = 448.0  # e4m3fn finite max (the format has no inf)
+
+
+@register_op("_contrib_quantize_fp8", num_outputs=2, differentiable=False)
+def quantize_fp8(data, *, min_calib_range=None, max_calib_range=None):
+    """float -> (e4m3, amax(1,)).  Mirrors quantize_v2's calibrated /
+    on-the-fly range convention; symmetric amax scaling.  Values are
+    clipped to ±448 BEFORE the cast — e4m3fn overflows to NaN, not inf,
+    so an unclipped range excursion would poison the accumulator."""
+    if min_calib_range is not None and max_calib_range is not None:
+        mn = jnp.float32(min_calib_range)
+        mx = jnp.float32(max_calib_range)
+    else:
+        mn = data.min().astype(jnp.float32)
+        mx = data.max().astype(jnp.float32)
+    amax = jnp.maximum(jnp.maximum(jnp.abs(mn), jnp.abs(mx)), 1e-12)
+    q = jnp.clip(data.astype(jnp.float32) * (_FP8_MAX / amax),
+                 -_FP8_MAX, _FP8_MAX).astype(jnp.float8_e4m3fn)
+    return q, amax.reshape(1)
+
+
+@register_op("_contrib_fp8_fully_connected", differentiable=False)
+def fp8_fully_connected(data, weight, bias, data_amax, weight_amax, *,
+                        num_hidden, no_bias=False, flatten=True):
+    """fp8 FC: e4m3 x e4m3 -> f32 accumulation (MXU native via
+    preferred_element_type); the descale (d_amax/448)*(w_amax/448)
+    recovers the real domain, bias is added there in f32.  Output is
+    plain f32 — no quantized triple."""
+    d = data.reshape(data.shape[0], -1) if flatten else data
+    acc = lax.dot_general(
+        d.astype(jnp.float8_e4m3fn), weight.astype(jnp.float8_e4m3fn),
+        (((d.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    out = acc * ((data_amax.reshape(()) / _FP8_MAX)
+                 * (weight_amax.reshape(()) / _FP8_MAX))
+    if not no_bias:
+        out = out + bias.astype(jnp.float32)
+    return out
+
+
+@register_op("_contrib_fp8_conv", differentiable=False)
+def fp8_conv(data, weight, bias, data_amax, weight_amax, *, kernel,
+             num_filter, stride=None, pad=None, dilate=None, num_group=1,
+             no_bias=False, layout=None):
+    """fp8 convolution: e4m3 operands, f32 accumulation, real-domain
+    f32 output (same contract as :func:`fp8_fully_connected`)."""
+    nd_ = len(kernel)
+    stride = tuple(stride) if stride else (1,) * nd_
+    pad = tuple(pad) if pad else (0,) * nd_
+    dilate = tuple(dilate) if dilate else (1,) * nd_
+    dn = lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if nd_ == 2 else ("NCW", "OIW", "NCW"))
+    acc = lax.conv_general_dilated(
+        data.astype(jnp.float8_e4m3fn), weight.astype(jnp.float8_e4m3fn),
+        window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32)
+    out = acc * ((data_amax.reshape(()) / _FP8_MAX)
+                 * (weight_amax.reshape(()) / _FP8_MAX))
+    if not no_bias:
+        out = out + bias.astype(jnp.float32).reshape(
+            (1, -1) + (1,) * nd_)
+    return out
 
 
 @register_op("_contrib_quantized_pooling", num_outputs=3,

@@ -315,29 +315,53 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
                            beta2, epsilon, opt_kwargs)
 
     if variant_ops is None:
-        # default race roster: the conv 1x1 lowering always; the bf16
+        # default race roster: the conv 1x1 lowering always; the
         # dtype ladder joins only when the knob arms it, no explicit
         # compute_dtype pins the answer, AND the env carries no hand
-        # override (MXNET_DTYPE_LADDER=bf16/fp32 already decided —
-        # racing a bf16 step to discard the result would waste a
-        # compile per signature)
+        # override (MXNET_DTYPE_LADDER=bf16/fp8/fp32 already decided —
+        # racing a pinned step to discard the result would waste a
+        # compile per signature).  Which rungs race — fp32/bf16, or
+        # fp8 too — is the knob's roster (autotune.ladder_rungs).
         variant_ops = ("conv1x1_dot",)
         if (compute_dtype is None and _at.dtype_ladder_armed()
                 and _at.variant_choice("dtype_ladder") is None):
             variant_ops += ("dtype_ladder",)
 
-    def loss_of(param_dict, x, y, key):
+    def _ladder_arm():
+        """The dtype-ladder decision for THIS trace (None = ladder not
+        consulted): an explicit compute_dtype always wins; otherwise a
+        tuner force scope, the MXNET_DTYPE_LADDER hand override, or
+        the cached per-program winner applied via program_scope."""
+        if compute_dtype is not None or not _at.dtype_ladder_armed():
+            return None
+        return _at.variant_choice("dtype_ladder")
+
+    def loss_of(param_dict, x, y, key, fp8=None):
         cdt = compute_dtype
-        if cdt is None and _at.dtype_ladder_armed():
-            # the bf16 dtype-ladder arm (round 14): an explicitly
-            # requested compute_dtype always wins; otherwise the
-            # "dtype_ladder" variant decision — a tuner force scope,
-            # MXNET_DTYPE_LADDER=bf16/fp32, or the cached per-program
-            # winner applied at trace via program_scope — picks the
-            # arm.  Consulted at TRACE time only, and only when the
-            # knob arms it (a dtype change is not numerics-neutral).
-            if _at.variant_choice("dtype_ladder") == "bf16":
-                cdt = "bfloat16"
+        arm = _ladder_arm()
+        if arm == "bf16":
+            # the bf16 dtype-ladder arm (round 14).  Consulted at
+            # TRACE time only, and only when the knob arms it (a
+            # dtype change is not numerics-neutral).
+            cdt = "bfloat16"
+        if arm == "fp8" and fp8 is not None:
+            # the fp8 rung (round 19): matmul/conv weights and the
+            # batch input snap to the e4m3 grid at the delayed
+            # per-tensor scales carried in opt_state['_fp8']; the
+            # straight-through backward snaps their gradients to e5m2
+            # (ops/pallas_opt.fp8_qdq).  Norm params (amp policy) and
+            # every other op stay in fp32 — the matmul/conv-only
+            # eligibility the contrib/amp FP8 lists mirror.  A cached
+            # fp8 winner reaching a step whose build did not provision
+            # the state (fp8 is None) falls through to fp32: never
+            # take a rung the build did not provision for.
+            gscale = fp8["g"][0]
+            param_dict = {
+                n: (_po.fp8_qdq(v, fp8["w"][n][0], gscale)
+                    if n in fp8["w"] else v)
+                for n, v in param_dict.items()}
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                x = _po.fp8_qdq(x, fp8["x"][0], gscale)
         if cdt is not None:
             # AMP policy (reference contrib/amp list semantics): matmul/
             # conv weights in bf16, norm affine+stats in fp32
@@ -445,6 +469,71 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
     if nan_guard:
         opt_state["_bad_steps"] = jnp.zeros((), jnp.int32)
 
+    # ---- fp8 dtype-ladder rung (round 19): delayed-scaling state.
+    # Provisioned at BUILD time whenever the armed roster names fp8
+    # (the race's fp8 arm and a cached fp8 winner both need it in the
+    # SAME opt_state pytree the other arms thread through), absent
+    # otherwise — an unarmed build's program stays HLO bit-identical
+    # to round 18.  Per-tensor scales: one (scale, amax-history) pair
+    # per matmul/conv weight, one for the batch input, one e5m2 pair
+    # for the gradients; history length is MXNET_FP8_AMAX_HISTORY.
+    # Not yet composed with the sharded-server exchange (gradients
+    # live there as flat bucket shards, not named tensors).
+    from ..ops import pallas_opt as _po
+
+    fp8_rung = (compute_dtype is None and _at.dtype_ladder_armed()
+                and "fp8" in _at.ladder_rungs() and not ps_mode)
+    if fp8_rung:
+        from ..config import get_env
+
+        fp8_hist_len = max(1, int(get_env("MXNET_FP8_AMAX_HISTORY")))
+
+        def _fp8_pair():
+            return (jnp.float32(1.0),  # step-1 scale: identity until
+                    #                     the history holds a real amax
+                    jnp.zeros((fp8_hist_len,), jnp.float32))
+
+        fp8_weight_names = [
+            n for n in names
+            if not _is_norm_stat(n) and getattr(params[n], "ndim", 0) >= 2
+        ]
+        opt_state["_fp8"] = {
+            "x": _fp8_pair(),
+            "g": _fp8_pair(),
+            "w": {n: _fp8_pair() for n in fp8_weight_names},
+        }
+
+    def _fp8_bookkeeping(fp8_state, params_, x, grads):
+        """The in-graph delayed-scaling update (ops/pallas_opt.
+        fp8_delayed_scale beside the loss-scale bookkeeping): observe
+        each quantized tensor class's |t|_inf THIS step, roll it into
+        the history, and derive the NEXT step's scale — no host sync,
+        and an overflowed observation backs the scale off without
+        corrupting the state."""
+        new = {}
+        _, xh = fp8_state["x"]
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            x_amax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+        else:
+            x_amax = jnp.max(xh)  # integer inputs never quantize
+        nh, ns = _po.fp8_delayed_scale(xh, x_amax)
+        new["x"] = (ns, nh)
+        _, gh = fp8_state["g"]
+        g_amax = jnp.float32(0.0)
+        for n in fp8_state["w"]:
+            g_amax = jnp.maximum(
+                g_amax, jnp.max(jnp.abs(grads[n].astype(jnp.float32))))
+        ngh, ngs = _po.fp8_delayed_scale(gh, g_amax,
+                                         fmax=_po.E5M2_MAX)
+        new["g"] = (ngs, ngh)
+        new_w = {}
+        for n, (_, wh) in fp8_state["w"].items():
+            w_amax = jnp.max(jnp.abs(params_[n].astype(jnp.float32)))
+            nwh, nws = _po.fp8_delayed_scale(wh, w_amax)
+            new_w[n] = (nws, nwh)
+        new["w"] = new_w
+        return new
+
     # ---- in-graph numerics monitor (telemetry.numerics, Monitor 2.0):
     # per-gradient summary reductions compile INTO the step and ride in
     # the returned state under the reserved _numerics key — zero host
@@ -472,20 +561,11 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
         stats["__loss"] = _nm.summary(loss)
         return stats
 
-    def _scale_bookkeeping(finite, scale, good):
-        """Dynamic-loss-scale update shared by the replicated and
-        sharded arms — ONE copy, because the two must stay
-        bit-identical for the sharded-vs-replicated parity contract:
-        overflow halves the scale (floor 1.0); 2000 consecutive
-        finite steps double it and reset the counter (reference amp
-        scaler)."""
-        good = jnp.where(finite, good + 1, 0)
-        new_scale = jnp.where(
-            finite,
-            jnp.where(good >= 2000, scale * 2.0, scale),
-            jnp.maximum(scale * 0.5, 1.0))
-        good = jnp.where(good >= 2000, 0, good)
-        return new_scale.astype(jnp.float32), good
+    # the dynamic-loss-scale verdict lives in ops/pallas_opt beside the
+    # fp8 delayed-scaling verdict (round 19) — one module, so the two
+    # backoff rules cannot drift; the replicated and sharded arms both
+    # call this ONE copy (sharded-vs-replicated parity contract)
+    _scale_bookkeeping = _po.scale_bookkeeping
 
     def _apply_updates(params_, opt_state_, grads, t, key):
         new_p, new_s = {}, {}
@@ -499,11 +579,30 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
         return new_p, new_s
 
     def step(params_, opt_state_, x, y, key, t):
+        # fp8 rung wiring (trace-time): thread the delayed scales into
+        # the loss, and roll this step's amax observations into the
+        # history.  On the other arms (a race's fp32/bf16 force, or a
+        # non-fp8 winner) the provisioned state passes through
+        # untouched so every arm emits the same opt_state pytree.
+        fp8_on = fp8_rung and _ladder_arm() == "fp8"
+        fp8_state = opt_state_["_fp8"] if fp8_rung else None
+
+        def lo(p, x_, y_, k_):
+            return loss_of(p, x_, y_, k_,
+                           fp8=fp8_state if fp8_on else None)
+
+        def _fp8_carry(new_s, grads):
+            if fp8_rung:
+                new_s["_fp8"] = _fp8_bookkeeping(
+                    fp8_state, params_, x, grads) if fp8_on \
+                    else fp8_state
+            return new_s
+
         if dynamic_scaling:
             scale, good = opt_state_["_loss_scale"]
 
             def scaled_loss(p, x_, y_, k_):
-                return loss_of(p, x_, y_, k_) * scale
+                return lo(p, x_, y_, k_) * scale
 
             sloss, sgrads = jax.value_and_grad(scaled_loss)(
                 params_, x, y, key)
@@ -527,6 +626,9 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
             }
             new_s["_loss_scale"] = _scale_bookkeeping(finite, scale,
                                                       good)
+            # the fp8 histories update even on a skipped step — the
+            # overflow observation is exactly what backs the scale off
+            new_s = _fp8_carry(new_s, grads)
             if numerics_on:
                 new_s["_numerics"] = _nm_pack(grads, sloss / scale)
             # unscale with the scale the loss was COMPUTED with, not the
@@ -536,7 +638,7 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
 
         if static_scale != 1.0:
             def scaled_loss(p, x_, y_, k_):
-                return loss_of(p, x_, y_, k_) * static_scale
+                return lo(p, x_, y_, k_) * static_scale
 
             loss, grads = jax.value_and_grad(scaled_loss)(params_, x, y,
                                                           key)
@@ -544,7 +646,7 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
             grads = jax.tree_util.tree_map(
                 lambda g: g / static_scale, grads)
         else:
-            loss, grads = jax.value_and_grad(loss_of)(params_, x, y, key)
+            loss, grads = jax.value_and_grad(lo)(params_, x, y, key)
         if nan_guard:
             # skip-and-count: a non-finite step leaves params/opt state
             # untouched and bumps the consecutive-bad counter; any
@@ -566,12 +668,15 @@ def make_train_step(block, loss_fn, optimizer="sgd", learning_rate=0.01,
             }
             new_s["_bad_steps"] = jnp.where(
                 finite, jnp.int32(0), opt_state_["_bad_steps"] + 1)
+            new_s = _fp8_carry(new_s, grads)
             if numerics_on:
                 # stats of the step AS IT HAPPENED, guard or no guard:
                 # the bad step's NaN counts are the explanation
                 new_s["_numerics"] = _nm_pack(grads, loss)
             return loss, new_p, new_s
-        new_p, new_s = _apply_updates(params_, opt_state_, grads, t, key)
+        new_p, new_s = _apply_updates(
+            params_, {n: opt_state_[n] for n in names}, grads, t, key)
+        new_s = _fp8_carry(new_s, grads)
         if numerics_on:
             new_s["_numerics"] = _nm_pack(grads, loss)
         return loss, new_p, new_s

@@ -1,4 +1,4 @@
-"""Quantized inference subsystem (round 18).
+"""Quantized inference subsystem (round 18; fp8 arm round 19).
 
 Reference parity: ``mxnet.contrib.quantization`` (SURVEY:
 src/operator/quantization/, 6,057 LoC) — the calibrate -> graph-rewrite
@@ -15,11 +15,16 @@ artifact:
    ``quantized_pooling`` / ``quantized_flatten`` wrappers with
    calibrated ``quantize_v2`` / ``requantize`` / ``dequantize``
    stitching and fp32 fallback for everything else.
-3. :func:`tune_quantized` races the int8 arms against fp32 inside a
-   jitted chained run of the real forward (autotune VARIANT_OPS
-   ``quantized_fc`` / ``quantized_conv``); adoption is per
-   (op, shape, platform) by MEASUREMENT, winners persisted in
-   ``autotune.json``; ``MXNET_QUANTIZE`` is the hand override.
+3. :func:`tune_quantized` races the int8 AND fp8 arms against fp32
+   inside a jitted chained run of the real forward (autotune
+   VARIANT_OPS ``quantized_fc`` / ``quantized_conv``, three variants
+   each since round 19); adoption is per (op, shape, platform) by
+   MEASUREMENT, winners persisted in ``autotune.json``;
+   ``MXNET_QUANTIZE`` is the hand override (``fp8`` pins the fp8
+   program).  The fp8 arm reuses the int8 calibration ranges — e4m3
+   scaling needs only the amax (``CalibrationResult.amax``) — and its
+   matmul/conv accumulate f32 with real-domain f32 outputs, so no
+   requantize stage exists for it.
 4. ``deploy.export_model`` serializes the quantized program into the
    CRC-framed ``.mxje`` format (now carrying ``quantized`` /
    ``param_dtypes`` header metadata) and

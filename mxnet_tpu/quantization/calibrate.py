@@ -222,6 +222,17 @@ class CalibrationResult:
         entry = self._ranges.get(name)
         return entry.get(which) if entry else None
 
+    def amax(self, name, which="in"):
+        """``max(|min|, |max|)`` of the calibrated range — the one
+        statistic the fp8 arm needs (round 19: per-tensor symmetric
+        e4m3 scaling consumes only the amax out of the same collected
+        range the int8 arm uses — no second calibration pass).  None
+        when the layer was never observed."""
+        r = self.range(name, which)
+        if r is None:
+            return None
+        return max(abs(float(r[0])), abs(float(r[1])))
+
     def as_dict(self):
         return {n: dict(e) for n, e in self._ranges.items()}
 

@@ -21,6 +21,15 @@ so quantization is adopted per (op, shape, platform) only where the
 in-step race measured a win, with ``MXNET_QUANTIZE`` as the hand
 override (round-9 precedence ladder).
 
+Round 19 adds a THIRD arm to the per-op race: fp8.  The same wrapper
+also bakes an e4m3 copy of its weight (plus the f32 bias and the
+weight amax — fp8 needs only amax out of the calibrated range), and
+``_arm()`` dispatches "fp32" / "int8" / "fp8" per trace.  The fp8 arm
+speaks real-domain f32 at both boundaries: matmul/conv accumulate f32
+(no requantize triple exists for fp8), so q-triple stitching never
+engages for it and mixed per-layer decisions keep composing — an int8
+triple arriving from upstream is dequantized first.
+
 Stitching: inside a (Hybrid)Sequential, consecutive quantized layers
 pass the quantized triple ``(int8 data, min, max)`` straight through —
 no dequantize/quantize pair between them; Pooling/Flatten wrappers are
@@ -41,6 +50,7 @@ __all__ = ["quantize_net", "tune_quantized", "QuantizedDense",
            "quantized_layers"]
 
 _INT8_RANGE = 127.0
+_FP8_MAX = 448.0  # e4m3fn finite max
 
 
 def _quantize_weight(arr):
@@ -52,6 +62,19 @@ def _quantize_weight(arr):
     q = onp.clip(onp.rint(w * (_INT8_RANGE / amax)),
                  -127, 127).astype("int8")
     return q, -amax, amax
+
+
+def _quantize_weight_fp8(arr):
+    """Symmetric per-tensor e4m3 of a weight (host-side, once at
+    rewrite): the weight is scaled onto the full ±448 e4m3 range and
+    clipped BEFORE the cast (e4m3fn overflows to NaN, not inf).
+    Returns (e4m3 NDArray, amax)."""
+    from .. import ndarray as nd
+
+    w = onp.asarray(arr, dtype="float32")
+    amax = float(onp.abs(w).max()) or 1.0
+    scaled = onp.clip(w * (_FP8_MAX / amax), -_FP8_MAX, _FP8_MAX)
+    return nd.array(scaled).astype("float8_e4m3fn"), amax
 
 
 def _is_qtensor(x):
@@ -83,15 +106,26 @@ class _QuantizedLayer(HybridBlock):
         self.emit_q = False
         self.accept_q = False
 
-    def _use_int8(self):
-        """Trace-time adoption decision: the autotune precedence ladder
+    def _arm(self):
+        """Trace-time adoption decision, three-way since round 19:
+        "fp32" / "int8" / "fp8", via the autotune precedence ladder
         (force scope > MXNET_QUANTIZE > cached per-program winner >
         default int8 — the layer was rewritten on purpose)."""
         if self.variant_op is None:
-            return True
+            return "int8"  # structural wrappers follow their input form
         from .. import autotune as _at
 
-        return bool(_at.variant_choice(self.variant_op, default=True))
+        v = _at.variant_choice(self.variant_op, default=True)
+        if v == "fp8":
+            from ..dtype import _float8
+
+            _float8("float8_e4m3fn")  # loud when this build lacks fp8
+            return "fp8"
+        return "int8" if v else "fp32"
+
+    def _use_int8(self):
+        """Back-compat shim over :meth:`_arm` (pre-round-19 callers)."""
+        return self._arm() == "int8"
 
     def _dequant(self, F, q):
         from .. import ndarray as nd
@@ -105,6 +139,18 @@ class _QuantizedLayer(HybridBlock):
         if self._in_range is None:
             return nd.invoke("_contrib_quantize_v2", [x])
         return nd.invoke("_contrib_quantize_v2", [x],
+                         min_calib_range=self._in_range[0],
+                         max_calib_range=self._in_range[1])
+
+    def _quant_in_fp8(self, F, x):
+        """fp32 input -> (e4m3, amax) pair — calibrated when the
+        collector saw this layer (fp8 reuses the int8 collector's
+        range; only its amax is consumed)."""
+        from .. import ndarray as nd
+
+        if self._in_range is None:
+            return nd.invoke("_contrib_quantize_fp8", [x])
+        return nd.invoke("_contrib_quantize_fp8", [x],
                          min_calib_range=self._in_range[0],
                          max_calib_range=self._in_range[1])
 
@@ -127,6 +173,7 @@ class _QuantizedCompute(_QuantizedLayer):
 
     def _bake_weights(self, w_param, b_param, n_out):
         from .. import ndarray as nd
+        from ..dtype import float8_supported
 
         wq, wmin, wmax = _quantize_weight(w_param.data().asnumpy())
         self._wq = nd.array(wq, dtype="int8")
@@ -138,18 +185,46 @@ class _QuantizedCompute(_QuantizedLayer):
             bq, bmin, bmax = _quantize_weight(b_param.data().asnumpy())
         self._bq = nd.array(bq, dtype="int8")
         self._bmin, self._bmax = nd.array([bmin]), nd.array([bmax])
+        # fp8 arm constants (round 19): e4m3 weight + its amax; bias
+        # stays f32, added in the real domain after the f32-accumulating
+        # matmul.  Only the arm the trace takes gets baked into the
+        # program — the others are inert host attributes.  Skipped on
+        # builds without float8 (_arm() raises loudly if fp8 is then
+        # requested; the int8/fp32 arms must keep working).
+        if float8_supported():
+            self._w8, w8_amax = _quantize_weight_fp8(
+                w_param.data().asnumpy())
+            self._w8_amax = nd.array([w8_amax])
+            self._b32 = nd.array(onp.zeros(n_out, "float32")) \
+                if self._no_bias else nd.array(onp.asarray(
+                    b_param.data().asnumpy(), "float32"))
 
     def _invoke(self, q):
         """Run the int8 op on the quantized input triple ``q``;
         returns the (int32 acc, min, max) triple."""
         raise NotImplementedError
 
+    def _invoke_fp8(self, q):
+        """Run the fp8 op on the (e4m3 data, amax) pair ``q``;
+        returns the real-domain f32 output (bias already added)."""
+        raise NotImplementedError
+
     def hybrid_forward(self, F, x):
         from .. import ndarray as nd
 
         q_in = _is_qtensor(x)
-        if not self._use_int8():
+        arm = self._arm()
+        if arm == "fp32":
             return self._orig(self._dequant(F, x) if q_in else x)
+        if arm == "fp8":
+            # real-domain f32 at both boundaries: an int8 triple from
+            # upstream is dequantized first, and no requantize triple
+            # is ever emitted — downstream wrappers treat the f32
+            # output like any fp32 input, so mixed decisions compose
+            xf = self._dequant(F, x) if q_in else x
+            out = self._invoke_fp8(self._quant_in_fp8(F, xf))
+            act = getattr(self._orig, "act", None)
+            return act(out) if act is not None else out
         q = tuple(x) if q_in else self._quant_in(F, x)
         acc, omin, omax = self._invoke(q)
         act = getattr(self._orig, "act", None)
@@ -164,14 +239,21 @@ class _QuantizedCompute(_QuantizedLayer):
         return act(out) if act is not None else out
 
     def export_dtypes(self):
-        return ["int8"] if self._no_bias else ["int8", "int8"]
+        arm = self._arm()
+        if arm == "fp8":
+            return ["float8_e4m3fn"] + \
+                ([] if self._no_bias else ["float32"])
+        if arm == "int8":
+            return ["int8"] if self._no_bias else ["int8", "int8"]
+        return []
 
 
 class QuantizedDense(_QuantizedCompute):
-    """INT8 Dense: calibrated input quantize + int8 x int8 -> int32 FC
-    (``_contrib_quantized_fully_connected``), requantized to int8 when
-    the next layer consumes quantized data, dequantized to fp32
-    otherwise; the wrapped fp32 Dense is the fallback arm."""
+    """Quantized Dense: calibrated input quantize + int8 x int8 -> int32
+    FC (``_contrib_quantized_fully_connected``), requantized to int8
+    when the next layer consumes quantized data, dequantized to fp32
+    otherwise; OR the fp8 arm (e4m3 x e4m3 -> f32, round 19); the
+    wrapped fp32 Dense is the fallback arm."""
 
     variant_op = "quantized_fc"
 
@@ -191,11 +273,21 @@ class QuantizedDense(_QuantizedCompute):
             num_hidden=self._units, no_bias=self._no_bias,
             flatten=self._flatten)
 
+    def _invoke_fp8(self, q):
+        from .. import ndarray as nd
+
+        return nd.invoke(
+            "_contrib_fp8_fully_connected",
+            [q[0], self._w8, self._b32, q[1], self._w8_amax],
+            num_hidden=self._units, no_bias=self._no_bias,
+            flatten=self._flatten)
+
 
 class QuantizedConv(_QuantizedCompute):
-    """INT8 convolution (``_contrib_quantized_conv``): channel-first
-    layouts only (the int8 op's dimension numbers); same adoption /
-    stitching contract as :class:`QuantizedDense`."""
+    """Quantized convolution (``_contrib_quantized_conv`` /
+    ``_contrib_fp8_conv``): channel-first layouts only (the quantized
+    ops' dimension numbers); same adoption / stitching contract as
+    :class:`QuantizedDense`."""
 
     variant_op = "quantized_conv"
 
@@ -220,6 +312,14 @@ class QuantizedConv(_QuantizedCompute):
             "_contrib_quantized_conv",
             [q[0], self._wq, self._bq, q[1], q[2],
              self._wmin, self._wmax, self._bmin, self._bmax],
+            no_bias=self._no_bias, **self._conv_kw)
+
+    def _invoke_fp8(self, q):
+        from .. import ndarray as nd
+
+        return nd.invoke(
+            "_contrib_fp8_conv",
+            [q[0], self._w8, self._b32, q[1], self._w8_amax],
             no_bias=self._no_bias, **self._conv_kw)
 
 
@@ -395,9 +495,10 @@ def quantize_net(net, calib, excluded_names=()):
 
 def tune_quantized(net, sample_x, iters=8, level=None):
     """Adoption by measurement (round-9 contract): race the rewritten
-    net's int8 arms against fp32 INSIDE one jitted chained run of the
-    real inference forward — ``quantized_fc`` and ``quantized_conv``
-    race independently (greedy, earlier winners pinned), winners
+    net's int8 AND fp8 arms against fp32 INSIDE one jitted chained run
+    of the real inference forward — ``quantized_fc`` and
+    ``quantized_conv`` race independently (greedy, earlier winners
+    pinned; each now carries three variants), winners
     persist in ``autotune.json`` keyed (op, input shape, dtype,
     platform, mesh) and apply at every later trace through
     ``program_scope`` (CachedOp, make_train_step, export_model).
@@ -453,7 +554,7 @@ def tune_quantized(net, sample_x, iters=8, level=None):
         telemetry.quantize(
             "race", mode="",
             layers=len([r for r in report.values()
-                        if r["winner"] == "int8"]),
+                        if r["winner"] != "fp32"]),
             excluded=0)
     except Exception:
         pass

@@ -407,3 +407,41 @@ def test_dtype_ladder_races_and_reloads(cache_dir, monkeypatch):
         net, loss_fn, learning_rate=0.1, compute_dtype="bfloat16",
         sample_data=(x, y))
     assert "dtype_ladder" not in at.last_report()
+
+
+# --------------------------------------------- round 19: the fp8 rung
+def test_dtype_ladder_fp8_winner_persists_across_processes(cache_dir):
+    """An fp8 ladder winner recorded by one process reloads in another
+    (the conv1x1_dot subprocess contract), but only a build whose
+    MXNET_DTYPE_LADDER roster names fp8 consumes it — op_variants
+    narrows a "fp32,bf16" roster so the cached fp8 verdict is ignored
+    and the entry simply re-races (its opt_state carries no fp8 state
+    to run on)."""
+    at.record("dtype_ladder", (8, 6), "float32", winner="fp8",
+              timings={"fp32": 3.0, "bf16": 2.0, "fp8": 1.0},
+              platform="cpu", mesh="none")
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import os\n"
+        "from mxnet_tpu import autotune as at\n"
+        "w = at.lookup('dtype_ladder', (8, 6), 'float32',\n"
+        "              platform='cpu', mesh='none')\n"
+        "assert w == 'fp8', w\n"
+        "os.environ['MXNET_DTYPE_LADDER'] = 'fp32,bf16,fp8'\n"
+        "with at.program_scope((8, 6), 'float32', platform='cpu',\n"
+        "                      mesh='none'):\n"
+        "    assert at.variant_choice('dtype_ladder') == 'fp8'\n"
+        "os.environ['MXNET_DTYPE_LADDER'] = 'fp32,bf16'\n"
+        "with at.program_scope((8, 6), 'float32', platform='cpu',\n"
+        "                      mesh='none'):\n"
+        "    assert at.variant_choice('dtype_ladder') is None\n"
+        "print('child-ok')\n" % _REPO
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXNET_AUTOTUNE_CACHE_DIR=os.environ[
+                   "MXNET_AUTOTUNE_CACHE_DIR"])
+    env.pop("MXNET_DTYPE_LADDER", None)
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "child-ok" in out.stdout

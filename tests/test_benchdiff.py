@@ -267,6 +267,38 @@ def test_quantization_trend_verdicts_and_missing_metric(tmp_path):
         "missing quantization metric"
 
 
+def test_fp8_agreement_floor_and_missing_after_shipped(tmp_path):
+    """Round 19: the fp8 arm is held to the SAME absolute 0.99
+    agreement floor as int8, and once a round ships the fp8 metric a
+    later round without it regresses — tracked independently of the
+    int8 metric's shipping round."""
+
+    def q(fp8=None, **kw):
+        doc = _quant(kw.pop("agreement", 1.0), **kw)
+        if fp8 is not None:
+            doc["agreement_top1_fp8"] = fp8
+        return doc
+
+    glob_b = _write_rounds(tmp_path, [
+        (1, 0, {"value": 1000.0, "quantization": q()}),  # int8 only
+        (2, 0, {"value": 1000.0,
+                "quantization": q(fp8=1.0)}),  # fp8 ships
+        (3, 0, {"value": 1000.0,
+                "quantization": q(fp8=0.98)}),  # fp8 floor
+        (4, 0, {"value": 1000.0, "quantization": q()}),  # fp8 lost
+    ])
+    rounds = bd.quantization_verdicts(bd.load_bench(
+        sorted(__import__("glob").glob(glob_b))), 0.15)
+    # pre-fp8 rounds are not punished for the metric not existing yet
+    assert rounds["r01"]["quant_verdict"] == "baseline"
+    assert rounds["r02"]["quant_verdict"] == "ok"
+    assert rounds["r03"]["quant_verdict"] == "regression"
+    assert "fp8 agreement 0.980 < 0.99" in rounds["r03"]["quant_reason"]
+    assert rounds["r04"]["quant_verdict"] == "regression"
+    assert "missing fp8 quantization metric" in \
+        rounds["r04"]["quant_reason"]
+
+
 def test_quantization_regression_gates_with_fail_on_regression(
         tmp_path, capsys):
     """An int8 accuracy regression exits 2 under --fail-on-regression

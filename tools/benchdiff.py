@@ -23,12 +23,13 @@ a machine-readable trend:
   jump, or an SLO flip is a REGRESSION; a round that HAD fleet data
   before and lost it is "missing fleet metric" — serving robustness
   regressions gate exactly like throughput ones.
-* **quantization trend** (round 18) — the ``quantization`` INFERENCE
-  phase's int8-arm metrics round-over-round: top-1 agreement with the
-  fp32 arm dropping below 0.99 regresses ABSOLUTELY (accuracy is a
-  floor, not a ratio), the int8 p99 rates like the fleet's (lower is
-  better), and a round that shipped the phase then lost it is
-  "missing quantization metric".
+* **quantization trend** (round 18; fp8 arm round 19) — the
+  ``quantization`` INFERENCE phase's quantized-arm metrics
+  round-over-round: top-1 agreement with the fp32 arm dropping below
+  0.99 regresses ABSOLUTELY (accuracy is a floor, not a ratio) for
+  BOTH the int8 and fp8 arms, the int8 p99 rates like the fleet's
+  (lower is better), and a round that shipped a metric then lost it
+  is "missing (fp8) quantization metric".
 * **generate serving trend** (round 17) — the ``generate`` INFERENCE
   phase's paged-KV decode metrics round-over-round: decode tokens/s
   drops past the threshold or a TTFT-p99 blow-up regresses (lower
@@ -104,7 +105,7 @@ def load_bench(paths):
                "fresh_p99_ms": None, "fresh_shed_rate": None,
                "fresh_within_slo": None, "fresh_monotonic": None,
                "quant_p99_ms": None, "quant_agreement": None,
-               "quant_speedup": None,
+               "quant_speedup": None, "quant_agreement_fp8": None,
                "gen_tokens_s": None, "gen_ttft_p99_ms": None,
                "gen_agreement": None, "gen_compiles": None,
                "zero_rs_ag_ratio": None, "zero_mem_ratio": None,
@@ -156,6 +157,8 @@ def load_bench(paths):
                 if isinstance(arm, dict):
                     row["quant_p99_ms"] = arm.get("p99_ms")
                 row["quant_speedup"] = qt.get("speedup_p50")
+                row["quant_agreement_fp8"] = qt.get(
+                    "agreement_top1_fp8")
             gen = parsed.get("generate")
             if isinstance(gen, dict) \
                     and gen.get("tokens_s") is not None:
@@ -274,8 +277,12 @@ def quantization_verdicts(rounds, threshold):
     previous round regresses, and the int8 p99 rates inverted like
     the fleet's (lower is better).  Rounds before the phase existed
     carry no quantization verdict; once shipped, a later round
-    without it is "missing quantization metric"."""
+    without it is "missing quantization metric".  The fp8 arm (round
+    19) is held to the SAME absolute 0.99 floor and the same
+    missing-after-shipped gate, tracked independently — the fp8
+    metric's shipping round may differ from int8's."""
     seen = False
+    seen_fp8 = False
     prev = None
     for label in sorted(rounds):
         row = rounds[label]
@@ -289,10 +296,17 @@ def quantization_verdicts(rounds, threshold):
                 row["quant_reason"] = None
             continue
         p99 = row["quant_p99_ms"]
+        agreement_fp8 = row["quant_agreement_fp8"]
         reasons = []
         if agreement < 0.99:
             reasons.append(
                 f"int8 agreement {agreement:.3f} < 0.99")
+        if agreement_fp8 is not None:
+            if agreement_fp8 < 0.99:
+                reasons.append(
+                    f"fp8 agreement {agreement_fp8:.3f} < 0.99")
+        elif seen_fp8:
+            reasons.append("missing fp8 quantization metric")
         if not seen:
             row["quant_verdict"] = "regression" if reasons \
                 else "baseline"
@@ -316,6 +330,7 @@ def quantization_verdicts(rounds, threshold):
                 row["quant_reason"] = (f"int8 p99 x{ratio:.2f}"
                                        if ratio is not None else None)
         seen = True
+        seen_fp8 = seen_fp8 or agreement_fp8 is not None
         prev = (agreement, p99)
     return rounds
 
