@@ -1454,6 +1454,163 @@ def _measure_freshness(smoke, deadline):
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def _measure_trace(smoke, deadline):
+    """Distributed-tracing phase (round 20): drive bursty load through
+    a 2-replica CPU fleet with ``serve.model:delay`` armed on replica 1,
+    runlogs armed per process (router + replicas), then merge the logs
+    with ``tools/tracemerge.py`` IN-PROCESS and report the causal
+    timeline's vitals into the headline JSON: span count, process
+    count, the estimated per-process clock skew, the doctor verdict
+    (dominant component + named bottleneck replica) and the
+    queue/coalesce/compute attribution of the request p99.
+
+    The phase also measures the tracing overhead ratio — armed-vs-
+    unarmed p50 of an in-process ModelServer submit (the PR-5 hot-path
+    bound, A/B on the same server config) — which benchdiff gates
+    absolutely."""
+    import importlib.util
+    import shutil
+    import tempfile
+
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, telemetry
+    from mxnet_tpu.serving import FleetRouter, ModelServer, \
+        ServeRejected
+    from mxnet_tpu.telemetry.opstats import percentile
+
+    tmpdir = tempfile.mkdtemp(prefix="mxnet_tpu_bench_trace_")
+    slo_ms = 8000.0
+    n_req = 24 if smoke else 96
+    delay_s = 0.05
+    try:
+        # ---- A/B overhead: same in-process server, unarmed vs armed
+        def submit_p50(armed):
+            if armed:
+                telemetry.reset(os.path.join(tmpdir, "ab.jsonl"))
+            else:
+                telemetry.reset(None)
+            srv = ModelServer(lambda xs: xs * 2.0, (8,), max_batch=8,
+                              slo_ms=slo_ms, coalesce_ms=0.5,
+                              name="ab")
+            srv.start()
+            try:
+                xs = onp.zeros(8, dtype="float32")
+                lats = []
+                for _ in range(16 if smoke else 64):
+                    t0 = time.perf_counter()
+                    srv.submit(xs, deadline_ms=slo_ms)
+                    lats.append((time.perf_counter() - t0) * 1e3)
+            finally:
+                srv.close()
+                telemetry.reset(None)
+            return percentile(sorted(lats), 0.50)
+
+        p50_unarmed = submit_p50(False)
+        p50_armed = submit_p50(True)
+        overhead = (p50_armed / p50_unarmed) if p50_unarmed else None
+        _heartbeat("trace", overhead=round(overhead, 3)
+                   if overhead else None)
+
+        # ---- the 2-replica drill: one replica delay-injected
+        mx.random.seed(11)
+        net = gluon.nn.Dense(16, in_units=8)
+        net.initialize(init=mx.init.Xavier())
+        artifact = os.path.join(tmpdir, "v1.mxje")
+        mx.deploy.export_model(net, nd.zeros((4, 8)), artifact,
+                               platforms=("cpu",))
+        logdir = os.path.join(tmpdir, "logs")
+        os.makedirs(logdir)
+        telemetry.reset(os.path.join(logdir, "router.jsonl"))
+        completed, shed, errors = [], 0, []
+        lock = threading.Lock()
+        try:
+            router = FleetRouter.spawn(
+                artifact, replicas=2, slo_ms=slo_ms,
+                env={"JAX_PLATFORMS": "cpu"}, coalesce_ms=1.0,
+                runlog_dir=logdir,
+                replica_env={1: {"MXNET_FAULT_SPEC":
+                                 f"serve.model:delay={delay_s}@1+"}},
+                ready_timeout=min(120.0, max(20.0,
+                                             deadline.remaining())))
+            try:
+                x = onp.random.rand(8).astype("float32")
+
+                def worker(k):
+                    nonlocal shed
+                    for _ in range(k):
+                        t0 = time.perf_counter()
+                        try:
+                            router.submit(x, deadline_ms=slo_ms)
+                            with lock:
+                                completed.append(
+                                    (time.perf_counter() - t0) * 1e3)
+                        except ServeRejected:
+                            with lock:
+                                shed += 1
+                        except Exception as exc:  # noqa: BLE001
+                            with lock:
+                                errors.append(repr(exc))
+
+                for _burst in range(2):
+                    if deadline.exceeded():
+                        deadline.note("trace:burst")
+                        break
+                    ts = [threading.Thread(target=worker,
+                                           args=(n_req // 8,))
+                          for _ in range(4)]
+                    for t in ts:
+                        t.start()
+                    for t in ts:
+                        t.join(timeout=120)
+                    _heartbeat("trace", completed=len(completed),
+                               shed=shed)
+            finally:
+                router.close()
+        finally:
+            telemetry.reset(None)
+
+        # ---- merge + doctor, in-process (the tool is stdlib-only)
+        spec = importlib.util.spec_from_file_location(
+            "tracemerge", os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "tools", "tracemerge.py"))
+        tm = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tm)
+        procs = tm.load_runlogs([logdir])
+        rep = tm.doctor(procs)
+        merged = tm.merge_trace(procs)
+        spans = sum(len(p["spans"]) for p in procs)
+        p99 = percentile(sorted(completed), 0.99) if completed \
+            else None
+        return {
+            "requests": len(completed) + shed + len(errors),
+            "completed": len(completed), "shed": shed,
+            "errors": len(errors), "error_sample": errors[:3],
+            "p50_ms": round(percentile(sorted(completed), 0.50), 3)
+            if completed else None,
+            "p99_ms": round(p99, 3) if p99 is not None else None,
+            "spans": spans,
+            "processes": rep["processes"],
+            "traced_requests": rep["requests"],
+            "skew_s": rep["skew_s"],
+            "components_pct": rep["components_pct"],
+            "dominant": rep["dominant"],
+            "bottleneck_process": rep["bottleneck_process"],
+            "swap_in_progress_requests":
+                rep["swap_in_progress_requests"],
+            "flow_links": sum(1 for e in merged["traceEvents"]
+                              if e.get("ph") == "s"),
+            "overhead_ratio": round(overhead, 4)
+            if overhead is not None else None,
+            "p50_unarmed_ms": round(p50_unarmed, 4),
+            "p50_armed_ms": round(p50_armed, 4),
+        }
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def _ckpt_save(prefix, epoch, params, opt_state):
     """Atomic checkpoint of the trained params/opt state
     (resilience.checkpoint); returns the timed write duration so the
@@ -2307,6 +2464,26 @@ def main(argv=None):
             out["degraded"] = True
             reasons.append(f"freshness phase failed: {exc!r}")
     _write_partial(out, "freshness")
+
+    # distributed-tracing phase (round 20): per-process runlogs from a
+    # 2-replica fleet (one replica delay-injected) merged by
+    # tools/tracemerge.py into one causal timeline — span/process
+    # counts, clock-skew estimates, the doctor bottleneck verdict and
+    # the armed-vs-unarmed overhead ratio land in the headline JSON
+    if deadline.exceeded(margin=0.0 if args.smoke else 60.0):
+        out["trace"] = "skipped (deadline)"
+        out["degraded"] = True
+        reasons.append("deadline: skipped trace phase")
+        deadline.note("trace")
+    else:
+        _heartbeat("trace")
+        try:
+            out["trace"] = _measure_trace(args.smoke, deadline)
+        except Exception as exc:  # auxiliary metric: never kill the run
+            out["trace"] = {"error": repr(exc)}
+            out["degraded"] = True
+            reasons.append(f"trace phase failed: {exc!r}")
+    _write_partial(out, "trace")
 
     # run-telemetry dogfood (round 10): the bench arms a run log,
     # reports its own steps into it, re-reads the JSONL and folds the

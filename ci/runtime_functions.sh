@@ -336,6 +336,27 @@ online_smoke() {
         -q
 }
 
+trace_smoke() {
+    # distributed-tracing gate (round 20) on CPU: the W3C traceparent
+    # mint/parse/propagate units, the unarmed A/B zero-cost contract
+    # (no mint, no span, env stamp scrubbed), the synthetic 3-process
+    # +-200ms clock-skew merge (NTP-pair offsets recovered, child
+    # spans never start before their parent) plus the zero-pair
+    # beat-file fallback, and THE drill — a live 2-replica FleetRouter
+    # with a delay fault on replica 1, merged by tools/tracemerge.py
+    # into ONE causal timeline (>=3 processes, cross-process parent
+    # links on every request, queue/coalesce/compute + other summing
+    # to e2e) whose doctor names the delay-injected replica.  Also
+    # collected by tier-1 (tests/test_tracing.py), so a regression
+    # turns the unit suite red between CI runs.
+    JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py -q
+    # the bench's trace phase end to end in --smoke mode: span counts,
+    # skew table, doctor verdict + overhead ratio smoke-asserted
+    JAX_PLATFORMS=cpu python -m pytest \
+        "tests/test_bench_smoke.py::test_smoke_emits_valid_json_with_heartbeats" \
+        -q
+}
+
 elastic_smoke() {
     # elastic scale-out gate (round 12): the tier-1 half runs the
     # single-host resize drill — train dp(4) under optimizer sharding,

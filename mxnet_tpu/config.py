@@ -301,6 +301,22 @@ register_env("MXNET_METRICS_TEXTFILE", "", str,
              "collector convention): telemetry counters + last "
              "throughput/loss, atomically rewritten on every sampled "
              "step.  Empty = off.")
+register_env("MXNET_TRACE_CONTEXT", "", str,
+             "Inbound W3C traceparent stamp "
+             "('00-<32hex trace>-<16hex span>-01') set by a spawner "
+             "(fleet replica launch, online-loop trainer, healing "
+             "relaunch) so the child's spans parent onto the spawn "
+             "(telemetry.tracing).  Empty = this process roots its "
+             "own traces.", live=False)
+register_env("MXNET_PROCESS_ROLE", "", str,
+             "Process identity stamped by spawners into the child's "
+             "run_start record (trainer|replica|router|io_worker|"
+             "bench|fit) — the track-group label tools/tracemerge.py "
+             "uses for the merged timeline.", live=False)
+register_env("MXNET_PROCESS_RANK", "", str,
+             "Numeric rank within the role (replica index, trainer "
+             "attempt), stamped next to MXNET_PROCESS_ROLE into "
+             "run_start.", live=False)
 register_env("MXNET_ELASTIC", False, bool,
              "Elastic multi-host runtime (resilience.elastic): arms "
              "runtime.init_distributed()/elastic_init() multi-process "

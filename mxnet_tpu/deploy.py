@@ -129,7 +129,10 @@ def export_model(net, example_input, path, platforms=("cpu", "tpu"),
     merged into the v2 header metadata — ``model_version`` (monotonic)
     and ``stream_cursor`` above all — so ``read_artifact_meta`` can
     answer "which version is this, trained through which sample?"
-    from a few hundred header bytes.  Reserved structural keys
+    from a few hundred header bytes.  Round 20 adds ``trace_anchor``
+    (a ``traceparent`` string): the exporting trainer's span context,
+    so a rolling-swap can parent its serve-side cutover span on the
+    training step that produced the weights.  Reserved structural keys
     (``batch``/``item_shape``/...) cannot be overridden.
 
     Round 18: a SINGLE-platform export traces under the autotune

@@ -58,6 +58,7 @@ import numpy as onp
 
 from .. import recordio
 from ..base import MXNetError
+from ..telemetry import tracing as _tracing
 from .io import DataBatch, DataDesc, DataIter
 
 __all__ = ["ImageDetRecordIter", "ImageRecordIter"]
@@ -787,21 +788,31 @@ class ImageRecordIter(DataIter):
         for _ in range(self._io_workers):
             self._spawn_worker(state, cv, stop)
         self._emitter = threading.Thread(
-            target=self._pool_emitter, args=(state, cv, stop, q, plan),
+            target=self._pool_emitter,
+            args=(state, cv, stop, q, plan,
+                  _tracing.current_context()),
             name="ImageRecordIter-emitter", daemon=True)
         self._emitter.start()
 
     def _spawn_worker(self, state, cv, stop):
+        # data workers are THREADS: trace context propagates by
+        # capture-at-spawn (tracing's stack is thread-local), not by
+        # env stamp — a respawned worker inherits the respawner's
+        # context so its records stay on the fit's causal timeline
         t = threading.Thread(target=self._pool_worker,
-                             args=(state, cv, stop),
+                             args=(state, cv, stop,
+                                   _tracing.current_context()),
                              name="ImageRecordIter-worker", daemon=True)
         self._pool_threads.append(t)
         t.start()
         return t
 
-    def _pool_worker(self, state, cv, stop):
+    def _pool_worker(self, state, cv, stop, trace_ctx=None):
         from ..resilience import faultsim
 
+        if trace_ctx is not None:
+            # thread-lifetime bind: the TLS stack dies with the thread
+            _tracing.use(trace_ctx).__enter__()
         me = threading.current_thread()
         while not stop.is_set():
             with cv:
@@ -982,10 +993,14 @@ class ImageRecordIter(DataIter):
                               respawn=self._respawns,
                               budget=self._respawn_budget)
 
-    def _pool_emitter(self, state, cv, stop, q, plan):
+    def _pool_emitter(self, state, cv, stop, q, plan, trace_ctx=None):
         """Emit results strictly in plan order (sequence-ordered batch
         assembly): the consumer sees the same stream at any worker
         count."""
+        if trace_ctx is not None:
+            # thread-lifetime bind (matches _pool_worker): respawn
+            # records the emitter writes stay on the caller's trace
+            _tracing.use(trace_ctx).__enter__()
         n = len(plan)
         try:
             while not stop.is_set() and state["next_emit"] < n:

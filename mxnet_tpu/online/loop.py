@@ -63,6 +63,7 @@ import numpy as onp
 
 from ..base import MXNetError
 from ..resilience import faultsim
+from ..telemetry import tracing as _tracing
 
 __all__ = ["OnlineTrainer", "OnlineLoop", "stream_batch"]
 
@@ -193,6 +194,15 @@ class OnlineTrainer:
         extra = {"stream_cursor": int(cursor),
                  "t_newest_sample": float(t_newest),
                  "model_version": int(v)}
+        # the trace anchor rides the artifact header + manifest so a
+        # rolling-swap's serve spans link back to the trainer step that
+        # produced the weights (tracemerge draws the arrow)
+        ctx = t_exp0 = None
+        if _tracing.enabled():
+            parent = _tracing.current_context()
+            ctx = parent.child() if parent is not None else _tracing.mint()
+            extra["trace_anchor"] = ctx.to_header()
+            t_exp0 = time.perf_counter()
         self.ckpt.save(v, arg_params=self._params(net), step=int(step),
                        batch_cursor=int(cursor), extra=extra)
         path = os.path.join(self.publish_dir, f"model-v{v:04d}.mxje")
@@ -204,6 +214,10 @@ class OnlineTrainer:
             os.path.join(self.publish_dir, f"v{v:04d}.json"),
             (json.dumps(man, sort_keys=True) + "\n").encode(),
             inject_point="online.publish")
+        if ctx is not None:
+            _tracing.emit_span("online_export", t_exp0,
+                               time.perf_counter(), ctx,
+                               model_version=int(v), step=int(step))
         return v
 
     # ------------------------------------------------------------- run
@@ -230,16 +244,20 @@ class OnlineTrainer:
         cursor, t_newest = done, time.time()
         for step in range(done + 1, self.steps + 1):
             faultsim.inject("online.step")
-            xb, yb = next(it)
-            t_newest = time.time()
-            with autograd.record():
-                loss = loss_fn(net(xb), yb)
-            loss.backward()
-            trainer.step(self.batch)
-            cursor = step
-            if step % self.export_every == 0 or step == self.steps:
-                versions.append(
-                    self._export(net, step, cursor, t_newest))
+            # each stream cursor is a trace entry point: the step span
+            # (rooted on the supervisor's spawn stamp when present)
+            # parents the export span, whose anchor the swap inherits
+            with _tracing.span("online_step", cursor=int(step)):
+                xb, yb = next(it)
+                t_newest = time.time()
+                with autograd.record():
+                    loss = loss_fn(net(xb), yb)
+                loss.backward()
+                trainer.step(self.batch)
+                cursor = step
+                if step % self.export_every == 0 or step == self.steps:
+                    versions.append(
+                        self._export(net, step, cursor, t_newest))
             if self.pace_s:
                 time.sleep(self.pace_s)
         final = {"step": int(cursor), "cursor": int(cursor),
@@ -332,6 +350,10 @@ class OnlineLoop:
         # the supervisor's own telemetry sink must not be shared with
         # the child (one-run-per-file contract)
         env.pop("MXNET_RUNLOG", None)
+        # trace + identity stamp: the trainer's step spans parent onto
+        # this supervisor's context (before worker_env so drills can
+        # override)
+        _tracing.stamp_env(env, "trainer", rank=attempt)
         env.update(self.worker_env)
         env.setdefault("JAX_PLATFORMS", "cpu")
         env["MXNET_HEAL_ATTEMPT"] = str(attempt)

@@ -696,6 +696,12 @@ def supervise(cmd, max_relaunch=None, env=None, healable=None):
     while True:
         run_env = dict(base_env)
         run_env["MXNET_HEAL_ATTEMPT"] = str(attempt)
+        # per-relaunch trace stamp: each attempt gets its own child
+        # context so tracemerge shows relaunches as distinct subtrees
+        from ..telemetry import tracing
+
+        tracing.stamp_env(run_env, run_env.get(tracing.ROLE_ENV)
+                          or "worker", rank=attempt)
         rc = subprocess.call(list(cmd), env=run_env)
         if rc == 0 or not healable(rc) or attempt >= int(max_relaunch):
             if rc != 0 and healable(rc):

@@ -60,6 +60,7 @@ import numpy as onp
 from ..base import MXNetError
 from ..resilience import faultsim
 from ..resilience.retry import retry_call
+from ..telemetry import tracing as _tracing
 from .frontend import ServeFrontend, http_call
 from .server import ModelServer, ServeRejected
 
@@ -779,6 +780,10 @@ class FleetRouter:
             p for p in [os.path.dirname(os.path.dirname(
                 os.path.dirname(os.path.abspath(__file__)))),
                 env.get("PYTHONPATH")] if p)
+        # round 20: identity + trace stamp BEFORE env/replica_env so a
+        # drill can still override them.  The child's run_start carries
+        # role=replica/rank=idx and its spans parent onto this spawn.
+        _tracing.stamp_env(env, "replica", rank=idx)
         env.update(spec["env"])
         if spec["runlog_dir"]:
             env["MXNET_RUNLOG"] = os.path.join(
@@ -995,6 +1000,16 @@ class FleetRouter:
         with self._lock:
             self.stats["requests"] += 1
         self._telemetry_count("fleet_requests")
+        # round-20 trace root: one fleet_request span per submit when
+        # telemetry is armed (or a caller-bound context exists); each
+        # routing attempt sends a child hop in the traceparent header
+        # so the replica's spans link back here
+        req_ctx = t_req0 = None
+        if _tracing.enabled() or _tracing.current_context() is not None:
+            parent = _tracing.current_context()
+            req_ctx = parent.child() if parent is not None \
+                else _tracing.mint()
+            t_req0 = time.perf_counter()
         last = {"reason": "no_replica",
                 "detail": "no ready replica to route to",
                 "failover": False}
@@ -1021,6 +1036,11 @@ class FleetRouter:
                             detail="fleet budget exhausted before "
                                    "dispatch")
                 raise _Failover
+            hop = hdrs = t_hop0 = None
+            if req_ctx is not None:
+                hop = req_ctx.child()
+                hdrs = {_tracing.TRACEPARENT_HEADER: hop.to_header()}
+                t_hop0 = time.perf_counter()
             with self._lock:
                 rep.outstanding += 1
             try:
@@ -1029,7 +1049,8 @@ class FleetRouter:
                     body={"inputs": [x.tolist()],
                           "deadline_ms": remaining_ms,
                           "model": model},
-                    timeout=remaining_ms / 1e3 + 5.0)
+                    timeout=remaining_ms / 1e3 + 5.0,
+                    headers=hdrs)
             except Exception as exc:  # connection-level death
                 if rep.proc is not None \
                         and rep.proc.poll() is not None:
@@ -1051,6 +1072,11 @@ class FleetRouter:
                     rep.outstanding -= 1
                     rep.routed += 1
             if status == 200:
+                if hop is not None:
+                    _tracing.emit_span("route_attempt", t_hop0,
+                                       time.perf_counter(), hop,
+                                       kind="client",
+                                       replica=int(rep.idx))
                 return onp.asarray(body["outputs"][0])
             reason = body.get("error", "model_error") \
                 if isinstance(body, dict) else "model_error"
@@ -1089,6 +1115,10 @@ class FleetRouter:
                 from None
         with self._lock:
             self.stats["completed"] += 1
+        if req_ctx is not None:
+            _tracing.emit_span("fleet_request", t_req0,
+                               time.perf_counter(), req_ctx,
+                               kind="server", model=str(model or ""))
         return out
 
     def _pick(self, exclude=()):
@@ -1273,7 +1303,21 @@ class FleetRouter:
         post-rollout ``identities`` consistency check (every live
         replica must answer with ONE artifact path)."""
         t0 = time.perf_counter()
-        version = (_artifact_identity(path) or {}).get("model_version")
+        meta = _artifact_identity(path) or {}
+        version = meta.get("model_version")
+        # round 20: the v2 header's trace_anchor is the trainer's
+        # export-span context — parenting the swap span on it links
+        # the serve-side cutover back to the training step that
+        # produced these weights, across processes and hosts
+        swap_ctx = None
+        if _tracing.enabled():
+            anchor = _tracing.from_header(meta.get("trace_anchor"))
+            if anchor is not None:
+                swap_ctx = anchor.child()
+            else:
+                cur = _tracing.current_context()
+                swap_ctx = cur.child() if cur is not None \
+                    else _tracing.mint()
         with self._lock:
             committed_version = self._committed_version
             prev_path = self._prev_artifact
@@ -1391,6 +1435,12 @@ class FleetRouter:
             swapped=sorted(per), errors=errors,
             committed=committed, version=version)
         self._fleet_record("swap")
+        if swap_ctx is not None:
+            _tracing.emit_span(
+                "rolling_swap", t0, time.perf_counter(), swap_ctx,
+                kind="internal", committed=bool(committed),
+                version=int(version) if version is not None else None,
+                replicas=len(per))
         return {"per_replica": per, "errors": errors,
                 "committed": committed,
                 "rolled_back": sorted(rolled_back),

@@ -45,6 +45,7 @@ reproducible SIGKILL the fleet drills route around.
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import threading
 import time
@@ -69,12 +70,14 @@ REJECT_STATUS = {
 }
 
 
-def http_call(addr, port, method, path, body=None, timeout=10.0):
+def http_call(addr, port, method, path, body=None, timeout=10.0,
+              headers=None):
     """One stdlib HTTP request (the router's client side): returns
     ``(status, payload)`` where payload is the parsed JSON body (or
     the raw text for non-JSON responses like ``/metrics``).
-    Connection-level failures raise ``OSError``/``http.client``
-    errors — the caller's failover path.
+    ``headers`` merges extra request headers (the router's
+    ``traceparent`` hop rides here).  Connection-level failures raise
+    ``OSError``/``http.client`` errors — the caller's failover path.
 
     One fresh connection per call, deliberately: a hand-rolled pool
     shared across the router's failover/probe threads would have to
@@ -88,11 +91,11 @@ def http_call(addr, port, method, path, body=None, timeout=10.0):
                                       timeout=float(timeout))
     try:
         data = None
-        headers = {}
+        hdrs = dict(headers) if headers else {}
         if body is not None:
             data = json.dumps(body).encode()
-            headers["Content-Type"] = "application/json"
-        conn.request(method, path, body=data, headers=headers)
+            hdrs["Content-Type"] = "application/json"
+        conn.request(method, path, body=data, headers=hdrs)
         resp = conn.getresponse()
         raw = resp.read()
         ctype = resp.getheader("Content-Type", "")
@@ -233,13 +236,16 @@ def _make_handler(host):
                 raise MXNetError("request body must be a JSON object")
             return doc
 
-        def _send(self, status, payload, ctype="application/json"):
+        def _send(self, status, payload, ctype="application/json",
+                  extra_headers=None):
             body = payload if isinstance(payload, bytes) else \
                 json.dumps(payload).encode() if ctype.endswith("json") \
                 else str(payload).encode()
             self.send_response(int(status))
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra_headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             try:
                 self.wfile.write(body)
@@ -326,27 +332,48 @@ def _make_handler(host):
             x = onp.asarray(rows)
             deadline_ms = body.get("deadline_ms")
             model = body.get("model")
-            try:
-                handles = [host.submit(row, deadline_ms=deadline_ms,
-                                       model=model) for row in x]
-            except ServeRejected as exc:
-                # already-admitted sibling rows still reach their own
-                # terminal state server-side; the REQUEST is the unit
-                # of shed here
-                return self._send_rejection(exc)
-            wait_s = (float(deadline_ms) / 1e3 + 30.0) \
-                if deadline_ms is not None else 120.0
-            outs = []
-            try:
-                for h in handles:
-                    outs.append(onp.asarray(h.result(timeout=wait_s)))
-            except ServeRejected as exc:
-                return self._send_rejection(exc)
+            # trace context: an inbound traceparent (the router's hop)
+            # is adopted and echoed; with none, an ARMED replica roots
+            # its own trace.  Unarmed with no header = no minting, no
+            # echo — the zero-cost contract
+            from ..telemetry import tracing
+            inbound = tracing.from_header(
+                self.headers.get(tracing.TRACEPARENT_HEADER))
+            req_ctx = inbound.child() if inbound is not None else \
+                (tracing.mint() if tracing.enabled() else None)
+            bind = tracing.use(req_ctx) if req_ctx is not None \
+                else contextlib.nullcontext()
+            with bind:
+                try:
+                    handles = [host.submit(row,
+                                           deadline_ms=deadline_ms,
+                                           model=model) for row in x]
+                except ServeRejected as exc:
+                    # already-admitted sibling rows still reach their
+                    # own terminal state server-side; the REQUEST is
+                    # the unit of shed here
+                    return self._send_rejection(exc)
+                wait_s = (float(deadline_ms) / 1e3 + 30.0) \
+                    if deadline_ms is not None else 120.0
+                outs = []
+                try:
+                    for h in handles:
+                        outs.append(
+                            onp.asarray(h.result(timeout=wait_s)))
+                except ServeRejected as exc:
+                    return self._send_rejection(exc)
+            t1 = time.perf_counter()
+            if req_ctx is not None:
+                tracing.emit_span("replica_request", t0, t1, req_ctx,
+                                  kind="server", rows=int(len(x)),
+                                  model=model or "")
             self._send(200, {
                 "outputs": [o.tolist() for o in outs],
-                "latency_ms": round(
-                    (time.perf_counter() - t0) * 1e3, 3),
-                "model": model})
+                "latency_ms": round((t1 - t0) * 1e3, 3),
+                "model": model},
+                extra_headers={tracing.TRACEPARENT_HEADER:
+                               req_ctx.to_header()}
+                if req_ctx is not None else None)
 
         def _swap(self, body):
             from .fleet import SwapRolledBack

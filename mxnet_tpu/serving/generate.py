@@ -58,6 +58,7 @@ import numpy as onp
 from ..base import MXNetError
 from ..quantization.kv import kv_quantize
 from ..resilience import faultsim
+from ..telemetry import tracing as _tracing
 from .kvcache import PagedKVPool
 from .server import ServeRejected
 
@@ -153,9 +154,10 @@ class GenerateHandle:
 class _Seq:
     __slots__ = ("id", "handle", "prompt", "max_new", "generated",
                  "slot", "t_submit", "t_first", "deadline", "evictions",
-                 "counted_admit")
+                 "counted_admit", "trace", "t_submit_pc", "t_first_pc")
 
-    def __init__(self, seq_id, handle, prompt, max_new, deadline):
+    def __init__(self, seq_id, handle, prompt, max_new, deadline,
+                 trace=None):
         self.id = seq_id
         self.handle = handle
         self.prompt = list(prompt)
@@ -167,6 +169,12 @@ class _Seq:
         self.deadline = deadline
         self.evictions = 0
         self.counted_admit = False
+        # round-20 trace context captured at submit (None = untraced:
+        # the scheduler emits no spans for this sequence)
+        self.trace = trace
+        self.t_submit_pc = time.perf_counter() if trace is not None \
+            else None
+        self.t_first_pc = None
 
     @property
     def context(self):
@@ -636,8 +644,15 @@ class GenerativeServer:
                     f"{self.pool.capacity_tokens}-token budget")
             self._seq_counter += 1
             handle = GenerateHandle(self._seq_counter)
+            trace = None
+            if _tracing.enabled():
+                cur = _tracing.current_context()
+                # entry point: adopt the caller's context, else root a
+                # fresh trace for this generation request
+                trace = cur.child() if cur is not None \
+                    else _tracing.mint()
             seq = _Seq(self._seq_counter, handle, prompt, max_new,
-                       time.monotonic() + budget_ms / 1e3)
+                       time.monotonic() + budget_ms / 1e3, trace=trace)
             self._queue.append(seq)
             self.stats["requests"] += 1
             self._telemetry_gauge("prefill_queue_depth",
@@ -746,6 +761,7 @@ class GenerativeServer:
         disaggregation boundary.  Prefill compiles once per bucket
         (counted); the slot install is pure in-place data updates."""
         faultsim.inject("serve.prefill")
+        t_pf0 = time.perf_counter()
         context = seq.context
         n = len(context)
         bucket = self._bucket_for(n)
@@ -763,11 +779,34 @@ class GenerativeServer:
         self.pool.alloc(seq.id, seq.budget_tokens)
         self.pool.write_prompt(seq.id, k[:, 0, :n], v[:, 0, :n])
         now = time.monotonic()
-        if seq.t_first is None:
+        was_first = seq.t_first is None
+        if was_first:
             seq.t_first = now
             seq.handle.ttft_ms = (now - seq.t_submit) * 1e3
             with self._lock:
                 self._ttft_ms.append(seq.handle.ttft_ms)
+        if seq.trace is not None:
+            # TTFT decomposition for a traced request: admission wait
+            # (submit -> prefill start, first install only) and the
+            # bucketed prefill itself
+            from .. import telemetry
+
+            rl = telemetry.current()
+            if rl is not None:
+                t_pf1 = time.perf_counter()
+                ctx = seq.trace
+                if was_first:
+                    seq.t_first_pc = t_pf1
+                    rl.span("gen_admit", seq.t_submit_pc, t_pf0,
+                            trace_id=ctx.trace_id,
+                            span_id=_tracing.new_span_id(),
+                            parent_span_id=ctx.span_id, flush=False)
+                rl.span("gen_prefill", t_pf0, t_pf1,
+                        trace_id=ctx.trace_id,
+                        span_id=_tracing.new_span_id(),
+                        parent_span_id=ctx.span_id, flush=False,
+                        bucket=int(bucket),
+                        reprefill=bool(seq.evictions))
         seq.generated.append(first)
         with self._lock:
             self.stats["tokens"] += 1
@@ -799,6 +838,23 @@ class GenerativeServer:
         if slot is not None:
             self._clear_slot(slot)
         seq.handle.latency_ms = (time.monotonic() - seq.t_submit) * 1e3
+        if seq.trace is not None:
+            from .. import telemetry
+
+            rl = telemetry.current()
+            if rl is not None:
+                t1 = time.perf_counter()
+                ctx = seq.trace
+                if seq.t_first_pc is not None and t1 > seq.t_first_pc:
+                    rl.span("gen_decode", seq.t_first_pc, t1,
+                            trace_id=ctx.trace_id,
+                            span_id=_tracing.new_span_id(),
+                            parent_span_id=ctx.span_id, flush=False,
+                            tokens=len(seq.generated),
+                            evictions=int(seq.evictions))
+                _tracing.emit_span("gen_request", seq.t_submit_pc, t1,
+                                   ctx, kind="server",
+                                   tokens=len(seq.generated))
         with self._lock:
             self.stats["completed"] += 1
             self._latency_ms.append(seq.handle.latency_ms)

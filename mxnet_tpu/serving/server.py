@@ -71,6 +71,7 @@ import numpy as onp
 from ..base import MXNetError
 from ..resilience import faultsim
 from ..resilience.retry import retry_call
+from ..telemetry import tracing as _tracing
 
 __all__ = ["ModelServer", "ServeHandle", "ServeRejected",
            "default_buckets"]
@@ -178,13 +179,20 @@ class ServeHandle:
 
 
 class _Request:
-    __slots__ = ("x", "deadline", "t_submit", "handle")
+    __slots__ = ("x", "deadline", "t_submit", "handle", "trace",
+                 "t_submit_pc")
 
-    def __init__(self, x, deadline, t_submit, handle):
+    def __init__(self, x, deadline, t_submit, handle, trace=None,
+                 t_submit_pc=None):
         self.x = x
         self.deadline = deadline
         self.t_submit = t_submit
         self.handle = handle
+        # distributed-trace context captured at submit (round 20):
+        # None on an untraced request — the dispatch loop emits no
+        # spans for it, preserving the armed-but-untraced hot path
+        self.trace = trace
+        self.t_submit_pc = t_submit_pc
 
 
 class ModelServer:
@@ -264,6 +272,7 @@ class ModelServer:
         self._thread = None
         self._wd = None
         self._hb = time.monotonic()
+        self._t_take_pc = None  # coalesce-start mark for trace spans
         self._ewma = {}             # bucket -> seconds
         self._ewma_alpha = 0.3
         self._breaker = "closed"
@@ -515,7 +524,13 @@ class ModelServer:
                     f"estimated completion +{est * 1e3:.1f} ms "
                     f"exceeds deadline +{budget_ms:.1f} ms")
             h = ServeHandle(deadline, now)
-            self._queue.append(_Request(x, deadline, now, h))
+            trace = t_pc = None
+            if _tracing.enabled():
+                trace = _tracing.current_context()
+                if trace is not None:
+                    t_pc = time.perf_counter()
+            self._queue.append(_Request(x, deadline, now, h,
+                                        trace, t_pc))
             self._inflight += 1
             self.stats["admitted"] += 1
             self._cond.notify_all()
@@ -629,6 +644,7 @@ class ModelServer:
         queued, waiting at most ``coalesce_s`` for the batch to grow
         toward the largest bucket — queue depth, not a timer, sizes
         the microbatch."""
+        self._t_take_pc = time.perf_counter()
         end = time.monotonic() + self.coalesce_s
         while len(self._queue) < self.max_batch and self._running:
             left = end - time.monotonic()
@@ -687,7 +703,7 @@ class ModelServer:
         finally:
             with self._cond:
                 self._batch_running = False
-        self._record_success(live, bucket, latency, now)
+        self._record_success(live, bucket, latency, now, t0)
         for i, r in enumerate(live):
             self._finish(r, out=out[i])
 
@@ -737,7 +753,8 @@ class ModelServer:
             telemetry.compile_fingerprint(shape, self.dtype,
                                           train=False))
 
-    def _record_success(self, live, bucket, latency, t_dispatch):
+    def _record_success(self, live, bucket, latency, t_dispatch,
+                        t_invoke=None):
         with self._cond:
             prev = self._ewma.get(bucket)
             self._ewma[bucket] = latency if prev is None else \
@@ -755,11 +772,37 @@ class ModelServer:
 
         rl = telemetry.current()
         if rl is not None:
+            if t_invoke is not None:
+                self._emit_request_spans(rl, live, bucket, t_invoke,
+                                         t_invoke + latency)
             rl.serve(model=self.name, batch=len(live),
                      padded_to=bucket, queue_depth=qd,
                      latency_ms=latency * 1e3,
                      deadline_margin_ms=margin_ms, shed=shed,
                      breaker=self._breaker)
+
+    def _emit_request_spans(self, rl, live, bucket, t_invoke, t_end):
+        """Per-request TTL decomposition for TRACED requests (round
+        20): ``serve_queue`` (submit -> batch taken), ``serve_coalesce``
+        (batch formation -> model invoke) and ``serve_model`` (the
+        invocation), all siblings under the request's captured context.
+        Untraced requests cost one attribute check; the spans queue
+        unflushed behind the batch's flushing ``serve`` record."""
+        t_take = self._t_take_pc
+        for r in live:
+            ctx = r.trace
+            if ctx is None:
+                continue
+            qs = r.t_submit_pc
+            cs = min(max(qs, t_take if t_take is not None
+                         else t_invoke), t_invoke)
+            for name, a, b in (("serve_queue", qs, cs),
+                               ("serve_coalesce", cs, t_invoke),
+                               ("serve_model", t_invoke, t_end)):
+                rl.span(name, a, b, trace_id=ctx.trace_id,
+                        span_id=_tracing.new_span_id(),
+                        parent_span_id=ctx.span_id, flush=False,
+                        model=self.name, padded_to=int(bucket))
 
     def _model_failure(self, live, exc):
         err = exc if isinstance(exc, ServeRejected) else ServeRejected(

@@ -11,13 +11,17 @@ Public surface:
 * :func:`describe_program` — XLA memory/flop/collective introspection
   of a compiled step.
 * :func:`fit_session` — the per-``Module.fit`` session wrapper.
+* :mod:`.tracing` — W3C-style distributed trace context + span
+  emission (round 20); merged across processes by
+  ``tools/tracemerge.py``.
 * :mod:`.schema` — the JSONL record contract tests and CI validate.
 
 Env knobs (registered in :mod:`mxnet_tpu.config`): ``MXNET_RUNLOG``,
 ``MXNET_TELEMETRY_SAMPLE``, ``MXNET_FLIGHTREC_DEPTH``,
-``MXNET_METRICS_TEXTFILE``.
+``MXNET_METRICS_TEXTFILE``, ``MXNET_TRACE_CONTEXT``,
+``MXNET_PROCESS_ROLE``, ``MXNET_PROCESS_RANK``.
 """
-from . import numerics, opstats, schema  # noqa: F401
+from . import numerics, opstats, schema, tracing  # noqa: F401
 from .runlog import (  # noqa: F401
     RunLog,
     checkpoint_event,
@@ -29,6 +33,7 @@ from .runlog import (  # noqa: F401
     data_plane,
     describe_program,
     event,
+    find_flight_dumps,
     flight_dump,
     flight_path_for,
     freshness,
@@ -40,7 +45,12 @@ from .runlog import (  # noqa: F401
     reset,
 )
 from .session import FitSession, fit_session  # noqa: F401
-from .watchdog import Watchdog, stack_path_for  # noqa: F401
+from .tracing import TraceContext  # noqa: F401
+from .watchdog import (  # noqa: F401
+    Watchdog,
+    find_stack_dumps,
+    stack_path_for,
+)
 
 __all__ = [
     "RunLog", "current", "reset", "close", "compile_event",
@@ -48,7 +58,9 @@ __all__ = [
     "heal", "freshness",
     "data_plane", "quantize", "checkpoint_event", "program_report",
     "flight_dump",
-    "flight_path_for", "describe_program", "FitSession",
+    "flight_path_for", "find_flight_dumps", "describe_program",
+    "FitSession",
     "fit_session", "schema", "Watchdog", "stack_path_for",
+    "find_stack_dumps", "tracing", "TraceContext",
     "numerics", "opstats",
 ]

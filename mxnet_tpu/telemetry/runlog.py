@@ -52,10 +52,16 @@ __all__ = ["RunLog", "current", "reset", "close", "compile_event",
            "compile_fingerprint", "event", "count", "gauge", "heal",
            "quantize", "freshness", "checkpoint_event",
            "program_report", "flight_dump", "describe_program",
-           "flight_path_for"]
+           "flight_path_for", "find_flight_dumps"]
 
 _LOCK = threading.RLock()
 _STATE = {"log": None, "resolved": False}
+
+#: set by telemetry.tracing at import: a zero-arg callable returning
+#: the thread's current TraceContext (or None).  Kept as a module slot
+#: instead of an import so runlog stays import-cycle-free; when absent
+#: records are simply unstamped.
+_TRACE_GETTER = None
 
 #: fingerprint key -> the compile cause it maps to when it changes
 _CAUSE_OF = {"shape": "shape", "dtype": "dtype", "train": "train_mode",
@@ -84,8 +90,28 @@ def _jsonable(v):
     return str(v)
 
 
-def flight_path_for(runlog_path):
-    return f"{runlog_path}.flight.json"
+def flight_path_for(runlog_path, pid=None):
+    """The flight-recorder dump path for a run log.  Pid-suffixed
+    (round 20): two processes armed with the same ``MXNET_RUNLOG``
+    path (supervisor + relaunched child, router + replica pointed at
+    one file) used to clobber each other's post-mortems."""
+    return f"{runlog_path}.flight.{os.getpid() if pid is None else pid}.json"
+
+
+def find_flight_dumps(runlog_path):
+    """Every flight dump paired with a run log, newest first — the
+    pid-suffixed round-20 names plus the legacy unsuffixed
+    ``<runlog>.flight.json`` (pre-round-20 artifacts must stay
+    loadable).  Loaders glob through here instead of deriving one
+    path, because the dump they want may belong to a DEAD child pid."""
+    import glob as _glob
+
+    found = _glob.glob(f"{runlog_path}.flight.*.json")
+    legacy = f"{runlog_path}.flight.json"
+    if os.path.exists(legacy):
+        found.append(legacy)
+    found.sort(key=lambda p: (os.path.getmtime(p), p), reverse=True)
+    return found
 
 
 def compile_fingerprint(shape, dtype, train, winners=None, hyper=None,
@@ -158,12 +184,26 @@ class RunLog:
         self._recent = collections.deque(maxlen=64)  # (t, samples)
         self._last = {"loss": None, "samples_per_sec": None}
         self._closed = False
-        self._write({"type": "run_start", "time": time.time(),
-                     "pid": os.getpid(), "env": self._env_snapshot(),
-                     "config": {"sample": self.sample,
-                                "flight_depth": depth,
-                                "textfile": self.textfile},
-                     "jax": self._jax_snapshot()})
+        start = {"type": "run_start", "time": time.time(),
+                 "pid": os.getpid(), "parent_pid": os.getppid(),
+                 "env": self._env_snapshot(),
+                 "config": {"sample": self.sample,
+                            "flight_depth": depth,
+                            "textfile": self.textfile},
+                 "jax": self._jax_snapshot()}
+        # round-20 process identity: the spawner (fleet, online loop,
+        # healing supervisor) stamps who this process IS, so tracemerge
+        # can label its track group without guessing from the filename
+        role = os.environ.get("MXNET_PROCESS_ROLE")
+        if role:
+            start["role"] = str(role)
+        rank = os.environ.get("MXNET_PROCESS_RANK")
+        if rank is not None:
+            try:
+                start["rank"] = int(rank)
+            except ValueError:
+                pass
+        self._write(start)
 
     # ------------------------------------------------------- plumbing
     @staticmethod
@@ -191,6 +231,18 @@ class RunLog:
         next flushing record, keeping the hot path syscall-free.
         ``raw=True`` skips the ``_jsonable`` recursion for records
         built from known scalars (``default=str`` catches strays)."""
+        # round 20: stamp the thread's trace context (when one is
+        # bound and the record isn't already stamped) so EVERY record
+        # type can join the cross-process timeline.  One TLS read on
+        # an armed log; unarmed runs never reach _write at all.
+        g = _TRACE_GETTER
+        if g is not None and "trace_id" not in rec:
+            ctx = g()
+            if ctx is not None:
+                rec["trace_id"] = ctx.trace_id
+                rec["span_id"] = ctx.span_id
+                if ctx.parent_span_id is not None:
+                    rec["parent_span_id"] = ctx.parent_span_id
         if not raw:
             rec = _jsonable(rec)
         with self._lock:
@@ -431,6 +483,25 @@ class RunLog:
                 args={"phase": str(phase),
                       "quiet_s": round(float(quiet_s), 3)},
                 tid=_TRACE_TID)
+
+    def span(self, name, t0, t1, *, trace_id, span_id,
+             parent_span_id=None, kind="internal", flush=True, **attrs):
+        """One completed distributed-trace span (telemetry.tracing).
+        ``t0``/``t1`` are ``time.perf_counter()`` readings; the record
+        stores the run-relative END time plus ``dur_ms`` so
+        tools/tracemerge.py reconstructs wall time from
+        ``run_start.time``.  Hot emitters (the serve dispatch loop)
+        pass ``flush=False`` — the spans queue behind the flushing
+        ``serve`` record of the same batch, adding zero syscalls."""
+        rec = {"type": "span", "t": round(t1 - self._t0, 6),
+               "name": str(name), "kind": str(kind),
+               "dur_ms": round((t1 - t0) * 1e3, 4),
+               "trace_id": trace_id, "span_id": span_id,
+               "parent_span_id": parent_span_id}
+        if attrs:
+            rec["attrs"] = _jsonable(attrs)
+        self._write(rec, flush=flush, raw=True)
+        return rec
 
     def serve(self, *, model, batch, padded_to, queue_depth,
               latency_ms, deadline_margin_ms=None, shed=0,

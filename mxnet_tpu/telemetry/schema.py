@@ -37,9 +37,18 @@ freshness one online-learning loop observation (publish / swap_commit
           the artifact's monotonic model version, the measured
           sample-to-served freshness and the loop's cumulative
           export/swap/shed/violation counters
+span      one completed trace span (name, duration, trace/span/parent
+          ids) — the cross-process causal unit tools/tracemerge.py
+          stitches into one timeline
 event     everything else (bad_step, ps_retry, fault, deadline, ...)
 run_end   final counters, written at close
 ========  =============================================================
+
+Round 20: every record type may additionally carry the optional trace
+fields ``trace_id`` / ``span_id`` / ``parent_span_id`` (validated when
+present; absent = pre-round-20 compatible), and ``run_start`` may carry
+the process identity ``role`` / ``rank`` / ``parent_pid`` stamped by
+its spawner.
 """
 from __future__ import annotations
 
@@ -47,7 +56,8 @@ __all__ = ["STEP_FIELDS", "RECORD_TYPES", "COMPILE_CAUSES",
            "OPSTATS_ROW_FIELDS", "TENSOR_STATS_ROW_FIELDS",
            "SERVE_FIELDS", "GENERATE_FIELDS", "FLEET_FIELDS",
            "HEAL_FIELDS", "DATA_FIELDS", "QUANT_FIELDS",
-           "FRESHNESS_FIELDS", "validate_record", "validate_lines"]
+           "FRESHNESS_FIELDS", "SPAN_FIELDS", "TRACE_FIELDS",
+           "validate_record", "validate_lines"]
 
 #: step-record contract: field -> (types, required).  ``None`` is legal
 #: for optional measurements (loss on an unsampled step, feed stats
@@ -77,7 +87,45 @@ STEP_FIELDS = {
 RECORD_TYPES = ("run_start", "step", "compile", "program_report",
                 "checkpoint", "watchdog", "opstats", "tensor_stats",
                 "serve", "generate", "fleet", "heal", "data",
-                "quantize", "freshness", "event", "run_end")
+                "quantize", "freshness", "span", "event", "run_end")
+
+#: contract of a ``span`` record (telemetry.tracing): one completed
+#: span of a distributed trace.  ``t`` is the run-relative END time
+#: (the runlog's native clock) and ``dur_ms`` walks it back to the
+#: start, so tracemerge reconstructs wall time as
+#: ``run_start.time + t - dur_ms/1e3``
+SPAN_FIELDS = {
+    "type": (str, True),
+    "t": ((int, float), True),            # run-relative end time
+    "name": (str, True),
+    "kind": (str, True),                  # server|client|internal|...
+    "dur_ms": ((int, float), True),
+    "trace_id": (str, True),              # 32 hex
+    "span_id": (str, True),               # 16 hex
+    "parent_span_id": ((str, type(None)), True),
+    "attrs": ((dict, type(None)), False),
+}
+
+#: optional trace stamps any OTHER record type may carry (absent =
+#: pre-round-20 record) — validated for shape whenever present
+TRACE_FIELDS = {
+    "trace_id": (str, False),
+    "span_id": (str, False),
+    "parent_span_id": ((str, type(None)), False),
+}
+
+
+def _check_trace_ids(rec):
+    """Hex-shape checks for trace stamps, applied whenever present."""
+    problems = []
+    tid = rec.get("trace_id")
+    if isinstance(tid, str) and len(tid) != 32:
+        problems.append(f"trace_id must be 32 hex chars, got {tid!r}")
+    for name in ("span_id", "parent_span_id"):
+        sid = rec.get(name)
+        if isinstance(sid, str) and len(sid) != 16:
+            problems.append(f"{name} must be 16 hex chars, got {sid!r}")
+    return problems
 
 #: per-batch contract of a ``serve`` record (serving.ModelServer)
 SERVE_FIELDS = {
@@ -242,6 +290,13 @@ def validate_record(rec):
     t = rec.get("type")
     if t not in RECORD_TYPES:
         return [f"unknown record type {t!r}"]
+    return _validate_typed(rec, t) + _check_fields(rec, TRACE_FIELDS) \
+        + _check_trace_ids(rec)
+
+
+def _validate_typed(rec, t):
+    if t == "span":
+        return _check_fields(rec, SPAN_FIELDS)
     if t == "step":
         return _check_fields(rec, STEP_FIELDS)
     if t == "compile":
@@ -315,10 +370,16 @@ def validate_record(rec):
         return _check_fields(rec, {"t": ((int, float), True),
                                    "kind": (str, True)})
     if t == "run_start":
-        return _check_fields(rec, {"time": ((int, float), True),
-                                   "pid": (int, True),
-                                   "env": (dict, True),
-                                   "config": (dict, True)})
+        return _check_fields(rec, {
+            "time": ((int, float), True),
+            "pid": (int, True),
+            "env": (dict, True),
+            "config": (dict, True),
+            # round-20 process identity, stamped by spawners; optional
+            # so pre-round-20 logs stay valid
+            "role": (str, False),
+            "rank": ((int, type(None)), False),
+            "parent_pid": (int, False)})
     if t == "run_end":
         return _check_fields(rec, {"t": ((int, float), True),
                                    "counters": (dict, True)})

@@ -11,6 +11,9 @@ fit loop can call unconditionally through :func:`fit_session`.
 from __future__ import annotations
 
 import time
+from contextlib import nullcontext as _nullcontext
+
+from . import tracing as _tracing
 
 __all__ = ["FitSession", "fit_session"]
 
@@ -39,8 +42,17 @@ class FitSession:
                     self._wd = Watchdog().arm("fit")
             except Exception:
                 self._wd = None  # the observer must not break fit
+        # fit is a trace entry point: one root context per fit call
+        # (child of the process stamp when this worker was spawned by
+        # a traced supervisor).  Sampled steps emit fit_step spans;
+        # unsampled steps stay on the PR-5 hot-path budget.
+        self._trace = None
         if runlog is not None:
-            runlog.event("fit_start", batch_size=self.batch_size)
+            parent = _tracing.current_context()
+            self._trace = parent.child() if parent is not None \
+                else _tracing.mint()
+            with _tracing.use(self._trace):
+                runlog.event("fit_start", batch_size=self.batch_size)
 
     def __bool__(self):
         return self.rl is not None
@@ -61,7 +73,8 @@ class FitSession:
                  synced=False, bad_step=False):
         if self.rl is None or self._t_step is None:
             return
-        wall = time.perf_counter() - self._t_step
+        t0, t1 = self._t_step, time.perf_counter()
+        wall = t1 - t0
         self._t_step = None
         feed_wait = h2d = None
         if self._feed is not None:
@@ -71,11 +84,21 @@ class FitSession:
                 - prev.get("consumer_wait_s", 0.0)
             h2d = snap.get("h2d_bytes", 0) - prev.get("h2d_bytes", 0)
             self._feed_snap = snap
-        self.rl.step(
-            epoch, batch, wall,
-            samples if samples is not None else self.batch_size,
-            loss=loss, synced=synced, feed_wait_s=feed_wait,
-            h2d_bytes=h2d, bad_step=bad_step)
+        ctx = None
+        if synced and self._trace is not None:
+            # sampled steps only: the span rides the step record's
+            # flush (flush=False) so traced fits pay zero extra
+            # syscalls on the step path
+            ctx = self._trace.child()
+            _tracing.emit_span("fit_step", t0, t1, ctx, flush=False,
+                               epoch=int(epoch), batch=int(batch))
+        with (_tracing.use(ctx) if ctx is not None
+              else _nullcontext()):
+            self.rl.step(
+                epoch, batch, wall,
+                samples if samples is not None else self.batch_size,
+                loss=loss, synced=synced, feed_wait_s=feed_wait,
+                h2d_bytes=h2d, bad_step=bad_step)
         self._step_no += 1
 
     # ------------------------------------------------------ death paths
@@ -97,8 +120,10 @@ class FitSession:
         if self.rl is None or self._ended:
             return
         self._ended = True
-        self.rl.event("fit_end", outcome=outcome,
-                      steps=self._step_no)
+        with (_tracing.use(self._trace) if self._trace is not None
+              else _nullcontext()):
+            self.rl.event("fit_end", outcome=outcome,
+                          steps=self._step_no)
         if self.rl.textfile:
             self.rl.write_textfile()
 

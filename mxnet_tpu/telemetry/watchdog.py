@@ -53,8 +53,8 @@ import tempfile
 import threading
 import time
 
-__all__ = ["Watchdog", "stack_path_for", "default_timeout",
-           "WATCHDOG_ABORT_EXIT_CODE"]
+__all__ = ["Watchdog", "stack_path_for", "find_stack_dumps",
+           "default_timeout", "WATCHDOG_ABORT_EXIT_CODE"]
 
 #: exit status of a MXNET_WATCHDOG_ABORT escalation — distinct from
 #: the faultsim crash code (87), a healing peer-death exit (83) and
@@ -63,10 +63,27 @@ __all__ = ["Watchdog", "stack_path_for", "default_timeout",
 WATCHDOG_ABORT_EXIT_CODE = 85
 
 
-def stack_path_for(runlog_path):
+def stack_path_for(runlog_path, pid=None):
     """The stack-dump file that pairs with a run log (like
-    ``flight_path_for``): ``<runlog>.stacks.txt``."""
-    return f"{runlog_path}.stacks.txt"
+    ``flight_path_for``): ``<runlog>.stacks.<pid>.txt``.  Pid-suffixed
+    since round 20 — two processes armed with the same ``MXNET_RUNLOG``
+    path (supervisor + child) used to interleave/clobber each other's
+    dumps in one file."""
+    return f"{runlog_path}.stacks.{os.getpid() if pid is None else pid}.txt"
+
+
+def find_stack_dumps(runlog_path):
+    """Every stack-dump file paired with a run log, newest first —
+    pid-suffixed names plus the legacy unsuffixed
+    ``<runlog>.stacks.txt`` (pre-round-20 artifacts stay loadable)."""
+    import glob as _glob
+
+    found = _glob.glob(f"{runlog_path}.stacks.*.txt")
+    legacy = f"{runlog_path}.stacks.txt"
+    if os.path.exists(legacy) and legacy not in found:
+        found.append(legacy)
+    found.sort(key=lambda p: (os.path.getmtime(p), p), reverse=True)
+    return found
 
 
 def default_timeout():

@@ -256,6 +256,24 @@ def test_smoke_emits_valid_json_with_heartbeats():
     assert fr["p50_ms"] > 0 and fr["p99_ms"] >= fr["p50_ms"]
     assert fr["slo_ms"] > 0
     assert fr["p99_within_slo"] is True
+    # the distributed-tracing phase (round 20): per-process runlogs
+    # from a 2-replica fleet merged into ONE causal timeline — spans
+    # crossed processes, the skew estimator ran, and doctor named the
+    # delay-injected replica as the bottleneck
+    tr = out["trace"]
+    assert tr["errors"] == 0, tr["error_sample"]
+    assert tr["completed"] > 0
+    assert tr["processes"] >= 3  # router + 2 replicas
+    assert tr["spans"] > 0
+    assert tr["traced_requests"] == tr["completed"]
+    assert tr["flow_links"] >= tr["completed"]  # every request hopped
+    assert len(tr["skew_s"]) == tr["processes"]
+    assert tr["dominant"] in ("queue", "coalesce", "compute",
+                              "other", "swap-in-progress")
+    assert tr["bottleneck_process"].startswith("replica-1"), tr
+    assert set(tr["components_pct"]) == {"queue", "coalesce",
+                                         "compute", "other"}
+    assert tr["overhead_ratio"] is not None
     # the hang watchdog was armed (bench defaults it on) and quiet
     assert out["watchdog_sec"] > 0
     assert out["watchdog_stalls"] == 0
@@ -264,8 +282,8 @@ def test_smoke_emits_valid_json_with_heartbeats():
                   "compile", "K1", "K2", "trials", "feed",
                   "checkpoint", "collectives", "fused_kernels",
                   "healing", "data_plane", "serving", "quantization",
-                  "generate", "fleet", "freshness", "telemetry",
-                  "conv_ab", "done"):
+                  "generate", "fleet", "freshness", "trace",
+                  "telemetry", "conv_ab", "done"):
         assert f"phase={phase}" in r.stderr, f"missing phase {phase}"
 
 

@@ -444,7 +444,8 @@ def test_winner_persistence_across_processes(tmp_path):
         report = quant.tune_quantized(qnet, _corpus(1)[0], iters=4)
         assert set(report) == {"quantized_conv", "quantized_fc"}
         for op, r in report.items():
-            assert r["winner"] in ("fp32", "int8")
+            # fp8 joined the race in round 19 — any arm may win on CPU
+            assert r["winner"] in ("fp32", "int8", "fp8")
             assert not r.get("cached")
         entries = json.load(open(
             os.path.join(cache_dir, "autotune.json")))["entries"]

@@ -721,8 +721,12 @@ def test_kill_mid_traffic_flight_dump_then_warm_relaunch(tmp_path):
     assert r.returncode == faultsim.CRASH_EXIT_CODE, \
         (r.returncode, r.stderr[-2000:])
     # the flight dump is the post-mortem the hard death left behind
-    flight = runlog1 + ".flight.json"
-    assert os.path.exists(flight)
+    # (pid-suffixed since round 20 — the glob loader finds it)
+    from mxnet_tpu.telemetry import find_flight_dumps
+
+    dumps = find_flight_dumps(runlog1)
+    assert dumps, "no flight dump left behind"
+    flight = dumps[0]
     with open(flight) as f:
         dump = json.load(f)
     assert dump["reason"] == "fault_crash:serve.model"

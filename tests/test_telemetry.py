@@ -345,8 +345,12 @@ def test_sigterm_fit_leaves_untorn_flight_dump(tmp_path):
                                              r.stderr[-2000:])
     assert "COMPLETED" not in r.stdout  # drained, not completed
 
-    flight_path = telemetry.flight_path_for(runlog)
-    assert os.path.exists(flight_path)
+    # the dump is pid-suffixed with the CHILD's pid (round 20: N
+    # processes sharing a prefix can no longer clobber each other) —
+    # the glob loader is the lookup
+    dumps = telemetry.find_flight_dumps(runlog)
+    assert dumps, "no flight dump found"
+    flight_path = dumps[0]
     # atomic: the dump parses whole and no torn temp files remain
     with open(flight_path) as f:
         flight = json.load(f)

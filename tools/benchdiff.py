@@ -46,6 +46,15 @@ a machine-readable trend:
   ever serves an older model, or misses its freshness promise, is
   broken at any speed — baseline rounds included), and a round that
   shipped the phase then lost it is "missing freshness metric".
+* **trace trend** (round 20) — the ``trace`` phase's distributed-
+  tracing metrics round-over-round: the traced-request p99 rates
+  inverted like the fleet's, the armed-vs-unarmed submit overhead
+  ratio must stay <= 2.0 ABSOLUTELY (the hot-path budget: spans ride
+  existing flushes), a round whose p99 lacks its queue/coalesce/
+  compute attribution or a named bottleneck process regresses
+  ABSOLUTELY (a timeline that cannot say WHERE the time went is not
+  observability), and a round that shipped the phase then lost it is
+  "missing trace metric".
 * **zero-stage trend** (round 16, ZeRO) — the collectives phase's
   ``zero`` block (stage-1 vs stage-3 sharded step on the virtual
   mesh): the per-step RS+AG bytes over the analytic plan minimum must
@@ -109,7 +118,10 @@ def load_bench(paths):
                "gen_tokens_s": None, "gen_ttft_p99_ms": None,
                "gen_agreement": None, "gen_compiles": None,
                "zero_rs_ag_ratio": None, "zero_mem_ratio": None,
-               "zero_mem_expected": None, "zero_step_ratio": None}
+               "zero_mem_expected": None, "zero_step_ratio": None,
+               "trace_p99_ms": None, "trace_overhead": None,
+               "trace_processes": None, "trace_attributed": None,
+               "trace_bottleneck": None}
         try:
             with open(path) as f:
                 doc = json.load(f)
@@ -166,6 +178,20 @@ def load_bench(paths):
                 row["gen_ttft_p99_ms"] = gen.get("ttft_p99_ms")
                 row["gen_agreement"] = gen.get("kv_agreement")
                 row["gen_compiles"] = gen.get("compiles_after_warm")
+            tr = parsed.get("trace")
+            if isinstance(tr, dict) and tr.get("processes") is not None:
+                row["trace_p99_ms"] = tr.get("p99_ms")
+                row["trace_overhead"] = tr.get("overhead_ratio")
+                row["trace_processes"] = tr.get("processes")
+                # "attribution present": the request p99 came with a
+                # queue/coalesce/compute decomposition + a named
+                # bottleneck — the observability deliverable itself
+                comp = tr.get("components_pct")
+                row["trace_attributed"] = bool(
+                    isinstance(comp, dict)
+                    and {"queue", "coalesce", "compute"} <= set(comp)
+                    and tr.get("bottleneck_process") is not None)
+                row["trace_bottleneck"] = tr.get("bottleneck_process")
             col = parsed.get("collectives")
             zr = col.get("zero") if isinstance(col, dict) else None
             if isinstance(zr, dict) \
@@ -450,6 +476,69 @@ def freshness_verdicts(rounds, threshold):
     return rounds
 
 
+#: armed-vs-unarmed submit p50 ratio budget: tracing must stay within
+#: the PR-5 hot-path bound (spans ride existing flushes), so an armed
+#: request path costing 2x an unarmed one is broken at any p99
+TRACE_OVERHEAD_MAX = 2.0
+
+
+def trace_verdicts(rounds, threshold):
+    """Verdict the ``trace`` phase (round 20) round-over-round.  Two
+    ABSOLUTE gates fire even on the baseline round: the request p99
+    must come with its queue/coalesce/compute attribution and a named
+    bottleneck process (a timeline that cannot say WHERE the time went
+    is not observability), and the armed-vs-unarmed overhead ratio
+    must stay under ``TRACE_OVERHEAD_MAX`` (the PR-5 hot-path bound,
+    A/B-measured every round).  The traced-request p99 itself rates
+    like the fleet's (lower is better, past the threshold =
+    regression).  Rounds before the phase existed carry no verdict;
+    once shipped, a later round without it is "missing trace
+    metric"."""
+    seen = False
+    prev = None
+    for label in sorted(rounds):
+        row = rounds[label]
+        p99 = row["trace_p99_ms"]
+        if p99 is None and row["trace_processes"] is None:
+            if seen:
+                row["trace_verdict"] = "regression"
+                row["trace_reason"] = "missing trace metric"
+            else:
+                row["trace_verdict"] = None
+                row["trace_reason"] = None
+            continue
+        reasons = []
+        if not row["trace_attributed"]:
+            reasons.append("request p99 attribution missing")
+        ov = row["trace_overhead"]
+        if ov is not None and ov > TRACE_OVERHEAD_MAX:
+            reasons.append(f"tracing overhead x{ov:.2f} "
+                           f"(budget {TRACE_OVERHEAD_MAX:.1f})")
+        if not seen:
+            row["trace_verdict"] = "regression" if reasons \
+                else "baseline"
+            row["trace_reason"] = "; ".join(reasons) or None
+        else:
+            ratio = (p99 / prev) if prev and p99 is not None else None
+            if ratio is not None and ratio > 1.0 + threshold:
+                reasons.append(f"traced p99 x{ratio:.2f}")
+            if reasons:
+                row["trace_verdict"] = "regression"
+                row["trace_reason"] = "; ".join(reasons)
+            elif ratio is not None \
+                    and ratio < 1.0 / (1.0 + threshold):
+                row["trace_verdict"] = "improved"
+                row["trace_reason"] = f"traced p99 x{ratio:.2f}"
+            else:
+                row["trace_verdict"] = "ok"
+                row["trace_reason"] = (f"traced p99 x{ratio:.2f}"
+                                       if ratio is not None else None)
+        seen = True
+        if p99 is not None:
+            prev = p99
+    return rounds
+
+
 def zero_verdicts(rounds, threshold):
     """Verdict the collectives phase's ``zero`` block (ZeRO stage-1 vs
     stage-3 A/B) round-over-round.  Unlike the headline these are
@@ -693,6 +782,26 @@ def render(bench, opperf, threshold):
                 f"{('-' if r['fresh_within_slo'] is None else str(r['fresh_within_slo'])):>8s}"
                 f"{('-' if r['fresh_monotonic'] is None else str(r['fresh_monotonic'])):>7s}"
                 f"  {verdict}")
+    trace_rows = [label for label in sorted(bench)
+                  if bench[label].get("trace_verdict")]
+    if trace_rows:
+        lines.append("")
+        lines.append("== trace trend (distributed tracing) ==")
+        lines.append(f"{'round':<10s}{'p99_ms':>10s}{'procs':>7s}"
+                     f"{'ovhd':>7s}{'attr':>6s}  verdict")
+        for label in trace_rows:
+            r = bench[label]
+            verdict = r["trace_verdict"]
+            if r.get("trace_reason"):
+                verdict += f": {r['trace_reason']}"
+            ov = r["trace_overhead"]
+            lines.append(
+                f"{label:<10s}"
+                f"{_fmt(r['trace_p99_ms']):>10s}"
+                f"{('-' if r['trace_processes'] is None else str(r['trace_processes'])):>7s}"
+                f"{('-' if ov is None else f'x{ov:.2f}'):>7s}"
+                f"{('-' if r['trace_attributed'] is None else str(bool(r['trace_attributed']))):>6s}"
+                f"  {verdict}")
     if opperf.get("compared_ops"):
         lines.append("")
         lines.append(f"== opperf trend {opperf['prev']} -> "
@@ -748,13 +857,15 @@ def main(argv=None):
               f"{opperf_glob!r}", file=sys.stderr)
         return 1
 
-    bench = freshness_verdicts(
-        zero_verdicts(
-            generate_verdicts(
-                quantization_verdicts(
-                    fleet_verdicts(
-                        headline_verdicts(load_bench(bench_paths),
-                                          args.threshold),
+    bench = trace_verdicts(
+        freshness_verdicts(
+            zero_verdicts(
+                generate_verdicts(
+                    quantization_verdicts(
+                        fleet_verdicts(
+                            headline_verdicts(load_bench(bench_paths),
+                                              args.threshold),
+                            args.threshold),
                         args.threshold),
                     args.threshold),
                 args.threshold),
@@ -788,6 +899,10 @@ def main(argv=None):
         if bench[last].get("fresh_verdict") == "regression":
             failures.append(
                 f"freshness {last}: {bench[last]['fresh_reason']}")
+        # distributed-tracing attribution + overhead budget (round 20)
+        if bench[last].get("trace_verdict") == "regression":
+            failures.append(
+                f"trace {last}: {bench[last]['trace_reason']}")
     if opperf.get("regressions"):
         failures.append(
             f"opperf {opperf['last']}: {len(opperf['regressions'])} "

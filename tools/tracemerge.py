@@ -1,0 +1,582 @@
+#!/usr/bin/env python
+"""Cross-process runlog merger (``tools/tracemerge.py``).
+
+Round 20: every process in the system (FleetRouter, its replica
+subprocesses, the online trainer, healing relaunches, bench itself)
+writes an isolated runlog, and round 20's tracing module stamps their
+records with W3C-style ``trace_id``/``span_id``/``parent_span_id``
+plus cross-boundary links (HTTP ``traceparent`` hop, the
+``MXNET_TRACE_CONTEXT`` env stamp, the artifact ``trace_anchor``).
+This tool is the read side: it folds N per-process runlogs into ONE
+causally-linked timeline.
+
+* ``merge`` — emit a single Perfetto/Chrome-trace JSON: one track
+  group per process (named from the round-20 ``run_start``
+  role/rank/pid identity), one sub-track per in-flight request, and
+  flow arrows on every cross-process parent link (router hop ->
+  replica request, trainer export -> rolling swap).
+* ``doctor`` — per-request bottleneck attribution: decompose each
+  routed request into queue / coalesce / compute / other against its
+  end-to-end span, report fleet-wide percentages, flag requests that
+  overlapped a ``rolling_swap``, and NAME the process (replica) whose
+  compute dominates — the "which replica is slow" answer.
+* ``prom-aggregate`` (also spelled ``--prom-aggregate``) — fold
+  per-replica Prometheus textfiles into one scrape file: counters
+  summed, gauges max-ed, TYPE lines preserved.
+
+Clock skew: wall clocks across processes are NOT trusted.  For every
+process pair linked by a request-response span pair (a ``client`` span
+whose id is the ``parent_span_id`` of a ``server`` span in another
+process) the offset is estimated NTP-style — midpoint of the feasible
+interval, ``((t2-t1)+(t3-t4))/2`` — and the per-pair MEDIAN is
+propagated from the reference process (the router when present)
+through the pair graph.  A process with no pair path falls back to
+healing beat files (``--beats DIR``: the ``rank-N.hb`` payload wall
+time vs file mtime puts every beater on the shared filesystem clock)
+and, failing that, to its ``run_start`` wall clock as-is.
+
+Wall-time reconstruction: a span record stores run-relative END time
+``t`` (perf_counter based) plus ``dur_ms``; its wall interval is
+``run_start.time + t - dur_ms/1e3 .. run_start.time + t``.
+
+Stdlib only — this tool must run anywhere the runlogs land.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+__all__ = [
+    "load_runlog", "load_runlogs", "estimate_offsets", "merge_trace",
+    "doctor", "aggregate_textfiles", "main",
+]
+
+#: span kinds forming a cross-process request-response pair
+_CLIENT = "client"
+_SERVER = "server"
+
+
+# ----------------------------------------------------------------- load
+def load_runlog(path):
+    """Parse one runlog into a process dict::
+
+        {path, label, pid, role, rank, start (run_start wall time),
+         spans: [span dicts + t_start/t_end wall times],
+         marks: [trace-stamped non-span records]}
+
+    Malformed lines are skipped (a crashed process may leave a torn
+    tail); a missing ``run_start`` makes the log unusable and returns
+    None.
+    """
+    proc = {"path": os.fspath(path), "pid": None, "role": None,
+            "rank": None, "start": None, "spans": [], "marks": []}
+    try:
+        f = open(path, "r", errors="replace")
+    except OSError:
+        return None
+    with f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict):
+                continue
+            t = rec.get("type")
+            if t == "run_start":
+                proc["start"] = float(rec.get("time", 0.0))
+                proc["pid"] = rec.get("pid")
+                proc["role"] = rec.get("role")
+                proc["rank"] = rec.get("rank")
+            elif t == "span":
+                if proc["start"] is None:
+                    continue
+                try:
+                    end = proc["start"] + float(rec["t"])
+                    dur = float(rec["dur_ms"]) / 1e3
+                except (KeyError, TypeError, ValueError):
+                    continue
+                s = dict(rec)
+                s["t_end"] = end
+                s["t_start"] = end - dur
+                proc["spans"].append(s)
+            elif "trace_id" in rec and proc["start"] is not None \
+                    and isinstance(rec.get("t"), (int, float)):
+                proc["marks"].append(dict(rec))
+    if proc["start"] is None:
+        return None
+    base = os.path.basename(proc["path"])
+    stem = base[:-6] if base.endswith(".jsonl") else base
+    if proc["role"]:
+        label = proc["role"]
+        if proc["rank"] is not None:
+            label += f"-{proc['rank']}"
+    else:
+        label = stem
+    proc["label"] = f"{label} (pid {proc['pid']})"
+    return proc
+
+
+def load_runlogs(paths):
+    """Expand dirs to ``*.jsonl``, load each, drop unusable logs."""
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(os.path.join(p, "*.jsonl"))))
+        else:
+            files.append(p)
+    procs = []
+    for p in files:
+        proc = load_runlog(p)
+        if proc is not None and (proc["spans"] or proc["marks"]):
+            procs.append(proc)
+        elif proc is not None:
+            procs.append(proc)  # identity-only logs still get a track
+    return procs
+
+
+# ----------------------------------------------------------------- skew
+def _median(xs):
+    xs = sorted(xs)
+    n = len(xs)
+    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+
+
+def _beat_offsets(beats_dir):
+    """pid -> (payload wall time - file mtime): how far that process's
+    wall clock ran ahead of the shared filesystem clock when it last
+    beat.  Subtracting pairs of these aligns any two beaters."""
+    out = {}
+    if not beats_dir:
+        return out
+    for path in sorted(glob.glob(os.path.join(
+            os.fspath(beats_dir), "*.hb"))):
+        try:
+            mtime = os.stat(path).st_mtime
+            with open(path) as f:
+                payload = json.load(f)
+            out[int(payload["pid"])] = float(payload["time"]) - mtime
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+    return out
+
+
+def estimate_offsets(procs, beats_dir=None):
+    """Per-process clock offsets (seconds to SUBTRACT from that
+    process's wall times to land in the reference clock frame).
+
+    Returns ``(offsets, info)`` where ``offsets[i]`` indexes ``procs``
+    and ``info`` reports the reference index, per-edge pair counts and
+    which processes fell back (``beats`` / ``wall``).
+    """
+    n = len(procs)
+    by_span = []  # per process: span_id -> span
+    for p in procs:
+        by_span.append({s.get("span_id"): s for s in p["spans"]
+                        if s.get("span_id")})
+    # pairwise NTP samples: edge (a, b) -> [offset of b relative to a]
+    samples = {}
+    for b, pb in enumerate(procs):
+        for s in pb["spans"]:
+            parent = s.get("parent_span_id")
+            if not parent or s.get("kind") != _SERVER:
+                continue
+            for a in range(n):
+                if a == b:
+                    continue
+                ps = by_span[a].get(parent)
+                if ps is None or ps.get("kind") != _CLIENT:
+                    continue
+                # t1..t4: client send, server recv, server send,
+                # client recv — midpoint of the feasible interval
+                t1, t4 = ps["t_start"], ps["t_end"]
+                t2, t3 = s["t_start"], s["t_end"]
+                theta = ((t2 - t1) + (t3 - t4)) / 2.0
+                samples.setdefault((a, b), []).append(theta)
+    edges = {e: _median(v) for e, v in samples.items()}
+    # reference: the router when present, else the process with the
+    # most client spans (it anchors the most edges), else the first
+    ref = 0
+    for i, p in enumerate(procs):
+        if p["role"] == "router":
+            ref = i
+            break
+    else:
+        best = -1
+        for i, p in enumerate(procs):
+            k = sum(1 for s in p["spans"] if s.get("kind") == _CLIENT)
+            if k > best:
+                best, ref = k, i
+    offsets = {ref: 0.0}
+    frontier = [ref]
+    while frontier:
+        nxt = []
+        for a in frontier:
+            for (x, y), th in edges.items():
+                if x == a and y not in offsets:
+                    offsets[y] = offsets[a] + th
+                    nxt.append(y)
+                elif y == a and x not in offsets:
+                    offsets[x] = offsets[a] - th
+                    nxt.append(x)
+        frontier = nxt
+    fallback = {}
+    missing = [i for i in range(n) if i not in offsets]
+    if missing:
+        beats = _beat_offsets(beats_dir)
+        ref_beat = beats.get(procs[ref]["pid"], 0.0)
+        for i in missing:
+            b = beats.get(procs[i]["pid"])
+            if b is not None:
+                # both sides measured against the filesystem clock
+                offsets[i] = b - ref_beat
+                fallback[i] = "beats"
+            else:
+                offsets[i] = 0.0   # trust run_start wall clock
+                fallback[i] = "wall"
+    info = {"reference": ref,
+            "pairs": {f"{a}->{b}": len(v)
+                      for (a, b), v in samples.items()},
+            "fallback": {procs[i]["label"]: how
+                         for i, how in fallback.items()}}
+    return offsets, info
+
+
+# ---------------------------------------------------------------- merge
+def merge_trace(procs, beats_dir=None, trace_id=None):
+    """Fold loaded runlogs into one Chrome-trace/Perfetto JSON dict.
+
+    One track group (pid) per process, one sub-track (tid) per
+    trace_id within a process, ``X`` duration events per span, ``i``
+    instants for trace-stamped non-span records, and ``s``/``f`` flow
+    arrows on every cross-process parent link.
+    """
+    offsets, info = estimate_offsets(procs, beats_dir)
+    # corrected wall times; epoch = earliest corrected instant
+    t0 = None
+    for i, p in enumerate(procs):
+        off = offsets[i]
+        for s in p["spans"]:
+            ts = s["t_start"] - off
+            t0 = ts if t0 is None or ts < t0 else t0
+        for m in p["marks"]:
+            ts = p["start"] + float(m["t"]) - off
+            t0 = ts if t0 is None or ts < t0 else t0
+    if t0 is None:
+        t0 = 0.0
+    events = []
+    span_proc = {}   # span_id -> (pid, tid, corrected start us)
+    child_links = []  # (parent_span_id, pid, tid, ts_us, span_id)
+    for i, p in enumerate(procs):
+        off = offsets[i]
+        pid = p["pid"] if isinstance(p["pid"], int) else i + 1
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "args": {"name": p["label"]}})
+        tids = {}
+
+        def tid_for(tr):
+            if tr not in tids:
+                tids[tr] = len(tids) + 1
+            return tids[tr]
+
+        for s in p["spans"]:
+            if trace_id is not None and s.get("trace_id") != trace_id:
+                continue
+            tid = tid_for(s.get("trace_id"))
+            ts = (s["t_start"] - off - t0) * 1e6
+            dur = max(0.0, float(s.get("dur_ms", 0.0)) * 1e3)
+            args = {k: s[k] for k in ("trace_id", "span_id",
+                                      "parent_span_id") if s.get(k)}
+            args.update(s.get("attrs") or {})
+            events.append({"ph": "X", "name": s.get("name", "span"),
+                           "cat": s.get("kind", "internal"),
+                           "pid": pid, "tid": tid,
+                           "ts": round(ts, 3), "dur": round(dur, 3),
+                           "args": args})
+            sid = s.get("span_id")
+            if sid:
+                span_proc[sid] = (pid, tid, ts)
+            par = s.get("parent_span_id")
+            if par:
+                child_links.append((par, pid, tid, ts, sid))
+        for m in p["marks"]:
+            if trace_id is not None and m.get("trace_id") != trace_id:
+                continue
+            tid = tid_for(m.get("trace_id"))
+            ts = (p["start"] + float(m["t"]) - off - t0) * 1e6
+            events.append({"ph": "i", "s": "t",
+                           "name": m.get("type", "mark"),
+                           "cat": "record", "pid": pid, "tid": tid,
+                           "ts": round(ts, 3),
+                           "args": {"span_id": m.get("span_id")}})
+    # flow arrows: only where the parent lives in ANOTHER track group
+    # (same-process nesting is already visible on the track)
+    flow_id = 0
+    for par, pid, tid, ts, sid in child_links:
+        src = span_proc.get(par)
+        if src is None or src[0] == pid:
+            continue
+        flow_id += 1
+        spid, stid, sts = src
+        events.append({"ph": "s", "id": flow_id, "name": "link",
+                       "cat": "trace", "pid": spid, "tid": stid,
+                       "ts": round(sts, 3)})
+        events.append({"ph": "f", "bp": "e", "id": flow_id,
+                       "name": "link", "cat": "trace", "pid": pid,
+                       "tid": tid, "ts": round(ts, 3)})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {
+                "tool": "tracemerge",
+                "processes": [p["label"] for p in procs],
+                "reference": procs[info["reference"]]["label"],
+                "skew_s": {procs[i]["label"]: round(offsets[i], 6)
+                           for i in range(len(procs))},
+                "pairs": info["pairs"],
+                "fallback": info["fallback"],
+                "epoch": t0,
+            }}
+
+
+# --------------------------------------------------------------- doctor
+#: per-request phase spans -> doctor component
+_PHASES = {"serve_queue": "queue", "serve_coalesce": "coalesce",
+           "serve_model": "compute", "gen_admit": "queue",
+           "gen_prefill": "compute", "gen_decode": "compute"}
+_ROOTS = ("fleet_request", "gen_request")
+
+
+def doctor(procs, beats_dir=None):
+    """Bottleneck attribution across routed requests.
+
+    Returns a dict: per-component totals/percentages, the dominant
+    component, requests overlapping a ``rolling_swap`` (the
+    swap-in-progress bucket), and the per-process compute ranking that
+    names the slow replica.
+    """
+    offsets, info = estimate_offsets(procs, beats_dir)
+    spans = []
+    for i, p in enumerate(procs):
+        off = offsets[i]
+        for s in p["spans"]:
+            c = dict(s)
+            c["t_start"] -= off
+            c["t_end"] -= off
+            c["proc"] = i
+            spans.append(c)
+    roots = [s for s in spans if s.get("name") in _ROOTS]
+    swaps = [s for s in spans if s.get("name") == "rolling_swap"]
+    by_trace = {}
+    for s in spans:
+        by_trace.setdefault(s.get("trace_id"), []).append(s)
+    comp = {"queue": 0.0, "coalesce": 0.0, "compute": 0.0,
+            "other": 0.0}
+    per_proc_compute = {}
+    e2e_total = 0.0
+    swap_overlapped = 0
+    requests = []
+    for root in roots:
+        tr = by_trace.get(root.get("trace_id"), [])
+        e2e = max(0.0, root["t_end"] - root["t_start"])
+        parts = {"queue": 0.0, "coalesce": 0.0, "compute": 0.0}
+        for s in tr:
+            phase = _PHASES.get(s.get("name"))
+            if phase is None or s is root:
+                continue
+            d = max(0.0, s["t_end"] - s["t_start"])
+            parts[phase] += d
+            if phase == "compute":
+                per = per_proc_compute.setdefault(
+                    s["proc"], {"total": 0.0, "n": 0})
+                per["total"] += d
+                per["n"] += 1
+        other = max(0.0, e2e - sum(parts.values()))
+        overlaps = any(sw["t_start"] < root["t_end"]
+                       and sw["t_end"] > root["t_start"]
+                       for sw in swaps)
+        if overlaps:
+            swap_overlapped += 1
+        for k, v in parts.items():
+            comp[k] += v
+        comp["other"] += other
+        e2e_total += e2e
+        requests.append({"trace_id": root.get("trace_id"),
+                         "name": root.get("name"), "e2e_ms": e2e * 1e3,
+                         "parts_ms": {k: v * 1e3
+                                      for k, v in parts.items()},
+                         "other_ms": other * 1e3,
+                         "swap_in_progress": overlaps})
+    pct = {k: (100.0 * v / e2e_total if e2e_total > 0 else 0.0)
+           for k, v in comp.items()}
+    dominant = max(pct, key=pct.get) if requests else None
+    if swap_overlapped and requests \
+            and swap_overlapped >= len(requests) / 2:
+        dominant = "swap-in-progress"
+    ranking = sorted(
+        ({"process": procs[i]["label"],
+          "mean_compute_ms": v["total"] / v["n"] * 1e3,
+          "spans": v["n"]}
+         for i, v in per_proc_compute.items() if v["n"]),
+        key=lambda r: -r["mean_compute_ms"])
+    return {"requests": len(requests), "processes": len(procs),
+            "e2e_total_ms": e2e_total * 1e3,
+            "components_pct": {k: round(v, 2) for k, v in pct.items()},
+            "dominant": dominant,
+            "swap_in_progress_requests": swap_overlapped,
+            "compute_ranking": ranking,
+            "bottleneck_process": (ranking[0]["process"]
+                                   if ranking else None),
+            "skew_s": {procs[i]["label"]: round(offsets[i], 6)
+                       for i in range(len(procs))},
+            "per_request": requests}
+
+
+def _render_doctor(rep):
+    lines = [f"tracemerge doctor: {rep['requests']} request(s) "
+             f"across {rep['processes']} process(es)"]
+    for k in ("queue", "coalesce", "compute", "other"):
+        lines.append(f"  {k:<9} {rep['components_pct'][k]:6.1f}%")
+    lines.append(f"  swap-in-progress: "
+                 f"{rep['swap_in_progress_requests']} request(s) "
+                 f"overlapped a rolling_swap")
+    if rep["dominant"] is not None:
+        lines.append(f"  dominant: {rep['dominant']}")
+    for r in rep["compute_ranking"]:
+        lines.append(f"    {r['process']}: mean serve_model "
+                     f"{r['mean_compute_ms']:.2f} ms "
+                     f"({r['spans']} span(s))")
+    if rep["bottleneck_process"] is not None:
+        lines.append(f"  bottleneck process: "
+                     f"{rep['bottleneck_process']}")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------- prom agg
+def aggregate_textfiles(paths):
+    """Fold Prometheus textfiles into one scrape body: counters
+    SUMMED, gauges MAX-ed (a fleet-wide ready gauge is "any replica
+    ready" = max; a fleet-wide request count is the sum).  Metric
+    identity includes labels; TYPE lines are emitted once per family
+    in first-seen order."""
+    kinds = {}    # family -> counter|gauge
+    values = {}   # full metric name (incl labels) -> folded value
+    order = []    # first-seen metric order
+    for path in paths:
+        try:
+            with open(path) as f:
+                body = f.read()
+        except OSError:
+            continue
+        for line in body.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith("# TYPE "):
+                try:
+                    _, _, family, kind = line.split(None, 3)
+                except ValueError:
+                    continue
+                kinds.setdefault(family, kind)
+                continue
+            if line.startswith("#"):
+                continue
+            try:
+                name, raw = line.rsplit(None, 1)
+                val = float(raw)
+            except ValueError:
+                continue
+            family = name.split("{", 1)[0]
+            kind = kinds.get(family, "gauge")
+            if name not in values:
+                values[name] = val
+                order.append(name)
+            elif kind == "counter":
+                values[name] += val
+            else:
+                values[name] = max(values[name], val)
+    lines = []
+    typed = set()
+    for name in order:
+        family = name.split("{", 1)[0]
+        if family not in typed:
+            typed.add(family)
+            lines.append(f"# TYPE {family} {kinds.get(family, 'gauge')}")
+        v = values[name]
+        out = int(v) if float(v).is_integer() else v
+        lines.append(f"{name} {out}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+# ------------------------------------------------------------------ CLI
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # "--prom-aggregate f1 f2" is sugar for the prom-aggregate command
+    if argv and argv[0] == "--prom-aggregate":
+        argv[0] = "prom-aggregate"
+    ap = argparse.ArgumentParser(
+        prog="tools/tracemerge.py",
+        description="merge per-process runlogs into one causal "
+        "timeline (Perfetto), diagnose per-request bottlenecks, "
+        "aggregate Prometheus textfiles")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    pm = sub.add_parser("merge", help="emit one merged Perfetto trace")
+    pm.add_argument("logs", nargs="+",
+                    help="runlog .jsonl files and/or runlog_dir dirs")
+    pm.add_argument("-o", "--out", default="-",
+                    help="output path (default stdout)")
+    pm.add_argument("--trace", default=None,
+                    help="restrict to one trace_id")
+    pm.add_argument("--beats", default=None,
+                    help="healing heartbeat dir (skew fallback)")
+    pd = sub.add_parser("doctor", help="per-request bottleneck "
+                        "attribution")
+    pd.add_argument("logs", nargs="+")
+    pd.add_argument("--beats", default=None)
+    pd.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON")
+    pp = sub.add_parser("prom-aggregate", help="fold per-replica "
+                        "textfiles into one scrape file")
+    pp.add_argument("files", nargs="+")
+    pp.add_argument("-o", "--out", default="-")
+    args = ap.parse_args(argv)
+    if args.cmd == "prom-aggregate":
+        body = aggregate_textfiles(args.files)
+        if args.out == "-":
+            sys.stdout.write(body)
+        else:
+            with open(args.out, "w") as f:
+                f.write(body)
+        return 0
+    procs = load_runlogs(args.logs)
+    if not procs:
+        print("tracemerge: no usable runlogs", file=sys.stderr)
+        return 2
+    if args.cmd == "merge":
+        trace = merge_trace(procs, beats_dir=args.beats,
+                            trace_id=args.trace)
+        body = json.dumps(trace, sort_keys=True)
+        if args.out == "-":
+            sys.stdout.write(body + "\n")
+        else:
+            with open(args.out, "w") as f:
+                f.write(body)
+            print(f"tracemerge: wrote {args.out} "
+                  f"({len(trace['traceEvents'])} events, "
+                  f"{len(procs)} process(es))")
+        return 0
+    rep = doctor(procs, beats_dir=args.beats)
+    if args.json:
+        full = dict(rep)
+        print(json.dumps(full, sort_keys=True))
+    else:
+        print(_render_doctor(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
